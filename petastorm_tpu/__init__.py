@@ -1,0 +1,50 @@
+"""petastorm_tpu — a TPU-native, JAX-first data-input framework.
+
+A brand-new framework with the capabilities of petastorm (reference:
+gregw18/petastorm, see SURVEY.md): a tensor-aware schema ("Unischema") with
+column codecs, Parquet dataset materialization + metadata tooling, and a
+parallel, shuffling, shardable, predicate-filtering reader over Parquet row
+groups — designed TPU-first:
+
+- row groups shard across pod hosts by ``jax.process_index()``;
+- ``make_jax_dataloader`` collates batches and stages them into TPU HBM via
+  double-buffered async ``jax.device_put`` (or emits globally-sharded arrays
+  for ``pjit`` via ``jax.make_array_from_process_local_data``);
+- the ETL layer is built on ``pyarrow.dataset`` (Spark optional), so a TPU
+  slice streams straight from GCS/HDFS with no GPU host in the loop.
+
+Public import surface mirrors the reference's (``petastorm/__init__.py``):
+``make_reader`` / ``make_batch_reader`` plus the schema/codec data model.
+Exports are lazy so importing the package stays light (no TF/Torch/JAX pull).
+"""
+
+__version__ = "0.1.0"
+
+_LAZY_EXPORTS = {
+    "make_reader": ("petastorm_tpu.reader.reader", "make_reader"),
+    "make_batch_reader": ("petastorm_tpu.reader.reader", "make_batch_reader"),
+    "Reader": ("petastorm_tpu.reader.reader", "Reader"),
+    "NoDataAvailableError": ("petastorm_tpu.reader.errors", "NoDataAvailableError"),
+    "Unischema": ("petastorm_tpu.schema.unischema", "Unischema"),
+    "UnischemaField": ("petastorm_tpu.schema.unischema", "UnischemaField"),
+    "TransformSpec": ("petastorm_tpu.schema.transform", "TransformSpec"),
+    "make_jax_dataloader": ("petastorm_tpu.jax.loader", "make_jax_dataloader"),
+}
+
+__all__ = list(_LAZY_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(__all__)

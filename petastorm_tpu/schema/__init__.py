@@ -1,0 +1,20 @@
+"""Tensor-aware schema + column codecs (the reference's L1 data model).
+
+Reference parity: ``petastorm/unischema.py``, ``petastorm/codecs.py``,
+``petastorm/transform.py`` (see SURVEY.md §2.1).
+"""
+
+from petastorm_tpu.schema.codecs import (  # noqa: F401
+    CompressedImageCodec,
+    CompressedNdarrayCodec,
+    DataframeColumnCodec,
+    NdarrayCodec,
+    ScalarCodec,
+)
+from petastorm_tpu.schema.transform import TransformSpec, transform_schema  # noqa: F401
+from petastorm_tpu.schema.unischema import (  # noqa: F401
+    Unischema,
+    UnischemaField,
+    insert_explicit_nulls,
+    match_unischema_fields,
+)

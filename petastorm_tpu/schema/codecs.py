@@ -1,0 +1,295 @@
+"""Column codecs: tensor <-> Parquet-cell encodings.
+
+Reference parity: ``petastorm/codecs.py`` (``DataframeColumnCodec``,
+``ScalarCodec``, ``NdarrayCodec``, ``CompressedNdarrayCodec``,
+``CompressedImageCodec``) — see SURVEY.md §2.1. Byte formats are kept
+compatible with the reference (``np.save`` payloads, cv2-encoded png/jpeg)
+so datasets written by the reference load unchanged.
+
+Design difference from the reference: codecs here report an *arrow* storage
+type (``arrow_dtype``) instead of a Spark SQL type, because the ETL engine is
+``pyarrow.dataset``, not Spark. A ``spark_dtype`` shim is provided for API
+parity when pyspark is importable.
+"""
+
+from __future__ import annotations
+
+import io
+from abc import ABC, abstractmethod
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+try:  # pragma: no cover - exercised only where cv2 is absent
+    import cv2
+
+    _HAVE_CV2 = True
+except ImportError:  # pragma: no cover
+    cv2 = None
+    _HAVE_CV2 = False
+
+
+def numpy_to_arrow_type(numpy_dtype):
+    """Map a field's numpy dtype (or Decimal / str / bytes class) to an arrow type."""
+    if numpy_dtype is Decimal:
+        # We store decimals as strings (lossless, portable); reference datasets
+        # written via Spark DecimalType read back as arrow decimal128 and are
+        # handled on the decode side.
+        return pa.string()
+    if numpy_dtype in (str, np.str_, np.unicode_ if hasattr(np, "unicode_") else np.str_):
+        return pa.string()
+    if numpy_dtype in (bytes, np.bytes_):
+        return pa.binary()
+    dtype = np.dtype(numpy_dtype)
+    if dtype.kind in ("U", "S"):
+        return pa.string() if dtype.kind == "U" else pa.binary()
+    if dtype.kind == "M":  # datetime64
+        unit = np.datetime_data(dtype)[0]
+        if unit == "D":
+            return pa.date32()
+        return pa.timestamp(unit if unit in ("s", "ms", "us", "ns") else "us")
+    return pa.from_numpy_dtype(dtype)
+
+
+class DataframeColumnCodec(ABC):
+    """Abstract codec: how one Unischema field is stored in a Parquet cell."""
+
+    @abstractmethod
+    def encode(self, unischema_field, value):
+        """Encode ``value`` into the storage representation (scalar or bytes)."""
+
+    @abstractmethod
+    def decode(self, unischema_field, value):
+        """Decode a storage cell back into the field's numpy representation."""
+
+    @abstractmethod
+    def arrow_dtype(self):
+        """The ``pyarrow.DataType`` of the stored column."""
+
+    def spark_dtype(self):  # pragma: no cover - only with pyspark installed
+        """API-parity shim (reference codecs report Spark SQL types)."""
+        raise NotImplementedError(
+            "spark_dtype requires pyspark; this build's ETL engine is pyarrow"
+        )
+
+    def __eq__(self, other):
+        return isinstance(other, self.__class__) and self.__dict__ == other.__dict__
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash((self.__class__.__name__, tuple(sorted(self.__dict__.items(), key=str))))
+
+
+class ScalarCodec(DataframeColumnCodec):
+    """Stores a scalar natively in its Parquet column.
+
+    Reference parity: ``petastorm/codecs.py::ScalarCodec(spark_type)``. Here the
+    constructor takes an arrow type, a numpy dtype, or ``str``/``bytes``/
+    ``Decimal`` — whatever identifies the storage type.
+    """
+
+    def __init__(self, arrow_type_or_dtype=None):
+        if arrow_type_or_dtype is None:
+            self._arrow_type = None  # derived from the field at encode time
+        elif isinstance(arrow_type_or_dtype, pa.DataType):
+            self._arrow_type = arrow_type_or_dtype
+        else:
+            self._arrow_type = numpy_to_arrow_type(arrow_type_or_dtype)
+
+    def arrow_dtype(self):
+        return self._arrow_type
+
+    def arrow_dtype_for_field(self, unischema_field):
+        if self._arrow_type is not None:
+            return self._arrow_type
+        return numpy_to_arrow_type(unischema_field.numpy_dtype)
+
+    def encode(self, unischema_field, value):
+        if unischema_field.shape:
+            raise ValueError(
+                f"ScalarCodec can only encode scalars; field {unischema_field.name!r} "
+                f"has shape {unischema_field.shape}"
+            )
+        if value is None:
+            return None
+        dtype = unischema_field.numpy_dtype
+        if dtype is Decimal:
+            return str(value if isinstance(value, Decimal) else Decimal(str(value)))
+        if dtype in (str, np.str_):
+            return str(value)
+        if dtype in (bytes, np.bytes_):
+            return bytes(value)
+        if np.dtype(dtype).kind == "M":
+            return value
+        # np scalar or python scalar -> python native for arrow
+        return np.dtype(dtype).type(value).item()
+
+    def decode(self, unischema_field, value):
+        if value is None:
+            return None
+        dtype = unischema_field.numpy_dtype
+        if dtype is Decimal:
+            if isinstance(value, Decimal):
+                return value
+            if isinstance(value, bytes):
+                value = value.decode("utf-8")
+            return Decimal(value)
+        if dtype in (str, np.str_):
+            return value.decode("utf-8") if isinstance(value, bytes) else str(value)
+        if dtype in (bytes, np.bytes_):
+            return value
+        if np.dtype(dtype).kind == "M":
+            return np.datetime64(value)
+        return np.dtype(dtype).type(value)
+
+
+class NdarrayCodec(DataframeColumnCodec):
+    """Stores an ndarray as ``np.save`` bytes in a binary column.
+
+    Byte-compatible with the reference's ``petastorm/codecs.py::NdarrayCodec``.
+    """
+
+    def arrow_dtype(self):
+        return pa.binary()
+
+    def encode(self, unischema_field, value):
+        expected = np.dtype(unischema_field.numpy_dtype)
+        if value.dtype != expected:
+            raise ValueError(
+                f"Field {unischema_field.name!r}: expected dtype {expected}, got {value.dtype}"
+            )
+        _check_shape_compatible(unischema_field, value)
+        memfile = io.BytesIO()
+        np.save(memfile, value)
+        return memfile.getvalue()
+
+    def decode(self, unischema_field, value):
+        if value is None:
+            return None
+        memfile = io.BytesIO(value)
+        return np.load(memfile, allow_pickle=False)
+
+
+class CompressedNdarrayCodec(DataframeColumnCodec):
+    """Stores an ndarray as ``np.savez_compressed`` bytes (zlib-compressed).
+
+    Byte-compatible with the reference's ``CompressedNdarrayCodec`` (array is
+    stored under the archive key ``arr``).
+    """
+
+    def arrow_dtype(self):
+        return pa.binary()
+
+    def encode(self, unischema_field, value):
+        expected = np.dtype(unischema_field.numpy_dtype)
+        if value.dtype != expected:
+            raise ValueError(
+                f"Field {unischema_field.name!r}: expected dtype {expected}, got {value.dtype}"
+            )
+        _check_shape_compatible(unischema_field, value)
+        memfile = io.BytesIO()
+        np.savez_compressed(memfile, arr=value)
+        return memfile.getvalue()
+
+    def decode(self, unischema_field, value):
+        if value is None:
+            return None
+        memfile = io.BytesIO(value)
+        with np.load(memfile, allow_pickle=False) as archive:
+            keys = archive.files
+            return archive["arr" if "arr" in keys else keys[0]]
+
+
+class CompressedImageCodec(DataframeColumnCodec):
+    """Stores an image ndarray as png/jpeg bytes via cv2 (Pillow fallback).
+
+    Byte-compatible with the reference's ``CompressedImageCodec``: channel
+    order is whatever the user stored (cv2 convention is BGR, but the codec is
+    agnostic); decode uses IMREAD_UNCHANGED so uint16 png and alpha survive.
+    """
+
+    def __init__(self, image_codec="png", quality=80):
+        if image_codec not in ("png", "jpeg", "jpg"):
+            raise ValueError(f"Unsupported image codec: {image_codec!r}")
+        self._image_codec = "jpeg" if image_codec == "jpg" else image_codec
+        self._quality = quality
+
+    @property
+    def image_codec(self):
+        return self._image_codec
+
+    def arrow_dtype(self):
+        return pa.binary()
+
+    def encode(self, unischema_field, value):
+        if not isinstance(value, np.ndarray):
+            raise ValueError(
+                f"Field {unischema_field.name!r}: CompressedImageCodec expects ndarray"
+            )
+        if value.dtype != np.dtype(unischema_field.numpy_dtype):
+            raise ValueError(
+                f"Field {unischema_field.name!r}: expected dtype "
+                f"{np.dtype(unischema_field.numpy_dtype)}, got {value.dtype}"
+            )
+        _check_shape_compatible(unischema_field, value)
+        if _HAVE_CV2:
+            if self._image_codec == "png":
+                ok, contents = cv2.imencode(".png", value)
+            else:
+                ok, contents = cv2.imencode(
+                    ".jpeg", value, [int(cv2.IMWRITE_JPEG_QUALITY), self._quality]
+                )
+            if not ok:
+                raise ValueError(f"cv2.imencode failed for field {unischema_field.name!r}")
+            return contents.tobytes()
+        return self._pil_encode(value)
+
+    def decode(self, unischema_field, value):
+        if value is None:
+            return None
+        if _HAVE_CV2:
+            return cv2.imdecode(
+                np.frombuffer(value, dtype=np.uint8), cv2.IMREAD_UNCHANGED
+            )
+        return self._pil_decode(value)
+
+    def _pil_encode(self, value):  # pragma: no cover - cv2 present in this env
+        from PIL import Image
+
+        memfile = io.BytesIO()
+        img = value
+        if img.ndim == 3 and img.shape[2] == 3:
+            img = img[:, :, ::-1]  # PIL is RGB; preserve stored-BGR convention
+        Image.fromarray(img).save(
+            memfile, format="PNG" if self._image_codec == "png" else "JPEG",
+            quality=self._quality,
+        )
+        return memfile.getvalue()
+
+    def _pil_decode(self, value):  # pragma: no cover - cv2 present in this env
+        from PIL import Image
+
+        arr = np.asarray(Image.open(io.BytesIO(value)))
+        if arr.ndim == 3 and arr.shape[2] == 3:
+            arr = arr[:, :, ::-1]
+        return arr
+
+
+def _check_shape_compatible(unischema_field, value):
+    shape = unischema_field.shape
+    if shape is None:
+        return
+    if len(shape) != value.ndim:
+        raise ValueError(
+            f"Field {unischema_field.name!r}: expected rank {len(shape)}, "
+            f"got rank {value.ndim}"
+        )
+    for expected_dim, actual_dim in zip(shape, value.shape):
+        if expected_dim is not None and expected_dim != actual_dim:
+            raise ValueError(
+                f"Field {unischema_field.name!r}: expected shape {shape}, "
+                f"got {value.shape}"
+            )

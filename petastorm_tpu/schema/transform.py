@@ -1,0 +1,63 @@
+"""TransformSpec: user transforms applied inside workers (in parallel).
+
+Reference parity: ``petastorm/transform.py`` (``TransformSpec``,
+``transform_schema``) — see SURVEY.md §2.1. The ``func`` operates on a row
+dict (``make_reader`` path) or a pandas DataFrame (``make_batch_reader``
+path); ``edit_fields``/``removed_fields`` describe the schema delta so
+downstream adapters see post-transform dtypes/shapes.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+
+class TransformSpec:
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+        self.func = func
+        self.edit_fields = list(edit_fields or [])
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+        if self.selected_fields is not None and self.removed_fields:
+            raise ValueError("Specify only one of selected_fields and removed_fields")
+
+    def __eq__(self, other):
+        return isinstance(other, TransformSpec) and self.__dict__ == other.__dict__
+
+
+def _as_unischema_field(field_spec):
+    if isinstance(field_spec, UnischemaField):
+        return field_spec
+    # reference accepts ('name', np_dtype, shape, nullable) tuples in edit_fields
+    name, numpy_dtype, shape, nullable = field_spec
+    return UnischemaField(name, numpy_dtype, shape, None, nullable)
+
+
+def transform_schema(schema, transform_spec):
+    """Apply a TransformSpec's schema delta to a Unischema.
+
+    Reference parity: ``petastorm/transform.py::transform_schema``.
+    """
+    removed = set(transform_spec.removed_fields)
+    edited = {f.name: f for f in (_as_unischema_field(e) for e in transform_spec.edit_fields)}
+
+    fields = []
+    for field in schema.fields.values():
+        if field.name in removed:
+            continue
+        if field.name in edited:
+            fields.append(edited.pop(field.name))
+        else:
+            fields.append(field)
+    # brand-new fields appended in edit order
+    fields.extend(edited.values())
+
+    if transform_spec.selected_fields is not None:
+        selected = set(transform_spec.selected_fields)
+        unknown = selected - {f.name for f in fields}
+        if unknown:
+            raise ValueError(f"selected_fields not in post-transform schema: {sorted(unknown)}")
+        fields = [f for f in fields if f.name in selected]
+
+    return Unischema(f"transformed_{schema._name}", fields)

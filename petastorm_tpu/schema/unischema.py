@@ -1,0 +1,338 @@
+"""Unischema: a tensor-aware schema over Parquet columns.
+
+Reference parity: ``petastorm/unischema.py`` (``Unischema``, ``UnischemaField``,
+``dict_to_spark_row``, ``insert_explicit_nulls``, ``Unischema.from_arrow_schema``,
+``create_schema_view``, ``match_unischema_fields``) — see SURVEY.md §2.1.
+
+Differences from the reference (TPU-first design):
+- the canonical serialized form is JSON (safe), not a pickle — see
+  ``petastorm_tpu/etl/metadata.py``; reference pickled schemas are *read*
+  via a compat unpickler so existing corpora load unchanged;
+- conversion targets arrow schemas (the pyarrow ETL engine), with Spark
+  StructType conversion provided only as an optional shim.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import OrderedDict, namedtuple
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.schema.codecs import (
+    ScalarCodec,
+    numpy_to_arrow_type,
+)
+
+
+class UnischemaField(
+    namedtuple("UnischemaField", ["name", "numpy_dtype", "shape", "codec", "nullable"])
+):
+    """A single field: name, numpy dtype, tensor shape, storage codec, nullability.
+
+    ``shape`` is a tuple; ``None`` entries mean "any size in this dimension".
+    ``codec=None`` means the field is stored natively (plain Parquet column).
+    """
+
+    __slots__ = ()
+
+    def __new__(cls, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        if shape is None:
+            shape = ()
+        return super().__new__(cls, name, numpy_dtype, tuple(shape), codec, nullable)
+
+    def __hash__(self):
+        return hash((self.name, _dtype_token(self.numpy_dtype), self.shape, self.nullable))
+
+    def __eq__(self, other):
+        if not isinstance(other, UnischemaField):
+            return NotImplemented
+        return (
+            self.name == other.name
+            and _dtype_token(self.numpy_dtype) == _dtype_token(other.numpy_dtype)
+            and self.shape == other.shape
+            and self.codec == other.codec
+            and self.nullable == other.nullable
+        )
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        return result if result is NotImplemented else not result
+
+
+def _dtype_token(numpy_dtype):
+    """A hashable, comparable token for a field dtype (np dtype, Decimal, str, bytes)."""
+    if numpy_dtype is Decimal:
+        return "decimal"
+    if numpy_dtype in (str, np.str_):
+        return "str"
+    if numpy_dtype in (bytes, np.bytes_):
+        return "bytes"
+    return np.dtype(numpy_dtype).str
+
+
+class Unischema:
+    """An ordered collection of :class:`UnischemaField`.
+
+    Exposes each field as an attribute (``schema.field_name``), generates the
+    namedtuple row type used by the reader, and converts to/from arrow schemas.
+    """
+
+    def __init__(self, name, fields):
+        self._name = name
+        self._fields = OrderedDict((f.name, f) for f in fields)
+        for field in self._fields.values():
+            if hasattr(self, field.name):
+                raise ValueError(
+                    f"Field name {field.name!r} conflicts with a Unischema attribute"
+                )
+            setattr(self, field.name, field)
+        self._namedtuple = None
+
+    @property
+    def fields(self):
+        return self._fields
+
+    def __getstate__(self):
+        # The memoized namedtuple class is dynamically generated and not
+        # picklable; workers regenerate it lazily after unpickling.
+        state = self.__dict__.copy()
+        state["_namedtuple"] = None
+        return state
+
+    def _get_namedtuple(self):
+        if self._namedtuple is None:
+            self._namedtuple = namedtuple(
+                _sanitize_identifier(self._name), list(self._fields.keys())
+            )
+        return self._namedtuple
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row namedtuple from per-field kwargs (missing nullable -> None)."""
+        typed = {}
+        for name in self._fields:
+            typed[name] = kwargs.get(name, None)
+        return self._get_namedtuple()(**typed)
+
+    def make_namedtuple_tf(self, *args, **kwargs):
+        return self._get_namedtuple()(*args, **kwargs)
+
+    def create_schema_view(self, fields):
+        """A sub-schema. ``fields`` is a list of UnischemaField instances and/or
+        field-name regex strings (full match, reference semantics)."""
+        if not isinstance(fields, (list, tuple)):
+            raise ValueError("fields must be a list of UnischemaField or regex strings")
+        view_fields = []
+        seen = set()
+        for item in fields:
+            if isinstance(item, UnischemaField):
+                if item.name not in self._fields:
+                    raise ValueError(
+                        f"Field {item.name!r} does not belong to schema {self._name!r}"
+                    )
+                matches = [self._fields[item.name]]
+            elif isinstance(item, str):
+                matches = match_unischema_fields(self, [item])
+                if not matches:
+                    raise ValueError(
+                        f"Field regex {item!r} matched no fields of schema {self._name!r}"
+                    )
+            else:
+                raise ValueError(f"Invalid field spec: {item!r}")
+            for match in matches:
+                if match.name not in seen:
+                    seen.add(match.name)
+                    view_fields.append(match)
+        # preserve schema order
+        ordered = [f for f in self._fields.values() if f.name in seen]
+        return Unischema(f"{self._name}_view", ordered)
+
+    def as_arrow_schema(self):
+        """The *storage* arrow schema (codec-encoded columns are binary)."""
+        arrow_fields = []
+        for field in self._fields.values():
+            arrow_fields.append(
+                pa.field(field.name, _storage_arrow_type(field), nullable=field.nullable)
+            )
+        return pa.schema(arrow_fields)
+
+    def as_spark_schema(self):  # pragma: no cover - pyspark absent in this build
+        """API-parity shim: Spark StructType (requires pyspark)."""
+        from petastorm_tpu.compat.spark_shim import unischema_as_spark_schema
+
+        return unischema_as_spark_schema(self)
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema_or_dataset, omit_unsupported_fields=False):
+        """Infer a (codec-less) Unischema from an arrow schema — the
+        ``make_batch_reader`` path for plain Parquet stores."""
+        arrow_schema = arrow_schema_or_dataset
+        if not isinstance(arrow_schema, pa.Schema):
+            arrow_schema = arrow_schema_or_dataset.schema
+            if not isinstance(arrow_schema, pa.Schema):  # pyarrow.dataset.Dataset
+                arrow_schema = arrow_schema_or_dataset.schema.to_arrow_schema()
+        fields = []
+        for arrow_field in arrow_schema:
+            try:
+                numpy_dtype, shape = _arrow_to_numpy_dtype(arrow_field.type)
+            except ValueError:
+                if omit_unsupported_fields:
+                    continue
+                raise
+            fields.append(
+                UnischemaField(
+                    arrow_field.name, numpy_dtype, shape, None, arrow_field.nullable
+                )
+            )
+        return cls("inferred_schema", fields)
+
+    def resolve_schema_view(self, schema_fields):
+        """``schema_fields=None`` -> self; else a view (names/regexes/fields)."""
+        if schema_fields is None:
+            return self
+        return self.create_schema_view(list(schema_fields))
+
+    def __eq__(self, other):
+        if not isinstance(other, Unischema):
+            return NotImplemented
+        return list(self._fields.values()) == list(other._fields.values())
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        return result if result is NotImplemented else not result
+
+    def __hash__(self):
+        return hash(tuple(self._fields.values()))
+
+    def __repr__(self):
+        lines = [f"{self._name}:"]
+        for field in self._fields.values():
+            lines.append(
+                f"  {field.name}: {_dtype_token(field.numpy_dtype)} {field.shape} "
+                f"codec={type(field.codec).__name__ if field.codec else None} "
+                f"nullable={field.nullable}"
+            )
+        return "\n".join(lines)
+
+
+def _sanitize_identifier(name):
+    sanitized = re.sub(r"[^A-Za-z0-9_]", "_", name)
+    if not sanitized or sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return sanitized
+
+
+def _storage_arrow_type(field):
+    codec = field.codec
+    if codec is None:
+        if field.shape:
+            # codec-less tensor field: stored as a (nested) arrow list column
+            inner = numpy_to_arrow_type(field.numpy_dtype)
+            for _ in field.shape:
+                inner = pa.list_(inner)
+            return inner
+        return numpy_to_arrow_type(field.numpy_dtype)
+    if isinstance(codec, ScalarCodec):
+        return codec.arrow_dtype_for_field(field)
+    return codec.arrow_dtype()
+
+
+def _arrow_to_numpy_dtype(arrow_type, depth=0):
+    """arrow type -> (numpy dtype or str/bytes/Decimal class, shape tuple)."""
+    if pa.types.is_list(arrow_type) or pa.types.is_large_list(arrow_type):
+        inner_dtype, inner_shape = _arrow_to_numpy_dtype(arrow_type.value_type, depth + 1)
+        return inner_dtype, (None,) + inner_shape
+    if pa.types.is_decimal(arrow_type):
+        return Decimal, ()
+    if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
+        return str, ()
+    if pa.types.is_binary(arrow_type) or pa.types.is_large_binary(arrow_type):
+        return bytes, ()
+    if pa.types.is_timestamp(arrow_type):
+        return np.dtype(f"datetime64[{arrow_type.unit}]"), ()
+    if pa.types.is_date32(arrow_type):
+        return np.dtype("datetime64[D]"), ()
+    if pa.types.is_date64(arrow_type):
+        return np.dtype("datetime64[ms]"), ()
+    try:
+        return np.dtype(arrow_type.to_pandas_dtype()), ()
+    except (NotImplementedError, TypeError) as exc:
+        raise ValueError(f"Unsupported arrow type: {arrow_type}") from exc
+
+
+def match_unischema_fields(schema, field_regexes):
+    """Return schema fields whose names fully match any of ``field_regexes``.
+
+    Reference semantics (``petastorm/unischema.py::match_unischema_fields``):
+    patterns are anchored full matches, not prefix matches.
+    """
+    if not field_regexes:
+        return []
+    compiled = [re.compile(pattern) for pattern in field_regexes]
+    matched = []
+    for field in schema.fields.values():
+        if any(c.fullmatch(field.name) for c in compiled):
+            matched.append(field)
+    return matched
+
+
+def insert_explicit_nulls(unischema, row_dict):
+    """Insert ``None`` for missing nullable fields; raise on missing non-nullable.
+
+    Reference parity: ``petastorm/unischema.py::insert_explicit_nulls``.
+    """
+    for field_name, field in unischema.fields.items():
+        if field_name not in row_dict:
+            if field.nullable:
+                row_dict[field_name] = None
+            else:
+                raise ValueError(
+                    f"Field {field_name!r} is not nullable but is missing from the row"
+                )
+    return row_dict
+
+
+def encode_row(unischema, row_dict):
+    """Encode one row dict into storage cells (our arrow-native analogue of the
+    reference's ``dict_to_spark_row``): validates field names, applies codecs,
+    inserts explicit nulls."""
+    if not isinstance(row_dict, dict):
+        raise TypeError(f"row must be a dict, got {type(row_dict)}")
+    unknown = set(row_dict.keys()) - set(unischema.fields.keys())
+    if unknown:
+        raise ValueError(f"Unknown fields in row: {sorted(unknown)}")
+    insert_explicit_nulls(unischema, row_dict)
+    encoded = {}
+    for name, field in unischema.fields.items():
+        value = row_dict[name]
+        if value is None:
+            if not field.nullable:
+                raise ValueError(f"Field {name!r} is not nullable but got None")
+            encoded[name] = None
+        elif field.codec is not None:
+            encoded[name] = field.codec.encode(field, value)
+        else:
+            encoded[name] = _encode_codecless(field, value)
+    return encoded
+
+
+def _encode_codecless(field, value):
+    if field.shape:
+        arr = np.asarray(value, dtype=np.dtype(field.numpy_dtype))
+        return arr.tolist()
+    return ScalarCodec().encode(field, value)
+
+
+def dict_to_spark_row(unischema, row_dict):  # pragma: no cover - pyspark absent
+    """API-parity shim for the reference's Spark write path (requires pyspark)."""
+    from petastorm_tpu.compat.spark_shim import dict_to_spark_row as _impl
+
+    return _impl(unischema, row_dict)
+
+
+# `np.unicode_` was removed in numpy 2; guard referenced in codecs too.
+if not hasattr(np, "unicode_"):  # pragma: no cover
+    pass

@@ -1,0 +1,46 @@
+"""Row decode loop + small shared helpers.
+
+Reference parity: ``petastorm/utils.py`` (``decode_row``, ``DecodeFieldError``;
+``add_to_dataset_metadata`` lives in ``petastorm_tpu/etl/metadata.py`` because
+the metadata engine here is pyarrow-native).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DecodeFieldError(RuntimeError):
+    pass
+
+
+def decode_row(row, schema):
+    """Decode all fields of one storage-row dict into numpy-land values.
+
+    Reference parity: ``petastorm/utils.py::decode_row``. Fields with a codec
+    are decoded by it; codec-less tensor fields (plain-Parquet list columns)
+    are converted to ndarrays; scalars pass through with dtype normalization.
+    """
+    decoded_row = {}
+    for field_name, value in row.items():
+        field = schema.fields.get(field_name)
+        if field is None:
+            continue
+        try:
+            if value is None:
+                decoded_row[field_name] = None
+            elif field.codec is not None:
+                decoded_row[field_name] = field.codec.decode(field, value)
+            elif field.shape:
+                decoded_row[field_name] = np.asarray(
+                    value, dtype=np.dtype(field.numpy_dtype)
+                )
+            else:
+                from petastorm_tpu.schema.codecs import ScalarCodec
+
+                decoded_row[field_name] = ScalarCodec().decode(field, value)
+        except Exception as exc:
+            raise DecodeFieldError(
+                f"Decoding field {field_name!r} failed: {exc}"
+            ) from exc
+    return decoded_row

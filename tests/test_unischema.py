@@ -1,0 +1,175 @@
+"""Unischema tests (reference model: petastorm/tests/test_unischema.py)."""
+
+import pickle
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.schema.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.schema.transform import TransformSpec, transform_schema
+from petastorm_tpu.schema.unischema import (
+    Unischema,
+    UnischemaField,
+    encode_row,
+    insert_explicit_nulls,
+    match_unischema_fields,
+)
+from petastorm_tpu.utils import decode_row
+
+
+def _sample_schema():
+    return Unischema(
+        "TestSchema",
+        [
+            UnischemaField("id", np.int64, (), ScalarCodec(np.int64), False),
+            UnischemaField("name", np.str_, (), ScalarCodec(str), False),
+            UnischemaField("matrix", np.float64, (3, 4), NdarrayCodec(), False),
+            UnischemaField("opt", np.int32, (), ScalarCodec(np.int32), True),
+        ],
+    )
+
+
+def test_fields_as_attributes():
+    schema = _sample_schema()
+    assert schema.id.name == "id"
+    assert schema.matrix.shape == (3, 4)
+    assert list(schema.fields.keys()) == ["id", "name", "matrix", "opt"]
+
+
+def test_make_namedtuple():
+    schema = _sample_schema()
+    row = schema.make_namedtuple(id=1, name="a", matrix=None, opt=None)
+    assert row.id == 1 and row.name == "a" and row.opt is None
+    assert type(row).__name__ == "TestSchema"
+
+
+def test_create_schema_view_by_field_and_regex():
+    schema = _sample_schema()
+    view = schema.create_schema_view([schema.id, "mat.*"])
+    assert list(view.fields.keys()) == ["id", "matrix"]
+    # full-match semantics: 'mat' alone matches nothing
+    with pytest.raises(ValueError):
+        schema.create_schema_view(["mat"])
+
+
+def test_create_schema_view_rejects_foreign_field():
+    schema = _sample_schema()
+    foreign = UnischemaField("zzz", np.int32, (), ScalarCodec(np.int32), False)
+    with pytest.raises(ValueError):
+        schema.create_schema_view([foreign])
+
+
+def test_match_unischema_fields():
+    schema = _sample_schema()
+    assert {f.name for f in match_unischema_fields(schema, ["id", "name"])} == {"id", "name"}
+    assert {f.name for f in match_unischema_fields(schema, [".*a.*"])} == {"name", "matrix"}
+    assert match_unischema_fields(schema, []) == []
+
+
+def test_schema_equality_and_pickle():
+    s1, s2 = _sample_schema(), _sample_schema()
+    assert s1 == s2
+    s1.make_namedtuple(id=0, name="", matrix=None, opt=None)  # memoize namedtuple
+    restored = pickle.loads(pickle.dumps(s1))
+    assert restored == s2
+    assert restored.make_namedtuple(id=5, name="x", matrix=None, opt=None).id == 5
+
+
+def test_field_equality_and_hash():
+    f1 = UnischemaField("a", np.int32, (), ScalarCodec(np.int32), False)
+    f2 = UnischemaField("a", np.int32, (), ScalarCodec(np.int32), False)
+    f3 = UnischemaField("a", np.int64, (), ScalarCodec(np.int64), False)
+    assert f1 == f2 and hash(f1) == hash(f2)
+    assert f1 != f3
+
+
+def test_as_arrow_schema_storage_types():
+    schema = _sample_schema()
+    arrow = schema.as_arrow_schema()
+    assert arrow.field("id").type == pa.int64()
+    assert arrow.field("name").type == pa.string()
+    assert arrow.field("matrix").type == pa.binary()
+    assert arrow.field("opt").nullable is True
+
+
+def test_from_arrow_schema_roundtrip_plain_parquet():
+    arrow = pa.schema(
+        [
+            pa.field("i", pa.int32(), nullable=False),
+            pa.field("f", pa.float64()),
+            pa.field("s", pa.string()),
+            pa.field("d", pa.decimal128(10, 2)),
+            pa.field("ts", pa.timestamp("us")),
+            pa.field("lst", pa.list_(pa.int64())),
+        ]
+    )
+    schema = Unischema.from_arrow_schema(arrow)
+    assert schema.i.numpy_dtype == np.dtype("int32") and schema.i.nullable is False
+    assert schema.f.numpy_dtype == np.dtype("float64")
+    assert schema.s.numpy_dtype is str
+    assert schema.d.numpy_dtype is Decimal
+    assert schema.ts.numpy_dtype == np.dtype("datetime64[us]")
+    assert schema.lst.shape == (None,)
+    assert schema.lst.numpy_dtype == np.dtype("int64")
+
+
+def test_from_arrow_schema_unsupported_field():
+    arrow = pa.schema([pa.field("ok", pa.int32()), pa.field("bad", pa.struct([("x", pa.int32())]))])
+    with pytest.raises(ValueError):
+        Unischema.from_arrow_schema(arrow)
+    schema = Unischema.from_arrow_schema(arrow, omit_unsupported_fields=True)
+    assert list(schema.fields.keys()) == ["ok"]
+
+
+def test_insert_explicit_nulls():
+    schema = _sample_schema()
+    row = {"id": 1, "name": "a", "matrix": np.zeros((3, 4))}
+    insert_explicit_nulls(schema, row)
+    assert row["opt"] is None
+    with pytest.raises(ValueError):
+        insert_explicit_nulls(schema, {"id": 1, "name": "a"})
+
+
+def test_encode_decode_row_roundtrip():
+    schema = _sample_schema()
+    matrix = np.random.random((3, 4))
+    encoded = encode_row(schema, {"id": 7, "name": "row", "matrix": matrix})
+    assert isinstance(encoded["matrix"], bytes)
+    decoded = decode_row(encoded, schema)
+    assert decoded["id"] == 7
+    np.testing.assert_array_equal(decoded["matrix"], matrix)
+    assert decoded["opt"] is None
+
+
+def test_encode_row_unknown_field_raises():
+    schema = _sample_schema()
+    with pytest.raises(ValueError, match="Unknown"):
+        encode_row(schema, {"id": 1, "name": "x", "matrix": np.zeros((3, 4)), "nope": 0})
+
+
+def test_transform_schema_edit_remove_select():
+    schema = _sample_schema()
+    spec = TransformSpec(
+        func=lambda x: x,
+        edit_fields=[("matrix", np.float32, (12,), False)],
+        removed_fields=["opt"],
+    )
+    out = transform_schema(schema, spec)
+    assert out.matrix.numpy_dtype == np.float32
+    assert out.matrix.shape == (12,)
+    assert "opt" not in out.fields
+
+    sel = transform_schema(schema, TransformSpec(selected_fields=["id", "name"]))
+    assert list(sel.fields.keys()) == ["id", "name"]
+
+    with pytest.raises(ValueError):
+        TransformSpec(selected_fields=["id"], removed_fields=["opt"])
+
+
+def test_resolve_schema_view_none_is_identity():
+    schema = _sample_schema()
+    assert schema.resolve_schema_view(None) is schema
+    view = schema.resolve_schema_view(["id"])
+    assert list(view.fields.keys()) == ["id"]
