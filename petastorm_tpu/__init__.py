@@ -24,7 +24,7 @@ _LAZY_EXPORTS = {
     "make_reader": ("petastorm_tpu.reader.reader", "make_reader"),
     "make_batch_reader": ("petastorm_tpu.reader.reader", "make_batch_reader"),
     "Reader": ("petastorm_tpu.reader.reader", "Reader"),
-    "NoDataAvailableError": ("petastorm_tpu.reader.errors", "NoDataAvailableError"),
+    "NoDataAvailableError": ("petastorm_tpu.errors", "NoDataAvailableError"),
     "Unischema": ("petastorm_tpu.schema.unischema", "Unischema"),
     "UnischemaField": ("petastorm_tpu.schema.unischema", "UnischemaField"),
     "TransformSpec": ("petastorm_tpu.schema.transform", "TransformSpec"),

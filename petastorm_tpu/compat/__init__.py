@@ -1,0 +1,7 @@
+"""Optional-dependency shims.
+
+The reference insulates itself against pyarrow API churn in
+``petastorm/compat.py``; this build targets pyarrow>=16 ``pyarrow.dataset``
+natively, so the only compat surface left is the optional Spark shim
+(:mod:`petastorm_tpu.compat.spark_shim`).
+"""

@@ -1,0 +1,115 @@
+"""Spark API-parity shim (optional; requires pyspark).
+
+The reference's data model is Spark-typed (``petastorm/unischema.py::
+as_spark_schema/dict_to_spark_row``, ``petastorm/codecs.py::spark_dtype``).
+This build's ETL engine is pyarrow, so Spark conversion is an optional shim:
+importable API surface that raises a clear error when pyspark is absent, and
+does the real conversion when it is present.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+try:  # pragma: no cover - pyspark absent in this environment
+    from pyspark.sql.types import (  # noqa: F401
+        BinaryType,
+        BooleanType,
+        ByteType,
+        DateType,
+        DecimalType,
+        DoubleType,
+        FloatType,
+        IntegerType,
+        LongType,
+        Row,
+        ShortType,
+        StringType,
+        StructField,
+        StructType,
+        TimestampType,
+    )
+
+    _HAVE_PYSPARK = True
+except ImportError:
+    _HAVE_PYSPARK = False
+
+
+def _require_pyspark():
+    if not _HAVE_PYSPARK:
+        raise NotImplementedError(
+            "This operation requires pyspark, which is not installed; "
+            "this build's ETL engine is pyarrow (see petastorm_tpu.etl)."
+        )
+
+
+def _numpy_to_spark_type(numpy_dtype):  # pragma: no cover - needs pyspark
+    _require_pyspark()
+    if numpy_dtype is Decimal:
+        return DecimalType(38, 18)
+    if numpy_dtype in (str, np.str_):
+        return StringType()
+    if numpy_dtype in (bytes, np.bytes_):
+        return BinaryType()
+    dtype = np.dtype(numpy_dtype)
+    mapping = {
+        "b": BooleanType(),
+        "i1": ByteType(),
+        "i2": ShortType(),
+        "i4": IntegerType(),
+        "i8": LongType(),
+        "u1": ShortType(),
+        "u2": IntegerType(),
+        "u4": LongType(),
+        "u8": LongType(),
+        "f2": FloatType(),
+        "f4": FloatType(),
+        "f8": DoubleType(),
+    }
+    if dtype.kind == "M":
+        return DateType() if np.datetime_data(dtype)[0] == "D" else TimestampType()
+    if dtype.kind in ("U", "S"):
+        return StringType() if dtype.kind == "U" else BinaryType()
+    key = dtype.kind if dtype.kind == "b" else dtype.kind + str(dtype.itemsize)
+    if key not in mapping:
+        raise ValueError(f"Unsupported numpy dtype for Spark conversion: {dtype}")
+    return mapping[key]
+
+
+def unischema_as_spark_schema(unischema):  # pragma: no cover - needs pyspark
+    """Reference parity: ``Unischema.as_spark_schema``."""
+    _require_pyspark()
+    struct_fields = []
+    for field in unischema.fields.values():
+        if field.codec is None:
+            spark_type = _numpy_to_spark_type(field.numpy_dtype)
+        else:
+            spark_type = _codec_spark_dtype(field)
+        struct_fields.append(StructField(field.name, spark_type, field.nullable))
+    return StructType(struct_fields)
+
+
+def _codec_spark_dtype(field):  # pragma: no cover - needs pyspark
+    from petastorm_tpu.schema.codecs import ScalarCodec
+
+    if isinstance(field.codec, ScalarCodec):
+        return _numpy_to_spark_type(field.numpy_dtype)
+    return BinaryType()  # Ndarray / CompressedNdarray / CompressedImage codecs
+
+
+def dict_to_spark_row(unischema, row_dict):  # pragma: no cover - needs pyspark
+    """Reference parity: ``petastorm/unischema.py::dict_to_spark_row`` — encode
+    a row dict with codecs and wrap it in a Spark ``Row`` (fields sorted by
+    name, matching Row kwargs semantics)."""
+    _require_pyspark()
+    from petastorm_tpu.schema.unischema import encode_row
+
+    encoded = encode_row(unischema, row_dict)
+    converted = {}
+    for name, value in encoded.items():
+        if isinstance(value, bytes):
+            value = bytearray(value)
+        converted[name] = value
+    return Row(**converted)

@@ -1,0 +1,20 @@
+"""Public exceptions.
+
+Reference parity: ``petastorm/errors.py`` (``NoDataAvailableError``) plus
+``petastorm/etl/dataset_metadata.py::PetastormMetadataError`` — SURVEY.md §2.1,
+§2.3.
+"""
+
+
+class NoDataAvailableError(RuntimeError):
+    """Raised when a reader is constructed over a selection with no data
+    (e.g. every row group was filtered out by predicates/selectors/shards)."""
+
+
+class PetastormMetadataError(RuntimeError):
+    """Raised when dataset metadata (``_common_metadata`` schema / row-group
+    info) is missing or malformed for the requested operation."""
+
+
+class PetastormMetadataGenerationError(PetastormMetadataError):
+    """Raised when metadata (re)generation fails for a dataset."""

@@ -1,0 +1,15 @@
+"""ETL: dataset materialization, metadata, row-group indexing.
+
+Reference parity: ``petastorm/etl/`` — SURVEY.md §2.3. The engine here is
+``pyarrow.dataset`` (no JVM): materialization runs in-process or across a
+local process pool, and a TPU pod's hosts each read metadata independently
+(zero data-plane cross-host traffic, SURVEY.md §5).
+"""
+
+from petastorm_tpu.etl.metadata import (  # noqa: F401
+    materialize_dataset,
+    get_schema,
+    get_schema_from_dataset_url,
+    infer_or_load_unischema,
+    load_row_groups,
+)

@@ -1,0 +1,627 @@
+"""Dataset metadata: materialization bookkeeping + schema persistence.
+
+Reference parity: ``petastorm/etl/dataset_metadata.py`` (``materialize_dataset``,
+``get_schema``, ``get_schema_from_dataset_url``, ``infer_or_load_unischema``,
+``load_row_groups``, ``ROW_GROUPS_PER_FILE_KEY``, ``UNISCHEMA_KEY``) and
+``petastorm/utils.py::add_to_dataset_metadata`` — SURVEY.md §2.3, §3.3.
+
+Design differences (TPU-first):
+
+- The canonical schema serialization we *write* is JSON under
+  ``UNISCHEMA_JSON_KEY`` (safe, language-neutral). Reference datasets carrying
+  a *pickled* schema under ``dataset-toolkit.unischema.v1`` (or the newer
+  ``petastorm.unischema.v1``) are read via a **restricted unpickler**
+  (:func:`unischema_from_reference_pickle`) that only reconstructs a fixed
+  allowlist of schema/codec/numpy types — existing corpora load unchanged,
+  with no arbitrary-code-execution hazard.
+- ``materialize_dataset`` is engine-agnostic: the ``spark`` argument is kept
+  for API parity and may be ``None`` (the pyarrow path). Row-group size is
+  applied by the in-process writer (:func:`write_rows`) or, when a Spark
+  session is passed, via the same hadoop conf key the reference sets.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+from contextlib import contextmanager
+from dataclasses import dataclass
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu.errors import PetastormMetadataError
+from petastorm_tpu.fs_utils import FilesystemResolver
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField, encode_row
+from petastorm_tpu.schema import codecs as codecs_mod
+
+# Keys written by the reference (read-compat) — SURVEY.md §2.3:
+ROW_GROUPS_PER_FILE_KEY = b"dataset-toolkit.num_row_groups_per_file.v1"
+UNISCHEMA_KEY = b"dataset-toolkit.unischema.v1"
+UNISCHEMA_KEY_V2 = b"petastorm.unischema.v1"
+# Key this build writes (JSON-serialized schema; safe to load anywhere):
+UNISCHEMA_JSON_KEY = b"petastorm_tpu.unischema.json.v1"
+
+_COMMON_METADATA = "_common_metadata"
+_METADATA = "_metadata"
+
+
+# ---------------------------------------------------------------------------
+# Unischema <-> JSON
+# ---------------------------------------------------------------------------
+
+_DTYPE_SPECIALS = {"str": str, "bytes": bytes, "decimal": Decimal}
+
+
+def _dtype_to_json(numpy_dtype):
+    if numpy_dtype is Decimal:
+        return "decimal"
+    if numpy_dtype in (str, np.str_):
+        return "str"
+    if numpy_dtype in (bytes, np.bytes_):
+        return "bytes"
+    return np.dtype(numpy_dtype).str
+
+
+def _dtype_from_json(token):
+    if token in _DTYPE_SPECIALS:
+        return _DTYPE_SPECIALS[token]
+    return np.dtype(token)
+
+
+def _codec_to_json(codec):
+    if codec is None:
+        return None
+    name = type(codec).__name__
+    spec = {"codec": name}
+    if isinstance(codec, codecs_mod.ScalarCodec):
+        arrow_type = codec.arrow_dtype()
+        spec["arrow_type"] = str(arrow_type) if arrow_type is not None else None
+    elif isinstance(codec, codecs_mod.CompressedImageCodec):
+        spec["image_codec"] = codec.image_codec
+        spec["quality"] = codec._quality
+    return spec
+
+
+def _codec_from_json(spec):
+    if spec is None:
+        return None
+    name = spec["codec"]
+    if name == "ScalarCodec":
+        arrow_type = spec.get("arrow_type")
+        if arrow_type is None:
+            return codecs_mod.ScalarCodec()
+        return codecs_mod.ScalarCodec(_arrow_type_from_string(arrow_type))
+    if name == "NdarrayCodec":
+        return codecs_mod.NdarrayCodec()
+    if name == "CompressedNdarrayCodec":
+        return codecs_mod.CompressedNdarrayCodec()
+    if name == "CompressedImageCodec":
+        return codecs_mod.CompressedImageCodec(
+            spec.get("image_codec", "png"), spec.get("quality", 80)
+        )
+    raise PetastormMetadataError(f"Unknown codec in serialized schema: {name!r}")
+
+
+def _arrow_type_from_string(type_str):
+    simple = {
+        "bool": pa.bool_(), "int8": pa.int8(), "int16": pa.int16(),
+        "int32": pa.int32(), "int64": pa.int64(), "uint8": pa.uint8(),
+        "uint16": pa.uint16(), "uint32": pa.uint32(), "uint64": pa.uint64(),
+        "halffloat": pa.float16(), "float": pa.float32(), "double": pa.float64(),
+        "string": pa.string(), "large_string": pa.large_string(),
+        "binary": pa.binary(), "large_binary": pa.large_binary(),
+        "date32[day]": pa.date32(), "date64[ms]": pa.date64(),
+    }
+    if type_str in simple:
+        return simple[type_str]
+    if type_str.startswith("timestamp["):
+        unit = type_str[len("timestamp["):-1].split(",")[0]
+        return pa.timestamp(unit)
+    raise PetastormMetadataError(f"Cannot parse arrow type string {type_str!r}")
+
+
+def unischema_to_json(schema):
+    """Serialize a Unischema to a JSON string (this build's canonical form)."""
+    fields = []
+    for field in schema.fields.values():
+        fields.append({
+            "name": field.name,
+            "numpy_dtype": _dtype_to_json(field.numpy_dtype),
+            "shape": list(field.shape),
+            "codec": _codec_to_json(field.codec),
+            "nullable": field.nullable,
+        })
+    return json.dumps({"version": 1, "name": schema._name, "fields": fields})
+
+
+def unischema_from_json(payload):
+    """Inverse of :func:`unischema_to_json`."""
+    if isinstance(payload, bytes):
+        payload = payload.decode("utf-8")
+    doc = json.loads(payload)
+    fields = [
+        UnischemaField(
+            f["name"],
+            _dtype_from_json(f["numpy_dtype"]),
+            tuple(None if d is None else d for d in f["shape"]),
+            _codec_from_json(f["codec"]),
+            f["nullable"],
+        )
+        for f in doc["fields"]
+    ]
+    return Unischema(doc.get("name", "schema"), fields)
+
+
+# ---------------------------------------------------------------------------
+# Reference-pickle read compatibility (restricted unpickler)
+# ---------------------------------------------------------------------------
+
+class _RefSparkType:
+    """Stand-in for a pyspark.sql.types.*Type instance inside a reference pickle."""
+
+    spark_name = "unknown"
+
+    def __setstate__(self, state):
+        self.__dict__.update(state if isinstance(state, dict) else {})
+
+
+def _make_spark_type_standin(name):
+    return type(name, (_RefSparkType,), {"spark_name": name})
+
+
+_SPARK_TYPE_NAMES = [
+    "BooleanType", "ByteType", "ShortType", "IntegerType", "LongType",
+    "FloatType", "DoubleType", "StringType", "BinaryType", "DecimalType",
+    "DateType", "TimestampType",
+]
+_SPARK_STANDINS = {n: _make_spark_type_standin(n) for n in _SPARK_TYPE_NAMES}
+
+_SPARK_NAME_TO_ARROW = {
+    "BooleanType": pa.bool_(), "ByteType": pa.int8(), "ShortType": pa.int16(),
+    "IntegerType": pa.int32(), "LongType": pa.int64(), "FloatType": pa.float32(),
+    "DoubleType": pa.float64(), "StringType": pa.string(),
+    "BinaryType": pa.binary(), "DecimalType": pa.string(),
+    "DateType": pa.date32(), "TimestampType": pa.timestamp("us"),
+}
+
+
+class _RefUnischema:
+    """Stand-in that absorbs a pickled reference ``petastorm.unischema.Unischema``."""
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class _RefScalarCodec:
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class _RefCodecPassthrough:
+    target = None
+
+    def __setstate__(self, state):
+        self.__dict__.update(state if isinstance(state, dict) else {})
+
+
+_SAFE_BUILTINS = {
+    t.__name__: t
+    for t in (dict, list, tuple, set, frozenset, str, bytes, int, float, bool,
+              complex, object)
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Unpickles reference schemas while refusing everything not allowlisted."""
+
+    _ALLOWED = {
+        ("petastorm.unischema", "Unischema"): _RefUnischema,
+        ("petastorm.unischema", "UnischemaField"): None,  # handled as namedtuple
+        ("petastorm.codecs", "ScalarCodec"): _RefScalarCodec,
+        ("petastorm.codecs", "NdarrayCodec"): type("_RefNdarray", (_RefCodecPassthrough,), {"target": "NdarrayCodec"}),
+        ("petastorm.codecs", "CompressedNdarrayCodec"): type("_RefCompressedNdarray", (_RefCodecPassthrough,), {"target": "CompressedNdarrayCodec"}),
+        ("petastorm.codecs", "CompressedImageCodec"): type("_RefCompressedImage", (_RefCodecPassthrough,), {"target": "CompressedImageCodec"}),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED:
+            target = self._ALLOWED[(module, name)]
+            if target is None:
+                return _RefFieldStandin
+            return target
+        if module.startswith("pyspark.sql.types") and name in _SPARK_STANDINS:
+            return _SPARK_STANDINS[name]
+        if module in ("numpy", "numpy.core.multiarray", "numpy._core.multiarray",
+                      "numpy.core.numerictypes", "numpy._core.numerictypes"):
+            return getattr(np, name) if hasattr(np, name) else _numpy_attr(module, name)
+        if module == "collections" and name == "OrderedDict":
+            from collections import OrderedDict
+
+            return OrderedDict
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return _SAFE_BUILTINS[name]
+        if module == "decimal" and name == "Decimal":
+            return Decimal
+        raise pickle.UnpicklingError(
+            f"Reference-schema unpickler: refusing {module}.{name}"
+        )
+
+
+def _numpy_attr(module, name):
+    import importlib
+
+    try:
+        mod = importlib.import_module(module)
+        return getattr(mod, name)
+    except (ImportError, AttributeError) as exc:
+        raise pickle.UnpicklingError(
+            f"Reference-schema unpickler: cannot resolve {module}.{name}"
+        ) from exc
+
+
+class _RefFieldStandin:
+    """Stand-in for the reference's pickled ``UnischemaField`` namedtuple.
+
+    Namedtuples pickle as ``cls.__new__(cls, *values)`` (NEWOBJ); returning a
+    plain dict payload here lets :func:`_convert_ref_field` rebuild a native
+    field without trusting any reference class code.
+    """
+
+    def __new__(cls, *args, **kwargs):
+        names = ["name", "numpy_dtype", "shape", "codec", "nullable"]
+        values = dict(zip(names, args))
+        values.update(kwargs)
+        return {"__ref_field__": True, **values}
+
+
+def _convert_ref_codec(codec):
+    if codec is None:
+        return None
+    if isinstance(codec, _RefScalarCodec):
+        spark_type = codec.__dict__.get("_spark_type") or codec.__dict__.get("spark_type")
+        if isinstance(spark_type, _RefSparkType):
+            arrow = _SPARK_NAME_TO_ARROW.get(spark_type.spark_name)
+            return codecs_mod.ScalarCodec(arrow)
+        return codecs_mod.ScalarCodec()
+    if isinstance(codec, _RefCodecPassthrough):
+        if codec.target == "NdarrayCodec":
+            return codecs_mod.NdarrayCodec()
+        if codec.target == "CompressedNdarrayCodec":
+            return codecs_mod.CompressedNdarrayCodec()
+        if codec.target == "CompressedImageCodec":
+            image_codec = codec.__dict__.get("_image_codec", "png")
+            if not isinstance(image_codec, str):  # reference stores a cv2 token sometimes
+                image_codec = "png"
+            quality = codec.__dict__.get("_quality", 80)
+            return codecs_mod.CompressedImageCodec(image_codec, quality)
+    raise PetastormMetadataError(f"Cannot convert reference codec {codec!r}")
+
+
+def _convert_ref_field(field):
+    if isinstance(field, dict) and field.get("__ref_field__"):
+        dtype = field["numpy_dtype"]
+        if isinstance(dtype, type) and issubclass(dtype, np.generic):
+            dtype = np.dtype(dtype)
+        shape = field.get("shape") or ()
+        return UnischemaField(
+            field["name"], dtype, tuple(shape),
+            _convert_ref_codec(field.get("codec")),
+            bool(field.get("nullable", False)),
+        )
+    raise PetastormMetadataError(f"Unexpected reference field payload: {field!r}")
+
+
+def unischema_from_reference_pickle(payload):
+    """Load a reference ``dataset-toolkit.unischema.v1`` pickle (restricted).
+
+    Reconstructs a native :class:`Unischema` with arrow-typed codecs —
+    SURVEY.md §7 hard-part #4 (reference-dataset compatibility).
+    """
+    ref = _RestrictedUnpickler(io.BytesIO(payload)).load()
+    if isinstance(ref, _RefUnischema):
+        name = ref.__dict__.get("_name", "reference_schema")
+        raw_fields = ref.__dict__.get("_fields", {})
+        iterable = raw_fields.values() if isinstance(raw_fields, dict) else raw_fields
+        fields = [_convert_ref_field(f) for f in iterable]
+        return Unischema(name, fields)
+    raise PetastormMetadataError(
+        f"Reference pickle did not contain a Unischema (got {type(ref)})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# _common_metadata read/write
+# ---------------------------------------------------------------------------
+
+def add_to_dataset_metadata(filesystem, dataset_path, key, value):
+    """Merge one key/value into the dataset's ``_common_metadata`` footer.
+
+    Reference parity: ``petastorm/utils.py::add_to_dataset_metadata``. ``key``
+    and ``value`` are bytes (or str, encoded utf-8).
+    """
+    if isinstance(key, str):
+        key = key.encode("utf-8")
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    common_path = _join(dataset_path, _COMMON_METADATA)
+    arrow_schema = None
+    existing = {}
+    if _exists(filesystem, common_path):
+        with filesystem.open_input_file(common_path) as f:
+            meta = pq.read_metadata(f)
+        arrow_schema = meta.schema.to_arrow_schema()
+        existing = dict(arrow_schema.metadata or {})
+    else:
+        # Derive the schema from any data file in the dataset
+        import pyarrow.dataset as pads
+
+        dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
+        arrow_schema = dataset.schema
+        existing = dict(arrow_schema.metadata or {})
+    existing[key] = value
+    schema_with_meta = arrow_schema.with_metadata(existing)
+    with filesystem.open_output_stream(common_path) as out:
+        pq.write_metadata(schema_with_meta, out)
+
+
+def read_dataset_metadata(filesystem, dataset_path):
+    """Return the key/value metadata dict from ``_common_metadata`` (or {})."""
+    common_path = _join(dataset_path, _COMMON_METADATA)
+    if not _exists(filesystem, common_path):
+        return {}
+    with filesystem.open_input_file(common_path) as f:
+        meta = pq.read_metadata(f)
+    return dict(meta.schema.to_arrow_schema().metadata or {})
+
+
+def _join(base, name):
+    return base.rstrip("/") + "/" + name
+
+
+def _exists(filesystem, path):
+    import pyarrow.fs as pafs
+
+    info = filesystem.get_file_info(path)
+    return info.type != pafs.FileType.NotFound
+
+
+# ---------------------------------------------------------------------------
+# materialize_dataset
+# ---------------------------------------------------------------------------
+
+@contextmanager
+def materialize_dataset(spark, dataset_url, schema, row_group_size_mb=None,
+                        use_summary_metadata=False, filesystem_factory=None,
+                        storage_options=None, filesystem=None):
+    """Context manager bracketing a dataset write; attaches schema + row-group
+    metadata on exit.
+
+    Reference parity: ``petastorm/etl/dataset_metadata.py::materialize_dataset``
+    (same signature shape). ``spark`` may be ``None`` — the pyarrow path, where
+    the user writes Parquet inside the block (e.g. via :func:`write_rows`) —
+    or a SparkSession, in which case the same hadoop conf keys the reference
+    sets are applied around the block.
+    """
+    spark_conf_restore = None
+    if spark is not None:  # pragma: no cover - pyspark absent in this build env
+        hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+        spark_conf_restore = {
+            "parquet.block.size": hadoop_conf.get("parquet.block.size"),
+            "parquet.summary.metadata.level": hadoop_conf.get("parquet.summary.metadata.level"),
+        }
+        if row_group_size_mb:
+            hadoop_conf.setInt("parquet.block.size", row_group_size_mb * 1024 * 1024)
+        hadoop_conf.set(
+            "parquet.summary.metadata.level",
+            "ALL" if use_summary_metadata else "NONE",
+        )
+    try:
+        yield
+    finally:
+        if spark is not None and spark_conf_restore:  # pragma: no cover
+            hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+            for conf_key, old in spark_conf_restore.items():
+                if old is None:
+                    hadoop_conf.unset(conf_key)
+                else:
+                    hadoop_conf.set(conf_key, old)
+
+    # Post-write: attach metadata (outside the try so a failed write skips it)
+    if filesystem_factory is not None:
+        fs = filesystem_factory()
+        path = FilesystemResolver(dataset_url, filesystem=fs).get_dataset_path()
+    else:
+        resolver = FilesystemResolver(dataset_url, storage_options=storage_options,
+                                      filesystem=filesystem)
+        fs = resolver.filesystem()
+        path = resolver.get_dataset_path()
+    row_groups_per_file = _enumerate_row_groups_per_file(fs, path)
+    add_to_dataset_metadata(fs, path, ROW_GROUPS_PER_FILE_KEY,
+                            json.dumps(row_groups_per_file))
+    add_to_dataset_metadata(fs, path, UNISCHEMA_JSON_KEY, unischema_to_json(schema))
+
+
+def _enumerate_row_groups_per_file(filesystem, dataset_path):
+    """{relative file path: num_row_groups} for every parquet file in the dataset."""
+    import pyarrow.dataset as pads
+
+    dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
+    counts = {}
+    base = dataset_path.rstrip("/") + "/"
+    for fragment in dataset.get_fragments():
+        rel = fragment.path[len(base):] if fragment.path.startswith(base) else fragment.path
+        counts[rel] = fragment.metadata.num_row_groups if fragment.metadata \
+            else len(fragment.row_groups)
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# Native (pyarrow) writer — the Spark-free materialization engine
+# ---------------------------------------------------------------------------
+
+def write_rows(dataset_url, schema, rows, row_group_size_mb=None,
+               rows_per_file=None, rows_per_row_group=None, compression="snappy",
+               storage_options=None, filesystem=None, basename_template=None):
+    """Encode + write an iterable of row dicts as a petastorm-format dataset.
+
+    This is the in-process materialization engine (the reference delegates the
+    same job to Spark executors — ``petastorm/etl/dataset_metadata.py`` §3.3).
+    Row-group size is controlled directly through ``pq.ParquetWriter`` instead
+    of hadoop conf. Call inside :func:`materialize_dataset` (or use
+    :func:`materialize_rows` which brackets both).
+    """
+    resolver = FilesystemResolver(dataset_url, storage_options=storage_options,
+                                  filesystem=filesystem)
+    fs = resolver.filesystem()
+    path = resolver.get_dataset_path()
+    fs.create_dir(path, recursive=True)
+
+    arrow_schema = schema.as_arrow_schema()
+    rows = list(rows)
+    if not rows:
+        raise ValueError("write_rows requires at least one row")
+    if rows_per_file is None:
+        rows_per_file = len(rows)
+    template = basename_template or "part-{:05d}.parquet"
+
+    encoded_columns_files = []
+    for file_index, start in enumerate(range(0, len(rows), rows_per_file)):
+        chunk = rows[start:start + rows_per_file]
+        encoded = [encode_row(schema, row) for row in chunk]
+        table = _rows_to_table(encoded, schema, arrow_schema)
+        file_path = _join(path, template.format(file_index))
+        writer_kwargs = {"compression": compression}
+        if rows_per_row_group:
+            row_group_rows = rows_per_row_group
+        elif row_group_size_mb:
+            est = max(1, int(table.nbytes / max(1, len(chunk))))
+            row_group_rows = max(1, (row_group_size_mb * 1024 * 1024) // est)
+        else:
+            row_group_rows = len(chunk)
+        with fs.open_output_stream(file_path) as sink:
+            pq.write_table(table, sink, row_group_size=row_group_rows, **writer_kwargs)
+        encoded_columns_files.append(file_path)
+    return encoded_columns_files
+
+
+def _rows_to_table(encoded_rows, schema, arrow_schema):
+    columns = {}
+    for field_name in schema.fields:
+        columns[field_name] = [row[field_name] for row in encoded_rows]
+    arrays = []
+    for field in arrow_schema:
+        arrays.append(pa.array(columns[field.name], type=field.type))
+    return pa.Table.from_arrays(arrays, schema=arrow_schema)
+
+
+def materialize_rows(dataset_url, schema, rows, **write_kwargs):
+    """One-call materialization: write rows + attach metadata."""
+    storage_options = write_kwargs.pop("storage_options", None)
+    filesystem = write_kwargs.pop("filesystem", None)
+    row_group_size_mb = write_kwargs.get("row_group_size_mb")
+    with materialize_dataset(None, dataset_url, schema,
+                             row_group_size_mb=row_group_size_mb,
+                             storage_options=storage_options, filesystem=filesystem):
+        write_rows(dataset_url, schema, rows, storage_options=storage_options,
+                   filesystem=filesystem, **write_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Schema loading
+# ---------------------------------------------------------------------------
+
+def get_schema(dataset_or_metadata, dataset_path=None, filesystem=None):
+    """Load the Unischema attached to a dataset's ``_common_metadata``.
+
+    Accepts either a metadata dict (from :func:`read_dataset_metadata`) or a
+    ``(filesystem, dataset_path)`` pair. Raises
+    :class:`~petastorm_tpu.errors.PetastormMetadataError` when absent.
+    """
+    if isinstance(dataset_or_metadata, dict):
+        metadata = dataset_or_metadata
+    else:
+        metadata = read_dataset_metadata(dataset_or_metadata, dataset_path)
+    if UNISCHEMA_JSON_KEY in metadata:
+        return unischema_from_json(metadata[UNISCHEMA_JSON_KEY])
+    for key in (UNISCHEMA_KEY_V2, UNISCHEMA_KEY):
+        if key in metadata:
+            return unischema_from_reference_pickle(metadata[key])
+    raise PetastormMetadataError(
+        "Dataset carries no Unischema metadata (not a petastorm dataset?). "
+        "Use make_batch_reader for plain Parquet stores, or regenerate "
+        "metadata with petastorm-tpu-generate-metadata."
+    )
+
+
+def get_schema_from_dataset_url(dataset_url, hdfs_driver="libhdfs",
+                                storage_options=None, filesystem=None):
+    """Reference parity: ``dataset_metadata.get_schema_from_dataset_url``."""
+    resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
+                                  storage_options=storage_options,
+                                  filesystem=filesystem)
+    return get_schema(resolver.filesystem(), resolver.get_dataset_path())
+
+
+def infer_or_load_unischema(filesystem, dataset_path):
+    """Attached Unischema if present, else infer one from the arrow schema
+    (reference parity: ``dataset_metadata.infer_or_load_unischema``)."""
+    try:
+        return get_schema(filesystem, dataset_path), True
+    except PetastormMetadataError:
+        import pyarrow.dataset as pads
+
+        dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
+        return Unischema.from_arrow_schema(dataset.schema), False
+
+
+# ---------------------------------------------------------------------------
+# Row-group enumeration
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RowGroupPiece:
+    """One unit of ventilated work: a single row group of a single file."""
+
+    path: str
+    row_group: int
+    num_rows: int
+    partition_keys: tuple = ()
+
+    def read(self, filesystem, columns=None):
+        """Read this row group's columns into a ``pa.Table``."""
+        with filesystem.open_input_file(self.path) as f:
+            pf = pq.ParquetFile(f)
+            return pf.read_row_group(self.row_group, columns=columns)
+
+
+def load_row_groups(filesystem, dataset_path, metadata=None):
+    """Enumerate the dataset's row groups as :class:`RowGroupPiece` list.
+
+    Reference parity: ``dataset_metadata.load_row_groups`` — prefers the
+    ``num_row_groups_per_file`` metadata (no footer scans), falls back to a
+    fragment scan (the reference's "slow path" warning case).
+    """
+    if metadata is None:
+        metadata = read_dataset_metadata(filesystem, dataset_path)
+    pieces = []
+    if ROW_GROUPS_PER_FILE_KEY in metadata:
+        counts = json.loads(metadata[ROW_GROUPS_PER_FILE_KEY].decode("utf-8"))
+        base = dataset_path.rstrip("/")
+        for rel_path, n_row_groups in sorted(counts.items()):
+            full = rel_path if rel_path.startswith(base) else _join(base, rel_path)
+            # num_rows unknown without the footer; filled lazily as -1
+            for rg in range(n_row_groups):
+                pieces.append(RowGroupPiece(full, rg, -1))
+        return pieces
+    import pyarrow.dataset as pads
+
+    dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
+    for fragment in sorted(dataset.get_fragments(), key=lambda f: f.path):
+        for rg_fragment in fragment.split_by_row_group():
+            rg = rg_fragment.row_groups[0]
+            pieces.append(RowGroupPiece(fragment.path, rg.id, rg.num_rows))
+    return pieces

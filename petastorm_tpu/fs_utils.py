@@ -1,0 +1,142 @@
+"""URL → filesystem resolution.
+
+Reference parity: ``petastorm/fs_utils.py`` (``FilesystemResolver``,
+``get_filesystem_and_path_or_paths``, ``get_dataset_path``) — SURVEY.md §2.4.
+
+TPU-first design difference: the reference resolves ``hdfs://`` through its
+own namenode-resolution machinery (``petastorm/hdfs/namenode.py``) and s3/gcs
+through fsspec wrappers. Here every scheme goes through
+``pyarrow.fs.FileSystem`` — the same C++ filesystem layer pyarrow's Parquet
+reader uses natively — with fsspec as the fallback for exotic schemes
+(wrapped via ``pyarrow.fs.PyFileSystem``). On a TPU pod each host resolves the
+filesystem independently; there is no cross-host data-plane traffic
+(SURVEY.md §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+from urllib.parse import urlparse
+
+import pyarrow.fs as pafs
+
+
+class FilesystemResolver:
+    """Resolves a dataset URL into a ``pyarrow.fs.FileSystem`` + path.
+
+    Supported: local paths, ``file://``, ``hdfs://host:port``, ``s3://``,
+    ``gs://``/``gcs://``, plus anything fsspec can open (via
+    ``storage_options``). A pre-built ``filesystem`` short-circuits resolution.
+    """
+
+    def __init__(self, dataset_url, hadoop_configuration=None, connector=None,
+                 hdfs_driver="libhdfs", user=None, storage_options=None,
+                 filesystem=None):
+        if not isinstance(dataset_url, str):
+            raise ValueError(f"dataset_url must be a string, got {type(dataset_url)}")
+        self._dataset_url = dataset_url.rstrip("/")
+        self._user = user
+        self._storage_options = storage_options or {}
+
+        parsed = urlparse(self._dataset_url)
+        self._scheme = parsed.scheme
+
+        if filesystem is not None:
+            self._filesystem = _ensure_arrow_filesystem(filesystem)
+            self._path = _strip_scheme(self._dataset_url)
+            return
+
+        if self._scheme in ("", "file"):
+            self._filesystem = pafs.LocalFileSystem()
+            self._path = parsed.path if self._scheme == "file" else self._dataset_url
+        elif self._scheme == "hdfs":
+            self._filesystem, self._path = self._resolve_hdfs(parsed)
+        elif self._scheme in ("s3", "s3a", "s3n", "gs", "gcs") or self._storage_options:
+            self._filesystem, self._path = self._resolve_remote(parsed)
+        else:
+            try:
+                self._filesystem, self._path = pafs.FileSystem.from_uri(self._dataset_url)
+            except Exception as exc:
+                raise ValueError(
+                    f"Unsupported dataset URL scheme {self._scheme!r} in "
+                    f"{dataset_url!r}: {exc}"
+                ) from exc
+
+    def _resolve_hdfs(self, parsed):
+        from petastorm_tpu.hdfs.namenode import connect_hdfs
+
+        return connect_hdfs(parsed, user=self._user)
+
+    def _resolve_remote(self, parsed):
+        url = self._dataset_url
+        if self._scheme in ("s3a", "s3n"):
+            url = "s3" + url[len(self._scheme):]
+        if self._scheme in ("gcs",):
+            url = "gs" + url[len(self._scheme):]
+        if self._storage_options:
+            # fsspec honors storage_options; wrap the result for pyarrow.
+            import fsspec
+
+            fs, path = fsspec.core.url_to_fs(url, **self._storage_options)
+            return _ensure_arrow_filesystem(fs), path
+        fs, path = pafs.FileSystem.from_uri(url)
+        return fs, path
+
+    def filesystem(self):
+        return self._filesystem
+
+    def get_dataset_path(self):
+        return self._path
+
+    @property
+    def parsed_dataset_url(self):
+        return urlparse(self._dataset_url)
+
+
+def _ensure_arrow_filesystem(filesystem):
+    if isinstance(filesystem, pafs.FileSystem):
+        return filesystem
+    # fsspec filesystem → wrap through the pyarrow FSSpecHandler
+    try:
+        from pyarrow.fs import FSSpecHandler, PyFileSystem
+
+        return PyFileSystem(FSSpecHandler(filesystem))
+    except Exception as exc:
+        raise ValueError(f"Cannot adapt filesystem {filesystem!r}: {exc}") from exc
+
+
+def _strip_scheme(url):
+    parsed = urlparse(url)
+    if parsed.scheme in ("", "file"):
+        return parsed.path or url
+    return (parsed.netloc + parsed.path) if parsed.scheme in ("s3", "gs", "gcs") \
+        else parsed.path
+
+
+def get_filesystem_and_path_or_paths(url_or_urls, hdfs_driver="libhdfs",
+                                     storage_options=None, filesystem=None):
+    """Reference parity: ``petastorm/fs_utils.py::get_filesystem_and_path_or_paths``.
+
+    Accepts one URL or a list; all must share a scheme. Returns
+    ``(filesystem, path_or_paths)``.
+    """
+    urls = url_or_urls if isinstance(url_or_urls, list) else [url_or_urls]
+    if not urls:
+        raise ValueError("Empty dataset URL list")
+    schemes = {urlparse(u).scheme for u in urls}
+    if len(schemes) > 1:
+        raise ValueError(f"All dataset URLs must share one scheme, got {schemes}")
+    resolvers = [
+        FilesystemResolver(u, hdfs_driver=hdfs_driver,
+                           storage_options=storage_options, filesystem=filesystem)
+        for u in urls
+    ]
+    fs = resolvers[0].filesystem()
+    paths = [r.get_dataset_path() for r in resolvers]
+    return fs, paths if isinstance(url_or_urls, list) else paths[0]
+
+
+def get_dataset_path(parsed_url):
+    """Path portion of a parsed dataset URL (reference-parity helper)."""
+    if parsed_url.scheme in ("s3", "s3a", "s3n", "gs", "gcs"):
+        return parsed_url.netloc + parsed_url.path
+    return parsed_url.path

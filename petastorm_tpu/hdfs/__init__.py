@@ -1,0 +1,1 @@
+"""HDFS namenode resolution (reference parity: ``petastorm/hdfs/``)."""

@@ -142,7 +142,9 @@ class ScalarCodec(DataframeColumnCodec):
         if dtype in (bytes, np.bytes_):
             return value
         if np.dtype(dtype).kind == "M":
-            return np.datetime64(value)
+            # Cast to the field's declared unit; np.datetime64(value) alone
+            # would infer a unit from the input and break dtype normalization.
+            return np.datetime64(value).astype(np.dtype(dtype))
         return np.dtype(dtype).type(value)
 
 

@@ -6,8 +6,9 @@ Reference parity: ``petastorm/unischema.py`` (``Unischema``, ``UnischemaField``,
 
 Differences from the reference (TPU-first design):
 - the canonical serialized form is JSON (safe), not a pickle — see
-  ``petastorm_tpu/etl/metadata.py``; reference pickled schemas are *read*
-  via a compat unpickler so existing corpora load unchanged;
+  ``petastorm_tpu/etl/metadata.py`` (``unischema_to_json`` /
+  ``unischema_from_json``); reference pickled schemas are *read* via a
+  restricted compat unpickler there so existing corpora load unchanged;
 - conversion targets arrow schemas (the pyarrow ETL engine), with Spark
   StructType conversion provided only as an optional shim.
 """
@@ -16,6 +17,7 @@ from __future__ import annotations
 
 import re
 import sys
+import warnings
 from collections import OrderedDict, namedtuple
 from decimal import Decimal
 
@@ -133,7 +135,15 @@ class Unischema:
                     raise ValueError(
                         f"Field {item.name!r} does not belong to schema {self._name!r}"
                     )
-                matches = [self._fields[item.name]]
+                own = self._fields[item.name]
+                if item != own:
+                    warnings.warn(
+                        f"Field {item.name!r} differs from the schema's definition "
+                        f"(dtype/shape/codec/nullable mismatch); using the schema's field",
+                        UserWarning,
+                        stacklevel=2,
+                    )
+                matches = [own]
             elif isinstance(item, str):
                 matches = match_unischema_fields(self, [item])
                 if not matches:
@@ -304,6 +314,7 @@ def encode_row(unischema, row_dict):
     unknown = set(row_dict.keys()) - set(unischema.fields.keys())
     if unknown:
         raise ValueError(f"Unknown fields in row: {sorted(unknown)}")
+    row_dict = dict(row_dict)  # never mutate the caller's dict
     insert_explicit_nulls(unischema, row_dict)
     encoded = {}
     for name, field in unischema.fields.items():
@@ -331,8 +342,3 @@ def dict_to_spark_row(unischema, row_dict):  # pragma: no cover - pyspark absent
     from petastorm_tpu.compat.spark_shim import dict_to_spark_row as _impl
 
     return _impl(unischema, row_dict)
-
-
-# `np.unicode_` was removed in numpy 2; guard referenced in codecs too.
-if not hasattr(np, "unicode_"):  # pragma: no cover
-    pass

@@ -1,0 +1,240 @@
+"""ETL metadata tests: materialization, _common_metadata, schema round-trips,
+row-group enumeration, reference-pickle read compatibility."""
+
+import io
+import json
+import pickle
+
+import numpy as np
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.errors import PetastormMetadataError
+from petastorm_tpu.etl.metadata import (
+    ROW_GROUPS_PER_FILE_KEY,
+    UNISCHEMA_KEY,
+    add_to_dataset_metadata,
+    get_schema,
+    get_schema_from_dataset_url,
+    infer_or_load_unischema,
+    load_row_groups,
+    materialize_rows,
+    read_dataset_metadata,
+    unischema_from_json,
+    unischema_from_reference_pickle,
+    unischema_to_json,
+    write_rows,
+)
+from petastorm_tpu.schema.codecs import (
+    CompressedImageCodec,
+    NdarrayCodec,
+    ScalarCodec,
+)
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+
+def _toy_schema():
+    return Unischema("Toy", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("name", str, (), ScalarCodec(), True),
+        UnischemaField("vec", np.float32, (4,), NdarrayCodec(), False),
+        UnischemaField("img", np.uint8, (8, 8, 3), CompressedImageCodec("png"), False),
+    ])
+
+
+def _toy_rows(n=10):
+    rng = np.random.RandomState(0)
+    return [{
+        "id": i,
+        "name": f"row{i}",
+        "vec": rng.rand(4).astype(np.float32),
+        "img": rng.randint(0, 255, (8, 8, 3), dtype=np.uint8),
+    } for i in range(n)]
+
+
+def test_schema_json_roundtrip():
+    schema = _toy_schema()
+    restored = unischema_from_json(unischema_to_json(schema))
+    assert list(restored.fields) == list(schema.fields)
+    for name in schema.fields:
+        assert restored.fields[name] == schema.fields[name]
+
+
+def test_materialize_and_load_schema(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    schema = _toy_schema()
+    materialize_rows(url, schema, _toy_rows(), rows_per_row_group=4)
+    loaded = get_schema_from_dataset_url(url)
+    assert list(loaded.fields) == ["id", "name", "vec", "img"]
+    assert loaded.fields["vec"].shape == (4,)
+
+
+def test_row_group_enumeration_uses_metadata(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    schema = _toy_schema()
+    materialize_rows(url, schema, _toy_rows(10), rows_per_row_group=4)
+    fs = pafs.LocalFileSystem()
+    path = str(tmp_path / "ds")
+    metadata = read_dataset_metadata(fs, path)
+    assert ROW_GROUPS_PER_FILE_KEY in metadata
+    counts = json.loads(metadata[ROW_GROUPS_PER_FILE_KEY])
+    assert sum(counts.values()) == 3  # 10 rows / 4-per-group -> 3 row groups
+    pieces = load_row_groups(fs, path)
+    assert len(pieces) == 3
+    table = pieces[0].read(fs, columns=["id"])
+    assert table.num_rows == 4
+
+
+def test_load_row_groups_fallback_scan(tmp_path):
+    """Without _common_metadata, row groups come from a fragment scan."""
+    url = f"file://{tmp_path}/plain"
+    schema = _toy_schema()
+    write_rows(url, schema, _toy_rows(8), rows_per_row_group=4)
+    fs = pafs.LocalFileSystem()
+    pieces = load_row_groups(fs, str(tmp_path / "plain"))
+    assert len(pieces) == 2
+    assert all(p.num_rows == 4 for p in pieces)
+
+
+def test_infer_or_load(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    schema = _toy_schema()
+    materialize_rows(url, schema, _toy_rows(4))
+    fs = pafs.LocalFileSystem()
+    loaded, attached = infer_or_load_unischema(fs, str(tmp_path / "ds"))
+    assert attached and list(loaded.fields) == list(schema.fields)
+
+    url2 = f"file://{tmp_path}/plain"
+    write_rows(url2, schema, _toy_rows(4))
+    inferred, attached2 = infer_or_load_unischema(fs, str(tmp_path / "plain"))
+    assert not attached2
+    assert "id" in inferred.fields
+
+
+def test_get_schema_missing_raises(tmp_path):
+    url = f"file://{tmp_path}/plain"
+    write_rows(url, _toy_schema(), _toy_rows(2))
+    with pytest.raises(PetastormMetadataError, match="make_batch_reader"):
+        get_schema_from_dataset_url(url)
+
+
+def test_add_to_dataset_metadata_merges(tmp_path):
+    url = f"file://{tmp_path}/ds"
+    materialize_rows(url, _toy_schema(), _toy_rows(2))
+    fs = pafs.LocalFileSystem()
+    path = str(tmp_path / "ds")
+    add_to_dataset_metadata(fs, path, b"my.key", b"my-value")
+    metadata = read_dataset_metadata(fs, path)
+    assert metadata[b"my.key"] == b"my-value"
+    assert ROW_GROUPS_PER_FILE_KEY in metadata  # prior keys survive
+
+
+# --- reference-pickle compatibility -------------------------------------
+
+def _fabricate_reference_pickle():
+    """Craft a pickle byte-stream shaped like the reference's
+    ``dataset-toolkit.unischema.v1`` payload (petastorm module paths,
+    pyspark-typed ScalarCodec) without petastorm/pyspark installed.
+
+    Fake ``petastorm.*`` / ``pyspark.sql.types`` modules are injected into
+    ``sys.modules`` only for the duration of the dump, so pickle's GLOBAL
+    opcodes carry the reference's module paths on the wire.
+    """
+    import sys
+    import types
+    from collections import namedtuple
+
+    fake_modules = {}
+
+    def make_module(name):
+        mod = types.ModuleType(name)
+        fake_modules[name] = mod
+        return mod
+
+    m_uni = make_module("petastorm.unischema")
+    m_codecs = make_module("petastorm.codecs")
+    m_spark = make_module("pyspark.sql.types")
+    make_module("petastorm")
+    make_module("pyspark")
+    make_module("pyspark.sql")
+
+    field_cls = namedtuple("UnischemaField",
+                           ["name", "numpy_dtype", "shape", "codec", "nullable"])
+    field_cls.__module__ = "petastorm.unischema"
+    m_uni.UnischemaField = field_cls
+
+    def plain_class(module, name):
+        cls = type(name, (), {})
+        cls.__module__ = module.__name__
+        setattr(module, name, cls)
+        return cls
+
+    uni_cls = plain_class(m_uni, "Unischema")
+    scalar_cls = plain_class(m_codecs, "ScalarCodec")
+    ndarray_cls = plain_class(m_codecs, "NdarrayCodec")
+    int_type_cls = plain_class(m_spark, "IntegerType")
+
+    spark_int = int_type_cls()
+    scalar_codec = scalar_cls()
+    scalar_codec._spark_type = spark_int
+    ndarray_codec = ndarray_cls()
+
+    f1 = field_cls("id", np.int32, (), scalar_codec, False)
+    f2 = field_cls("emb", np.dtype("float64"), (3,), ndarray_codec, True)
+    schema = uni_cls()
+    schema._name = "RefSchema"
+    schema._fields = {"id": f1, "emb": f2}
+
+    saved = {k: sys.modules.get(k) for k in fake_modules}
+    sys.modules.update(fake_modules)
+    try:
+        payload = pickle.dumps(schema, protocol=2)
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                sys.modules.pop(k, None)
+            else:  # pragma: no cover
+                sys.modules[k] = old
+    return payload
+
+
+def test_reference_pickle_read_compat():
+    payload = _fabricate_reference_pickle()
+    schema = unischema_from_reference_pickle(payload)
+    assert list(schema.fields) == ["id", "emb"]
+    id_field = schema.fields["id"]
+    assert id_field.numpy_dtype == np.dtype("int32")
+    assert isinstance(id_field.codec, ScalarCodec)
+    emb = schema.fields["emb"]
+    assert emb.shape == (3,)
+    assert isinstance(emb.codec, NdarrayCodec)
+    assert emb.nullable
+
+
+def test_reference_pickle_via_common_metadata(tmp_path):
+    """A dataset carrying only the reference's pickled-schema key loads."""
+    url = f"file://{tmp_path}/refds"
+    schema = _toy_schema()
+    write_rows(url, schema, _toy_rows(4))
+    fs = pafs.LocalFileSystem()
+    path = str(tmp_path / "refds")
+    add_to_dataset_metadata(fs, path, UNISCHEMA_KEY, _fabricate_reference_pickle())
+    loaded = get_schema(fs, path)
+    assert list(loaded.fields) == ["id", "emb"]
+
+
+def test_restricted_unpickler_refuses_arbitrary_classes():
+    evil = pickle.dumps(io.BytesIO())  # io.BytesIO not on the allowlist
+    with pytest.raises(Exception, match="refusing|Unpickling"):
+        unischema_from_reference_pickle(evil)
+
+
+def test_row_group_size_mb_controls_groups(tmp_path):
+    url = f"file://{tmp_path}/sized"
+    schema = _toy_schema()
+    write_rows(url, schema, _toy_rows(64), row_group_size_mb=1)
+    files = list((tmp_path / "sized").glob("*.parquet"))
+    assert files
+    pf = pq.ParquetFile(files[0])
+    assert pf.metadata.num_row_groups >= 1
