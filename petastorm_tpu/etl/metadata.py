@@ -119,8 +119,19 @@ def _arrow_type_from_string(type_str):
     if type_str in simple:
         return simple[type_str]
     if type_str.startswith("timestamp["):
-        unit = type_str[len("timestamp["):-1].split(",")[0]
-        return pa.timestamp(unit)
+        # "timestamp[us]" or "timestamp[us, tz=UTC]"
+        inner = type_str[len("timestamp["):-1]
+        parts = [p.strip() for p in inner.split(",")]
+        unit = parts[0]
+        tz = None
+        for part in parts[1:]:
+            if part.startswith("tz="):
+                tz = part[len("tz="):]
+        return pa.timestamp(unit, tz=tz)
+    for prefix, ctor in (("decimal128(", pa.decimal128), ("decimal256(", pa.decimal256)):
+        if type_str.startswith(prefix):
+            precision, scale = type_str[len(prefix):-1].split(",")
+            return ctor(int(precision), int(scale))
     raise PetastormMetadataError(f"Cannot parse arrow type string {type_str!r}")
 
 
@@ -208,6 +219,19 @@ class _RefCodecPassthrough:
         self.__dict__.update(state if isinstance(state, dict) else {})
 
 
+_NUMPY_ALLOWED_NAMES = frozenset({
+    # dtype machinery
+    "dtype", "scalar", "_reconstruct", "ndarray", "_frombuffer",
+    # scalar type classes (pickled as GLOBAL numpy.<name>)
+    "bool_", "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "longdouble", "complex64",
+    "complex128", "str_", "bytes_", "void", "datetime64", "timedelta64",
+    "generic", "number", "integer", "signedinteger", "unsignedinteger",
+    "inexact", "floating", "complexfloating", "flexible", "character",
+    "intp", "uintp", "intc", "uintc", "byte", "ubyte", "short", "ushort",
+    "longlong", "ulonglong", "half", "single", "double",
+})
+
 _SAFE_BUILTINS = {
     t.__name__: t
     for t in (dict, list, tuple, set, frozenset, str, bytes, int, float, bool,
@@ -237,7 +261,13 @@ class _RestrictedUnpickler(pickle.Unpickler):
             return _SPARK_STANDINS[name]
         if module in ("numpy", "numpy.core.multiarray", "numpy._core.multiarray",
                       "numpy.core.numerictypes", "numpy._core.numerictypes"):
-            return getattr(np, name) if hasattr(np, name) else _numpy_attr(module, name)
+            # Only dtype/scalar reconstruction machinery — NOT all of numpy
+            # (np.save/np.load etc. would be arbitrary-file-write/exec gadgets).
+            if name in _NUMPY_ALLOWED_NAMES:
+                return getattr(np, name) if hasattr(np, name) else _numpy_attr(module, name)
+            raise pickle.UnpicklingError(
+                f"Reference-schema unpickler: refusing {module}.{name}"
+            )
         if module == "collections" and name == "OrderedDict":
             from collections import OrderedDict
 
@@ -463,6 +493,10 @@ def _enumerate_row_groups_per_file(filesystem, dataset_path):
 # Native (pyarrow) writer — the Spark-free materialization engine
 # ---------------------------------------------------------------------------
 
+_DEFAULT_ROW_GROUP_PROBE = 64
+_DEFAULT_ROWS_PER_ROW_GROUP = 4096
+
+
 def write_rows(dataset_url, schema, rows, row_group_size_mb=None,
                rows_per_file=None, rows_per_row_group=None, compression="snappy",
                storage_options=None, filesystem=None, basename_template=None):
@@ -473,7 +507,15 @@ def write_rows(dataset_url, schema, rows, row_group_size_mb=None,
     Row-group size is controlled directly through ``pq.ParquetWriter`` instead
     of hadoop conf. Call inside :func:`materialize_dataset` (or use
     :func:`materialize_rows` which brackets both).
-    """
+
+    ``rows`` may be any iterable (including a generator); it is consumed in
+    row-group-sized batches, so memory stays O(row group), not O(dataset).
+    Row-group sizing: ``rows_per_row_group`` wins; else ``row_group_size_mb``
+    is converted to a row count by probing the first encoded batch; else a
+    default of {default_rows} rows per group.
+    """.format(default_rows=_DEFAULT_ROWS_PER_ROW_GROUP)
+    from itertools import islice
+
     resolver = FilesystemResolver(dataset_url, storage_options=storage_options,
                                   filesystem=filesystem)
     fs = resolver.filesystem()
@@ -481,31 +523,65 @@ def write_rows(dataset_url, schema, rows, row_group_size_mb=None,
     fs.create_dir(path, recursive=True)
 
     arrow_schema = schema.as_arrow_schema()
-    rows = list(rows)
-    if not rows:
-        raise ValueError("write_rows requires at least one row")
-    if rows_per_file is None:
-        rows_per_file = len(rows)
     template = basename_template or "part-{:05d}.parquet"
+    rows_iter = iter(rows)
 
-    encoded_columns_files = []
-    for file_index, start in enumerate(range(0, len(rows), rows_per_file)):
-        chunk = rows[start:start + rows_per_file]
-        encoded = [encode_row(schema, row) for row in chunk]
-        table = _rows_to_table(encoded, schema, arrow_schema)
-        file_path = _join(path, template.format(file_index))
-        writer_kwargs = {"compression": compression}
-        if rows_per_row_group:
-            row_group_rows = rows_per_row_group
-        elif row_group_size_mb:
-            est = max(1, int(table.nbytes / max(1, len(chunk))))
-            row_group_rows = max(1, (row_group_size_mb * 1024 * 1024) // est)
-        else:
-            row_group_rows = len(chunk)
-        with fs.open_output_stream(file_path) as sink:
-            pq.write_table(table, sink, row_group_size=row_group_rows, **writer_kwargs)
-        encoded_columns_files.append(file_path)
-    return encoded_columns_files
+    # Determine rows per row group, probing the data if size-based.
+    pending = []
+    if rows_per_row_group:
+        group_rows = rows_per_row_group
+    elif row_group_size_mb:
+        probe = list(islice(rows_iter, _DEFAULT_ROW_GROUP_PROBE))
+        if not probe:
+            raise ValueError("write_rows requires at least one row")
+        encoded_probe = [encode_row(schema, r) for r in probe]
+        probe_table = _rows_to_table(encoded_probe, schema, arrow_schema)
+        bytes_per_row = max(1, probe_table.nbytes // len(probe))
+        group_rows = max(1, (row_group_size_mb * 1024 * 1024) // bytes_per_row)
+        pending = probe
+    else:
+        group_rows = _DEFAULT_ROWS_PER_ROW_GROUP
+    if rows_per_file:
+        # row groups never span files; rotation happens at the first
+        # row-group boundary at or past rows_per_file
+        group_rows = min(group_rows, rows_per_file)
+
+    def batches():
+        buffer = list(pending)
+        while True:
+            need = group_rows - len(buffer)
+            buffer.extend(islice(rows_iter, need))
+            if not buffer:
+                return
+            yield buffer[:group_rows]
+            buffer = buffer[group_rows:]
+
+    written_files = []
+    writer = None
+    rows_in_file = 0
+    file_index = 0
+    try:
+        for batch in batches():
+            encoded = [encode_row(schema, row) for row in batch]
+            table = _rows_to_table(encoded, schema, arrow_schema)
+            if writer is None:
+                file_path = _join(path, template.format(file_index))
+                sink = fs.open_output_stream(file_path)
+                writer = pq.ParquetWriter(sink, arrow_schema, compression=compression)
+                written_files.append(file_path)
+            writer.write_table(table, row_group_size=len(batch))
+            rows_in_file += len(batch)
+            if rows_per_file and rows_in_file >= rows_per_file:
+                writer.close()
+                writer = None
+                rows_in_file = 0
+                file_index += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    if not written_files:
+        raise ValueError("write_rows requires at least one row")
+    return written_files
 
 
 def _rows_to_table(encoded_rows, schema, arrow_schema):
@@ -584,11 +660,16 @@ def infer_or_load_unischema(filesystem, dataset_path):
 
 @dataclass(frozen=True)
 class RowGroupPiece:
-    """One unit of ventilated work: a single row group of a single file."""
+    """One unit of ventilated work: a single row group of a single file.
+
+    ``num_rows`` is ``None`` when enumeration came from the
+    ``num_row_groups_per_file`` metadata fast path (counts live in footers the
+    fast path deliberately never opens).
+    """
 
     path: str
     row_group: int
-    num_rows: int
+    num_rows: int | None = None
     partition_keys: tuple = ()
 
     def read(self, filesystem, columns=None):
@@ -613,9 +694,8 @@ def load_row_groups(filesystem, dataset_path, metadata=None):
         base = dataset_path.rstrip("/")
         for rel_path, n_row_groups in sorted(counts.items()):
             full = rel_path if rel_path.startswith(base) else _join(base, rel_path)
-            # num_rows unknown without the footer; filled lazily as -1
             for rg in range(n_row_groups):
-                pieces.append(RowGroupPiece(full, rg, -1))
+                pieces.append(RowGroupPiece(full, rg, None))
         return pieces
     import pyarrow.dataset as pads
 

@@ -1,0 +1,4 @@
+"""Reader implementation internals (serializers, shuffling buffers).
+
+Reference parity: ``petastorm/reader_impl/`` — SURVEY.md §2.1/§2.2.
+"""

@@ -1,0 +1,16 @@
+"""Pickle payload serializer for the process pool's zmq transport.
+
+Reference parity: ``petastorm/reader_impl/pickle_serializer.py``.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+
+class PickleSerializer:
+    def serialize(self, rows):
+        return pickle.dumps(rows, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, serialized_rows):
+        return pickle.loads(serialized_rows)  # noqa: S301 - host-local IPC from our own workers

@@ -1,0 +1,33 @@
+"""Parallel execution engine: worker pools + ventilation.
+
+Reference parity: ``petastorm/workers_pool/`` — SURVEY.md §2.2. Three pool
+flavors share one contract (``start``/``ventilate``/``get_results``/``stop``/
+``join``):
+
+- :class:`~petastorm_tpu.workers_pool.thread_pool.ThreadPool` — N threads,
+  best when the hot work releases the GIL (pyarrow Parquet decode, cv2);
+- :class:`~petastorm_tpu.workers_pool.process_pool.ProcessPool` — separate
+  Python processes over zmq PUSH/PULL/PUB, sidesteps the GIL for pure-Python
+  decode;
+- :class:`~petastorm_tpu.workers_pool.dummy_pool.DummyPool` — synchronous,
+  deterministic, for tests/debug.
+
+On a TPU host the pool feeds the JAX staging layer
+(``petastorm_tpu/jax_utils/loader.py``); all pool traffic is host-local —
+cross-host scaling is by row-group sharding, never data-plane messaging
+(SURVEY.md §5).
+"""
+
+DEFAULT_TIMEOUT_S = 60
+
+
+class EmptyResultError(Exception):
+    """All ventilated items were processed and every result was consumed."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    """``get_results`` waited longer than the configured timeout."""
+
+
+class VentilatedItemProcessedMessage:
+    """Control marker a worker emits after finishing one ventilated item."""

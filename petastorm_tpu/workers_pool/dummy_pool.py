@@ -1,0 +1,68 @@
+"""Synchronous in-caller-thread pool — determinism for tests and debugging.
+
+Reference parity: ``petastorm/workers_pool/dummy_pool.py::DummyPool``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from petastorm_tpu.workers_pool import EmptyResultError
+from petastorm_tpu.workers_pool.thread_pool import WorkerException
+
+
+class DummyPool:
+    """Processes each ventilated item synchronously inside :meth:`ventilate`."""
+
+    def __init__(self, workers_count=1, results_queue_size=None):
+        self._results = deque()
+        self._worker = None
+        self._ventilator = None
+        self._stopped = False
+        self.workers_count = workers_count
+        self.diagnostics = {}
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        self._worker = worker_class(0, self._results.append, worker_setup_args)
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        import sys
+        import traceback
+
+        try:
+            self._worker.process(*args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 - forwarded to the consumer
+            tb = "".join(traceback.format_exception(*sys.exc_info()))
+            self._results.append(WorkerException(exc, tb))
+        finally:
+            if self._ventilator is not None:
+                self._ventilator.processed_item()
+
+    def get_results(self, timeout=None):
+        # The concurrent ventilator (if any) runs on its own thread and calls
+        # back into ventilate(); wait for it to either produce or complete.
+        while True:
+            if self._results:
+                result = self._results.popleft()
+                if isinstance(result, WorkerException):
+                    raise result
+                return result
+            if self._stopped or self._ventilator is None or self._ventilator.completed():
+                raise EmptyResultError()
+            time.sleep(0.001)
+
+    def results_qsize(self):
+        return len(self._results)
+
+    def stop(self):
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+
+    def join(self):
+        if self._worker is not None:
+            self._worker.shutdown()

@@ -1,0 +1,65 @@
+"""Boot a function in a brand-new Python process (not a fork).
+
+Reference parity: ``petastorm/workers_pool/exec_in_new_process.py``. A fresh
+interpreter avoids fork-safety hazards (pyarrow/JAX/TPU runtime state does not
+survive forks well), exactly why the reference did the same.
+
+Usage: ``exec_in_new_process(func, *args, **kwargs)`` pickles
+``(func, args, kwargs)`` to a temp file and launches
+``python -m petastorm_tpu.workers_pool.exec_in_new_process <file>``; the child
+unpickles and calls ``func``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+
+def exec_in_new_process(func, *args, **kwargs):
+    """Launch ``func(*args, **kwargs)`` in a new interpreter. Returns the Popen.
+
+    Payloads are written with cloudpickle so classes/functions defined in the
+    caller's ``__main__`` script serialize by value (plain pickle would emit a
+    dangling ``__main__.X`` reference the child cannot resolve); the child
+    loads them with the stdlib unpickler.
+    """
+    import cloudpickle
+
+    fd, payload_path = tempfile.mkstemp(prefix="petastorm_tpu_spawn_", suffix=".pkl")
+    with os.fdopen(fd, "wb") as f:
+        cloudpickle.dump((func, args, kwargs), f, protocol=pickle.HIGHEST_PROTOCOL)
+    env = dict(os.environ)
+    # Child workers must resolve the same modules the parent can (including
+    # the package itself and any caller module that defined the pickled
+    # worker class): propagate the parent's full sys.path.
+    parent_paths = [p for p in sys.path if p]
+    existing = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    merged = parent_paths + [p for p in existing if p not in parent_paths]
+    env["PYTHONPATH"] = os.pathsep.join(merged)
+    # Data workers must never grab the TPU: a second process initializing the
+    # TPU runtime would deadlock against the training process holding it.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "petastorm_tpu.workers_pool.exec_in_new_process",
+         payload_path],
+        env=env,
+    )
+
+
+def _main():
+    payload_path = sys.argv[1]
+    with open(payload_path, "rb") as f:
+        func, args, kwargs = pickle.load(f)
+    try:
+        os.unlink(payload_path)
+    except OSError:  # pragma: no cover
+        pass
+    func(*args, **kwargs)
+
+
+if __name__ == "__main__":
+    _main()

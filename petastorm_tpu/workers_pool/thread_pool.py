@@ -1,0 +1,140 @@
+"""Thread-based worker pool.
+
+Reference parity: ``petastorm/workers_pool/thread_pool.py::ThreadPool``.
+The default pool: pyarrow Parquet decode and cv2 release the GIL, so threads
+give real parallelism for the hot loops (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import traceback
+
+from petastorm_tpu.workers_pool import (
+    DEFAULT_TIMEOUT_S,
+    EmptyResultError,
+    TimeoutWaitingForResultError,
+    VentilatedItemProcessedMessage,
+)
+from petastorm_tpu.workers_pool.worker_base import EOFSentinel
+
+
+class WorkerException(Exception):
+    """Wraps an exception raised inside a worker, carrying its traceback."""
+
+    def __init__(self, exc, formatted_traceback):
+        self.exc = exc
+        self.formatted_traceback = formatted_traceback
+        super().__init__(f"Worker raised {exc!r}\n{formatted_traceback}")
+
+
+class ThreadPool:
+    def __init__(self, workers_count, results_queue_size=50, profiling_enabled=False):
+        self._workers_count = workers_count
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._ventilator_queue = queue.Queue()
+        self._threads = []
+        self._workers = []
+        self._ventilator = None
+        self._stop_event = threading.Event()
+        self._ventilated_items = 0
+        self._completed_items = 0
+        self._counter_lock = threading.Lock()
+        self.diagnostics = {}
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        if self._threads:
+            raise RuntimeError("ThreadPool already started")
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._results_queue.put, worker_setup_args)
+            self._workers.append(worker)
+            thread = threading.Thread(
+                target=self._worker_loop, args=(worker,), daemon=True,
+                name=f"petastorm-tpu-worker-{worker_id}",
+            )
+            self._threads.append(thread)
+            thread.start()
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def _worker_loop(self, worker):
+        while not self._stop_event.is_set():
+            try:
+                item = self._ventilator_queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if isinstance(item, EOFSentinel):
+                break
+            args, kwargs = item
+            try:
+                worker.process(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 - forwarded to the consumer
+                tb = "".join(traceback.format_exception(*sys.exc_info()))
+                self._results_queue.put(WorkerException(exc, tb))
+            finally:
+                # Count failed items as processed too — otherwise the
+                # ventilator's in-flight window leaks and the pool deadlocks.
+                self._results_queue.put(VentilatedItemProcessedMessage())
+
+    def ventilate(self, *args, **kwargs):
+        with self._counter_lock:
+            self._ventilated_items += 1
+        self._ventilator_queue.put((args, kwargs))
+
+    def get_results(self, timeout=DEFAULT_TIMEOUT_S):
+        """Return the next published payload.
+
+        Raises :class:`EmptyResultError` when ventilation is finished and all
+        results have been consumed; re-raises worker exceptions.
+        """
+        while True:
+            if self._results_queue.empty() and self._all_done():
+                raise EmptyResultError()
+            try:
+                result = self._results_queue.get(timeout=timeout)
+            except queue.Empty:
+                if self._all_done():
+                    raise EmptyResultError() from None
+                raise TimeoutWaitingForResultError(
+                    f"No results for {timeout}s; "
+                    f"ventilated={self._ventilated_items} completed={self._completed_items}"
+                ) from None
+            if isinstance(result, VentilatedItemProcessedMessage):
+                with self._counter_lock:
+                    self._completed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(result, WorkerException):
+                raise result
+            return result
+
+    def _all_done(self):
+        with self._counter_lock:
+            counts_settled = self._ventilated_items == self._completed_items
+        ventilation_over = self._ventilator is None or self._ventilator.completed()
+        return counts_settled and ventilation_over and self._ventilator_queue.empty()
+
+    def results_qsize(self):
+        return self._results_queue.qsize()
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+        for _ in self._threads:
+            self._ventilator_queue.put(EOFSentinel())
+
+    def join(self):
+        for thread in self._threads:
+            thread.join(timeout=30)
+        for worker in self._workers:
+            worker.shutdown()
+        self._threads = []

@@ -1,0 +1,137 @@
+"""Work ventilation: drip-feeding items to a pool with bounded in-flight count.
+
+Reference parity: ``petastorm/workers_pool/ventilator.py`` (``Ventilator``,
+``ConcurrentVentilator``) — SURVEY.md §2.2. The ventilator is the memory
+backpressure mechanism: without it, every row group of every epoch would be
+enqueued at once.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from abc import ABC, abstractmethod
+
+
+class Ventilator(ABC):
+    """Base ventilator: feeds work items to a pool via ``ventilate_fn``."""
+
+    def __init__(self, ventilate_fn):
+        self._ventilate_fn = ventilate_fn
+
+    @abstractmethod
+    def start(self):
+        """Begin ventilation (typically on a background thread)."""
+
+    @abstractmethod
+    def processed_item(self):
+        """Notify that one ventilated item finished (advances the window)."""
+
+    @abstractmethod
+    def completed(self):
+        """True when no further items will ever be ventilated."""
+
+    @abstractmethod
+    def stop(self):
+        """Stop ventilation and release the background thread."""
+
+
+class ConcurrentVentilator(Ventilator):
+    """Ventilates ``items_to_ventilate`` for ``iterations`` epochs on a
+    background thread, keeping at most ``max_ventilation_queue_size`` items
+    in flight.
+
+    ``iterations=None`` ventilates forever (infinite epochs).
+    ``randomize_item_order`` reshuffles the item order every epoch.
+    Items are dicts passed as kwargs to ``ventilate_fn`` (reference semantics).
+    """
+
+    def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
+                 randomize_item_order=False, random_seed=None,
+                 max_ventilation_queue_size=None, ventilation_interval=0.01):
+        super().__init__(ventilate_fn)
+        if iterations is not None and iterations <= 0:
+            raise ValueError(f"iterations must be positive or None, got {iterations}")
+        self._items_to_ventilate = list(items_to_ventilate)
+        self._iterations = iterations
+        self._randomize_item_order = randomize_item_order
+        self._random = random.Random(random_seed)
+        self._max_ventilation_queue_size = (
+            max_ventilation_queue_size
+            if max_ventilation_queue_size is not None
+            else len(self._items_to_ventilate) or 1
+        )
+        self._ventilation_interval = ventilation_interval
+
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._space_available = threading.Condition(self._lock)
+        self._stop_requested = False
+        self._completed = False
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("Ventilator already started")
+        if not self._items_to_ventilate:
+            self._completed = True
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="petastorm-tpu-ventilator")
+        self._thread.start()
+
+    def _run(self):
+        iterations_left = self._iterations
+        while iterations_left is None or iterations_left > 0:
+            items = list(self._items_to_ventilate)
+            if self._randomize_item_order:
+                self._random.shuffle(items)
+            for item in items:
+                with self._space_available:
+                    while (self._in_flight >= self._max_ventilation_queue_size
+                           and not self._stop_requested):
+                        self._space_available.wait(self._ventilation_interval)
+                    if self._stop_requested:
+                        self._completed = True
+                        return
+                    self._in_flight += 1
+                self._ventilate_fn(**item)
+            if iterations_left is not None:
+                iterations_left -= 1
+            if self._stop_requested:
+                break
+        self._completed = True
+
+    def processed_item(self):
+        with self._space_available:
+            if self._in_flight > 0:
+                self._in_flight -= 1
+            self._space_available.notify()
+
+    def completed(self):
+        # Completed only when the thread finished ventilating every item of
+        # every epoch; in-flight items may still be in the pool's queues.
+        return self._completed
+
+    def stop(self):
+        with self._space_available:
+            self._stop_requested = True
+            self._space_available.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def reset(self):
+        """Restart ventilation from epoch 0 (only when previous run finished).
+
+        Supports ``Reader.reset()``: re-ventilates the same items for the
+        original number of iterations.
+        """
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("Cannot reset a ventilator that is still running")
+        self._thread = None
+        self._stop_requested = False
+        self._completed = False
+        with self._lock:
+            self._in_flight = 0
+        self.start()

@@ -1,0 +1,29 @@
+"""Worker contract.
+
+Reference parity: ``petastorm/workers_pool/worker_base.py::WorkerBase``.
+"""
+
+from __future__ import annotations
+
+
+class WorkerBase:
+    """A pool worker. Subclasses implement :meth:`process`; results are
+    emitted via ``publish_func`` (possibly several per ventilated item)."""
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self.publish_func = publish_func
+        self.args = args
+
+    def process(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def shutdown(self):
+        """Called once when the pool stops this worker (optional cleanup)."""
+
+    def publish_func(self, data):  # pragma: no cover - replaced in __init__
+        raise NotImplementedError
+
+
+class EOFSentinel:
+    """Internal end-of-work marker placed on worker input queues."""

@@ -230,6 +230,49 @@ def test_restricted_unpickler_refuses_arbitrary_classes():
         unischema_from_reference_pickle(evil)
 
 
+def test_restricted_unpickler_refuses_numpy_gadgets():
+    """np.save/np.load etc. must NOT be reachable through the unpickler."""
+    from petastorm_tpu.etl.metadata import _RestrictedUnpickler
+
+    up = _RestrictedUnpickler(io.BytesIO(b""))
+    for gadget in ("save", "savetxt", "load", "fromfile", "frombuffer"):
+        with pytest.raises(pickle.UnpicklingError, match="refusing"):
+            up.find_class("numpy", gadget)
+    assert up.find_class("numpy", "dtype") is np.dtype  # machinery still allowed
+
+
+def test_scalar_codec_decimal_and_tz_arrow_types_roundtrip():
+    import pyarrow as pa
+
+    schema = Unischema("D", [
+        UnischemaField("d", __import__("decimal").Decimal, (),
+                       ScalarCodec(pa.decimal128(38, 18)), False),
+        UnischemaField("t", np.dtype("datetime64[us]"), (),
+                       ScalarCodec(pa.timestamp("us", tz="UTC")), False),
+    ])
+    restored = unischema_from_json(unischema_to_json(schema))
+    assert restored.fields["d"].codec.arrow_dtype() == pa.decimal128(38, 18)
+    assert restored.fields["t"].codec.arrow_dtype() == pa.timestamp("us", tz="UTC")
+
+
+def test_write_rows_streams_generator(tmp_path):
+    """write_rows must accept a pure generator without materializing it."""
+    schema = Unischema("G", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+    ])
+
+    def gen():
+        for i in range(1000):
+            yield {"id": i}
+
+    url = f"file://{tmp_path}/gen"
+    write_rows(url, schema, gen(), rows_per_row_group=128)
+    fs = pafs.LocalFileSystem()
+    pieces = load_row_groups(fs, str(tmp_path / "gen"))
+    assert sum(p.num_rows for p in pieces) == 1000
+    assert len(pieces) == 8  # ceil(1000/128)
+
+
 def test_row_group_size_mb_controls_groups(tmp_path):
     url = f"file://{tmp_path}/sized"
     schema = _toy_schema()

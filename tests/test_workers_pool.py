@@ -1,0 +1,309 @@
+"""Execution-engine tests: pools × ventilator, crash + shutdown paths.
+
+Modeled on the reference's ``workers_pool/tests/`` suites (SURVEY.md §4).
+"""
+
+import subprocess
+import time
+
+import pytest
+
+from petastorm_tpu.workers_pool import EmptyResultError, TimeoutWaitingForResultError
+from petastorm_tpu.workers_pool.dummy_pool import DummyPool
+from petastorm_tpu.workers_pool.exec_in_new_process import exec_in_new_process
+from petastorm_tpu.workers_pool.process_pool import ProcessPool
+from petastorm_tpu.workers_pool.thread_pool import ThreadPool, WorkerException
+from petastorm_tpu.workers_pool.ventilator import ConcurrentVentilator
+from petastorm_tpu.workers_pool.worker_base import WorkerBase
+from petastorm_tpu.reader_impl.arrow_table_serializer import ArrowTableSerializer
+from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+
+
+class SquareWorker(WorkerBase):
+    def process(self, value):
+        self.publish_func(value * value)
+
+
+class MultiPublishWorker(WorkerBase):
+    def process(self, value):
+        for i in range(3):
+            self.publish_func((value, i))
+
+
+class FailingWorker(WorkerBase):
+    def process(self, value):
+        if value == 13:
+            raise ValueError("unlucky value")
+        self.publish_func(value)
+
+
+class ArrowWorker(WorkerBase):
+    def process(self, n):
+        import pyarrow as pa
+
+        self.publish_func(pa.table({"x": list(range(n))}))
+
+
+def _drain(pool):
+    results = []
+    while True:
+        try:
+            results.append(pool.get_results(timeout=20))
+        except EmptyResultError:
+            return results
+
+
+def _make_pool(kind, workers=3, **kwargs):
+    if kind == "thread":
+        return ThreadPool(workers, **kwargs)
+    if kind == "process":
+        return ProcessPool(workers, **kwargs)
+    return DummyPool()
+
+
+POOL_KINDS = ["thread", "dummy", "process"]
+
+
+@pytest.mark.parametrize("pool_kind", POOL_KINDS)
+def test_pool_roundtrip(pool_kind):
+    pool = _make_pool(pool_kind)
+    pool.start(SquareWorker)
+    for v in range(10):
+        pool.ventilate(v)
+    # without a ventilator the pool can't know ventilation is over; collect
+    # exactly the expected count then stop
+    results = [pool.get_results(timeout=20) for _ in range(10)]
+    assert sorted(results) == [v * v for v in range(10)]
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize("pool_kind", POOL_KINDS)
+def test_pool_with_ventilator_epochs(pool_kind):
+    pool = _make_pool(pool_kind)
+    items = [{"value": v} for v in range(5)]
+    ventilator = ConcurrentVentilator(pool.ventilate, items, iterations=3)
+    pool.start(SquareWorker, ventilator=ventilator)
+    results = _drain(pool)
+    assert sorted(results) == sorted([v * v for v in range(5)] * 3)
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize("pool_kind", POOL_KINDS)
+def test_pool_multiple_publishes_per_item(pool_kind):
+    pool = _make_pool(pool_kind)
+    items = [{"value": v} for v in range(4)]
+    ventilator = ConcurrentVentilator(pool.ventilate, items, iterations=1)
+    pool.start(MultiPublishWorker, ventilator=ventilator)
+    results = _drain(pool)
+    assert len(results) == 12
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize("pool_kind", ["thread", "dummy", "process"])
+def test_worker_exception_propagates(pool_kind):
+    pool = _make_pool(pool_kind)
+    items = [{"value": v} for v in [1, 13, 2]]
+    ventilator = ConcurrentVentilator(pool.ventilate, items, iterations=1)
+    pool.start(FailingWorker, ventilator=ventilator)
+    with pytest.raises(WorkerException, match="unlucky"):
+        for _ in range(10):
+            pool.get_results(timeout=20)
+    pool.stop()
+    pool.join()
+
+
+@pytest.mark.parametrize("pool_kind", POOL_KINDS)
+def test_worker_exception_does_not_stall_ventilation_window(pool_kind):
+    """A failing item must still advance the in-flight window (deadlock fix)."""
+    pool = _make_pool(pool_kind)
+    items = [{"value": v} for v in [1, 13, 2, 3]]
+    ventilator = ConcurrentVentilator(pool.ventilate, items, iterations=1,
+                                      max_ventilation_queue_size=1)
+    pool.start(FailingWorker, ventilator=ventilator)
+    results = []
+    exceptions = 0
+    while True:
+        try:
+            results.append(pool.get_results(timeout=20))
+        except WorkerException:
+            exceptions += 1
+        except EmptyResultError:
+            break
+    assert exceptions == 1
+    assert sorted(results) == [1, 2, 3]  # items after the failure still flow
+    pool.stop()
+    pool.join()
+
+
+def test_process_pool_arrow_serializer():
+    pool = ProcessPool(2, serializer=ArrowTableSerializer())
+    ventilator = ConcurrentVentilator(pool.ventilate, [{"n": 4}, {"n": 7}], iterations=1)
+    pool.start(ArrowWorker, ventilator=ventilator)
+    tables = _drain(pool)
+    assert sorted(t.num_rows for t in tables) == [4, 7]
+    pool.stop()
+    pool.join()
+
+
+def test_process_pool_no_orphans():
+    pool = ProcessPool(2)
+    ventilator = ConcurrentVentilator(pool.ventilate, [{"value": 1}], iterations=1)
+    pool.start(SquareWorker, ventilator=ventilator)
+    _drain(pool)
+    pids = [p.pid for p in pool._processes]
+    pool.stop()
+    pool.join()
+    for pid in pids:
+        # after join, no child with that pid should remain running
+        alive = subprocess.run(["kill", "-0", str(pid)], capture_output=True)
+        assert alive.returncode != 0, f"worker {pid} orphaned"
+
+
+def test_process_pool_backpressure_shutdown():
+    """Workers blocked publishing into a tiny results HWM must still exit."""
+    pool = ProcessPool(2, results_queue_size=1)
+    items = [{"value": v} for v in range(50)]
+    ventilator = ConcurrentVentilator(pool.ventilate, items, iterations=1)
+    pool.start(MultiPublishWorker, ventilator=ventilator)
+    # consume only a couple results, then stop mid-stream
+    pool.get_results(timeout=20)
+    pool.get_results(timeout=20)
+    pool.stop()
+    pool.join()
+    assert all(p.poll() is not None for p in pool._processes)
+
+
+def test_ventilator_backpressure_caps_inflight():
+    seen = []
+
+    class Recorder:
+        def ventilate(self, **item):
+            seen.append(item)
+
+    recorder = Recorder()
+    ventilator = ConcurrentVentilator(recorder.ventilate,
+                                      [{"i": i} for i in range(100)],
+                                      iterations=1, max_ventilation_queue_size=5)
+    ventilator.start()
+    time.sleep(0.2)
+    assert len(seen) <= 5  # window stuck: nothing marked processed yet
+    for _ in range(100):
+        ventilator.processed_item()
+    deadline = time.monotonic() + 5
+    while not ventilator.completed() and time.monotonic() < deadline:
+        ventilator.processed_item()
+        time.sleep(0.001)
+    assert ventilator.completed()
+    assert len(seen) == 100
+    ventilator.stop()
+
+
+def test_ventilator_randomize_order_changes_epochs():
+    epochs = []
+    current = []
+
+    def record(i):
+        current.append(i)
+
+    items = [{"i": i} for i in range(50)]
+    ventilator = ConcurrentVentilator(record, items, iterations=2,
+                                      randomize_item_order=True, random_seed=5,
+                                      max_ventilation_queue_size=1000)
+    ventilator.start()
+    deadline = time.monotonic() + 5
+    while not ventilator.completed() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(current) == 100
+    first, second = current[:50], current[50:]
+    assert sorted(first) == sorted(second) == list(range(50))
+    assert first != second  # shuffled differently across epochs
+    ventilator.stop()
+
+
+def test_ventilator_infinite_iterations_and_stop():
+    count = [0]
+
+    def bump(i):
+        count[0] += 1
+
+    ventilator = ConcurrentVentilator(bump, [{"i": 0}], iterations=None,
+                                      max_ventilation_queue_size=1000)
+    ventilator.start()
+    time.sleep(0.1)
+    assert not ventilator.completed()
+    ventilator.stop()
+    assert count[0] > 0
+
+
+def test_ventilator_reset_reruns_items():
+    collected = []
+    ventilator = ConcurrentVentilator(lambda i: collected.append(i),
+                                      [{"i": i} for i in range(3)], iterations=1)
+    ventilator.start()
+    deadline = time.monotonic() + 5
+    while not ventilator.completed() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert sorted(collected) == [0, 1, 2]
+    ventilator.reset()
+    deadline = time.monotonic() + 5
+    while not ventilator.completed() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert sorted(collected) == [0, 0, 1, 1, 2, 2]
+    ventilator.stop()
+
+
+class SlowWorker(WorkerBase):
+    def process(self, value):
+        time.sleep(1.0)
+        self.publish_func(value)
+
+
+def test_thread_pool_timeout_and_empty():
+    pool = ThreadPool(1)
+    pool.start(SquareWorker)
+    # nothing ventilated, no ventilator: the pool is legitimately empty
+    with pytest.raises(EmptyResultError):
+        pool.get_results(timeout=0.2)
+    pool.stop()
+    pool.join()
+
+    slow = ThreadPool(1)
+    slow.start(SlowWorker)
+    slow.ventilate(1)
+    with pytest.raises(TimeoutWaitingForResultError):
+        slow.get_results(timeout=0.2)
+    assert slow.get_results(timeout=20) == 1  # eventually lands
+    slow.stop()
+    slow.join()
+
+
+def test_exec_in_new_process_runs_function(tmp_path):
+    marker = tmp_path / "touched.txt"
+    process = exec_in_new_process(_touch_file, str(marker), text="hello")
+    process.wait(timeout=30)
+    assert process.returncode == 0
+    assert marker.read_text() == "hello"
+
+
+def _touch_file(path, text=""):
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def test_serializers_roundtrip():
+    import numpy as np
+    import pyarrow as pa
+
+    rows = [{"a": np.arange(5), "b": "text"}]
+    ps = PickleSerializer()
+    restored = ps.deserialize(ps.serialize(rows))
+    assert restored[0]["b"] == "text"
+    assert np.array_equal(restored[0]["a"], np.arange(5))
+
+    table = pa.table({"x": [1.5, 2.5], "y": ["u", "v"]})
+    ats = ArrowTableSerializer()
+    restored_table = ats.deserialize(ats.serialize(table))
+    assert restored_table.equals(table)
