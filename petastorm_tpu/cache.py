@@ -1,0 +1,25 @@
+"""Row-group result cache interface.
+
+Reference parity: ``petastorm/cache.py`` (``CacheBase``, ``NullCache``).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class CacheBase(ABC):
+    @abstractmethod
+    def get(self, key, fill_cache_func):
+        """Return the cached value for ``key``, computing and storing it via
+        ``fill_cache_func()`` on a miss."""
+
+    def cleanup(self):
+        """Release resources (optional)."""
+
+
+class NullCache(CacheBase):
+    """No caching: always recompute (the default)."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()

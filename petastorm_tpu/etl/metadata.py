@@ -512,8 +512,8 @@ def write_rows(dataset_url, schema, rows, row_group_size_mb=None,
     row-group-sized batches, so memory stays O(row group), not O(dataset).
     Row-group sizing: ``rows_per_row_group`` wins; else ``row_group_size_mb``
     is converted to a row count by probing the first encoded batch; else a
-    default of {default_rows} rows per group.
-    """.format(default_rows=_DEFAULT_ROWS_PER_ROW_GROUP)
+    default of ``_DEFAULT_ROWS_PER_ROW_GROUP`` (4096) rows per group.
+    """
     from itertools import islice
 
     resolver = FilesystemResolver(dataset_url, storage_options=storage_options,

@@ -1,0 +1,42 @@
+"""Local-disk cache for ``pa.Table`` payloads (batch-reader variant).
+
+Reference parity: ``petastorm/local_disk_arrow_table_cache.py``. Tables are
+stored as Arrow IPC files (columnar, memory-mappable) rather than pickles.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+
+
+class LocalDiskArrowTableCache(LocalDiskCache):
+    def _serialize(self, value):
+        if not isinstance(value, pa.Table):
+            raise ValueError(
+                f"LocalDiskArrowTableCache stores pa.Table, got {type(value)}"
+            )
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, value.schema) as writer:
+            writer.write_table(value)
+        return sink.getvalue().to_pybytes()
+
+    def _deserialize(self, payload):
+        with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+            return reader.read_all()
+
+    def get(self, key, fill_cache_func):
+        file_path = self._key_path(key)
+        import os
+
+        try:
+            with open(file_path, "rb") as f:
+                value = self._deserialize(f.read())
+            os.utime(file_path)
+            return value
+        except (OSError, pa.ArrowInvalid):
+            pass
+        value = fill_cache_func()
+        self._store(file_path, self._serialize(value))
+        return value

@@ -1,0 +1,110 @@
+"""Local-disk row-group cache with size-based LRU eviction.
+
+Reference parity: ``petastorm/local_disk_cache.py::LocalDiskCache``. The
+reference delegates storage to the third-party ``diskcache`` package; that is
+absent in this environment (SURVEY.md §7 preamble), so the store is
+self-written: one file per key (sha256-named), LRU eviction by access time
+when the directory exceeds ``size_limit``. Concurrent readers on one host are
+safe: writes go through a temp file + atomic rename, and eviction tolerates
+concurrently-deleted files.
+
+Repeated-epoch accelerator: on a TPU pod reading from GCS, epoch 2+ hits
+local NVMe instead of the network.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+
+
+class LocalDiskCache:
+    def __init__(self, path, size_limit, expected_row_size_estimate=None,
+                 shards=None, cleanup=False, **settings):
+        """``size_limit`` in bytes; ``expected_row_size_estimate`` kept for
+        reference API parity (unused — eviction is measured, not estimated)."""
+        self._path = path
+        self._size_limit = size_limit
+        self._cleanup_on_exit = cleanup
+        os.makedirs(path, exist_ok=True)
+
+    def _key_path(self, key):
+        digest = hashlib.sha256(repr(key).encode("utf-8")).hexdigest()
+        return os.path.join(self._path, digest + ".cache")
+
+    def get(self, key, fill_cache_func):
+        file_path = self._key_path(key)
+        try:
+            with open(file_path, "rb") as f:
+                value = pickle.load(f)  # noqa: S301 - our own cache files
+            os.utime(file_path)  # LRU touch
+            return value
+        except (OSError, pickle.PickleError, EOFError):
+            pass
+        value = fill_cache_func()
+        self._store(file_path, self._serialize(value))
+        return value
+
+    def _serialize(self, value):
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _deserialize(self, payload):
+        return pickle.loads(payload)  # noqa: S301
+
+    def _store(self, file_path, payload):
+        fd, tmp_path = tempfile.mkstemp(dir=self._path, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp_path, file_path)
+        except OSError:  # pragma: no cover - disk full etc.; cache is best-effort
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            return
+        self._evict_if_needed()
+
+    def _evict_if_needed(self):
+        entries = []
+        total = 0
+        try:
+            names = os.listdir(self._path)
+        except OSError:  # pragma: no cover
+            return
+        for name in names:
+            if not name.endswith(".cache"):
+                continue
+            full = os.path.join(self._path, name)
+            try:
+                stat = os.stat(full)
+            except OSError:
+                continue
+            entries.append((stat.st_atime, stat.st_size, full))
+            total += stat.st_size
+        if total <= self._size_limit:
+            return
+        entries.sort()  # oldest access first
+        for _, size, full in entries:
+            if total <= self._size_limit:
+                break
+            try:
+                os.unlink(full)
+                total -= size
+            except OSError:
+                continue
+
+    def size_on_disk(self):
+        return sum(
+            os.stat(os.path.join(self._path, n)).st_size
+            for n in os.listdir(self._path) if n.endswith(".cache")
+        )
+
+    def cleanup(self):
+        if not self._cleanup_on_exit:
+            return
+        import shutil
+
+        shutil.rmtree(self._path, ignore_errors=True)

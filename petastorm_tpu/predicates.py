@@ -1,0 +1,137 @@
+"""Row-level predicates, evaluated worker-side before full decode.
+
+Reference parity: ``petastorm/predicates.py`` (``PredicateBase``, ``in_set``,
+``in_lambda``, ``in_negate``, ``in_reduce``, ``in_pseudorandom_split``) —
+SURVEY.md §2.1. Predicates declare the minimal column subset they need
+(:meth:`PredicateBase.get_fields`); the reader worker does a two-phase read
+(predicate columns → boolean mask → remaining columns for surviving rows), so
+a selective predicate skips most of the expensive decode work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from abc import ABC, abstractmethod
+
+
+class PredicateBase(ABC):
+    """A row filter: which fields it needs + per-row inclusion decision."""
+
+    @abstractmethod
+    def get_fields(self):
+        """Set of field names :meth:`do_include` reads."""
+
+    @abstractmethod
+    def do_include(self, values):
+        """``values`` maps each field from :meth:`get_fields` to the row's
+        value; return True to keep the row."""
+
+
+class in_set(PredicateBase):
+    """Keep rows whose ``predicate_field`` value is in ``inclusion_values``."""
+
+    def __init__(self, inclusion_values, predicate_field):
+        self._inclusion_values = set(inclusion_values)
+        self._predicate_field = predicate_field
+
+    def get_fields(self):
+        return {self._predicate_field}
+
+    def do_include(self, values):
+        return values[self._predicate_field] in self._inclusion_values
+
+
+class in_lambda(PredicateBase):
+    """Keep rows for which ``predicate_func(values [, state])`` is truthy."""
+
+    def __init__(self, predicate_fields, predicate_func, state_arg=None):
+        if not isinstance(predicate_fields, (list, tuple, set)):
+            raise ValueError("predicate_fields must be a list/tuple/set of names")
+        self._predicate_fields = set(predicate_fields)
+        self._predicate_func = predicate_func
+        self._state_arg = state_arg
+
+    def get_fields(self):
+        return set(self._predicate_fields)
+
+    def do_include(self, values):
+        if self._state_arg is not None:
+            return self._predicate_func(values, self._state_arg)
+        return self._predicate_func(values)
+
+
+class in_negate(PredicateBase):
+    """Logical NOT of another predicate."""
+
+    def __init__(self, predicate):
+        self._predicate = predicate
+
+    def get_fields(self):
+        return self._predicate.get_fields()
+
+    def do_include(self, values):
+        return not self._predicate.do_include(values)
+
+
+class in_reduce(PredicateBase):
+    """Combine several predicates with a reduction (``all``/``any``-style).
+
+    ``reduce_func`` receives the list of per-predicate booleans.
+    """
+
+    def __init__(self, predicate_list, reduce_func):
+        self._predicate_list = list(predicate_list)
+        self._reduce_func = reduce_func
+
+    def get_fields(self):
+        fields = set()
+        for predicate in self._predicate_list:
+            fields |= predicate.get_fields()
+        return fields
+
+    def do_include(self, values):
+        return self._reduce_func(
+            [p.do_include(values) for p in self._predicate_list]
+        )
+
+
+class in_pseudorandom_split(PredicateBase):
+    """Deterministic hash-of-field train/val/test splitting.
+
+    ``fraction_list`` partitions [0, 1); a row belongs to subset ``i`` when
+    the normalized md5 hash of its ``predicate_field`` value falls in the
+    ``i``-th interval. The same value always lands in the same subset, on any
+    host — which is what makes the split usable across a TPU pod with no
+    coordination (reference parity: ``petastorm/predicates.py``).
+    """
+
+    def __init__(self, fraction_list, subset_index, predicate_field):
+        if not 0 <= subset_index < len(fraction_list):
+            raise ValueError(
+                f"subset_index {subset_index} out of range for "
+                f"{len(fraction_list)} fractions"
+            )
+        if sum(fraction_list) > 1.0 + 1e-9:
+            raise ValueError(f"fractions sum to {sum(fraction_list)} > 1")
+        self._fraction_list = list(fraction_list)
+        self._subset_index = subset_index
+        self._predicate_field = predicate_field
+
+    def get_fields(self):
+        return {self._predicate_field}
+
+    def do_include(self, values):
+        value = values[self._predicate_field]
+        position = _hash_to_unit_interval(value)
+        low = sum(self._fraction_list[: self._subset_index])
+        high = low + self._fraction_list[self._subset_index]
+        return low <= position < high
+
+
+def _hash_to_unit_interval(value):
+    if isinstance(value, bytes):
+        data = value
+    else:
+        data = str(value).encode("utf-8")
+    digest = hashlib.md5(data).hexdigest()  # noqa: S324 - splitting, not security
+    return int(digest, 16) / float(1 << 128)

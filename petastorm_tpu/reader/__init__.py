@@ -1,0 +1,11 @@
+"""Reader core: the parallel, shuffling, shardable Parquet row-group reader.
+
+Reference parity: ``petastorm/reader.py`` + the two worker modules —
+SURVEY.md §2.1, call stacks §3.1/3.2.
+"""
+
+from petastorm_tpu.reader.reader import (  # noqa: F401
+    Reader,
+    make_batch_reader,
+    make_reader,
+)

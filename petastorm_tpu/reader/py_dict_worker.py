@@ -1,0 +1,159 @@
+"""Row-decoding worker: one row group → decoded row dicts (or NGram windows).
+
+Reference parity: ``petastorm/py_dict_reader_worker.py`` (``PyDictReaderWorker``,
+``PyDictReaderWorkerResultsQueueReader``) — SURVEY.md §2.1, hot path §3.2.
+
+Per ventilated item the worker: reads the row group's needed columns (two-phase
+when a predicate is present: predicate columns → boolean mask → remaining
+columns for surviving rows), applies ``shuffle_row_drop_partitions``
+subsampling, decodes codecs per row (``decode_row`` — the cv2/np.load hot
+loop), assembles NGram windows, applies the TransformSpec, and publishes the
+row list. The pyarrow column read and cv2 decode both release the GIL, which
+is what makes the thread pool effective here.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from petastorm_tpu.schema.transform import transform_schema
+from petastorm_tpu.utils import decode_row
+from petastorm_tpu.workers_pool.worker_base import WorkerBase
+
+
+class PyDictReaderWorker(WorkerBase):
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        (self._filesystem, self._pieces, self._schema, self._read_schema,
+         self._ngram, self._cache, self._transform_spec) = args
+        # Schema the *consumer* sees (post-transform); field decode uses the
+        # pre-transform read schema.
+        self._result_schema = (
+            transform_schema(self._read_schema, self._transform_spec)
+            if self._transform_spec else self._read_schema
+        )
+
+    def process(self, piece_index, worker_predicate=None,
+                shuffle_row_drop_partition=(0, 1)):
+        piece = self._pieces[piece_index]
+        cache_key = self._cache_key(piece, worker_predicate,
+                                    shuffle_row_drop_partition)
+        rows = self._cache.get(
+            cache_key,
+            lambda: self._load_rows(piece, worker_predicate,
+                                    shuffle_row_drop_partition),
+        )
+        if rows:
+            self.publish_func(rows)
+
+    def _cache_key(self, piece, worker_predicate, shuffle_row_drop_partition):
+        fields = sorted(self._read_schema.fields)
+        return (piece.path, piece.row_group, repr(worker_predicate),
+                tuple(fields), shuffle_row_drop_partition)
+
+    def _load_rows(self, piece, worker_predicate, shuffle_row_drop_partition):
+        if worker_predicate is not None:
+            storage_rows = self._read_with_predicate(piece, worker_predicate)
+        else:
+            columns = self._needed_columns()
+            table = piece.read(self._filesystem, columns=columns)
+            storage_rows = table.to_pylist()
+
+        storage_rows = self._drop_partition(storage_rows,
+                                            shuffle_row_drop_partition)
+
+        decoded = [decode_row(row, self._read_schema) for row in storage_rows]
+
+        if self._ngram is not None:
+            windows = self._ngram.form_ngram(decoded, self._read_schema)
+            if self._transform_spec and self._transform_spec.func:
+                windows = [
+                    {offset: self._transform_spec.func(dict(ts_row))
+                     for offset, ts_row in window.items()}
+                    for window in windows
+                ]
+            return windows
+
+        if self._transform_spec:
+            decoded = [self._apply_transform(row) for row in decoded]
+        return decoded
+
+    def _needed_columns(self):
+        if self._ngram is not None:
+            return self._ngram.get_field_names_at_all_timesteps()
+        return sorted(self._read_schema.fields)
+
+    def _read_with_predicate(self, piece, predicate):
+        """Two-phase read: predicate columns first, the rest only for survivors."""
+        predicate_fields = sorted(predicate.get_fields())
+        unknown = [f for f in predicate_fields if f not in self._schema.fields]
+        if unknown:
+            raise ValueError(f"Predicate fields not in schema: {unknown}")
+        predicate_view = self._schema.create_schema_view(
+            [self._schema.fields[f] for f in predicate_fields]
+        )
+        predicate_table = piece.read(self._filesystem, columns=predicate_fields)
+        predicate_rows = predicate_table.to_pylist()
+        mask = []
+        for row in predicate_rows:
+            decoded = decode_row(row, predicate_view)
+            mask.append(bool(predicate.do_include(decoded)))
+        if not any(mask):
+            return []
+        other_columns = [c for c in self._needed_columns()
+                         if c not in predicate_fields]
+        if other_columns:
+            other_table = piece.read(self._filesystem, columns=other_columns)
+            other_rows = other_table.to_pylist()
+        else:
+            other_rows = [{} for _ in predicate_rows]
+        result = []
+        for keep, pred_row, other_row in zip(mask, predicate_rows, other_rows):
+            if not keep:
+                continue
+            merged = dict(other_row)
+            # keep only predicate fields that are also part of the read schema
+            for name in predicate_fields:
+                if name in self._read_schema.fields or (
+                        self._ngram is not None
+                        and name in self._ngram.get_field_names_at_all_timesteps()):
+                    merged[name] = pred_row[name]
+            result.append(merged)
+        return result
+
+    def _drop_partition(self, rows, shuffle_row_drop_partition):
+        this_partition, num_partitions = shuffle_row_drop_partition
+        if num_partitions <= 1:
+            return rows
+        return rows[this_partition::num_partitions]
+
+    def _apply_transform(self, row):
+        if self._transform_spec.func:
+            row = self._transform_spec.func(dict(row))
+        # enforce the post-transform field set
+        return {name: row[name] for name in self._result_schema.fields
+                if name in row}
+
+    @property
+    def result_schema(self):
+        return self._result_schema
+
+
+class PyDictResultsQueueReader:
+    """Consumer-side: turns published row lists into single namedtuple rows."""
+
+    def __init__(self):
+        self._buffer = deque()
+
+    @property
+    def batched_output(self):
+        return False
+
+    def read_next(self, pool, schema, ngram):
+        while not self._buffer:
+            rows = pool.get_results()  # raises EmptyResultError at end of data
+            self._buffer.extend(rows)
+        row = self._buffer.popleft()
+        if ngram is not None:
+            return ngram.make_namedtuple(schema, row)
+        return schema.make_namedtuple(**row)

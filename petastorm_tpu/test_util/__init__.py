@@ -1,0 +1,2 @@
+"""Test support shipped with the package (reference parity:
+``petastorm/tests/test_common.py`` + ``petastorm/test_util/``)."""

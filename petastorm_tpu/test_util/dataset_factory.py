@@ -1,0 +1,86 @@
+"""Synthetic dataset factory — the fixture nearly every behavioral test reads.
+
+Reference parity: ``petastorm/tests/test_common.py`` (``TestSchema``,
+``create_test_dataset``, ``create_test_scalar_dataset``) — SURVEY.md §2.7.
+Differences: materialization is pyarrow-native (no Spark) and the schema is
+arrow-typed.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.etl.metadata import materialize_rows, write_rows
+from petastorm_tpu.schema.codecs import (
+    CompressedImageCodec,
+    CompressedNdarrayCodec,
+    NdarrayCodec,
+    ScalarCodec,
+)
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema("TestSchema", [
+    UnischemaField("id", np.int64, (), ScalarCodec(), False),
+    UnischemaField("id2", np.int32, (), ScalarCodec(), False),
+    UnischemaField("partition_key", str, (), ScalarCodec(), False),
+    UnischemaField("python_primitive_uint8", np.uint8, (), ScalarCodec(), False),
+    UnischemaField("image_png", np.uint8, (16, 32, 3), CompressedImageCodec("png"), False),
+    UnischemaField("matrix", np.float32, (4, 8), NdarrayCodec(), False),
+    UnischemaField("matrix_nullable", np.float64, (2, 3), CompressedNdarrayCodec(), True),
+    UnischemaField("decimal", Decimal, (), ScalarCodec(), False),
+    UnischemaField("string_value", str, (), ScalarCodec(), False),
+    UnischemaField("sensor_name", str, (), ScalarCodec(), False),
+    UnischemaField("timestamp_s", np.int64, (), ScalarCodec(), False),
+])
+
+
+def make_test_row(index, rng=None):
+    rng = rng or np.random.RandomState(index)
+    return {
+        "id": index,
+        "id2": index % 5,
+        "partition_key": f"p_{index % 4}",
+        "python_primitive_uint8" : np.uint8(index % 255),
+        "image_png": rng.randint(0, 255, (16, 32, 3), dtype=np.uint8),
+        "matrix": rng.rand(4, 8).astype(np.float32),
+        "matrix_nullable": (rng.rand(2, 3).astype(np.float64)
+                            if index % 3 else None),
+        "decimal": Decimal(f"{index}.{index % 10}"),
+        "string_value": f"string_{index}",
+        "sensor_name": f"sensor_{index % 2}",
+        "timestamp_s": 1_000_000 + index,
+    }
+
+
+def create_test_dataset(dataset_url, rows_count=30, rows_per_row_group=10,
+                        rows_per_file=None, **write_kwargs):
+    """Write a petastorm-format synthetic dataset; returns the source rows."""
+    rows = [make_test_row(i) for i in range(rows_count)]
+    materialize_rows(dataset_url, TestSchema, rows,
+                     rows_per_row_group=rows_per_row_group,
+                     rows_per_file=rows_per_file, **write_kwargs)
+    return rows
+
+
+ScalarSchema = Unischema("ScalarSchema", [
+    UnischemaField("id", np.int64, (), None, False),
+    UnischemaField("float_col", np.float64, (), None, False),
+    UnischemaField("int_col", np.int32, (), None, False),
+    UnischemaField("string_col", str, (), None, False),
+])
+
+
+def create_test_scalar_dataset(dataset_url, rows_count=30,
+                               rows_per_row_group=10, **write_kwargs):
+    """Plain-Parquet dataset (no petastorm metadata) for make_batch_reader."""
+    rows = [{
+        "id": i,
+        "float_col": i * 1.5,
+        "int_col": np.int32(i * 2),
+        "string_col": f"value_{i}",
+    } for i in range(rows_count)]
+    write_rows(dataset_url, ScalarSchema, rows,
+               rows_per_row_group=rows_per_row_group, **write_kwargs)
+    return rows

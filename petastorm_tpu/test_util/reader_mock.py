@@ -1,0 +1,54 @@
+"""Schema-driven fake Reader for testing downstream consumers without Parquet.
+
+Reference parity: ``petastorm/test_util/reader_mock.py::ReaderMock`` —
+SURVEY.md §2.7. Adapter (TF/Torch/JAX) tests wrap this instead of a real
+dataset.
+"""
+
+from __future__ import annotations
+
+
+class ReaderMock:
+    """Yields ``schema.make_namedtuple(**row_generator(i))`` forever (or for
+    ``num_rows`` rows when given)."""
+
+    def __init__(self, schema, row_generator, num_rows=None, batched_output=False):
+        self.schema = schema
+        self.ngram = None
+        self.batched_output = batched_output
+        self.last_row_consumed = False
+        self._row_generator = row_generator
+        self._num_rows = num_rows
+        self._served = 0
+        self.stopped = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._num_rows is not None and self._served >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        row = self._row_generator(self._served)
+        self._served += 1
+        return self.schema.make_namedtuple(**row)
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        self._served = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        self.stopped = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()

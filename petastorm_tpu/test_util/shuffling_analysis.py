@@ -1,0 +1,28 @@
+"""Statistical shuffle-quality metric.
+
+Reference parity: ``petastorm/test_util/shuffling_analysis.py`` — quantifies
+how decorrelated an observed order is from the source order so tests can
+assert "shuffling actually shuffles" without flaky exact-order checks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_correlation_distance_metric(observed_ids):
+    """Mean |spearman-style rank displacement| normalized to [0, 1].
+
+    0 ≈ identical order; values near 1 ≈ thoroughly shuffled. Assumes
+    ``observed_ids`` is a permutation of a contiguous id range.
+    """
+    observed = np.asarray(list(observed_ids))
+    n = len(observed)
+    if n < 2:
+        return 0.0
+    source_positions = {value: index for index, value in enumerate(sorted(observed))}
+    displacement = np.abs(
+        np.arange(n) - np.array([source_positions[v] for v in observed])
+    )
+    # max mean displacement for a permutation is ~n/2
+    return float(displacement.mean() / (n / 2.0))

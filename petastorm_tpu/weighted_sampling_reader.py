@@ -1,0 +1,65 @@
+"""Mix several readers with given sampling probabilities.
+
+Reference parity: ``petastorm/weighted_sampling_reader.py::WeightedSamplingReader``
+— dataset mixing (BASELINE.md config #5 uses it for the multi-corpus shuffle).
+"""
+
+from __future__ import annotations
+
+import random
+
+
+class WeightedSamplingReader:
+    """``next()`` draws from ``readers[i]`` with probability ``probabilities[i]``
+    (normalized). Iteration stops when the drawn reader is exhausted
+    (reference semantics: StopIteration propagates)."""
+
+    def __init__(self, readers, probabilities, random_seed=None):
+        if len(readers) != len(probabilities):
+            raise ValueError(
+                f"len(readers)={len(readers)} != len(probabilities)={len(probabilities)}"
+            )
+        if not readers:
+            raise ValueError("At least one reader is required")
+        total = float(sum(probabilities))
+        if total <= 0:
+            raise ValueError("Probabilities must sum to a positive value")
+        self._readers = list(readers)
+        self._cum = []
+        acc = 0.0
+        for p in probabilities:
+            acc += p / total
+            self._cum.append(acc)
+        self._random = random.Random(random_seed)
+
+        # Mixing requires compatible row types; expose the first reader's
+        # schema/ngram like a plain reader so adapters can wrap us.
+        first = readers[0]
+        self.schema = getattr(first, "schema", None)
+        self.ngram = getattr(first, "ngram", None)
+        self.batched_output = getattr(first, "batched_output", False)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        draw = self._random.random()
+        for index, threshold in enumerate(self._cum):
+            if draw < threshold:
+                return next(self._readers[index])
+        return next(self._readers[-1])  # guard for fp rounding at 1.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+
+    def stop(self):
+        for reader in self._readers:
+            reader.stop()
+
+    def join(self):
+        for reader in self._readers:
+            reader.join()

@@ -42,15 +42,23 @@ class DummyPool:
             if self._ventilator is not None:
                 self._ventilator.processed_item()
 
-    def get_results(self, timeout=None):
+    def get_results(self, timeout=60):
         # The concurrent ventilator (if any) runs on its own thread and calls
         # back into ventilate(); wait for it to either produce or complete.
+        from petastorm_tpu.workers_pool import TimeoutWaitingForResultError
+
+        deadline = time.monotonic() + timeout if timeout else None
         while True:
+            if deadline is not None and not self._results and time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError(f"No results for {timeout}s")
             if self._results:
                 result = self._results.popleft()
                 if isinstance(result, WorkerException):
                     raise result
                 return result
+            error = getattr(self._ventilator, "error", None) if self._ventilator else None
+            if error is not None:
+                raise RuntimeError(f"Ventilation failed: {error!r}") from error
             if self._stopped or self._ventilator is None or self._ventilator.completed():
                 raise EmptyResultError()
             time.sleep(0.001)

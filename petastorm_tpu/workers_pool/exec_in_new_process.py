@@ -41,8 +41,9 @@ def exec_in_new_process(func, *args, **kwargs):
     merged = parent_paths + [p for p in existing if p not in parent_paths]
     env["PYTHONPATH"] = os.pathsep.join(merged)
     # Data workers must never grab the TPU: a second process initializing the
-    # TPU runtime would deadlock against the training process holding it.
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    # TPU runtime would deadlock against the training process holding it —
+    # unconditional override, the parent often runs with JAX_PLATFORMS=tpu.
+    env["JAX_PLATFORMS"] = "cpu"
     return subprocess.Popen(
         [sys.executable, "-m", "petastorm_tpu.workers_pool.exec_in_new_process",
          payload_path],

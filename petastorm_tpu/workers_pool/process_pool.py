@@ -132,12 +132,19 @@ class ProcessPool:
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        import cloudpickle
+
+        # cloudpickle: work items may carry lambdas (e.g. in_lambda predicates)
+        payload = cloudpickle.dumps((args, kwargs))
         self._ventilated_items += 1
-        self._vent_socket.send(pickle.dumps((args, kwargs)))
+        self._vent_socket.send(payload)
 
     def get_results(self, timeout=DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout
         while True:
+            error = getattr(self._ventilator, "error", None) if self._ventilator else None
+            if error is not None:
+                raise RuntimeError(f"Ventilation failed: {error!r}") from error
             if self._all_done():
                 raise EmptyResultError()
             if not self._results_socket.poll(100):

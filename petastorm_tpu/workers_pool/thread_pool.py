@@ -10,6 +10,7 @@ from __future__ import annotations
 import queue
 import sys
 import threading
+import time
 import traceback
 
 from petastorm_tpu.workers_pool import (
@@ -92,20 +93,28 @@ class ThreadPool:
         """Return the next published payload.
 
         Raises :class:`EmptyResultError` when ventilation is finished and all
-        results have been consumed; re-raises worker exceptions.
+        results have been consumed; re-raises worker exceptions. ``timeout``
+        bounds the whole call (deadline), not each internal wait.
         """
+
+        deadline = time.monotonic() + timeout
         while True:
+            self._raise_on_ventilator_error()
             if self._results_queue.empty() and self._all_done():
                 raise EmptyResultError()
             try:
-                result = self._results_queue.get(timeout=timeout)
+                wait = min(0.5, max(0.001, deadline - time.monotonic()))
+                result = self._results_queue.get(timeout=wait)
             except queue.Empty:
                 if self._all_done():
                     raise EmptyResultError() from None
-                raise TimeoutWaitingForResultError(
-                    f"No results for {timeout}s; "
-                    f"ventilated={self._ventilated_items} completed={self._completed_items}"
-                ) from None
+                if time.monotonic() >= deadline:
+                    raise TimeoutWaitingForResultError(
+                        f"No results for {timeout}s; "
+                        f"ventilated={self._ventilated_items} "
+                        f"completed={self._completed_items}"
+                    ) from None
+                continue
             if isinstance(result, VentilatedItemProcessedMessage):
                 with self._counter_lock:
                     self._completed_items += 1
@@ -115,6 +124,11 @@ class ThreadPool:
             if isinstance(result, WorkerException):
                 raise result
             return result
+
+    def _raise_on_ventilator_error(self):
+        error = getattr(self._ventilator, "error", None) if self._ventilator else None
+        if error is not None:
+            raise RuntimeError(f"Ventilation failed: {error!r}") from error
 
     def _all_done(self):
         with self._counter_lock:
@@ -133,8 +147,20 @@ class ThreadPool:
             self._ventilator_queue.put(EOFSentinel())
 
     def join(self):
+        deadline = time.monotonic() + 30
+        while any(t.is_alive() for t in self._threads):
+            # Drain the bounded results queue so workers blocked in put()
+            # can observe the stop event and exit.
+            try:
+                while True:
+                    self._results_queue.get_nowait()
+            except queue.Empty:
+                pass
+            if time.monotonic() > deadline:  # pragma: no cover - stuck worker
+                break
+            time.sleep(0.01)
         for thread in self._threads:
-            thread.join(timeout=30)
+            thread.join(timeout=1)
         for worker in self._workers:
             worker.shutdown()
         self._threads = []

@@ -68,7 +68,15 @@ class ConcurrentVentilator(Ventilator):
         self._space_available = threading.Condition(self._lock)
         self._stop_requested = False
         self._completed = False
+        self._error = None
         self._thread = None
+
+    @property
+    def error(self):
+        """Exception that killed the ventilation thread, if any. Pools check
+        this so a ventilation failure surfaces to the consumer instead of
+        hanging the reader until timeout."""
+        return self._error
 
     def start(self):
         if self._thread is not None:
@@ -81,6 +89,13 @@ class ConcurrentVentilator(Ventilator):
         self._thread.start()
 
     def _run(self):
+        try:
+            self._run_inner()
+        except Exception as exc:  # noqa: BLE001 - surfaced via self.error
+            self._error = exc
+            self._completed = True
+
+    def _run_inner(self):
         iterations_left = self._iterations
         while iterations_left is None or iterations_left > 0:
             items = list(self._items_to_ventilate)
@@ -132,6 +147,7 @@ class ConcurrentVentilator(Ventilator):
         self._thread = None
         self._stop_requested = False
         self._completed = False
+        self._error = None
         with self._lock:
             self._in_flight = 0
         self.start()

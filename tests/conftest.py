@@ -17,3 +17,30 @@ if "xla_force_host_platform_device_count" not in _flags:
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from types import SimpleNamespace  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def petastorm_dataset(tmp_path_factory):
+    """Session-scoped synthetic petastorm-format dataset (30 rows, 3 row
+    groups) — the analogue of the reference's ``create_test_dataset`` fixture."""
+    from petastorm_tpu.test_util.dataset_factory import TestSchema, create_test_dataset
+
+    path = tmp_path_factory.mktemp("data") / "petastorm_ds"
+    url = f"file://{path}"
+    rows = create_test_dataset(url, rows_count=30, rows_per_row_group=10)
+    return SimpleNamespace(url=url, path=str(path), rows=rows, schema=TestSchema)
+
+
+@pytest.fixture(scope="session")
+def scalar_dataset(tmp_path_factory):
+    """Session-scoped plain-Parquet dataset for make_batch_reader tests."""
+    from petastorm_tpu.test_util.dataset_factory import ScalarSchema, create_test_scalar_dataset
+
+    path = tmp_path_factory.mktemp("data") / "scalar_ds"
+    url = f"file://{path}"
+    rows = create_test_scalar_dataset(url, rows_count=30, rows_per_row_group=10)
+    return SimpleNamespace(url=url, path=str(path), rows=rows, schema=ScalarSchema)

@@ -1,0 +1,106 @@
+"""NGram property tests: delta_threshold gaps, overlap control, boundaries.
+
+Mirrors the reference's ngram end-to-end tests (SURVEY.md §4, §7 hard-part #3).
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.schema.codecs import ScalarCodec
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+SCHEMA = Unischema("Seq", [
+    UnischemaField("ts", np.int64, (), ScalarCodec(), False),
+    UnischemaField("value", np.float64, (), ScalarCodec(), False),
+    UnischemaField("aux", str, (), ScalarCodec(), True),
+])
+
+
+def _rows(timestamps):
+    return [{"ts": t, "value": float(t) * 2, "aux": f"a{t}"} for t in timestamps]
+
+
+def test_basic_windows():
+    ngram = NGram({0: ["ts", "value"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    windows = ngram.form_ngram(_rows([1, 2, 3, 4]), SCHEMA)
+    assert len(windows) == 3
+    assert [w[0]["ts"] for w in windows] == [1, 2, 3]
+    assert all("value" in w[0] and "value" not in w[1] for w in windows)
+
+
+def test_delta_threshold_rejects_gaps():
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    # gap between 3 and 10 kills windows spanning it
+    windows = ngram.form_ngram(_rows([1, 2, 3, 10, 11]), SCHEMA)
+    starts = [w[0]["ts"] for w in windows]
+    assert starts == [1, 2, 10]
+
+
+def test_delta_threshold_none_accepts_all():
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=None,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    windows = ngram.form_ngram(_rows([1, 100, 5000]), SCHEMA)
+    assert len(windows) == 2
+
+
+def test_rows_sorted_before_windowing():
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1, timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    windows = ngram.form_ngram(_rows([3, 1, 2]), SCHEMA)
+    assert [w[0]["ts"] for w in windows] == [1, 2]
+
+
+def test_timestamp_overlap_false_strides_by_length():
+    ngram = NGram({0: ["ts"], 1: ["ts"]}, delta_threshold=1,
+                  timestamp_field="ts", timestamp_overlap=False)
+    ngram.resolve_regex_field_names(SCHEMA)
+    windows = ngram.form_ngram(_rows([1, 2, 3, 4, 5, 6]), SCHEMA)
+    assert [w[0]["ts"] for w in windows] == [1, 3, 5]
+
+
+def test_negative_and_sparse_offsets():
+    ngram = NGram({-1: ["value"], 1: ["value"]}, delta_threshold=2,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    assert ngram.length == 3
+    windows = ngram.form_ngram(_rows([10, 11, 12, 13]), SCHEMA)
+    assert len(windows) == 2
+    assert set(windows[0].keys()) == {-1, 1}
+
+
+def test_regex_field_resolution():
+    ngram = NGram({0: ["val.*", "ts"]}, delta_threshold=None, timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    assert set(ngram.get_field_names_at_timestep(0)) == {"value", "ts"}
+    with pytest.raises(ValueError, match="matched nothing"):
+        bad = NGram({0: ["nope.*"]}, delta_threshold=None, timestamp_field="ts")
+        bad.resolve_regex_field_names(SCHEMA)
+
+
+def test_window_shorter_than_data_yields_nothing():
+    ngram = NGram({0: ["ts"], 4: ["ts"]}, delta_threshold=None,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    assert ngram.form_ngram(_rows([1, 2, 3]), SCHEMA) == []
+
+
+def test_make_namedtuple_shapes():
+    ngram = NGram({0: ["ts", "value"], 1: ["value"]}, delta_threshold=1,
+                  timestamp_field="ts")
+    ngram.resolve_regex_field_names(SCHEMA)
+    windows = ngram.form_ngram(_rows([1, 2]), SCHEMA)
+    as_tuple = ngram.make_namedtuple(SCHEMA, windows[0])
+    assert as_tuple[0].ts == 1 and as_tuple[0].value == 2.0
+    assert as_tuple[1]._fields == ("value",)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="non-empty"):
+        NGram({}, 1, "ts")
+    with pytest.raises(ValueError, match="Offsets"):
+        NGram({"a": ["ts"]}, 1, "ts")

@@ -1,0 +1,252 @@
+"""Unit tests: shuffling buffers, caches, weighted sampling, rowgroup indexes,
+predicates, selectors."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pytest
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.etl.rowgroup_indexers import FieldNotNullIndexer, SingleFieldIndexer
+from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index, get_row_group_indexes
+from petastorm_tpu.local_disk_arrow_table_cache import LocalDiskArrowTableCache
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+from petastorm_tpu.predicates import (
+    in_lambda,
+    in_negate,
+    in_pseudorandom_split,
+    in_reduce,
+    in_set,
+)
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.reader_impl.shuffling_buffer import (
+    NoopShufflingBuffer,
+    RandomShufflingBuffer,
+)
+from petastorm_tpu.selectors import (
+    IntersectIndexSelector,
+    SingleIndexSelector,
+    UnionIndexSelector,
+)
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+from petastorm_tpu.schema.codecs import ScalarCodec
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+
+# ---- predicates ----------------------------------------------------------
+
+def test_predicate_combinators():
+    even = in_lambda(["x"], lambda v: v["x"] % 2 == 0)
+    small = in_set(range(10), "x")
+    both = in_reduce([even, small], all)
+    either = in_reduce([even, small], any)
+    neg = in_negate(even)
+    assert both.get_fields() == {"x"}
+    assert both.do_include({"x": 4}) and not both.do_include({"x": 11}) \
+        and not both.do_include({"x": 12})
+    assert either.do_include({"x": 12}) and either.do_include({"x": 9})
+    assert not either.do_include({"x": 11})
+    assert neg.do_include({"x": 3}) and not neg.do_include({"x": 4})
+
+
+def test_pseudorandom_split_fractions():
+    split = [0.6, 0.2, 0.2]
+    counts = [0, 0, 0]
+    for subset in range(3):
+        predicate = in_pseudorandom_split(split, subset, "key")
+        for i in range(3000):
+            if predicate.do_include({"key": f"k{i}"}):
+                counts[subset] += 1
+    assert sum(counts) == 3000  # partition covers everything exactly once
+    assert abs(counts[0] / 3000 - 0.6) < 0.05
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.5, 0.6], 0, "key")
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.5, 0.5], 2, "key")
+
+
+# ---- shuffling buffers ---------------------------------------------------
+
+def test_noop_buffer_fifo():
+    buf = NoopShufflingBuffer()
+    buf.add_many([1, 2, 3])
+    assert [buf.retrieve() for _ in range(3)] == [1, 2, 3]
+    assert not buf.can_retrieve()
+
+
+def test_random_buffer_shuffles_and_drains():
+    buf = RandomShufflingBuffer(100, min_after_retrieve=10, random_seed=0)
+    buf.add_many(range(100))
+    assert not buf.can_add()
+    out = []
+    while buf.can_retrieve():
+        out.append(buf.retrieve())
+    assert len(out) == 90  # min_after_retrieve floor holds while not finished
+    buf.finish()
+    while buf.can_retrieve():
+        out.append(buf.retrieve())
+    assert sorted(out) == list(range(100))
+    assert out != sorted(out)
+
+
+def test_random_buffer_overflow_guard():
+    buf = RandomShufflingBuffer(10, extra_capacity=5)
+    with pytest.raises(RuntimeError, match="overflow"):
+        buf.add_many(range(20))
+    with pytest.raises(ValueError):
+        RandomShufflingBuffer(5, min_after_retrieve=6)
+
+
+# ---- caches --------------------------------------------------------------
+
+def test_null_cache_always_recomputes():
+    calls = []
+    cache = NullCache()
+    assert cache.get("k", lambda: calls.append(1) or 42) == 42
+    assert cache.get("k", lambda: calls.append(1) or 42) == 42
+    assert len(calls) == 2
+
+
+def test_local_disk_cache_hit_and_eviction(tmp_path):
+    cache = LocalDiskCache(str(tmp_path / "cache"), size_limit=50_000)
+    calls = []
+
+    def load():
+        calls.append(1)
+        return np.zeros(1000)  # ~8KB pickled
+
+    first = cache.get(("piece", 0), load)
+    second = cache.get(("piece", 0), load)
+    assert len(calls) == 1  # second hit served from disk
+    assert np.array_equal(first, second)
+
+    for i in range(20):  # ~160KB total >> 50KB limit
+        cache.get(("piece", i + 1), lambda: np.zeros(1000))
+    assert cache.size_on_disk() <= 50_000
+
+
+def test_local_disk_arrow_table_cache(tmp_path):
+    cache = LocalDiskArrowTableCache(str(tmp_path / "acache"), size_limit=10**6)
+    table = pa.table({"x": [1, 2, 3]})
+    calls = []
+
+    def load():
+        calls.append(1)
+        return table
+
+    assert cache.get("k", load).equals(table)
+    assert cache.get("k", load).equals(table)
+    assert len(calls) == 1
+    with pytest.raises(ValueError, match="pa.Table"):
+        cache.get("bad", lambda: [1, 2, 3])
+
+
+def test_reader_local_disk_cache_speeds_second_epoch(petastorm_dataset, tmp_path):
+    with make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                     num_epochs=2, cache_type="local-disk",
+                     cache_location=str(tmp_path / "rcache"),
+                     cache_size_limit=10**8) as reader:
+        ids = [row.id for row in reader]
+    assert sorted(ids) == sorted(list(range(30)) * 2)
+
+
+# ---- weighted sampling ---------------------------------------------------
+
+SIMPLE = Unischema("Simple", [
+    UnischemaField("source", np.int32, (), ScalarCodec(), False),
+])
+
+
+def test_weighted_sampling_mixes_readers():
+    reader_a = ReaderMock(SIMPLE, lambda i: {"source": np.int32(0)})
+    reader_b = ReaderMock(SIMPLE, lambda i: {"source": np.int32(1)})
+    mixed = WeightedSamplingReader([reader_a, reader_b], [0.8, 0.2],
+                                   random_seed=3)
+    draws = [next(mixed).source for _ in range(2000)]
+    share_a = draws.count(0) / len(draws)
+    assert abs(share_a - 0.8) < 0.05
+    mixed.stop()
+    assert reader_a.stopped and reader_b.stopped
+
+
+def test_weighted_sampling_stops_with_exhausted_reader():
+    reader_a = ReaderMock(SIMPLE, lambda i: {"source": np.int32(0)}, num_rows=2)
+    reader_b = ReaderMock(SIMPLE, lambda i: {"source": np.int32(1)})
+    mixed = WeightedSamplingReader([reader_a, reader_b], [1.0, 0.0])
+    assert next(mixed).source == 0
+    assert next(mixed).source == 0
+    with pytest.raises(StopIteration):
+        while True:
+            next(mixed)
+
+
+def test_weighted_sampling_validation():
+    reader = ReaderMock(SIMPLE, lambda i: {"source": np.int32(0)})
+    with pytest.raises(ValueError):
+        WeightedSamplingReader([reader], [0.5, 0.5])
+    with pytest.raises(ValueError):
+        WeightedSamplingReader([], [])
+
+
+# ---- rowgroup indexing + selectors --------------------------------------
+
+def test_rowgroup_index_and_selectors(petastorm_dataset):
+    fs = pafs.LocalFileSystem()
+    indexers = [
+        SingleFieldIndexer("by_sensor", "sensor_name"),
+        FieldNotNullIndexer("has_matrix_nullable", "matrix_nullable"),
+    ]
+    index_dict = build_rowgroup_index(petastorm_dataset.url, indexers)
+    assert set(index_dict) == {"by_sensor", "has_matrix_nullable"}
+
+    loaded = get_row_group_indexes(fs, petastorm_dataset.path)
+    by_sensor = loaded["by_sensor"]
+    # both sensors appear in every row group (ids alternate)
+    assert by_sensor.get_row_group_indexes("sensor_0") == {0, 1, 2}
+    assert by_sensor.get_row_group_indexes("nonexistent") == set()
+
+    single = SingleIndexSelector("by_sensor", ["sensor_1"])
+    assert single.select_row_groups(loaded) == {0, 1, 2}
+    inter = IntersectIndexSelector([
+        SingleIndexSelector("by_sensor", ["sensor_0"]),
+        SingleIndexSelector("has_matrix_nullable", [None]),
+    ])
+    union = UnionIndexSelector([
+        SingleIndexSelector("by_sensor", ["sensor_0"]),
+        SingleIndexSelector("by_sensor", ["sensor_1"]),
+    ])
+    assert inter.select_row_groups(loaded) == {0, 1, 2}
+    assert union.select_row_groups(loaded) == {0, 1, 2}
+
+
+def test_reader_with_rowgroup_selector(petastorm_dataset, tmp_path):
+    """Selector prunes row groups before any read: index id2 values."""
+    from petastorm_tpu.test_util.dataset_factory import create_test_dataset
+
+    path = tmp_path / "sel_ds"
+    url = f"file://{path}"
+    create_test_dataset(url, rows_count=30, rows_per_row_group=10)
+    build_rowgroup_index(url, [SingleFieldIndexer("by_part", "partition_key")])
+
+    with make_reader(url, reader_pool_type="dummy",
+                     rowgroup_selector=SingleIndexSelector("by_part", ["p_0"])
+                     ) as reader:
+        ids = [row.id for row in reader]
+    # every row group contains p_0 rows here, so selector keeps all groups;
+    # assert it at least returned the whole set (pruning correctness is
+    # covered by the direct selector assertions above)
+    assert sorted(ids) == list(range(30))
+
+
+def test_selector_missing_index_raises(petastorm_dataset):
+    from petastorm_tpu.errors import PetastormMetadataError
+
+    selector = SingleIndexSelector("no_such_index", ["v"])
+    # ValueError when the index store exists but lacks the name;
+    # PetastormMetadataError when no index store was ever built (run order)
+    with pytest.raises((ValueError, PetastormMetadataError),
+                       match="no rowgroup index|no_such_index"):
+        with make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         rowgroup_selector=selector):
+            pass
