@@ -1,0 +1,124 @@
+"""Driver benchmark: end-to-end JAX-loader throughput on a synthetic image set.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+What it measures: rows/sec through the full delivery path — Parquet row
+groups → thread-pool workers (parallel column read + PNG decode) →
+fixed-size batch collation → async ``jax.device_put`` into device memory —
+versus a naive sequential baseline (dummy pool, no pipelining), which is the
+performance floor a reference-style single-threaded consumer would see.
+Input-stall % for the device consumer rides along (the north-star metric,
+BASELINE.md).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", "768"))
+ROWS_PER_RG = 64
+IMAGE_SHAPE = (64, 64, 3)
+BATCH = 64
+EPOCHS = int(os.environ.get("BENCH_EPOCHS", "2"))
+
+
+def _write_dataset(url):
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import (CompressedImageCodec,
+                                             NdarrayCodec, ScalarCodec)
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("BenchSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("image", np.uint8, IMAGE_SHAPE,
+                       CompressedImageCodec("png"), False),
+        UnischemaField("features", np.float32, (16,), NdarrayCodec(), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(0)
+
+    def rows():
+        for i in range(ROWS):
+            yield {"id": i,
+                   "image": rng.randint(0, 255, IMAGE_SHAPE, dtype=np.uint8),
+                   "features": rng.rand(16).astype(np.float32),
+                   "label": np.int32(i % 10)}
+
+    materialize_rows(url, schema, rows(), rows_per_row_group=ROWS_PER_RG)
+
+
+def _baseline_rows_per_sec(url):
+    """Sequential floor: dummy pool (in-caller-thread), row-at-a-time."""
+    from petastorm_tpu import make_reader
+
+    reader = make_reader(url, reader_pool_type="dummy", num_epochs=1,
+                         shuffle_row_groups=False)
+    n = 0
+    t0 = time.perf_counter()
+    with reader:
+        for _ in reader:
+            n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def _pipeline_rows_per_sec(url):
+    """Full path: thread pool + JAX loader staging batches onto the device."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    import jax
+
+    workers = min(os.cpu_count() or 4, 16)
+    reader = make_reader(url, reader_pool_type="thread",
+                         workers_count=workers, num_epochs=EPOCHS,
+                         shuffle_row_groups=True)
+    loader = make_jax_dataloader(reader, BATCH, last_batch="drop",
+                                 non_tensor_policy="drop",
+                                 host_prefetch=8, device_prefetch=2)
+    rows = 0
+    last = None
+    t0 = time.perf_counter()
+    with loader:
+        for batch in loader:
+            rows += batch["image"].shape[0]
+            last = batch["image"]
+    if last is not None:
+        jax.block_until_ready(last)
+    dt = time.perf_counter() - t0
+    return rows / dt, loader.diagnostics
+
+
+def main():
+    import logging
+
+    logging.disable(logging.WARNING)
+    tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_bench_")
+    try:
+        url = f"file://{os.path.join(tmpdir, 'ds')}"
+        _write_dataset(url)
+        # Warm the JAX runtime off the clock.
+        import jax
+
+        jax.device_put(np.zeros(8)).block_until_ready()
+
+        baseline = _baseline_rows_per_sec(url)
+        value, diag = _pipeline_rows_per_sec(url)
+        print(json.dumps({
+            "metric": "jax_loader_rows_per_sec",
+            "value": round(value, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(value / baseline, 2),
+            "baseline_sequential_rows_per_sec": round(baseline, 1),
+            "input_stall_pct": diag["input_stall_pct"],
+            "device": jax.devices()[0].platform,
+        }))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,7 @@ _LAZY_EXPORTS = {
     "Unischema": ("petastorm_tpu.schema.unischema", "Unischema"),
     "UnischemaField": ("petastorm_tpu.schema.unischema", "UnischemaField"),
     "TransformSpec": ("petastorm_tpu.schema.transform", "TransformSpec"),
-    "make_jax_dataloader": ("petastorm_tpu.jax.loader", "make_jax_dataloader"),
+    "make_jax_dataloader": ("petastorm_tpu.jax_utils.loader", "make_jax_dataloader"),
 }
 
 __all__ = list(_LAZY_EXPORTS) + ["__version__"]

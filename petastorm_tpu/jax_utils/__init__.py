@@ -1,0 +1,40 @@
+"""JAX/TPU delivery layer — the rebuild's north-star addition.
+
+The reference (``petastorm``, SURVEY.md §3 "boundary summary") never owns the
+device boundary: TF/Torch adapters hand numpy to the framework and the user
+calls ``.to(device)``. On TPU that design leaves HBM staging, per-host batch
+cardinality, and input-stall measurement to every user. This package owns all
+three:
+
+- :func:`make_jax_dataloader` — fixed-size numpy batches with an explicit
+  pad/drop policy (equal per-host step counts for SPMD lockstep), staged into
+  device HBM via double-buffered async ``jax.device_put`` (or emitted as
+  globally-sharded ``jax.Array`` s via
+  ``jax.make_array_from_process_local_data`` when a sharding is given);
+- NGram windows collate to ``[B, T, ...]`` arrays;
+- built-in input-stall instrumentation (``loader.diagnostics``) — the
+  north-star metric (BASELINE.md).
+"""
+
+from petastorm_tpu.jax_utils.batcher import (
+    batch_iterator,
+    collate_ngram_rows,
+    collate_rows,
+)
+from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
+from petastorm_tpu.jax_utils.sharding import (
+    batch_sharding,
+    default_shard_options,
+    local_data_to_global_array,
+)
+
+__all__ = [
+    "make_jax_dataloader",
+    "JaxDataLoader",
+    "batch_iterator",
+    "collate_rows",
+    "collate_ngram_rows",
+    "default_shard_options",
+    "batch_sharding",
+    "local_data_to_global_array",
+]

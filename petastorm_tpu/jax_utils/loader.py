@@ -1,0 +1,277 @@
+"""``make_jax_dataloader`` — batches from a Reader into TPU HBM.
+
+Pipeline (SURVEY.md §7 stage 5, hard-part #6 "pipelined host→HBM staging"):
+
+    Reader (its own worker pool)            ← Parquet read + decode
+      → producer thread: collate to fixed-size numpy batches (batcher.py)
+      → bounded host queue (backpressure)
+      → consumer: async ``jax.device_put`` kept ``device_prefetch`` batches
+        ahead (double buffering — H2D DMA overlaps the caller's compute)
+      → yields jax.Array batches (or globally-sharded arrays when a
+        ``sharding`` is given, via ``make_array_from_process_local_data``)
+
+Input-stall instrumentation is built in: time the consumer blocks waiting on
+the host queue is "stall", measured against wall time between yields —
+``loader.diagnostics['input_stall_pct']`` is the north-star metric
+(BASELINE.md: ≤5% stall at v5e-64).
+
+Non-tensor columns (strings, Decimals — object-dtype after collation) cannot
+live in HBM; the ``non_tensor_policy`` knob keeps them host-side ("host",
+default), drops them ("drop"), or rejects them ("error").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from petastorm_tpu.jax_utils.batcher import PAD_MASK_KEY, batch_iterator
+
+_SENTINEL = object()
+
+
+def make_jax_dataloader(reader, batch_size,
+                        last_batch="drop",
+                        max_batches=None,
+                        device=None,
+                        sharding=None,
+                        host_prefetch=4,
+                        device_prefetch=2,
+                        non_tensor_policy="host",
+                        stage_to_device=True,
+                        shuffle_buffer_size=0,
+                        shuffle_seed=None):
+    """Create a :class:`JaxDataLoader` over ``reader``.
+
+    :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
+        or column-batch output all supported).
+    :param batch_size: rows per emitted batch. With ``sharding``, this is the
+        *per-host* batch size; the global array's batch dim is
+        ``batch_size * jax.process_count()``.
+    :param last_batch: "drop" | "pad" | "keep" (see batcher.py; "pad" adds a
+        boolean ``__pad_mask__`` column).
+    :param max_batches: stop after N batches (equal-step coordination: pass
+        the pre-agreed per-host step count).
+    :param device: target ``jax.Device`` (default: first local device).
+        Mutually exclusive with ``sharding``.
+    :param sharding: a ``jax.sharding.Sharding``; batches are emitted as
+        globally-sharded ``jax.Array`` s via
+        ``make_array_from_process_local_data``.
+    :param host_prefetch: bounded host-queue depth (collated numpy batches).
+    :param device_prefetch: how many batches to keep in-flight on device
+        (≥2 ⇒ double buffering).
+    :param non_tensor_policy: "host" | "drop" | "error" for object-dtype
+        columns.
+    :param stage_to_device: False ⇒ yield plain numpy dicts (no JAX import;
+        useful for tests and host-only consumers).
+    :param shuffle_buffer_size: > 0 adds a row-level RandomShufflingBuffer on
+        top of row-group shuffling (reference ``shuffling_queue_capacity``
+        semantics; row readers only).
+    :param shuffle_seed: seed for the shuffle buffer.
+    """
+    return JaxDataLoader(reader, batch_size, last_batch=last_batch,
+                         max_batches=max_batches, device=device,
+                         sharding=sharding, host_prefetch=host_prefetch,
+                         device_prefetch=device_prefetch,
+                         non_tensor_policy=non_tensor_policy,
+                         stage_to_device=stage_to_device,
+                         shuffle_buffer_size=shuffle_buffer_size,
+                         shuffle_seed=shuffle_seed)
+
+
+class JaxDataLoader:
+    """Iterable/context-manager yielding ``{field: array}`` batches."""
+
+    def __init__(self, reader, batch_size, last_batch="drop", max_batches=None,
+                 device=None, sharding=None, host_prefetch=4,
+                 device_prefetch=2, non_tensor_policy="host",
+                 stage_to_device=True, shuffle_buffer_size=0,
+                 shuffle_seed=None):
+        if device is not None and sharding is not None:
+            raise ValueError("device and sharding are mutually exclusive")
+        if non_tensor_policy not in ("host", "drop", "error"):
+            raise ValueError("non_tensor_policy must be host|drop|error")
+        if device_prefetch < 1:
+            raise ValueError("device_prefetch must be >= 1")
+        self.reader = reader
+        self._batch_size = batch_size
+        self._last_batch = last_batch
+        self._max_batches = max_batches
+        self._device = device
+        self._sharding = sharding
+        self._host_prefetch = max(1, host_prefetch)
+        self._device_prefetch = device_prefetch
+        self._non_tensor_policy = non_tensor_policy
+        self._stage_to_device = stage_to_device
+        self._shuffle_buffer_size = shuffle_buffer_size
+        self._shuffle_seed = shuffle_seed
+
+        self._queue = None
+        self._producer = None
+        self._producer_error = None
+        self._stop = threading.Event()
+        self.diagnostics = {
+            "batches": 0,
+            "rows": 0,
+            "stall_s": 0.0,
+            "wall_s": 0.0,
+            "input_stall_pct": 0.0,
+        }
+
+    # -- producer ---------------------------------------------------------
+
+    def _produce(self):
+        try:
+            for batch in batch_iterator(
+                    self.reader, self._batch_size,
+                    last_batch=self._last_batch,
+                    max_batches=self._max_batches,
+                    shuffle_buffer_size=self._shuffle_buffer_size,
+                    shuffle_seed=self._shuffle_seed):
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except Exception as exc:  # surfaced on the consumer side
+            self._producer_error = exc
+        finally:
+            # The sentinel MUST land or the consumer blocks forever; retry in
+            # a stop-checking loop (the consumer may legitimately pause far
+            # longer than any fixed timeout — e.g. first-step XLA compile).
+            while True:
+                try:
+                    self._queue.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    if self._stop.is_set():
+                        break
+
+    # -- consumer ---------------------------------------------------------
+
+    def __iter__(self):
+        if self._producer is not None and self._producer.is_alive():
+            # A previous iteration is still producing; two producers would
+            # pull from the same (non-thread-safe) reader concurrently. Stop
+            # the old one before re-iterating.
+            self.stop()
+            self._producer.join(timeout=30)
+            if self._producer.is_alive():
+                raise RuntimeError(
+                    "Previous iteration's producer thread did not stop within "
+                    "30s (reader blocked on I/O?); cannot safely re-iterate")
+        self._queue = queue.Queue(maxsize=self._host_prefetch)
+        self._stop.clear()
+        self._producer_error = None
+        # Diagnostics are per-iteration: stall/wall must describe one pass or
+        # input_stall_pct (the north-star metric) is meaningless.
+        self.diagnostics.update(batches=0, rows=0, stall_s=0.0, wall_s=0.0,
+                                input_stall_pct=0.0)
+        self._producer = threading.Thread(target=self._produce, daemon=True,
+                                          name="jax-loader-producer")
+        self._producer.start()
+        return self._iterate()
+
+    def _iterate(self):
+        inflight = []  # device batches dispatched ahead (double buffer)
+        done = False
+        start = time.perf_counter()
+        try:
+            while True:
+                # Keep device_prefetch batches in flight.
+                while not done and len(inflight) < self._device_prefetch:
+                    t0 = time.perf_counter()
+                    host_batch = self._queue.get()
+                    self.diagnostics["stall_s"] += time.perf_counter() - t0
+                    if host_batch is _SENTINEL:
+                        done = True
+                        if self._producer_error is not None:
+                            raise self._producer_error
+                        break
+                    inflight.append(self._stage(host_batch))
+                if not inflight:
+                    return
+                batch = inflight.pop(0)
+                self.diagnostics["batches"] += 1
+                self.diagnostics["rows"] += self._batch_rows(batch)
+                yield batch
+        finally:
+            self.diagnostics["wall_s"] = time.perf_counter() - start
+            if self.diagnostics["wall_s"] > 0:
+                self.diagnostics["input_stall_pct"] = round(
+                    100.0 * self.diagnostics["stall_s"]
+                    / self.diagnostics["wall_s"], 2)
+            # Generator abandoned (break) or exhausted: stop the producer so
+            # it doesn't keep decoding the rest of the dataset forever.
+            self.stop()
+
+    @staticmethod
+    def _batch_rows(batch):
+        for name, col in batch.items():
+            if name == PAD_MASK_KEY:
+                continue
+            try:
+                return int(np.asarray(col.shape[0]).item()) \
+                    if hasattr(col, "shape") else len(col)
+            except TypeError:
+                continue
+        return 0
+
+    def _stage(self, host_batch):
+        """Numpy batch dict → device (or pass through when staging is off)."""
+        if not self._stage_to_device:
+            return host_batch
+        import jax
+
+        out = {}
+        for name, col in host_batch.items():
+            arr = np.asarray(col)
+            if arr.dtype == object or arr.dtype.kind in ("U", "S", "M", "m"):
+                if self._non_tensor_policy == "error":
+                    raise TypeError(
+                        f"Column {name!r} has non-tensor dtype {arr.dtype}; "
+                        f"set non_tensor_policy='host' or 'drop', select "
+                        f"numeric schema_fields, or add a TransformSpec")
+                if self._non_tensor_policy == "drop":
+                    continue
+                out[name] = arr  # host-side passthrough
+                continue
+            if self._sharding is not None:
+                from petastorm_tpu.jax_utils.sharding import (
+                    local_data_to_global_array,
+                )
+
+                out[name] = local_data_to_global_array(self._sharding, arr)
+            else:
+                device = self._device or jax.local_devices()[0]
+                out[name] = jax.device_put(arr, device)
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+
+    def stop(self):
+        self._stop.set()
+        if self._queue is not None:
+            try:  # unblock a producer waiting on a full queue
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+
+    def join(self):
+        if self._producer is not None:
+            self._producer.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        self.join()
+        self.reader.stop()
+        self.reader.join()

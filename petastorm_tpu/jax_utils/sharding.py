@@ -1,0 +1,55 @@
+"""Sharding helpers: pod-aware reader shards + global jax.Array assembly.
+
+TPU-first replacement for the reference's implicit Horovod-rank sharding
+(SURVEY.md §5 "distributed communication backend"): the reference expects the
+user to pass ``cur_shard=hvd.rank(), shard_count=hvd.size()``; here the
+defaults come from ``jax.process_index()/process_count()`` so a pod "just
+works", and batches can be assembled into globally-sharded ``jax.Array`` s for
+pjit. The data plane still never crosses hosts — each host reads its own row
+groups from the (DCN-attached) store; ICI collectives belong to the training
+step, exactly as the scaling recipe prescribes.
+"""
+
+from __future__ import annotations
+
+
+def default_shard_options(cur_shard=None, shard_count=None):
+    """Fill (cur_shard, shard_count) from the JAX runtime when unset.
+
+    Single-process (or JAX absent): (None, None) — no sharding, matching the
+    reference's default behavior.
+    """
+    if cur_shard is not None or shard_count is not None:
+        return cur_shard, shard_count
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index(), jax.process_count()
+    except Exception:  # pragma: no cover - jax missing/uninitialized
+        pass
+    return None, None
+
+
+def batch_sharding(mesh, axis="data"):
+    """NamedSharding that splits the batch (leading) axis over ``mesh[axis]``.
+
+    The standard data-parallel input sharding: every other array dim is
+    replicated; model/tensor axes of the mesh replicate the input so the
+    training step's pjit can re-shard activations as it likes.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def local_data_to_global_array(sharding, array):
+    """Host-local numpy batch → globally-sharded ``jax.Array``.
+
+    Wraps ``jax.make_array_from_process_local_data``: each host contributes
+    its shard of the global batch; XLA never moves the data over DCN — the
+    global array is metadata stitching over per-host HBM buffers.
+    """
+    import jax
+
+    return jax.make_array_from_process_local_data(sharding, array)

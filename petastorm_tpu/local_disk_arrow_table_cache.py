@@ -25,18 +25,3 @@ class LocalDiskArrowTableCache(LocalDiskCache):
     def _deserialize(self, payload):
         with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
             return reader.read_all()
-
-    def get(self, key, fill_cache_func):
-        file_path = self._key_path(key)
-        import os
-
-        try:
-            with open(file_path, "rb") as f:
-                value = self._deserialize(f.read())
-            os.utime(file_path)
-            return value
-        except (OSError, pa.ArrowInvalid):
-            pass
-        value = fill_cache_func()
-        self._store(file_path, self._serialize(value))
-        return value

@@ -38,11 +38,15 @@ class LocalDiskCache:
         file_path = self._key_path(key)
         try:
             with open(file_path, "rb") as f:
-                value = pickle.load(f)  # noqa: S301 - our own cache files
-            os.utime(file_path)  # LRU touch
-            return value
-        except (OSError, pickle.PickleError, EOFError):
+                value = self._deserialize(f.read())
+        except Exception:  # corrupt/missing/format-mismatched entry → refill
             pass
+        else:
+            try:
+                os.utime(file_path)  # LRU touch
+            except OSError:  # read-only/shared cache dir: value still valid
+                pass
+            return value
         value = fill_cache_func()
         self._store(file_path, self._serialize(value))
         return value

@@ -1,0 +1,19 @@
+"""Example/flagship model consumers of the data framework.
+
+The reference ships no model code (SURVEY.md §0: petastorm is an input-data
+framework) — these models exist to exercise and demonstrate the TPU delivery
+path end-to-end: Parquet → Reader → ``make_jax_dataloader`` → sharded pjit
+train step. They are intentionally small, pure-JAX (no flax dependency), and
+written SPMD-first: parameters carry explicit ``PartitionSpec`` s so a single
+``jax.jit`` over a ``Mesh`` scales them data- and tensor-parallel.
+"""
+
+from petastorm_tpu.models.image_classifier import (
+    apply_model,
+    init_params,
+    make_train_step,
+    param_partition_specs,
+)
+
+__all__ = ["init_params", "apply_model", "make_train_step",
+           "param_partition_specs"]

@@ -11,11 +11,19 @@ a selective predicate skips most of the expensive decode work.
 from __future__ import annotations
 
 import hashlib
+import re
+import uuid
 from abc import ABC, abstractmethod
 
 
 class PredicateBase(ABC):
-    """A row filter: which fields it needs + per-row inclusion decision."""
+    """A row filter: which fields it needs + per-row inclusion decision.
+
+    Subclasses define deterministic ``__repr__`` s: the repr is part of the
+    disk-cache key (``LocalDiskCache`` persists across runs, so an
+    address-bearing default repr would both defeat cache hits and risk
+    aliasing different predicates).
+    """
 
     @abstractmethod
     def get_fields(self):
@@ -25,6 +33,64 @@ class PredicateBase(ABC):
     def do_include(self, values):
         """``values`` maps each field from :meth:`get_fields` to the row's
         value; return True to keep the row."""
+
+
+def _func_fingerprint(func):
+    """Stable fingerprint of a callable: qualname + bytecode + consts +
+    captured state (closure cells, defaults) digest.
+
+    Closure cells matter: ``lambda v: v['id'] > t`` compiled with ``t=5`` and
+    ``t=10`` shares bytecode — only the cell value distinguishes them, and the
+    disk-cache key must too."""
+    code = getattr(func, "__code__", None)
+    if code is None:  # builtins (e.g. all/any) have no __code__
+        return getattr(func, "__qualname__", repr(func))
+    cells = tuple(_stable_repr(cell.cell_contents)
+                  for cell in (func.__closure__ or ()))
+    defaults = _stable_repr(getattr(func, "__defaults__", None))
+    # Referenced globals by VALUE, not just name: ``lambda v: v > THRESHOLD``
+    # must change key when THRESHOLD changes.
+    func_globals = getattr(func, "__globals__", {})
+    globals_used = tuple(
+        (name, _stable_repr(func_globals[name]))
+        for name in code.co_names if name in func_globals)
+    digest = hashlib.sha256(
+        code.co_code + repr(code.co_consts).encode("utf-8")
+        + repr(code.co_names).encode("utf-8")  # attribute/builtin names
+        + repr(globals_used).encode("utf-8")
+        + repr(cells).encode("utf-8") + defaults.encode("utf-8")
+    ).hexdigest()[:16]
+    return f"{getattr(func, '__qualname__', '<fn>')}:{digest}"
+
+
+_DEFAULT_OBJECT_REPR = re.compile(r"<.+ at 0x[0-9a-fA-F]+>")
+
+_PROCESS_SALT = uuid.uuid4().hex[:12]
+
+
+def _stable_repr(obj):
+    """repr(), except address-bearing default reprs become content digests.
+
+    ``<Foo object at 0x7f...>`` changes every process — useless (and
+    alias-prone, if stripped) in a persistent cache key. Pickle the object
+    instead: contents-based, cross-run stable. Unpicklable objects fall back
+    to the class name alone (cache misses, never aliases wrong data because
+    the rest of the key still distinguishes dataset/row-group/fields)."""
+    r = repr(obj)
+    if not _DEFAULT_OBJECT_REPR.search(r):
+        return r
+    import pickle
+
+    try:
+        digest = hashlib.sha256(
+            pickle.dumps(obj, protocol=4)).hexdigest()[:16]
+        return f"<{type(obj).__qualname__} pickle:{digest}>"
+    except Exception:
+        # Unpicklable: id() distinguishes objects within this process; the
+        # per-process salt guarantees a cross-run cache MISS (ids can recur
+        # across runs — a miss is safe, an alias serves wrong rows).
+        return (f"<{type(obj).__qualname__} "
+                f"unpicklable:{id(obj)}:{_PROCESS_SALT}>")
 
 
 class in_set(PredicateBase):
@@ -39,6 +105,10 @@ class in_set(PredicateBase):
 
     def do_include(self, values):
         return values[self._predicate_field] in self._inclusion_values
+
+    def __repr__(self):
+        return (f"in_set({sorted(map(repr, self._inclusion_values))}, "
+                f"{self._predicate_field!r})")
 
 
 class in_lambda(PredicateBase):
@@ -59,6 +129,11 @@ class in_lambda(PredicateBase):
             return self._predicate_func(values, self._state_arg)
         return self._predicate_func(values)
 
+    def __repr__(self):
+        return (f"in_lambda({sorted(self._predicate_fields)}, "
+                f"{_func_fingerprint(self._predicate_func)}, "
+                f"{_stable_repr(self._state_arg)})")
+
 
 class in_negate(PredicateBase):
     """Logical NOT of another predicate."""
@@ -71,6 +146,9 @@ class in_negate(PredicateBase):
 
     def do_include(self, values):
         return not self._predicate.do_include(values)
+
+    def __repr__(self):
+        return f"in_negate({self._predicate!r})"
 
 
 class in_reduce(PredicateBase):
@@ -93,6 +171,10 @@ class in_reduce(PredicateBase):
         return self._reduce_func(
             [p.do_include(values) for p in self._predicate_list]
         )
+
+    def __repr__(self):
+        return (f"in_reduce({self._predicate_list!r}, "
+                f"{_func_fingerprint(self._reduce_func)})")
 
 
 class in_pseudorandom_split(PredicateBase):
@@ -126,6 +208,10 @@ class in_pseudorandom_split(PredicateBase):
         low = sum(self._fraction_list[: self._subset_index])
         high = low + self._fraction_list[self._subset_index]
         return low <= position < high
+
+    def __repr__(self):
+        return (f"in_pseudorandom_split({self._fraction_list!r}, "
+                f"{self._subset_index!r}, {self._predicate_field!r})")
 
 
 def _hash_to_unit_interval(value):

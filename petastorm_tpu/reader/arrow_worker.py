@@ -35,9 +35,11 @@ class ArrowReaderWorker(WorkerBase):
     def process(self, piece_index, worker_predicate=None,
                 shuffle_row_drop_partition=(0, 1)):
         piece = self._pieces[piece_index]
+        # Transform repr included: cached tables are post-transform (see
+        # py_dict_worker._cache_key).
         cache_key = (piece.path, piece.row_group, repr(worker_predicate),
                      tuple(sorted(self._read_schema.fields)),
-                     shuffle_row_drop_partition)
+                     shuffle_row_drop_partition, repr(self._transform_spec))
         table = self._cache.get(
             cache_key,
             lambda: self._load_table(piece, worker_predicate,

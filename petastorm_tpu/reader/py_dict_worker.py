@@ -47,9 +47,12 @@ class PyDictReaderWorker(WorkerBase):
             self.publish_func(rows)
 
     def _cache_key(self, piece, worker_predicate, shuffle_row_drop_partition):
+        # Cached rows are POST-transform: the transform repr must be in the
+        # key or a persistent cache serves rows transformed by a stale func.
         fields = sorted(self._read_schema.fields)
         return (piece.path, piece.row_group, repr(worker_predicate),
-                tuple(fields), shuffle_row_drop_partition)
+                tuple(fields), shuffle_row_drop_partition,
+                repr(self._transform_spec))
 
     def _load_rows(self, piece, worker_predicate, shuffle_row_drop_partition):
         if worker_predicate is not None:

@@ -69,6 +69,7 @@ def make_reader(dataset_url,
     Raises a pointed error directing to :func:`make_batch_reader` when the
     store is plain Parquet.
     """
+    cur_shard, shard_count = _default_shard_options(cur_shard, shard_count)
     resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
                                   storage_options=storage_options,
                                   filesystem=filesystem)
@@ -134,6 +135,7 @@ def make_batch_reader(dataset_url_or_urls,
     """
     if isinstance(schema_fields, NGram):
         raise ValueError("NGram is not supported by make_batch_reader")
+    cur_shard, shard_count = _default_shard_options(cur_shard, shard_count)
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, hdfs_driver=hdfs_driver,
         storage_options=storage_options, filesystem=filesystem)
@@ -174,6 +176,16 @@ def make_batch_reader(dataset_url_or_urls,
                   cache=cache,
                   transform_spec=transform_spec,
                   filters=filters)
+
+
+def _default_shard_options(cur_shard, shard_count):
+    """On a multi-host JAX pod with no explicit sharding, default to
+    ``jax.process_index()/process_count()`` so every host reads a disjoint
+    row-group shard (the docstring promise 'the JAX loader does this for
+    you'). Single-process (or JAX absent): unchanged."""
+    from petastorm_tpu.jax_utils.sharding import default_shard_options
+
+    return default_shard_options(cur_shard, shard_count)
 
 
 def _make_cache(cache_type, cache_location, cache_size_limit,
@@ -266,12 +278,24 @@ class Reader:
         # --- row-group planning ------------------------------------------
         pieces = self._enumerate_pieces(filters)
         if rowgroup_selector is not None:
-            pieces = self._apply_selector(pieces, rowgroup_selector)
+            # With filters=None (single path) pieces IS the canonical
+            # load_row_groups list — don't enumerate the store twice.
+            canonical = (pieces if filters is None
+                         and not isinstance(dataset_path, list) else None)
+            pieces = self._apply_selector(pieces, rowgroup_selector, canonical)
+        pre_shard_count = len(pieces)
         pieces = self._shard_pieces(pieces, cur_shard, shard_count, shard_seed)
-        if not pieces:
+        if not pieces and pre_shard_count > 0:
+            # Empty *shard* of a non-empty dataset: a valid reader that yields
+            # nothing, so the host process survives to coordinate (raising
+            # would kill it outright). NOTE equal SPMD step counts are NOT
+            # automatic in this state — pad can't synthesize batches from zero
+            # rows; the training loop must agree on steps (e.g. loader
+            # max_batches=0 everywhere, or fewer shards than row groups).
+            pass
+        elif not pieces:
             raise NoDataAvailableError(
-                "No row groups left after filters/selector/sharding — nothing "
-                "to read"
+                "No row groups left after filters/selector — nothing to read"
             )
         self._pieces = pieces
 
@@ -323,7 +347,7 @@ class Reader:
                 pieces.append(RowGroupPiece(fragment.path, rg.id, rg.num_rows))
         return pieces
 
-    def _apply_selector(self, pieces, rowgroup_selector):
+    def _apply_selector(self, pieces, rowgroup_selector, canonical=None):
         from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
 
         if isinstance(self._dataset_path, list):
@@ -331,7 +355,15 @@ class Reader:
                              "dataset URLs")
         index_dict = get_row_group_indexes(self._filesystem, self._dataset_path)
         selected = rowgroup_selector.select_row_groups(index_dict)
-        return [piece for index, piece in enumerate(pieces) if index in selected]
+        # Selector ordinals are canonical (load_row_groups order); ``pieces``
+        # may already be pruned by ``filters``, so match by (path, row_group)
+        # identity rather than by position in the pruned list.
+        if canonical is None:
+            canonical = load_row_groups(self._filesystem, self._dataset_path)
+        selected_ids = {(p.path, p.row_group)
+                        for index, p in enumerate(canonical) if index in selected}
+        return [piece for piece in pieces
+                if (piece.path, piece.row_group) in selected_ids]
 
     def _shard_pieces(self, pieces, cur_shard, shard_count, shard_seed):
         if shard_count is None:
@@ -343,8 +375,10 @@ class Reader:
         if not sharded:
             warnings.warn(
                 f"Shard {cur_shard}/{shard_count} received zero row groups "
-                f"(dataset has only {len(pieces)}); SPMD consumers will stall "
-                f"unless the loader pads per-host step counts",
+                f"(dataset has only {len(pieces)}); this reader yields "
+                f"nothing. SPMD consumers must coordinate per-host step "
+                f"counts themselves (zero rows cannot be padded into "
+                f"batches) — prefer shard_count <= row-group count",
                 UserWarning, stacklevel=3,
             )
         return sharded

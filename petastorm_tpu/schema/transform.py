@@ -25,6 +25,17 @@ class TransformSpec:
     def __eq__(self, other):
         return isinstance(other, TransformSpec) and self.__dict__ == other.__dict__
 
+    def __repr__(self):
+        # Deterministic (address-free): part of the persistent disk-cache key —
+        # cached values are post-transform, so a changed transform must change
+        # the key (same contract as PredicateBase reprs).
+        from petastorm_tpu.predicates import _func_fingerprint
+
+        func = _func_fingerprint(self.func) if self.func is not None else None
+        return (f"TransformSpec({func}, edit={self.edit_fields!r}, "
+                f"removed={self.removed_fields!r}, "
+                f"selected={self.selected_fields!r})")
+
 
 def _as_unischema_field(field_spec):
     if isinstance(field_spec, UnischemaField):

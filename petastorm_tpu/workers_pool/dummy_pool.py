@@ -42,9 +42,11 @@ class DummyPool:
             if self._ventilator is not None:
                 self._ventilator.processed_item()
 
-    def get_results(self, timeout=60):
+    def get_results(self, timeout=None):
         # The concurrent ventilator (if any) runs on its own thread and calls
         # back into ventilate(); wait for it to either produce or complete.
+        # Default waits forever: a single slow row group (large images over a
+        # remote store) is normal, not a failure.
         from petastorm_tpu.workers_pool import TimeoutWaitingForResultError
 
         deadline = time.monotonic() + timeout if timeout else None
@@ -60,6 +62,11 @@ class DummyPool:
             if error is not None:
                 raise RuntimeError(f"Ventilation failed: {error!r}") from error
             if self._stopped or self._ventilator is None or self._ventilator.completed():
+                # The ventilator thread may have appended results between the
+                # emptiness check above and completed() flipping true — re-check
+                # before declaring the stream drained, or the tail is lost.
+                if self._results:
+                    continue
                 raise EmptyResultError()
             time.sleep(0.001)
 

@@ -7,12 +7,20 @@ Env vars must be set before jax is first imported anywhere in the test run.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session has a real TPU attached (JAX_PLATFORMS=axon):
+# the suite needs 8 virtual devices to exercise sharding; the single real chip
+# is for bench.py only. The axon sitecustomize overrides the JAX_PLATFORMS env
+# var via jax.config, so we must override back through jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys  # noqa: E402
 
