@@ -17,7 +17,7 @@ from __future__ import annotations
 from collections import deque
 
 from petastorm_tpu.schema.transform import transform_schema
-from petastorm_tpu.utils import decode_row
+from petastorm_tpu.utils import decode_row, decode_table
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -57,15 +57,20 @@ class PyDictReaderWorker(WorkerBase):
     def _load_rows(self, piece, worker_predicate, shuffle_row_drop_partition):
         if worker_predicate is not None:
             storage_rows = self._read_with_predicate(piece, worker_predicate)
+            storage_rows = self._drop_partition(storage_rows,
+                                                shuffle_row_drop_partition)
+            decoded = [decode_row(row, self._read_schema)
+                       for row in storage_rows]
         else:
             columns = self._needed_columns()
             table = piece.read(self._filesystem, columns=columns)
-            storage_rows = table.to_pylist()
+            this_partition, num_partitions = shuffle_row_drop_partition
+            if num_partitions > 1:
+                import numpy as np
 
-        storage_rows = self._drop_partition(storage_rows,
-                                            shuffle_row_drop_partition)
-
-        decoded = [decode_row(row, self._read_schema) for row in storage_rows]
+                table = table.take(np.arange(this_partition, table.num_rows,
+                                             num_partitions))
+            decoded = decode_table(table, self._read_schema)
 
         if self._ngram is not None:
             windows = self._ngram.form_ngram(decoded, self._read_schema)
@@ -155,8 +160,16 @@ class PyDictResultsQueueReader:
     def read_next(self, pool, schema, ngram):
         while not self._buffer:
             rows = pool.get_results()  # raises EmptyResultError at end of data
-            self._buffer.extend(rows)
-        row = self._buffer.popleft()
-        if ngram is not None:
-            return ngram.make_namedtuple(schema, row)
-        return schema.make_namedtuple(**row)
+            # Convert the whole delivered row-group at once: namedtuple
+            # construction via map(row.get, fields) is the consumer's hot
+            # loop and caps pool throughput (it is serial no matter how many
+            # workers feed it).
+            if ngram is not None:
+                self._buffer.extend(
+                    ngram.make_namedtuple(schema, row) for row in rows)
+            else:
+                nt = schema._get_namedtuple()
+                fields = schema.field_names
+                self._buffer.extend(
+                    nt(*map(row.get, fields)) for row in rows)
+        return self._buffer.popleft()

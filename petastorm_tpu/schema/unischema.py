@@ -112,12 +112,19 @@ class Unischema:
             )
         return self._namedtuple
 
+    @property
+    def field_names(self):
+        """Field names in schema order (cached tuple — hot-path helper)."""
+        names = getattr(self, "_field_names", None)
+        if names is None:
+            names = self._field_names = tuple(self._fields)
+        return names
+
     def make_namedtuple(self, **kwargs):
         """Build a row namedtuple from per-field kwargs (missing nullable -> None)."""
-        typed = {}
-        for name in self._fields:
-            typed[name] = kwargs.get(name, None)
-        return self._get_namedtuple()(**typed)
+        # map(dict.get, ...) runs the per-field loop in C — this is the
+        # consumer-side hot path (one call per delivered row, §3.2).
+        return self._get_namedtuple()(*map(kwargs.get, self.field_names))
 
     def make_namedtuple_tf(self, *args, **kwargs):
         return self._get_namedtuple()(*args, **kwargs)

@@ -14,6 +14,71 @@ class DecodeFieldError(RuntimeError):
     pass
 
 
+def decode_table(table, schema):
+    """Columnar decode of a whole ``pa.Table`` into a list of row dicts.
+
+    Same result as ``[decode_row(r, schema) for r in table.to_pylist()]`` but
+    decodes column-at-a-time: numeric scalar columns convert through one
+    ``to_numpy`` call (C loop) instead of per-cell ``np.dtype(...).type(v)``,
+    and only one dict per row is built. This is the no-predicate hot path of
+    ``PyDictReaderWorker`` (reference hot-loop analysis: SURVEY.md §3.2).
+    """
+    names, cols = [], []
+    for name in table.column_names:
+        field = schema.fields.get(name)
+        if field is None:
+            continue
+        names.append(name)
+        cols.append(_decode_column(table.column(name), field))
+    if not names:
+        return []
+    return [dict(zip(names, vals)) for vals in zip(*cols)]
+
+
+def _decode_column(col, field):
+    from petastorm_tpu.schema.codecs import ScalarCodec
+
+    try:
+        if field.codec is not None:
+            if isinstance(field.codec, ScalarCodec):
+                fast = _fast_numeric_column(col, field)
+                if fast is not None:
+                    return fast
+            decode = field.codec.decode
+            return [None if v is None else decode(field, v)
+                    for v in col.to_pylist()]
+        if field.shape:
+            dtype = np.dtype(field.numpy_dtype)
+            return [None if v is None else np.asarray(v, dtype=dtype)
+                    for v in col.to_pylist()]
+        fast = _fast_numeric_column(col, field)
+        if fast is not None:
+            return fast
+        codec = ScalarCodec()
+        return [None if v is None else codec.decode(field, v)
+                for v in col.to_pylist()]
+    except Exception as exc:
+        raise DecodeFieldError(
+            f"Decoding field {field.name!r} failed: {exc}") from exc
+
+
+def _fast_numeric_column(col, field):
+    """Whole-column numeric conversion; None when the dtype needs the
+    per-cell path (strings, Decimal, datetime, nulls present)."""
+    from decimal import Decimal
+
+    if field.numpy_dtype in (str, bytes, np.str_, np.bytes_, Decimal):
+        return None
+    try:
+        dtype = np.dtype(field.numpy_dtype)
+    except TypeError:
+        return None
+    if dtype.kind not in "biuf" or col.null_count:
+        return None
+    arr = col.to_numpy(zero_copy_only=False).astype(dtype, copy=False)
+    return list(arr)
+
+
 def decode_row(row, schema):
     """Decode all fields of one storage-row dict into numpy-land values.
 
