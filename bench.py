@@ -182,7 +182,7 @@ def main():
         # collapsing throughput for the rest of the process — so keep total
         # volume low (bf16 staging), measure the headline (pipelined) leg
         # FIRST, and take the best of a small number of repeats.
-        repeats = int(os.environ.get("BENCH_REPEATS", "2"))
+        repeats = max(1, int(os.environ.get("BENCH_REPEATS", "2")))
         # donate_argnums deletes the params passed in, so every repeat must
         # consume the params the previous repeat returned.
         params, step = _make_model()

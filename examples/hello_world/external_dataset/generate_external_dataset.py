@@ -1,0 +1,30 @@
+"""Generate a plain-Parquet ("external") dataset — no petastorm metadata.
+
+Reference analogue: ``examples/hello_world/external_dataset/generate_external_dataset.py``.
+"""
+
+import argparse
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def generate_external_dataset(output_url, rows_count=50):
+    path = output_url[7:] if output_url.startswith("file://") else output_url
+    table = pa.table({
+        "id": list(range(rows_count)),
+        "value1": [i * 2.0 for i in range(rows_count)],
+        "value2": [f"text_{i}" for i in range(rows_count)],
+    })
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, f"{path}/data.parquet", row_group_size=10)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output-url", default="file:///tmp/external_dataset")
+    args = parser.parse_args()
+    generate_external_dataset(args.output_url)
+    print(f"Dataset written to {args.output_url}")

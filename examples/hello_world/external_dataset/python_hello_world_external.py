@@ -1,0 +1,21 @@
+"""Read a plain-Parquet store with make_batch_reader.
+
+Reference analogue: ``examples/hello_world/external_dataset/python_hello_world_external.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+
+
+def python_hello_world_external(dataset_url):
+    with make_batch_reader(dataset_url) as reader:
+        for batch in reader:
+            print(len(batch.id), "rows; first:", batch.id[0], batch.value2[0])
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/external_dataset")
+    args = parser.parse_args()
+    python_hello_world_external(args.dataset_url)

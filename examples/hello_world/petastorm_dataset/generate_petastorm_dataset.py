@@ -1,0 +1,45 @@
+"""Generate the hello-world petastorm-format dataset.
+
+Reference analogue: ``examples/hello_world/petastorm_dataset/
+generate_petastorm_dataset.py`` — same schema shape (id, 128x256 image,
+4x128 matrix), Spark replaced by the in-process pyarrow writer.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu.etl.metadata import materialize_rows
+from petastorm_tpu.schema.codecs import (CompressedImageCodec, NdarrayCodec,
+                                         ScalarCodec)
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+HelloWorldSchema = Unischema("HelloWorldSchema", [
+    UnischemaField("id", np.int32, (), ScalarCodec(), False),
+    UnischemaField("image1", np.uint8, (128, 256, 3),
+                   CompressedImageCodec("png"), False),
+    UnischemaField("array_4d", np.uint8, (None, 128, 30, None),
+                   NdarrayCodec(), False),
+])
+
+
+def row_generator(x):
+    """Returns a single entry in the generated dataset."""
+    rng = np.random.RandomState(x)
+    return {"id": x,
+            "image1": rng.randint(0, 255, (128, 256, 3), dtype=np.uint8),
+            "array_4d": rng.randint(0, 255, (4, 128, 30, 3), dtype=np.uint8)}
+
+
+def generate_petastorm_dataset(output_url, rows_count=10):
+    rows = [row_generator(x) for x in range(rows_count)]
+    materialize_rows(output_url, HelloWorldSchema, rows,
+                     rows_per_row_group=5)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output-url", default="file:///tmp/hello_world_dataset")
+    args = parser.parse_args()
+    generate_petastorm_dataset(args.output_url)
+    print(f"Dataset written to {args.output_url}")

@@ -1,0 +1,21 @@
+"""Read the hello-world dataset with plain Python iteration.
+
+Reference analogue: ``examples/hello_world/petastorm_dataset/python_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+
+
+def python_hello_world(dataset_url):
+    with make_reader(dataset_url) as reader:
+        for row in reader:
+            print(row.id, row.image1.shape, row.array_4d.shape)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/hello_world_dataset")
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)

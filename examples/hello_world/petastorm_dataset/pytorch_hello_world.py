@@ -1,0 +1,24 @@
+"""Read the hello-world dataset through the torch DataLoader.
+
+Reference analogue: ``examples/hello_world/petastorm_dataset/pytorch_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.pytorch import DataLoader
+
+
+def pytorch_hello_world(dataset_url):
+    reader = make_reader(dataset_url, schema_fields=["id", "image1"],
+                         num_epochs=1)
+    with DataLoader(reader, batch_size=4) as loader:
+        for batch in loader:
+            print(batch["id"].tolist(), batch["image1"].shape)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/hello_world_dataset")
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)

@@ -1,0 +1,23 @@
+"""Read the hello-world dataset as a tf.data.Dataset.
+
+Reference analogue: ``examples/hello_world/petastorm_dataset/tensorflow_hello_world.py``.
+"""
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url):
+    with make_reader(dataset_url, schema_fields=["id", "image1"]) as reader:
+        dataset = make_petastorm_dataset(reader)
+        for tensor in dataset.take(3):
+            print(int(tensor.id.numpy()), tensor.image1.shape)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/hello_world_dataset")
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)

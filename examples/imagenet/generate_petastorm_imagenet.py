@@ -1,0 +1,52 @@
+"""Materialize an ImageNet-pattern petastorm dataset from an image directory.
+
+Reference analogue: ``examples/imagenet/generate_petastorm_imagenet.py``.
+Expects ``<input-dir>/<noun_id>/*.jpg`` layout; with ``--synthetic`` writes
+random image rows instead (no corpus in this environment).
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from examples.imagenet.schema import ImagenetSchema
+from petastorm_tpu.etl.metadata import materialize_rows
+
+
+def _synthetic_rows(count):
+    rng = np.random.RandomState(0)
+    for i in range(count):
+        yield {"noun_id": f"n{i:08d}",
+               "text": f"synthetic noun {i}",
+               "image": rng.randint(0, 255, (375, 500, 3), dtype=np.uint8)}
+
+
+def _directory_rows(input_dir):
+    import cv2
+
+    for noun_id in sorted(os.listdir(input_dir)):
+        noun_dir = os.path.join(input_dir, noun_id)
+        if not os.path.isdir(noun_dir):
+            continue
+        for name in sorted(os.listdir(noun_dir)):
+            image = cv2.imread(os.path.join(noun_dir, name))
+            if image is None:
+                continue
+            image = cv2.resize(image, (500, 375))
+            yield {"noun_id": noun_id, "text": noun_id, "image": image}
+
+
+def generate_petastorm_imagenet(output_url, input_dir=None, count=32):
+    rows = _directory_rows(input_dir) if input_dir else _synthetic_rows(count)
+    materialize_rows(output_url, ImagenetSchema, rows, row_group_size_mb=64)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output-url", default="file:///tmp/imagenet_petastorm")
+    parser.add_argument("--input-dir", default=None)
+    parser.add_argument("--count", type=int, default=32)
+    args = parser.parse_args()
+    generate_petastorm_imagenet(args.output_url, args.input_dir, args.count)
+    print(f"Dataset written to {args.output_url}")

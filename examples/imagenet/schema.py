@@ -1,0 +1,17 @@
+"""ImageNet petastorm schema.
+
+Reference analogue: ``examples/imagenet/schema.py`` — same field shapes
+(noun_id, text, 375x500x3 uint8 png image), BASELINE.md config #2 pattern.
+"""
+
+import numpy as np
+
+from petastorm_tpu.schema.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema("ImagenetSchema", [
+    UnischemaField("noun_id", str, (), ScalarCodec(), False),
+    UnischemaField("text", str, (), ScalarCodec(), False),
+    UnischemaField("image", np.uint8, (375, 500, 3),
+                   CompressedImageCodec("png"), False),
+])

@@ -1,0 +1,66 @@
+"""Materialize an MNIST-shaped petastorm dataset.
+
+Reference analogue: ``examples/mnist/generate_petastorm_mnist.py``
+(BASELINE.md config #1). With no network access, ``--synthetic`` (default)
+generates MNIST-shaped random digits; pass ``--data-dir`` with the standard
+IDX files to convert the real corpus.
+"""
+
+import argparse
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from petastorm_tpu.etl.metadata import materialize_rows
+from petastorm_tpu.schema.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema("MnistSchema", [
+    UnischemaField("idx", np.int64, (), ScalarCodec(), False),
+    UnischemaField("digit", np.int64, (), ScalarCodec(), False),
+    UnischemaField("image", np.uint8, (28, 28), CompressedImageCodec("png"),
+                   False),
+])
+
+
+def _read_idx(images_path, labels_path):
+    with gzip.open(images_path, "rb") as f:
+        _, n, h, w = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), np.uint8).reshape(n, h, w)
+    with gzip.open(labels_path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), np.uint8)
+    return images, labels
+
+
+def mnist_rows(data_dir=None, split="train", count=1000):
+    if data_dir:
+        images, labels = _read_idx(
+            os.path.join(data_dir, f"{split}-images-idx3-ubyte.gz"),
+            os.path.join(data_dir, f"{split}-labels-idx1-ubyte.gz"))
+    else:  # synthetic MNIST-shaped data (no network in this environment)
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 255, (count, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, count)
+    for i, (image, label) in enumerate(zip(images, labels)):
+        yield {"idx": i, "digit": int(label), "image": np.ascontiguousarray(image)}
+
+
+def generate_petastorm_mnist(output_url, data_dir=None, count=1000):
+    materialize_rows(output_url, MnistSchema,
+                     mnist_rows(data_dir, count=count),
+                     rows_per_row_group=200)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--output-url", default="file:///tmp/mnist_petastorm")
+    parser.add_argument("--data-dir", default=None,
+                        help="directory with MNIST idx .gz files "
+                             "(default: synthetic)")
+    parser.add_argument("--count", type=int, default=1000)
+    args = parser.parse_args()
+    generate_petastorm_mnist(args.output_url, args.data_dir, args.count)
+    print(f"MNIST dataset written to {args.output_url}")

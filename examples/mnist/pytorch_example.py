@@ -1,0 +1,52 @@
+"""Train a small torch model on the MNIST petastorm dataset.
+
+Reference analogue: ``examples/mnist/pytorch_example.py``.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.pytorch import DataLoader
+from petastorm_tpu.schema.transform import TransformSpec
+
+
+def _to_float(row):
+    row["image"] = row["image"].astype(np.float32) / 255.0
+    return row
+
+
+def train(dataset_url, epochs=1, batch_size=64, lr=0.01):
+    import torch
+    import torch.nn.functional as F
+
+    model = torch.nn.Sequential(
+        torch.nn.Flatten(),
+        torch.nn.Linear(28 * 28, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr)
+    spec = TransformSpec(_to_float,
+                         edit_fields=[("image", np.float32, (28, 28), False)])
+    for epoch in range(epochs):
+        reader = make_reader(dataset_url, schema_fields=["image", "digit"],
+                             transform_spec=spec, num_epochs=1)
+        losses = []
+        with DataLoader(reader, batch_size=batch_size,
+                        shuffling_queue_capacity=512) as loader:
+            for batch in loader:
+                optimizer.zero_grad()
+                logits = model(batch["image"])
+                loss = F.cross_entropy(logits, batch["digit"])
+                loss.backward()
+                optimizer.step()
+                losses.append(loss.item())
+        print(f"epoch {epoch}: loss={float(np.mean(losses)):.4f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/mnist_petastorm")
+    parser.add_argument("--epochs", type=int, default=1)
+    args = parser.parse_args()
+    train(args.dataset_url, args.epochs)

@@ -1,0 +1,45 @@
+"""Train a small Keras model on the MNIST petastorm dataset.
+
+Reference analogue: ``examples/mnist/tf_example.py``.
+"""
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.schema.transform import TransformSpec
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def _to_float(row):
+    row["image"] = row["image"].astype(np.float32) / 255.0
+    return row
+
+
+def train(dataset_url, epochs=1, batch_size=64):
+    import tensorflow as tf
+
+    spec = TransformSpec(_to_float,
+                         edit_fields=[("image", np.float32, (28, 28), False)])
+    model = tf.keras.Sequential([
+        tf.keras.layers.Flatten(input_shape=(28, 28)),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10)])
+    model.compile(optimizer="sgd",
+                  loss=tf.keras.losses.SparseCategoricalCrossentropy(
+                      from_logits=True))
+    with make_reader(dataset_url, schema_fields=["image", "digit"],
+                     transform_spec=spec, num_epochs=epochs) as reader:
+        dataset = make_petastorm_dataset(reader) \
+            .map(lambda row: (row.image, row.digit)) \
+            .batch(batch_size)
+        model.fit(dataset, verbose=2)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset-url", default="file:///tmp/mnist_petastorm")
+    parser.add_argument("--epochs", type=int, default=1)
+    args = parser.parse_args()
+    train(args.dataset_url, args.epochs)

@@ -1,0 +1,33 @@
+"""DataFrame → JAX pipeline in one call via the dataset converter.
+
+Reference analogue: ``examples/spark_dataset_converter/*_converter_example.py``
+with the new JAX surface.
+"""
+
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from petastorm_tpu.spark import make_spark_converter, set_parent_cache_dir_url
+
+
+def main():
+    with tempfile.TemporaryDirectory() as cache_dir:
+        set_parent_cache_dir_url(f"file://{cache_dir}")
+        df = pd.DataFrame({
+            "features": np.random.rand(256).astype(np.float64),
+            "label": np.random.randint(0, 2, 256),
+        })
+        converter = make_spark_converter(df)  # floats cast to float32
+        print(f"materialized {len(converter)} rows at {converter.cache_dir_url}")
+        with converter.make_jax_dataloader(batch_size=64, num_epochs=1) \
+                as loader:
+            for batch in loader:
+                print("batch:", batch["features"].shape,
+                      batch["features"].dtype)
+        converter.delete()
+
+
+if __name__ == "__main__":
+    main()

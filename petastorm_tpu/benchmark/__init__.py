@@ -1,0 +1,10 @@
+"""Reader throughput benchmark (library + CLI).
+
+Reference parity: ``petastorm/benchmark/`` (``throughput.py``, ``cli.py``;
+console script ``petastorm-throughput.py``) — SURVEY.md §2.6. Run as
+``python -m petastorm_tpu.benchmark <dataset_url>``.
+"""
+
+from petastorm_tpu.benchmark.throughput import BenchmarkResult, reader_throughput
+
+__all__ = ["reader_throughput", "BenchmarkResult"]

@@ -1,0 +1,3 @@
+from petastorm_tpu.benchmark.cli import main
+
+raise SystemExit(main())

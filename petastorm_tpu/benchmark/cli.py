@@ -1,0 +1,49 @@
+"""``petastorm-tpu-throughput`` console entry.
+
+Reference parity: ``petastorm/benchmark/cli.py`` (console script
+``petastorm-throughput.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Measure Reader throughput (rows/sec) on a dataset")
+    parser.add_argument("dataset_url")
+    parser.add_argument("--field-regex", nargs="*", default=None,
+                        help="read only fields matching these regexes")
+    parser.add_argument("-w", "--warmup-cycles", type=int, default=200)
+    parser.add_argument("-m", "--measure-cycles", type=int, default=1000)
+    parser.add_argument("-p", "--pool-type", default="thread",
+                        choices=["thread", "process", "dummy"])
+    parser.add_argument("-l", "--loaders-count", type=int, default=3)
+    parser.add_argument("--read-method", default="python",
+                        choices=["python", "arrow"])
+    parser.add_argument("--jax-loader", action="store_true",
+                        help="measure through make_jax_dataloader "
+                             "(adds input-stall %%)")
+    parser.add_argument("--jax-batch-size", type=int, default=128)
+    args = parser.parse_args(argv)
+
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles_count=args.warmup_cycles,
+        measure_cycles_count=args.measure_cycles,
+        pool_type=args.pool_type, loaders_count=args.loaders_count,
+        read_method=args.read_method, apply_jax_loader=args.jax_loader,
+        jax_batch_size=args.jax_batch_size)
+    stall = (f", input_stall={result.input_stall_pct:.2f}%"
+             if result.input_stall_pct is not None else "")
+    print(f"{result.rows_per_second:.1f} rows/sec "
+          f"({result.rows_count} rows in {result.duration_s:.2f}s{stall})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

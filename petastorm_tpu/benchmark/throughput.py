@@ -1,0 +1,92 @@
+"""Measure Reader rows/sec (and input-stall %) under pool/worker configs.
+
+Reference parity: ``petastorm/benchmark/throughput.py::reader_throughput`` →
+``BenchmarkResult`` — SURVEY.md §2.6. Additions over the reference: an
+optional ``spawn_new_process``-free JAX-loader mode that reports the
+north-star input-stall % alongside rows/sec.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import namedtuple
+
+BenchmarkResult = namedtuple(
+    "BenchmarkResult",
+    ["rows_per_second", "rows_count", "duration_s", "input_stall_pct"])
+
+
+def reader_throughput(dataset_url, field_regex=None,
+                      warmup_cycles_count=200, measure_cycles_count=1000,
+                      pool_type="thread", loaders_count=3,
+                      read_method="python",
+                      shuffle_row_groups=True,
+                      apply_jax_loader=False, jax_batch_size=128,
+                      **reader_kwargs):
+    """Read ``warmup_cycles_count`` rows off the clock, then time
+    ``measure_cycles_count`` rows.
+
+    :param field_regex: list of field-name regexes to read (None = all).
+    :param pool_type: 'thread' | 'process' | 'dummy'.
+    :param loaders_count: workers_count for the pool.
+    :param read_method: 'python' (make_reader) or 'arrow' (make_batch_reader —
+        cycles then count record batches, as upstream).
+    :param apply_jax_loader: measure through ``make_jax_dataloader`` (cycles
+        count batches of ``jax_batch_size``); reports stall %.
+    """
+    from petastorm_tpu.reader.reader import make_batch_reader, make_reader
+
+    factory = {"python": make_reader, "arrow": make_batch_reader}.get(read_method)
+    if factory is None:
+        raise ValueError(f"Unknown read_method {read_method!r}")
+    reader = factory(dataset_url,
+                     schema_fields=field_regex,
+                     reader_pool_type=pool_type,
+                     workers_count=loaders_count,
+                     shuffle_row_groups=shuffle_row_groups,
+                     num_epochs=None,
+                     **reader_kwargs)
+    try:
+        if apply_jax_loader:
+            return _loader_throughput(reader, warmup_cycles_count,
+                                      measure_cycles_count, jax_batch_size)
+        return _raw_throughput(reader, warmup_cycles_count,
+                               measure_cycles_count)
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def _raw_throughput(reader, warmup, measure):
+    it = iter(reader)
+    for _ in range(warmup):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        next(it)
+    duration = time.perf_counter() - t0
+    return BenchmarkResult(rows_per_second=measure / duration,
+                           rows_count=measure, duration_s=duration,
+                           input_stall_pct=None)
+
+
+def _loader_throughput(reader, warmup, measure, batch_size):
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+
+    loader = make_jax_dataloader(reader, batch_size,
+                                 non_tensor_policy="drop",
+                                 max_batches=warmup + measure)
+    it = iter(loader)
+    for _ in range(warmup):
+        next(it)
+    rows = 0
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        batch = next(it)
+        rows += next(v.shape[0] for v in batch.values() if hasattr(v, "shape"))
+    duration = time.perf_counter() - t0
+    loader.stop()
+    loader.join()
+    return BenchmarkResult(rows_per_second=rows / duration, rows_count=rows,
+                           duration_s=duration,
+                           input_stall_pct=loader.diagnostics["input_stall_pct"])

@@ -1,0 +1,75 @@
+"""Inspect a petastorm dataset's metadata: schema, row groups, indexes.
+
+Reference parity: ``petastorm/etl/metadata_util.py`` (argparse inspector).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu.errors import PetastormMetadataError
+from petastorm_tpu.fs_utils import FilesystemResolver
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Print schema / row-group / index info for a dataset")
+    parser.add_argument("dataset_url")
+    parser.add_argument("--schema", action="store_true",
+                        help="print the Unischema fields")
+    parser.add_argument("--index", action="store_true",
+                        help="print rowgroup index summary")
+    parser.add_argument("--print-values", action="store_true",
+                        help="with --index: print indexed values")
+    parser.add_argument("--skip-index", nargs="*", default=[],
+                        help="index names to omit")
+    args = parser.parse_args(argv)
+
+    resolver = FilesystemResolver(args.dataset_url)
+    fs = resolver.filesystem()
+    path = resolver.get_dataset_path()
+
+    from petastorm_tpu.etl import metadata as etl_metadata
+
+    pieces = etl_metadata.load_row_groups(fs, path)
+    files = {p.path for p in pieces}
+    counts = [p.num_rows for p in pieces]
+    rows = sum(counts) if all(c is not None for c in counts) else "unknown"
+    print(f"Dataset: {args.dataset_url}")
+    print(f"Files: {len(files)}  Row groups: {len(pieces)}  Rows: {rows}")
+
+    if args.schema:
+        try:
+            schema = etl_metadata.get_schema(fs, path)
+            print(f"\nUnischema: {schema._name}")
+            for name, field in schema.fields.items():
+                print(f"  {name}: dtype={field.numpy_dtype}, "
+                      f"shape={field.shape}, codec={type(field.codec).__name__ if field.codec else None}, "
+                      f"nullable={field.nullable}")
+        except PetastormMetadataError as exc:
+            print(f"\nNo Unischema metadata: {exc}")
+
+    if args.index:
+        from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+
+        try:
+            indexes = get_row_group_indexes(fs, path)
+        except PetastormMetadataError as exc:
+            print(f"\nNo rowgroup index: {exc}")
+            return 0
+        print("\nRowgroup indexes:")
+        for name, indexer in indexes.items():
+            if name in args.skip_index:
+                continue
+            print(f"  {name}: columns={indexer.column_names}, "
+                  f"values={len(indexer.indexed_values)}")
+            if args.print_values:
+                for value in indexer.indexed_values:
+                    print(f"    {value!r} -> "
+                          f"{sorted(indexer.get_row_group_indexes(value))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""(Re)attach petastorm metadata to an existing Parquet dataset.
+
+Reference parity: ``petastorm/etl/petastorm_generate_metadata.py``
+(``generate_petastorm_metadata`` + console script
+``petastorm-generate-metadata.py``). Engine difference: row-group counts are
+enumerated with pyarrow directly instead of a Spark job; the Unischema comes
+from (a) an explicitly named ``module.Class`` unischema, (b) the dataset's
+existing metadata (regeneration), or (c) arrow-schema inference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+from petastorm_tpu.etl import metadata as etl_metadata
+from petastorm_tpu.fs_utils import FilesystemResolver
+
+
+def _load_unischema_by_name(qualified_name):
+    module_name, _, attr = qualified_name.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"--unischema-class must be a fully qualified name "
+            f"(module.ClassName), got {qualified_name!r}")
+    return getattr(importlib.import_module(module_name), attr)
+
+
+def generate_petastorm_metadata(dataset_url, unischema_class=None,
+                                use_summary_metadata=False,
+                                hdfs_driver="libhdfs", storage_options=None,
+                                filesystem=None):
+    """Write ``_common_metadata`` (schema + row-group counts) for a dataset.
+
+    ``unischema_class``: fully qualified ``module.Class`` name of a Unischema
+    instance (reference semantics); None = reuse stored schema or infer from
+    the arrow schema.
+    """
+    resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
+                                  storage_options=storage_options,
+                                  filesystem=filesystem)
+    fs = resolver.filesystem()
+    path = resolver.get_dataset_path()
+
+    if unischema_class is not None:
+        schema = (_load_unischema_by_name(unischema_class)
+                  if isinstance(unischema_class, str) else unischema_class)
+    else:
+        schema = etl_metadata.infer_or_load_unischema(fs, path)
+
+    with etl_metadata.materialize_dataset(
+            None, dataset_url, schema,
+            use_summary_metadata=use_summary_metadata,
+            storage_options=storage_options, filesystem=filesystem):
+        pass  # dataset already written; the exit hook attaches metadata
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Add petastorm metadata to an existing Parquet dataset")
+    parser.add_argument("dataset_url")
+    parser.add_argument("--unischema-class", default=None,
+                        help="fully qualified module.Class of the Unischema "
+                             "(default: reuse stored schema or infer)")
+    parser.add_argument("--use-summary-metadata", action="store_true")
+    args = parser.parse_args(argv)
+    generate_petastorm_metadata(args.dataset_url,
+                                unischema_class=args.unischema_class,
+                                use_summary_metadata=args.use_summary_metadata)
+    print(f"Metadata written for {args.dataset_url}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+"""PyTorch adapter: Reader → iterable DataLoaders of torch tensors.
+
+Reference parity: ``petastorm/pytorch.py`` (``DataLoader``,
+``BatchedDataLoader``, ``InMemBatchedDataLoader``, ``decimal_friendly_collate``,
+``_sanitize_pytorch_types``) — SURVEY.md §2.5, call stack §3.5. Torch lacks
+uint16/uint32/uint64, so those promote to int32/int64/int64; Decimals collate
+to lists of strings (decimal-friendly, as upstream).
+
+Torch import is deferred so the package never pulls torch unless used.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+_UNSIGNED_PROMOTIONS = {"uint16": np.int32, "uint32": np.int64,
+                        "uint64": np.int64}
+
+
+def _sanitize_pytorch_types(row_as_dict):
+    """In-place: promote dtypes torch lacks; leave strings/Decimals alone.
+
+    Reference parity: ``petastorm/pytorch.py::_sanitize_pytorch_types``.
+    """
+    for name, value in row_as_dict.items():
+        if isinstance(value, np.ndarray):
+            promoted = _UNSIGNED_PROMOTIONS.get(value.dtype.name)
+            if promoted is not None:
+                row_as_dict[name] = value.astype(promoted)
+        elif isinstance(value, np.generic):
+            promoted = _UNSIGNED_PROMOTIONS.get(value.dtype.name)
+            if promoted is not None:
+                row_as_dict[name] = promoted(value)
+    return row_as_dict
+
+
+def decimal_friendly_collate(batch):
+    """torch ``default_collate`` that survives ``Decimal`` values (as strings).
+
+    Reference parity: ``petastorm/pytorch.py::decimal_friendly_collate``.
+    """
+    import torch
+    from torch.utils.data._utils.collate import default_collate
+
+    first = batch[0]
+    if isinstance(first, Decimal):
+        return [str(value) for value in batch]
+    if isinstance(first, (str, bytes)):
+        return list(batch)
+    if isinstance(first, dict):
+        return {key: decimal_friendly_collate([row[key] for row in batch])
+                for key in first}
+    if isinstance(first, tuple) and hasattr(first, "_fields"):  # namedtuple
+        return type(first)(*(decimal_friendly_collate(col)
+                             for col in zip(*batch)))
+    if isinstance(first, (list, tuple)):
+        return [decimal_friendly_collate(col) for col in zip(*batch)]
+    if first is None:
+        raise TypeError(
+            "Cannot collate None values; filter nullable fields or use a "
+            "TransformSpec to fill them")
+    return default_collate(batch)
+
+
+class _LoaderBase:
+    """Shared iterator/context-manager shell for the three loaders."""
+
+    def __init__(self, reader):
+        self.reader = reader
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+    def stop(self):
+        self.reader.stop()
+        self.reader.join()
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class DataLoader(_LoaderBase):
+    """Row-wise loader for ``make_reader``: rows → shuffling buffer →
+    fixed-size collated torch batches.
+
+    Reference parity: ``petastorm/pytorch.py::DataLoader``. Iterating yields
+    dicts of tensors (``collate_fn`` decides the exact structure).
+    """
+
+    def __init__(self, reader, batch_size=1,
+                 collate_fn=decimal_friendly_collate,
+                 shuffling_queue_capacity=0, shuffling_queue_seed=None):
+        super().__init__(reader)
+        if getattr(reader, "batched_output", False):
+            raise ValueError(
+                "DataLoader expects a row reader (make_reader); use "
+                "BatchedDataLoader with make_batch_reader")
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._shuffling_queue_seed = shuffling_queue_seed
+
+    def _row_source(self):
+        if not self.shuffling_queue_capacity:
+            yield from self.reader
+            return
+        from petastorm_tpu.reader_impl.shuffling_buffer import (
+            RandomShufflingBuffer,
+        )
+
+        sbuf = RandomShufflingBuffer(
+            self.shuffling_queue_capacity,
+            min_after_retrieve=self.shuffling_queue_capacity // 2,
+            extra_capacity=max(self.shuffling_queue_capacity, 1000),
+            random_seed=self._shuffling_queue_seed)
+        for row in self.reader:
+            sbuf.add_many([row])
+            while not sbuf.can_add() and sbuf.can_retrieve():
+                yield sbuf.retrieve()
+        sbuf.finish()
+        while sbuf.can_retrieve():
+            yield sbuf.retrieve()
+
+    def __iter__(self):
+        batch = []
+        for row in self._row_source():
+            row_dict = _sanitize_pytorch_types(row._asdict())
+            batch.append(row_dict)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch:
+            yield self.collate_fn(batch)
+
+
+class BatchedDataLoader(_LoaderBase):
+    """Column-batch loader for ``make_batch_reader``: record batches →
+    vectorized torch shuffle buffer → fixed-size batches.
+
+    Reference parity: ``petastorm/pytorch.py::BatchedDataLoader``. Yields
+    dicts of tensors; ``transform_fn`` (if given) maps each yielded batch.
+    String/Decimal/object columns cannot become tensors and are rejected —
+    select numeric fields or drop them with a TransformSpec (upstream
+    behavior).
+    """
+
+    def __init__(self, reader, batch_size=1, transform_fn=None,
+                 shuffling_queue_capacity=0, shuffling_queue_seed=None):
+        super().__init__(reader)
+        if not getattr(reader, "batched_output", False):
+            raise ValueError(
+                "BatchedDataLoader expects a batch reader "
+                "(make_batch_reader); use DataLoader with make_reader")
+        self.batch_size = batch_size
+        self.transform_fn = transform_fn
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._shuffling_queue_seed = shuffling_queue_seed
+
+    def _make_buffer(self):
+        from petastorm_tpu.reader_impl.pytorch_shuffling_buffer import (
+            BatchedNoopShufflingBuffer,
+            BatchedRandomShufflingBuffer,
+        )
+
+        if self.shuffling_queue_capacity:
+            return BatchedRandomShufflingBuffer(
+                self.shuffling_queue_capacity,
+                min_after_retrieve=self.shuffling_queue_capacity // 2,
+                extra_capacity=max(self.shuffling_queue_capacity, 100000),
+                batch_size=self.batch_size,
+                random_seed=self._shuffling_queue_seed)
+        return BatchedNoopShufflingBuffer(batch_size=self.batch_size)
+
+    def __iter__(self):
+        import torch
+
+        buffer = self._make_buffer()
+        for col_batch in self.reader:
+            tensors = {}
+            for name, col in col_batch._asdict().items():
+                arr = np.asarray(col)
+                promoted = _UNSIGNED_PROMOTIONS.get(arr.dtype.name)
+                if promoted is not None:
+                    arr = arr.astype(promoted)
+                if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+                    raise TypeError(
+                        f"Column {name!r} (dtype {arr.dtype}) cannot become "
+                        f"a torch tensor; select numeric schema_fields or "
+                        f"drop it with a TransformSpec")
+                if not arr.flags.writeable:
+                    arr = arr.copy()  # arrow-backed buffers are read-only
+                tensors[name] = torch.as_tensor(arr)
+            buffer.add_many(tensors)
+            while not buffer.can_add() and buffer.can_retrieve():
+                yield self._emit(buffer.retrieve())
+        buffer.finish()
+        while buffer.can_retrieve():
+            yield self._emit(buffer.retrieve())
+
+    def _emit(self, batch):
+        return self.transform_fn(batch) if self.transform_fn else batch
+
+
+class InMemBatchedDataLoader(_LoaderBase):
+    """Caches every row in memory once, then serves shuffled batches for
+    ``num_epochs`` without re-reading Parquet.
+
+    Reference parity: ``petastorm/pytorch.py::InMemBatchedDataLoader``.
+    """
+
+    def __init__(self, reader, batch_size=1, num_epochs=1, rows_capacity=None,
+                 shuffle=True, random_seed=None):
+        super().__init__(reader)
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.shuffle = shuffle
+        self._rows_capacity = rows_capacity
+        self._random_seed = random_seed
+        self._cache = None  # dict name -> tensor [N, ...]
+
+    def _fill_cache(self):
+        import torch
+
+        if getattr(self.reader, "batched_output", False):
+            chunks = {}
+            cached_rows = 0
+            for col_batch in self.reader:
+                for name, col in col_batch._asdict().items():
+                    chunk = np.asarray(col)
+                    chunks.setdefault(name, []).append(
+                        torch.as_tensor(chunk.copy()
+                                        if not chunk.flags.writeable
+                                        else chunk))
+                cached_rows += len(next(iter(col_batch)))
+                # capacity must bound the read loop itself — with
+                # num_epochs=None the stream never ends on its own
+                if self._rows_capacity and cached_rows >= self._rows_capacity:
+                    break
+            self._cache = {k: torch.cat(v) for k, v in chunks.items()}
+        else:
+            rows = []
+            for row in self.reader:
+                rows.append(_sanitize_pytorch_types(row._asdict()))
+                if self._rows_capacity and len(rows) >= self._rows_capacity:
+                    break
+            if not rows:
+                self._cache = {}
+                return
+            self._cache = {
+                name: torch.as_tensor(
+                    np.stack([np.asarray(r[name]) for r in rows]))
+                for name in rows[0]}
+        if self._rows_capacity:
+            self._cache = {k: v[:self._rows_capacity]
+                           for k, v in self._cache.items()}
+
+    def __iter__(self):
+        import torch
+
+        if self._cache is None:
+            self._fill_cache()
+        if not self._cache:
+            return
+        n = next(iter(self._cache.values())).shape[0]
+        generator = torch.Generator()
+        if self._random_seed is not None:
+            generator.manual_seed(self._random_seed)
+        for _ in range(self.num_epochs):
+            order = (torch.randperm(n, generator=generator) if self.shuffle
+                     else torch.arange(n))
+            for start in range(0, n, self.batch_size):
+                idx = order[start:start + self.batch_size]
+                yield {k: v.index_select(0, idx)
+                       for k, v in self._cache.items()}

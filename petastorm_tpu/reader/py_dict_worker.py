@@ -168,8 +168,5 @@ class PyDictResultsQueueReader:
                 self._buffer.extend(
                     ngram.make_namedtuple(schema, row) for row in rows)
             else:
-                nt = schema._get_namedtuple()
-                fields = schema.field_names
-                self._buffer.extend(
-                    nt(*map(row.get, fields)) for row in rows)
+                self._buffer.extend(schema.make_namedtuples(rows))
         return self._buffer.popleft()

@@ -1,0 +1,150 @@
+"""Vectorized (index_select) shuffling buffers for batched torch tensors.
+
+Reference parity: ``petastorm/reader_impl/pytorch_shuffling_buffer.py``
+(``BatchedNoopShufflingBuffer``, ``BatchedRandomShufflingBuffer``) —
+SURVEY.md §2.5. Items are dicts of equal-length torch tensors (whole column
+batches); shuffling permutes ROWS across the buffered batches with tensor
+ops instead of a per-row python reservoir — the per-row buffer
+(``shuffling_buffer.py``) is orders of magnitude slower at batch scale.
+"""
+
+from __future__ import annotations
+
+
+class BatchedShufflingBufferBase:
+    """add_many(dict_of_tensors) → retrieve() → dict_of_tensors[batch_size]."""
+
+    def __init__(self, batch_size=1):
+        self.batch_size = batch_size
+        self._done = False
+        self.size = 0
+
+    def finish(self):
+        self._done = True
+
+    def can_add(self):
+        raise NotImplementedError
+
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+
+class BatchedNoopShufflingBuffer(BatchedShufflingBufferBase):
+    """FIFO pass-through: concatenates incoming batches, slices fixed ones."""
+
+    def __init__(self, batch_size=1):
+        super().__init__(batch_size)
+        self._store = None  # dict name -> list of tensors
+
+    def add_many(self, items):
+        import torch
+
+        if self._done:
+            raise RuntimeError("Cannot add to a finished buffer")
+        items = {k: torch.as_tensor(v) if not torch.is_tensor(v) else v
+                 for k, v in items.items()}
+        if self._store is None:
+            self._store = {k: [] for k in items}
+        n = None
+        for k, v in items.items():
+            self._store[k].append(v)
+            n = v.shape[0]
+        self.size += n or 0
+
+    def can_add(self):
+        return not self._done
+
+    def can_retrieve(self):
+        if self._done:
+            return self.size > 0
+        return self.size >= self.batch_size
+
+    def retrieve(self):
+        import torch
+
+        take = min(self.batch_size, self.size)
+        out = {}
+        for k, chunks in self._store.items():
+            joined = chunks[0] if len(chunks) == 1 else torch.cat(chunks)
+            out[k] = joined[:take]
+            self._store[k] = [joined[take:]] if joined.shape[0] > take else []
+        self.size -= take
+        return out
+
+
+class BatchedRandomShufflingBuffer(BatchedShufflingBufferBase):
+    """Random reservoir over rows of buffered column batches.
+
+    ``shuffling_buffer_capacity``: target fill; ``min_after_retrieve``:
+    shuffle-quality floor; ``extra_capacity``: headroom for whole-batch adds.
+    Retrieval draws ``batch_size`` random row indices and ``index_select`` s
+    them out, swapping the tail in (vectorized analogue of the per-row swap).
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve=0,
+                 extra_capacity=1000, batch_size=1, random_seed=None):
+        super().__init__(batch_size)
+        import torch
+
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._hard_capacity = shuffling_buffer_capacity + extra_capacity
+        self._generator = torch.Generator()
+        if random_seed is not None:
+            self._generator.manual_seed(random_seed)
+        self._store = None  # dict name -> single concatenated tensor
+
+    def add_many(self, items):
+        import torch
+
+        if self._done:
+            raise RuntimeError("Cannot add to a finished buffer")
+        items = {k: torch.as_tensor(v) if not torch.is_tensor(v) else v
+                 for k, v in items.items()}
+        n = next(iter(items.values())).shape[0] if items else 0
+        if self.size + n > self._hard_capacity:
+            raise RuntimeError(
+                f"Shuffling buffer overflow: {self.size} + {n} > "
+                f"{self._hard_capacity}; producers must check can_add()")
+        if self._store is None:
+            self._store = dict(items)
+        else:
+            self._store = {k: torch.cat([self._store[k], v])
+                           for k, v in items.items()}
+        self.size += n
+
+    def can_add(self):
+        return self.size < self._capacity and not self._done
+
+    def can_retrieve(self):
+        if self._done:
+            return self.size > 0
+        return self.size > self._min_after_retrieve
+
+    def retrieve(self):
+        import torch
+
+        if not self.can_retrieve():
+            raise RuntimeError("retrieve() when can_retrieve() is False")
+        take = min(self.batch_size, self.size)
+        chosen = torch.randperm(self.size, generator=self._generator)[:take]
+        out = {k: v.index_select(0, chosen) for k, v in self._store.items()}
+        # Backfill the vacated slots from the tail, then truncate — O(take)
+        # data movement instead of re-copying the whole buffer per batch.
+        last = self.size - take
+        slots = chosen[chosen < last]
+        tail_mask = torch.ones(take, dtype=torch.bool)
+        tail_mask[chosen[chosen >= last] - last] = False
+        tail_keep = torch.arange(last, self.size)[tail_mask]
+        for k, v in self._store.items():
+            if slots.numel():
+                v[slots] = v.index_select(0, tail_keep)
+            self._store[k] = v[:last]
+        self.size = last
+        return out

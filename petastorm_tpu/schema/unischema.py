@@ -126,6 +126,14 @@ class Unischema:
         # consumer-side hot path (one call per delivered row, §3.2).
         return self._get_namedtuple()(*map(kwargs.get, self.field_names))
 
+    def make_namedtuples(self, row_dicts):
+        """Batch variant of :meth:`make_namedtuple` (same missing-field→None
+        rule); owns the fast form so the reader hot loop and single-row path
+        can't drift apart."""
+        nt = self._get_namedtuple()
+        fields = self.field_names
+        return [nt(*map(row.get, fields)) for row in row_dicts]
+
     def make_namedtuple_tf(self, *args, **kwargs):
         return self._get_namedtuple()(*args, **kwargs)
 

@@ -1,0 +1,17 @@
+"""Dataset converter package (Spark-converter API shape, pyarrow-backed).
+
+Reference parity: ``petastorm/spark/`` — the package name is kept for import
+compatibility (``from petastorm_tpu.spark import make_spark_converter``),
+but the engine is pyarrow: pandas DataFrames and Arrow tables convert
+natively, Spark DataFrames via ``toPandas()`` when pyspark is importable.
+"""
+
+from petastorm_tpu.spark.dataset_converter import (
+    DatasetConverter,
+    SparkDatasetConverter,
+    make_spark_converter,
+    set_parent_cache_dir_url,
+)
+
+__all__ = ["make_spark_converter", "DatasetConverter", "SparkDatasetConverter",
+           "set_parent_cache_dir_url"]

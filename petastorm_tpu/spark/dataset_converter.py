@@ -1,0 +1,289 @@
+"""One-call path from a DataFrame to TF / Torch / JAX input pipelines.
+
+Reference parity: ``petastorm/spark/spark_dataset_converter.py``
+(``make_spark_converter``, ``SparkDatasetConverter`` with
+``make_tf_dataset`` / ``make_torch_dataloader`` / ``.delete()``, cache-dir
+management, dedup, ref-counting, atexit cleanup) — SURVEY.md §2.5, §7 stage 7
+and hard-part #7. Differences, by design:
+
+- engine is pyarrow: input is a pandas DataFrame or ``pa.Table`` (a pyspark
+  DataFrame is accepted and converted via ``toPandas()`` when pyspark is
+  importable) and materialization is ``pq.write_table`` — no JVM;
+- dedup is **content-hash** based (``pd.util.hash_pandas_object`` over the
+  materialized data + write options) instead of Spark's query-plan hash —
+  the reference hashes the plan because re-evaluating a Spark DF is
+  expensive; here the data is already local so hashing content is exact;
+- ``make_jax_dataloader`` is first-class alongside the TF/Torch surfaces.
+
+The parent cache dir comes from (in priority order) the explicit argument,
+:func:`set_parent_cache_dir_url`, or ``$PETASTORM_TPU_CACHE_DIR`` — standing
+in for the reference's Spark conf key
+``petastorm.spark.converter.parentCacheDirUrl``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import logging
+import os
+import shutil
+import threading
+import uuid
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_parent_cache_dir_url = None
+_cache_lock = threading.Lock()
+#: content-hash -> CachedDataFrameMeta (reference: ``_cache_df_meta_list``)
+_cache_registry = {}
+
+
+def set_parent_cache_dir_url(url):
+    """Set the parent directory under which materialized caches are created
+    (reference conf key ``petastorm.spark.converter.parentCacheDirUrl``)."""
+    global _parent_cache_dir_url
+    _parent_cache_dir_url = url
+
+
+def _resolve_parent_cache_dir(explicit):
+    url = explicit or _parent_cache_dir_url \
+        or os.environ.get("PETASTORM_TPU_CACHE_DIR")
+    if not url:
+        raise ValueError(
+            "No cache directory configured: pass parent_cache_dir_url=, call "
+            "set_parent_cache_dir_url(), or set $PETASTORM_TPU_CACHE_DIR "
+            "(reference conf key petastorm.spark.converter.parentCacheDirUrl)")
+    return url
+
+
+class CachedDataFrameMeta:
+    """Bookkeeping for one materialized cache dir (ref-counted)."""
+
+    def __init__(self, cache_key, dir_url, row_count):
+        self.cache_key = cache_key
+        self.dir_url = dir_url
+        self.row_count = row_count
+        self.ref_count = 0
+
+
+def _to_arrow_table(df, dtype=None):
+    """pandas / pyarrow / pyspark input → pa.Table (+optional float cast)."""
+    import pyarrow as pa
+
+    if hasattr(df, "toPandas"):  # pyspark DataFrame (optional shim)
+        df = df.toPandas()
+    if isinstance(df, pa.Table):
+        table = df
+    else:
+        import pandas as pd
+
+        if not isinstance(df, pd.DataFrame):
+            raise TypeError(
+                f"Unsupported input {type(df)}; expected pandas DataFrame, "
+                f"pyarrow Table, or pyspark DataFrame")
+        table = pa.Table.from_pandas(df, preserve_index=False)
+    if dtype:
+        target = pa.from_numpy_dtype(np.dtype(dtype))
+        cast_fields = [
+            pa.field(f.name, target) if pa.types.is_floating(f.type) else f
+            for f in table.schema]
+        table = table.cast(pa.schema(cast_fields))
+    return table
+
+
+def _content_hash(table, row_group_size_bytes, compression_codec, dtype):
+    """Content hash of the materialized bytes-to-be (dedup key).
+
+    Hashes the Arrow buffers directly — works for list/array-valued columns
+    (pandas hashing can't) and avoids a full to_pandas round-trip. Tables
+    with identical logical content but different chunking can hash
+    differently; that only costs an extra cache dir, never wrong reuse.
+    """
+    hasher = hashlib.sha256()
+    hasher.update(str(table.schema).encode("utf-8"))
+    hasher.update(f"{row_group_size_bytes}|{compression_codec}|{dtype}|"
+                  f"{table.num_rows}".encode("utf-8"))
+    for column in table.columns:
+        for chunk in column.chunks:
+            for buf in chunk.buffers():
+                if buf is not None:
+                    hasher.update(memoryview(buf))
+    return hasher.hexdigest()[:32]
+
+
+def make_spark_converter(df, parquet_row_group_size_bytes=32 * 1024 * 1024,
+                         compression_codec="snappy", dtype="float32",
+                         parent_cache_dir_url=None):
+    """Materialize ``df`` once (dedup by content hash) and return a converter.
+
+    Reference parity: ``make_spark_converter(df, parquet_row_group_size_bytes,
+    compression_codec, dtype)``. ``dtype`` casts floating columns (the
+    reference's precision conversion); pass ``None`` to keep exact dtypes.
+    """
+    import pyarrow.parquet as pq
+
+    parent = _resolve_parent_cache_dir(parent_cache_dir_url)
+    parent_path = parent[7:] if parent.startswith("file://") else parent
+    table = _to_arrow_table(df, dtype=dtype)
+    cache_key = _content_hash(table, parquet_row_group_size_bytes,
+                              compression_codec, dtype)
+    dir_path = os.path.join(parent_path, f"cache-{cache_key}")
+    # Materialize OUTSIDE the lock (a multi-second write must not serialize
+    # unrelated conversions); tmp-dir + atomic rename makes concurrent
+    # writers of the same content converge on one published dir.
+    if not os.path.isdir(dir_path):
+        os.makedirs(parent_path, exist_ok=True)
+        tmp_path = dir_path + f".tmp-{uuid.uuid4().hex[:8]}"
+        os.makedirs(tmp_path)
+        rows_per_group = max(
+            1, int(parquet_row_group_size_bytes
+                   // max(table.nbytes // max(table.num_rows, 1), 1)))
+        pq.write_table(table,
+                       os.path.join(tmp_path, "part-00000.parquet"),
+                       row_group_size=rows_per_group,
+                       compression=compression_codec)
+        try:
+            os.rename(tmp_path, dir_path)  # atomic publish
+        except OSError:  # another writer published first
+            shutil.rmtree(tmp_path, ignore_errors=True)
+    else:
+        logger.info("Reusing existing cache dir %s", dir_path)
+    with _cache_lock:
+        meta = _cache_registry.get(cache_key)
+        if meta is None:
+            meta = CachedDataFrameMeta(cache_key, f"file://{dir_path}",
+                                       table.num_rows)
+            _cache_registry[cache_key] = meta
+        meta.ref_count += 1
+    return DatasetConverter(meta)
+
+
+class DatasetConverter:
+    """Handle to a materialized cache dir; builds input pipelines over it.
+
+    Reference parity: ``SparkDatasetConverter`` — ``make_tf_dataset``,
+    ``make_torch_dataloader``, ``__len__``, ``.delete()``; plus the new
+    ``make_jax_dataloader``.
+    """
+
+    def __init__(self, cached_meta):
+        self._meta = cached_meta
+        self.cache_dir_url = cached_meta.dir_url
+
+    def __len__(self):
+        return self._meta.row_count
+
+    # -- pipeline factories (context managers, reference shape) -----------
+
+    def _make_batch_reader(self, reader_kwargs):
+        from petastorm_tpu import make_batch_reader
+
+        return make_batch_reader(self.cache_dir_url, **(reader_kwargs or {}))
+
+    def make_tf_dataset(self, batch_size=None, num_epochs=None,
+                        workers_count=None, shuffle_row_groups=True,
+                        **reader_kwargs):
+        reader_kwargs.setdefault("shuffle_row_groups", shuffle_row_groups)
+        if num_epochs is not None:
+            reader_kwargs["num_epochs"] = num_epochs
+        if workers_count is not None:
+            reader_kwargs["workers_count"] = workers_count
+        return _TFDatasetContextManager(
+            self._make_batch_reader(reader_kwargs), batch_size)
+
+    def make_torch_dataloader(self, batch_size=32, num_epochs=None,
+                              workers_count=None, shuffling_queue_capacity=0,
+                              **reader_kwargs):
+        if num_epochs is not None:
+            reader_kwargs["num_epochs"] = num_epochs
+        if workers_count is not None:
+            reader_kwargs["workers_count"] = workers_count
+        reader = self._make_batch_reader(reader_kwargs)
+        from petastorm_tpu.pytorch import BatchedDataLoader
+
+        return _ClosingContextManager(
+            BatchedDataLoader(reader, batch_size=batch_size,
+                              shuffling_queue_capacity=shuffling_queue_capacity))
+
+    def make_jax_dataloader(self, batch_size=32, num_epochs=None,
+                            workers_count=None, loader_kwargs=None,
+                            **reader_kwargs):
+        if num_epochs is not None:
+            reader_kwargs["num_epochs"] = num_epochs
+        if workers_count is not None:
+            reader_kwargs["workers_count"] = workers_count
+        reader = self._make_batch_reader(reader_kwargs)
+        from petastorm_tpu.jax_utils import make_jax_dataloader
+
+        return _ClosingContextManager(
+            make_jax_dataloader(reader, batch_size, **(loader_kwargs or {})))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def delete(self):
+        """Drop this handle's reference; removes the cache dir when the last
+        reference goes (reference ``.delete()`` semantics)."""
+        with _cache_lock:
+            meta = self._meta
+            meta.ref_count -= 1
+            if meta.ref_count <= 0:
+                _cache_registry.pop(meta.cache_key, None)
+                path = meta.dir_url[7:] if meta.dir_url.startswith("file://") \
+                    else meta.dir_url
+                shutil.rmtree(path, ignore_errors=True)
+
+
+#: Reference import-compat alias.
+SparkDatasetConverter = DatasetConverter
+# Reference conf-key name, kept as a documented constant for parity.
+SparkDatasetConverter.PARENT_CACHE_DIR_URL_CONF = \
+    "petastorm.spark.converter.parentCacheDirUrl"
+
+
+class _ClosingContextManager:
+    """``with converter.make_torch_dataloader() as loader:`` — closes the
+    loader (and its reader) on exit (reference context-manager shape)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+
+    def __enter__(self):
+        return self._loader.__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return self._loader.__exit__(exc_type, exc_val, exc_tb)
+
+
+class _TFDatasetContextManager:
+    """Yields a ``tf.data.Dataset``; closes the reader on exit."""
+
+    def __init__(self, reader, batch_size):
+        self._reader = reader
+        self._batch_size = batch_size
+
+    def __enter__(self):
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+        dataset = make_petastorm_dataset(self._reader)
+        if self._batch_size:
+            dataset = dataset.unbatch().batch(self._batch_size)
+        return dataset
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._reader.stop()
+        self._reader.join()
+
+
+@atexit.register
+def _cleanup_remaining_caches():
+    """Best-effort removal of still-referenced caches at interpreter exit
+    (reference registers the same kind of atexit hook)."""
+    with _cache_lock:
+        for meta in list(_cache_registry.values()):
+            path = meta.dir_url[7:] if meta.dir_url.startswith("file://") \
+                else meta.dir_url
+            shutil.rmtree(path, ignore_errors=True)
+        _cache_registry.clear()

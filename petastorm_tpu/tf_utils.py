@@ -1,0 +1,186 @@
+"""TensorFlow adapter: Reader → ``tf.data.Dataset`` / eager tensors.
+
+Reference parity: ``petastorm/tf_utils.py`` (``make_petastorm_dataset``,
+``tf_tensors``, ``_schema_to_tf_dtypes`` and the dtype-promotion map) —
+SURVEY.md §2.5, call stack §3.4. Differences from the reference:
+
+- TF2-first: ``from_generator`` with an ``output_signature`` (the reference's
+  TF1 ``tf.py_func`` + ``RandomShuffleQueue`` path is expressed with
+  ``tf.data`` shuffling instead);
+- TF's missing dtypes promote exactly as in the reference: uint16 → int32,
+  uint32 → int64, Decimal → string, datetime64 → int64 (epoch ns);
+- NGram readers yield ``{offset: namedtuple}`` structures, as upstream.
+
+TF import is deferred so the package never pulls TF unless this module is
+used (the reference guards its imports for the same reason).
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from decimal import Decimal
+
+import numpy as np
+
+_NUMPY_TO_TF_PROMOTIONS = {
+    # numpy dtype name -> tf dtype name; identity unless TF lacks the dtype
+    "uint16": "int32",
+    "uint32": "int64",
+    "uint64": "int64",
+}
+
+
+def _field_tf_dtype(field):
+    """tf.DType for a UnischemaField, honoring the promotion map."""
+    import tensorflow as tf
+
+    if field.numpy_dtype is Decimal:
+        return tf.string
+    if field.numpy_dtype in (str, np.str_, bytes, np.bytes_):
+        return tf.string
+    np_dtype = np.dtype(field.numpy_dtype)
+    if np_dtype.kind == "M":
+        return tf.int64  # epoch nanoseconds
+    name = _NUMPY_TO_TF_PROMOTIONS.get(np_dtype.name, np_dtype.name)
+    return tf.dtypes.as_dtype(name)
+
+
+def _schema_to_tf_dtypes(schema):
+    """Ordered ``{field_name: tf.DType}`` for a Unischema (reference helper)."""
+    return {name: _field_tf_dtype(field)
+            for name, field in schema.fields.items()}
+
+
+def _sanitize_field_tf_name(name):
+    """TF graph names reject some identifier characters the schema allows."""
+    return re.sub(r"[^A-Za-z0-9_.\-/]", "_", name)
+
+
+def _coerce_value(field, value, tf_dtype):
+    """Row value → numpy value matching the promoted TF dtype."""
+    if value is None:
+        raise ValueError(
+            f"Field {field.name!r} is None; TF tensors cannot carry nulls — "
+            f"filter nullable fields with a predicate/TransformSpec or select "
+            f"non-nullable schema_fields")
+    if field.numpy_dtype is Decimal:
+        return str(value)
+    np_dtype = np.dtype(field.numpy_dtype) \
+        if field.numpy_dtype not in (str, np.str_, bytes, np.bytes_) else None
+    if np_dtype is not None and np_dtype.kind == "M":
+        value = np.asarray(value, dtype="datetime64[ns]")
+        return value.astype(np.int64)
+    if tf_dtype.name in ("int32", "int64") and np_dtype is not None \
+            and np_dtype.kind == "u":
+        return np.asarray(value).astype(tf_dtype.name)
+    return value
+
+def _row_signature(schema, batched):
+    """(names, TensorSpec tuple) for the flattened generator output."""
+    import tensorflow as tf
+
+    names, specs = [], []
+    for name, field in schema.fields.items():
+        shape = tuple(field.shape or ())
+        if batched:
+            shape = (None,) + shape
+        specs.append(tf.TensorSpec(shape=shape, dtype=_field_tf_dtype(field),
+                                   name=_sanitize_field_tf_name(name)))
+        names.append(name)
+    return names, tuple(specs)
+
+
+def make_petastorm_dataset(reader):
+    """Wrap a Reader as a ``tf.data.Dataset``.
+
+    - ``make_reader``: dataset of schema namedtuples (one row per element);
+      with an NGram, elements are ``{offset: namedtuple}`` dicts.
+    - ``make_batch_reader``: dataset of namedtuples of column batches
+      (record-batch-sized — apply ``.unbatch().batch(B)`` for training).
+
+    Reference parity: ``petastorm/tf_utils.py::make_petastorm_dataset``.
+    """
+    import tensorflow as tf
+
+    if reader.ngram is not None:
+        return _make_ngram_dataset(tf, reader)
+
+    schema = reader.schema
+    names, specs = _row_signature(schema, batched=reader.batched_output)
+    fields = [schema.fields[n] for n in names]
+    dtypes = [_field_tf_dtype(f) for f in fields]
+
+    def generator():
+        for row in reader:
+            yield tuple(_coerce_value(f, getattr(row, n), d)
+                        for n, f, d in zip(names, fields, dtypes))
+
+    dataset = tf.data.Dataset.from_generator(generator,
+                                             output_signature=specs)
+    nt = schema._get_namedtuple()
+    return dataset.map(lambda *cols: nt(*cols))
+
+
+def _make_ngram_dataset(tf, reader):
+    """NGram reader → dataset of {offset: namedtuple} (reference structure)."""
+    ngram = reader.ngram
+    offsets = sorted(ngram.fields)
+    schema = reader.schema
+    per_offset = []
+    for offset in offsets:
+        field_names = sorted(ngram.get_field_names_at_timestep(offset))
+        fields = [schema.fields[n] for n in field_names]
+        per_offset.append((offset, field_names, fields,
+                           [_field_tf_dtype(f) for f in fields]))
+    specs = tuple(
+        tf.TensorSpec(shape=tuple(f.shape or ()), dtype=d,
+                      name=_sanitize_field_tf_name(f"{n}_{off}"))
+        for off, names_, fields_, dtypes_ in per_offset
+        for n, f, d in zip(names_, fields_, dtypes_))
+
+    def generator():
+        for window in reader:
+            flat = []
+            for offset, names_, fields_, dtypes_ in per_offset:
+                step_row = window[offset]
+                flat.extend(_coerce_value(f, getattr(step_row, n), d)
+                            for n, f, d in zip(names_, fields_, dtypes_))
+            yield tuple(flat)
+
+    dataset = tf.data.Dataset.from_generator(generator,
+                                             output_signature=specs)
+
+    from collections import namedtuple
+
+    step_types = {
+        offset: namedtuple(f"NGramStep_{offset}",
+                           [_sanitize_field_tf_name(n) for n in names_])
+        for offset, names_, _, _ in per_offset}
+
+    def reassemble(*cols):
+        out = {}
+        i = 0
+        for offset, names_, fields_, _ in per_offset:
+            k = len(names_)
+            out[offset] = step_types[offset](*cols[i:i + k])
+            i += k
+        return out
+
+    return dataset.map(reassemble)
+
+
+def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
+    """One-row-at-a-time eager tensors (reference's legacy surface, TF2-ified).
+
+    Returns an iterator yielding schema namedtuples of eager tensors; with
+    ``shuffling_queue_capacity`` > 0, rows pass through ``tf.data``'s shuffle
+    buffer (the TF2 equivalent of the reference's ``RandomShuffleQueue``;
+    ``min_after_dequeue`` is accepted for API parity and folded into the
+    buffer size).
+    """
+    dataset = make_petastorm_dataset(reader)
+    if shuffling_queue_capacity > 0:
+        dataset = dataset.shuffle(
+            max(shuffling_queue_capacity, min_after_dequeue + 1))
+    return iter(dataset)

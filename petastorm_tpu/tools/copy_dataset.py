@@ -1,0 +1,115 @@
+"""Copy/subset/re-rowgroup a petastorm dataset.
+
+Reference parity: ``petastorm/tools/copy_dataset.py`` (``copy_dataset`` +
+console script ``petastorm-copy-dataset.py``). Engine difference: the copy
+streams row groups through pyarrow in-process instead of a Spark job —
+``copy_dataset(None, ...)`` is the native path; a SparkSession first arg is
+accepted and ignored for signature parity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu.schema.unischema import match_unischema_fields
+
+
+def copy_dataset(spark, source_url, target_url, field_regex=None,
+                 not_null_fields=None, overwrite_output=False,
+                 partitions_count=None, row_group_size_mb=None,
+                 rows_per_row_group=None,
+                 hdfs_driver="libhdfs", storage_options=None):
+    """Copy ``source_url`` → ``target_url``, optionally subsetting fields
+    (``field_regex``) and dropping rows with nulls in ``not_null_fields``.
+
+    ``spark`` is accepted for reference-signature parity and unused.
+    ``partitions_count`` maps to output file count (rows are re-split).
+    """
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl import metadata as etl_metadata
+    from petastorm_tpu.fs_utils import FilesystemResolver
+
+    resolver = FilesystemResolver(target_url, hdfs_driver=hdfs_driver,
+                                  storage_options=storage_options)
+    target_fs, target_path = resolver.filesystem(), resolver.get_dataset_path()
+    if not overwrite_output:
+        try:
+            infos = target_fs.get_file_info(
+                __import__("pyarrow.fs", fromlist=["FileSelector"])
+                .FileSelector(target_path))
+            if infos:
+                raise ValueError(
+                    f"Target {target_url!r} is not empty; pass "
+                    f"overwrite_output=True to overwrite")
+        except FileNotFoundError:
+            pass
+
+    source_resolver = FilesystemResolver(source_url, hdfs_driver=hdfs_driver,
+                                         storage_options=storage_options)
+    schema = etl_metadata.get_schema(source_resolver.filesystem(),
+                                     source_resolver.get_dataset_path())
+    if field_regex:
+        subset_fields = match_unischema_fields(schema, field_regex)
+        if not subset_fields:
+            raise ValueError(
+                f"field_regex {field_regex!r} matched no fields of "
+                f"{list(schema.fields)}")
+        out_schema = schema.create_schema_view(subset_fields)
+    else:
+        out_schema = schema
+
+    not_null = set(not_null_fields or [])
+    unknown = not_null - set(out_schema.fields)
+    if unknown:
+        raise ValueError(f"not_null_fields not in copied schema: {unknown}")
+
+    reader = make_reader(source_url, schema_fields=list(out_schema.fields),
+                         reader_pool_type="dummy", num_epochs=1,
+                         shuffle_row_groups=False,
+                         storage_options=storage_options)
+
+    def rows():
+        with reader:
+            for row in reader:
+                row_dict = row._asdict()
+                if any(row_dict[f] is None for f in not_null):
+                    continue
+                yield row_dict
+
+    write_kwargs = {"storage_options": storage_options}
+    if row_group_size_mb is not None:
+        write_kwargs["row_group_size_mb"] = row_group_size_mb
+    if rows_per_row_group is not None:
+        write_kwargs["rows_per_row_group"] = rows_per_row_group
+    if partitions_count:
+        total = sum(p.num_rows for p in etl_metadata.load_row_groups(
+            source_resolver.filesystem(), source_resolver.get_dataset_path()))
+        write_kwargs["rows_per_file"] = max(1, -(-total // partitions_count))
+    etl_metadata.materialize_rows(target_url, out_schema, rows(),
+                                  **write_kwargs)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Copy a petastorm dataset, optionally subsetting")
+    parser.add_argument("source_url")
+    parser.add_argument("target_url")
+    parser.add_argument("--field-regex", nargs="*", default=None)
+    parser.add_argument("--not-null-fields", nargs="*", default=None)
+    parser.add_argument("--overwrite-output", action="store_true")
+    parser.add_argument("--partitions-count", type=int, default=None)
+    parser.add_argument("--row-group-size-mb", type=int, default=None)
+    args = parser.parse_args(argv)
+    copy_dataset(None, args.source_url, args.target_url,
+                 field_regex=args.field_regex,
+                 not_null_fields=args.not_null_fields,
+                 overwrite_output=args.overwrite_output,
+                 partitions_count=args.partitions_count,
+                 row_group_size_mb=args.row_group_size_mb)
+    print(f"Copied {args.source_url} -> {args.target_url}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

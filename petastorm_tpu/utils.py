@@ -65,12 +65,8 @@ def _decode_column(col, field):
 def _fast_numeric_column(col, field):
     """Whole-column numeric conversion; None when the dtype needs the
     per-cell path (strings, Decimal, datetime, nulls present)."""
-    from decimal import Decimal
-
-    if field.numpy_dtype in (str, bytes, np.str_, np.bytes_, Decimal):
-        return None
     try:
-        dtype = np.dtype(field.numpy_dtype)
+        dtype = np.dtype(field.numpy_dtype)  # Decimal etc. raise TypeError
     except TypeError:
         return None
     if dtype.kind not in "biuf" or col.null_count:

@@ -1,0 +1,266 @@
+"""TF + Torch adapter tests, mostly off ReaderMock (no Parquet), plus
+end-to-end reads of the conftest datasets.
+
+Reference analogue: ``petastorm/tests/{test_tf_utils,test_pytorch_dataloader}``
+— SURVEY.md §4 ("ReaderMock lets adapter tests run without Parquet").
+"""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.schema.codecs import ScalarCodec
+from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+from petastorm_tpu.test_util.reader_mock import ReaderMock
+
+AdapterSchema = Unischema("AdapterSchema", [
+    UnischemaField("id", np.int64, (), ScalarCodec(), False),
+    UnischemaField("mat", np.float32, (2, 3), None, False),
+    UnischemaField("counts", np.uint16, (4,), None, False),
+    UnischemaField("name", str, (), ScalarCodec(), False),
+    UnischemaField("price", Decimal, (), ScalarCodec(), False),
+])
+
+
+def _row(i):
+    return {"id": np.int64(i),
+            "mat": np.full((2, 3), i, dtype=np.float32),
+            "counts": np.full(4, i, dtype=np.uint16),
+            "name": f"row_{i}",
+            "price": Decimal(f"{i}.5")}
+
+
+def _mock(rows=10):
+    return ReaderMock(AdapterSchema, _row, num_rows=rows)
+
+
+# ---------------- TF ------------------------------------------------------
+
+def test_tf_dtype_promotions():
+    import tensorflow as tf
+
+    from petastorm_tpu.tf_utils import _schema_to_tf_dtypes
+
+    dtypes = _schema_to_tf_dtypes(AdapterSchema)
+    assert dtypes["id"] == tf.int64
+    assert dtypes["mat"] == tf.float32
+    assert dtypes["counts"] == tf.int32      # uint16 promotes
+    assert dtypes["name"] == tf.string
+    assert dtypes["price"] == tf.string      # Decimal → string
+
+
+def test_make_petastorm_dataset_rows():
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    dataset = make_petastorm_dataset(_mock(6))
+    rows = list(dataset)
+    assert len(rows) == 6
+    first = rows[0]
+    assert first.mat.shape == (2, 3)
+    assert first.counts.dtype.name == "int32"
+    assert first.price.numpy().decode() == "0.5"
+    assert first.name.numpy().decode() == "row_0"
+    ids = sorted(int(r.id.numpy()) for r in rows)
+    assert ids == list(range(6))
+
+
+def test_make_petastorm_dataset_batches_then_rebatch():
+    import tensorflow as tf
+
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    dataset = make_petastorm_dataset(_mock(9)).batch(3)
+    batches = list(dataset)
+    assert len(batches) == 3
+    assert batches[0].mat.shape == (3, 2, 3)
+    assert isinstance(batches[0], tuple)
+    total = tf.concat([b.id for b in batches], axis=0)
+    assert sorted(total.numpy().tolist()) == list(range(9))
+
+
+def test_tf_tensors_shuffling():
+    from petastorm_tpu.tf_utils import tf_tensors
+
+    it = tf_tensors(_mock(20), shuffling_queue_capacity=10)
+    ids = [int(row.id.numpy()) for row in it]
+    assert sorted(ids) == list(range(20))
+
+
+def test_tf_dataset_end_to_end(petastorm_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         schema_fields=["id", "matrix"], num_epochs=1,
+                         shuffle_row_groups=False)
+    with reader:
+        rows = list(make_petastorm_dataset(reader))
+    assert len(rows) == 30
+    assert rows[0].matrix.shape == (4, 8)
+
+
+def test_tf_dataset_ngram(petastorm_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.ngram import NGram
+
+    ngram = NGram({0: ["^id$", "^matrix$"], 1: ["^id$"]},
+                  delta_threshold=10, timestamp_field="timestamp_s")
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         schema_fields=ngram, num_epochs=1,
+                         shuffle_row_groups=False)
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with reader:
+        windows = list(make_petastorm_dataset(reader))
+    assert windows, "expected at least one ngram window"
+    w = windows[0]
+    assert set(w.keys()) == {0, 1}
+    # per-offset steps are namedtuples (reference structure)
+    assert int(w[1].id.numpy()) == int(w[0].id.numpy()) + 1
+    assert w[0].matrix.shape == (4, 8)
+
+
+# ---------------- Torch ---------------------------------------------------
+
+def test_sanitize_pytorch_types_promotions():
+    from petastorm_tpu.pytorch import _sanitize_pytorch_types
+
+    row = {"a": np.uint16(3), "b": np.arange(4, dtype=np.uint32),
+           "c": np.float32(1.5), "d": "s"}
+    out = _sanitize_pytorch_types(row)
+    assert out["a"].dtype == np.int32
+    assert out["b"].dtype == np.int64
+    assert out["c"].dtype == np.float32
+    assert out["d"] == "s"
+
+
+def test_decimal_friendly_collate_structures():
+    import torch
+
+    from petastorm_tpu.pytorch import decimal_friendly_collate
+
+    batch = [{"x": np.float32(1.0), "d": Decimal("1.5"), "s": "a"},
+             {"x": np.float32(2.0), "d": Decimal("2.5"), "s": "b"}]
+    out = decimal_friendly_collate(batch)
+    assert torch.is_tensor(out["x"]) and out["x"].shape == (2,)
+    assert out["d"] == ["1.5", "2.5"]
+    assert out["s"] == ["a", "b"]
+
+
+def test_torch_dataloader_rows():
+    import torch
+
+    from petastorm_tpu.pytorch import DataLoader
+
+    with DataLoader(_mock(10), batch_size=4) as loader:
+        batches = list(loader)
+    assert len(batches) == 3  # 4+4+2
+    assert torch.is_tensor(batches[0]["mat"])
+    assert batches[0]["mat"].shape == (4, 2, 3)
+    assert batches[0]["counts"].dtype == torch.int32
+    assert batches[0]["price"] == ["0.5", "1.5", "2.5", "3.5"]
+    ids = [int(v) for b in batches for v in b["id"]]
+    assert sorted(ids) == list(range(10))
+
+
+def test_torch_dataloader_shuffling_exactly_once():
+    from petastorm_tpu.pytorch import DataLoader
+
+    with DataLoader(_mock(40), batch_size=8,
+                    shuffling_queue_capacity=16,
+                    shuffling_queue_seed=1) as loader:
+        ids = [int(v) for b in loader for v in b["id"]]
+    assert sorted(ids) == list(range(40))
+    assert ids != list(range(40))
+
+
+def test_torch_dataloader_rejects_batch_reader():
+    from petastorm_tpu.pytorch import BatchedDataLoader, DataLoader
+
+    batch_mock = ReaderMock(AdapterSchema, _row, num_rows=4,
+                            batched_output=True)
+    with pytest.raises(ValueError, match="row reader"):
+        DataLoader(batch_mock)
+    with pytest.raises(ValueError, match="batch reader"):
+        BatchedDataLoader(_mock(4))
+
+
+def test_batched_dataloader_end_to_end(scalar_dataset):
+    import torch
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.pytorch import BatchedDataLoader
+    from petastorm_tpu.schema.transform import TransformSpec
+
+    # string_col can't be a tensor; drop it worker-side
+    spec = TransformSpec(removed_fields=["string_col"])
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="dummy",
+                               num_epochs=1, shuffle_row_groups=False,
+                               transform_spec=spec)
+    with BatchedDataLoader(reader, batch_size=7) as loader:
+        batches = list(loader)
+    assert all(torch.is_tensor(b["id"]) for b in batches)
+    ids = [int(v) for b in batches for v in b["id"]]
+    assert sorted(ids) == list(range(30))
+    assert batches[0]["id"].shape == (7,)
+
+
+def test_batched_dataloader_shuffled(scalar_dataset):
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.pytorch import BatchedDataLoader
+    from petastorm_tpu.schema.transform import TransformSpec
+
+    spec = TransformSpec(removed_fields=["string_col"])
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="dummy",
+                               num_epochs=1, shuffle_row_groups=False,
+                               transform_spec=spec)
+    with BatchedDataLoader(reader, batch_size=6, shuffling_queue_capacity=12,
+                           shuffling_queue_seed=3) as loader:
+        ids = [int(v) for b in loader for v in b["id"]]
+    assert sorted(ids) == list(range(30))
+    assert ids != list(range(30))
+
+
+def test_inmem_batched_dataloader_multi_epoch():
+    from petastorm_tpu.pytorch import InMemBatchedDataLoader
+
+    loader = InMemBatchedDataLoader(_mock(8), batch_size=4, num_epochs=3,
+                                    shuffle=True, random_seed=0)
+    # strings/Decimals can't go in the tensor cache — use numeric-only mock
+    NumSchema = Unischema("NumSchema", [
+        UnischemaField("id", np.int64, (), None, False),
+        UnischemaField("vec", np.float32, (2,), None, False),
+    ])
+    loader = InMemBatchedDataLoader(
+        ReaderMock(NumSchema,
+                   lambda i: {"id": np.int64(i),
+                              "vec": np.full(2, i, np.float32)},
+                   num_rows=8),
+        batch_size=4, num_epochs=3, shuffle=True, random_seed=0)
+    with loader:
+        batches = list(loader)
+    assert len(batches) == 6  # 2 per epoch x 3 epochs
+    per_epoch = [sorted(int(v) for b in batches[i:i + 2] for v in b["id"])
+                 for i in range(0, 6, 2)]
+    assert all(e == list(range(8)) for e in per_epoch)
+
+
+def test_batched_random_shuffling_buffer_vectorized():
+    import torch
+
+    from petastorm_tpu.reader_impl.pytorch_shuffling_buffer import (
+        BatchedRandomShufflingBuffer,
+    )
+
+    buf = BatchedRandomShufflingBuffer(20, min_after_retrieve=5,
+                                       batch_size=4, random_seed=0)
+    buf.add_many({"x": torch.arange(30)})
+    seen = []
+    while buf.can_retrieve():
+        seen.extend(buf.retrieve()["x"].tolist())
+    buf.finish()
+    while buf.can_retrieve():
+        seen.extend(buf.retrieve()["x"].tolist())
+    assert sorted(seen) == list(range(30))
+    assert seen != list(range(30))

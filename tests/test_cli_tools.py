@@ -1,0 +1,96 @@
+"""Benchmark library/CLI + metadata/copy CLI tests.
+
+Reference analogue: ``petastorm/tests/{test_copy_dataset,test_generate_metadata}``.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.benchmark.throughput import reader_throughput
+from petastorm_tpu.errors import PetastormMetadataError
+
+
+def test_reader_throughput_python(petastorm_dataset):
+    result = reader_throughput(petastorm_dataset.url, pool_type="dummy",
+                               warmup_cycles_count=5, measure_cycles_count=20)
+    assert result.rows_per_second > 0
+    assert result.rows_count == 20
+    assert result.input_stall_pct is None
+
+
+def test_reader_throughput_jax_loader(scalar_dataset):
+    result = reader_throughput(scalar_dataset.url, pool_type="dummy",
+                               read_method="arrow",
+                               warmup_cycles_count=1, measure_cycles_count=2,
+                               apply_jax_loader=True, jax_batch_size=5)
+    assert result.rows_per_second > 0
+    assert result.input_stall_pct is not None
+
+
+def test_benchmark_cli(petastorm_dataset, capsys):
+    from petastorm_tpu.benchmark.cli import main
+
+    assert main([petastorm_dataset.url, "-p", "dummy", "-w", "2",
+                 "-m", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "rows/sec" in out
+
+
+def test_generate_metadata_restores_deleted_metadata(tmp_path):
+    from petastorm_tpu.etl.petastorm_generate_metadata import (
+        generate_petastorm_metadata,
+    )
+    from petastorm_tpu.test_util.dataset_factory import create_test_dataset
+
+    path = tmp_path / "regen_ds"
+    url = f"file://{path}"
+    create_test_dataset(url, rows_count=20, rows_per_row_group=10)
+    (path / "_common_metadata").unlink()
+    with pytest.raises((RuntimeError, PetastormMetadataError)):
+        make_reader(url, reader_pool_type="dummy")
+    # schema inference can't reconstruct codecs, so name the schema class
+    generate_petastorm_metadata(
+        url,
+        unischema_class="petastorm_tpu.test_util.dataset_factory.TestSchema")
+    with make_reader(url, reader_pool_type="dummy", num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == list(range(20))
+
+
+def test_metadata_util_cli(petastorm_dataset, capsys):
+    from petastorm_tpu.etl.metadata_util import main
+
+    assert main([petastorm_dataset.url, "--schema", "--index"]) == 0
+    out = capsys.readouterr().out
+    assert "Row groups: 3" in out
+    assert "image_png" in out
+
+
+def test_copy_dataset_subset_and_not_null(petastorm_dataset, tmp_path):
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+    target = f"file://{tmp_path / 'copied'}"
+    copy_dataset(None, petastorm_dataset.url, target,
+                 field_regex=["^id$", "^matrix.*$"],
+                 not_null_fields=["matrix_nullable"],
+                 rows_per_row_group=5)
+    with make_reader(target, reader_pool_type="dummy", num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    # fixture nulls matrix_nullable on every 3rd row (i % 3 == 0)
+    expected_ids = [i for i in range(30) if i % 3 != 0]
+    assert sorted(r.id for r in rows) == expected_ids
+    assert set(rows[0]._fields) == {"id", "matrix", "matrix_nullable"}
+    assert rows[0].matrix.shape == (4, 8)
+
+
+def test_copy_dataset_cli_refuses_nonempty_target(petastorm_dataset, tmp_path):
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+
+    target_dir = tmp_path / "occupied"
+    target_dir.mkdir()
+    (target_dir / "something.txt").write_text("x")
+    with pytest.raises(ValueError, match="not empty"):
+        copy_dataset(None, petastorm_dataset.url, f"file://{target_dir}")

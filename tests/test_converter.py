@@ -1,0 +1,117 @@
+"""Dataset-converter tests: materialization dedup, ref-counting/cleanup,
+and the three pipeline surfaces.
+
+Reference analogue: ``petastorm/tests/test_spark_dataset_converter.py``.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from petastorm_tpu.spark import make_spark_converter
+from petastorm_tpu.spark import dataset_converter as dc
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(dc, "_parent_cache_dir_url", None)
+    monkeypatch.setattr(dc, "_cache_registry", {})
+    d = tmp_path / "conv_cache"
+    dc.set_parent_cache_dir_url(f"file://{d}")
+    yield str(d)
+    dc.set_parent_cache_dir_url(None)
+
+
+def _df(n=20):
+    return pd.DataFrame({
+        "x": np.arange(n, dtype=np.float64),
+        "y": np.arange(n, dtype=np.int64),
+    })
+
+
+def test_requires_cache_dir_config(monkeypatch, tmp_path):
+    monkeypatch.setattr(dc, "_parent_cache_dir_url", None)
+    monkeypatch.delenv("PETASTORM_TPU_CACHE_DIR", raising=False)
+    with pytest.raises(ValueError, match="No cache directory configured"):
+        make_spark_converter(_df())
+
+
+def test_materializes_once_and_dedups(cache_dir):
+    c1 = make_spark_converter(_df())
+    c2 = make_spark_converter(_df())          # identical content → same dir
+    c3 = make_spark_converter(_df(25))        # different content → new dir
+    assert c1.cache_dir_url == c2.cache_dir_url
+    assert c3.cache_dir_url != c1.cache_dir_url
+    assert len(os.listdir(cache_dir)) == 2
+    assert len(c1) == 20 and len(c3) == 25
+    c1.delete()
+    assert len(os.listdir(cache_dir)) == 2    # c2 still references it
+    c2.delete()
+    assert len(os.listdir(cache_dir)) == 1    # refcount hit zero → removed
+    c3.delete()
+    assert os.listdir(cache_dir) == []
+
+
+def test_dtype_cast_to_float32(cache_dir):
+    conv = make_spark_converter(_df(), dtype="float32")
+    with conv.make_jax_dataloader(batch_size=10, num_epochs=1,
+                                  loader_kwargs={"stage_to_device": False}) \
+            as loader:
+        batch = next(iter(loader))
+    assert batch["x"].dtype == np.float32     # cast
+    assert batch["y"].dtype == np.int64       # ints untouched
+    conv.delete()
+
+
+def test_make_torch_dataloader(cache_dir):
+    import torch
+
+    conv = make_spark_converter(_df(30))
+    with conv.make_torch_dataloader(batch_size=10, num_epochs=1,
+                                    shuffle_row_groups=False) as loader:
+        batches = list(loader)
+    assert len(batches) == 3
+    assert torch.is_tensor(batches[0]["x"])
+    ys = [int(v) for b in batches for v in b["y"]]
+    assert sorted(ys) == list(range(30))
+    conv.delete()
+
+
+def test_make_tf_dataset(cache_dir):
+    conv = make_spark_converter(_df(30))
+    with conv.make_tf_dataset(batch_size=10, num_epochs=1,
+                              shuffle_row_groups=False) as dataset:
+        batches = list(dataset)
+    assert len(batches) == 3
+    ys = sorted(int(v) for b in batches for v in b.y.numpy())
+    assert ys == list(range(30))
+    conv.delete()
+
+
+def test_pyarrow_table_input(cache_dir):
+    import pyarrow as pa
+
+    table = pa.table({"a": list(range(10))})
+    conv = make_spark_converter(table, dtype=None)
+    with conv.make_jax_dataloader(batch_size=5, num_epochs=1,
+                                  loader_kwargs={"stage_to_device": False}) \
+            as loader:
+        vals = [v for b in loader for v in b["a"].tolist()]
+    assert sorted(vals) == list(range(10))
+    conv.delete()
+
+
+def test_converter_handles_array_columns(cache_dir):
+    df = pd.DataFrame({
+        "id": [1, 2, 3],
+        "vec": [np.zeros(3), np.ones(3), np.full(3, 2.0)],
+    })
+    conv = make_spark_converter(df, dtype=None)
+    with conv.make_jax_dataloader(batch_size=3, num_epochs=1,
+                                  loader_kwargs={"stage_to_device": False}) \
+            as loader:
+        batch = next(iter(loader))
+    assert batch["vec"].shape == (3, 3)
+    conv.delete()

@@ -1,0 +1,72 @@
+"""Examples run as acceptance tests (reference CI runs its examples too —
+SURVEY.md §2.6)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_hello_world_petastorm_roundtrip(tmp_path, capsys):
+    from examples.hello_world.petastorm_dataset.generate_petastorm_dataset import (
+        generate_petastorm_dataset,
+    )
+    from examples.hello_world.petastorm_dataset.jax_hello_world import (
+        jax_hello_world,
+    )
+    from examples.hello_world.petastorm_dataset.python_hello_world import (
+        python_hello_world,
+    )
+
+    url = f"file://{tmp_path / 'hello'}"
+    generate_petastorm_dataset(url, rows_count=6)
+    python_hello_world(url)
+    out = capsys.readouterr().out
+    assert "(128, 256, 3)" in out and "(4, 128, 30, 3)" in out
+    jax_hello_world(url)
+    out = capsys.readouterr().out
+    assert "ArrayImpl" in out or "Array" in out
+
+
+def test_hello_world_external_roundtrip(tmp_path, capsys):
+    from examples.hello_world.external_dataset.generate_external_dataset import (
+        generate_external_dataset,
+    )
+    from examples.hello_world.external_dataset.python_hello_world_external import (
+        python_hello_world_external,
+    )
+
+    url = f"file://{tmp_path / 'external'}"
+    generate_external_dataset(url, rows_count=20)
+    python_hello_world_external(url)
+    out = capsys.readouterr().out
+    assert "rows" in out
+
+
+def test_mnist_jax_training_converges_shape(tmp_path, capsys):
+    from examples.mnist.generate_petastorm_mnist import generate_petastorm_mnist
+    from examples.mnist.jax_example import train
+
+    url = f"file://{tmp_path / 'mnist'}"
+    generate_petastorm_mnist(url, count=64)
+    params = train(url, epochs=1, batch_size=32)
+    out = capsys.readouterr().out
+    assert "input_stall=" in out
+    assert params["dense2"]["kernel"].shape[-1] == 10
+
+
+def test_imagenet_schema_materializes(tmp_path):
+    from examples.imagenet.generate_petastorm_imagenet import (
+        generate_petastorm_imagenet,
+    )
+    from petastorm_tpu import make_reader
+
+    url = f"file://{tmp_path / 'imagenet'}"
+    generate_petastorm_imagenet(url, count=4)
+    with make_reader(url, reader_pool_type="dummy", num_epochs=1) as reader:
+        rows = list(reader)
+    assert len(rows) == 4
+    assert rows[0].image.shape == (375, 500, 3)
+    assert rows[0].noun_id.startswith("n")
