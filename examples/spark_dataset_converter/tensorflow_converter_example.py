@@ -1,0 +1,29 @@
+"""DataFrame → tf.data.Dataset in one call via the dataset converter.
+
+Reference analogue: ``examples/spark_dataset_converter/tensorflow_converter_example.py``.
+"""
+
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from petastorm_tpu.spark import make_spark_converter, set_parent_cache_dir_url
+
+
+def main():
+    with tempfile.TemporaryDirectory() as cache_dir:
+        set_parent_cache_dir_url(f"file://{cache_dir}")
+        df = pd.DataFrame({
+            "feature": np.random.rand(256).astype(np.float64),
+            "label": np.random.randint(0, 2, 256),
+        })
+        converter = make_spark_converter(df)
+        with converter.make_tf_dataset(batch_size=64, num_epochs=1) as dataset:
+            for batch in dataset:
+                print("batch:", batch.feature.shape, batch.feature.dtype)
+        converter.delete()
+
+
+if __name__ == "__main__":
+    main()

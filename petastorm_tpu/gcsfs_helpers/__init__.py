@@ -1,0 +1,1 @@
+"""GCS helpers (reference parity: ``petastorm/gcsfs_helpers/``)."""

@@ -1,0 +1,182 @@
+"""Sequence encoder with ring attention — the long-context consumer.
+
+The reference's long-sequence feature is NGram window assembly
+(SURVEY.md §5): multi-frame sensor/video rows become ``[B, T, ...]`` windows.
+This model closes the loop on TPU: windows from
+``collate_ngram_rows``/``make_jax_dataloader`` feed a transformer-style
+encoder whose attention runs **sequence-parallel** over a mesh axis using
+**ring attention** — each device holds a ``T/sp`` slice of the sequence, and
+K/V blocks rotate around the ICI ring via ``lax.ppermute`` while an online
+(flash-style) softmax accumulates, so no device ever materializes the full
+``[T, T]`` score matrix or the full sequence. This is the standard JAX
+long-context recipe: ``shard_map`` + collective permute, letting XLA overlap
+the ring hop with the local block's compute.
+
+All shapes are static; the ring loop is a ``lax.fori_loop`` (compiler-visible
+control flow); matmuls run in bfloat16 on the MXU with f32 softmax
+statistics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def attention_reference(q, k, v):
+    """Plain (unsharded) scaled-dot-product attention — numerics oracle for
+    the ring version. Shapes: [B, T, H, Dh]."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    ``q, k, v``: the local sequence slice, [B, L, H, Dh] with L = T/sp.
+    K/V blocks rotate ``axis_size`` times around ``axis_name``; an online
+    softmax (running max + running sum, f32) makes the result exactly equal
+    to attention over the full sequence.
+    """
+    b, l, h, dh = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.array(dh, jnp.float32))
+    qf = q.astype(jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(_, carry):
+        k_cur, v_cur, acc, row_max, row_sum = carry
+        scores = jnp.einsum("blhd,bmhd->bhlm", qf,
+                            k_cur.astype(jnp.float32)) * scale
+        blk_max = scores.max(axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        probs = jnp.exp(scores - new_max[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhlm,bmhd->bhld", probs, v_cur.astype(jnp.float32))
+        row_sum = row_sum * correction + probs.sum(axis=-1)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc, new_max, row_sum
+
+    # The softmax stats start as constants but the loop body mixes them with
+    # the (sequence-varying) K/V blocks; mark them varying over the ring axis
+    # so the fori_loop carry types line up under shard_map's vma typing.
+    def varying(x):
+        return jax.lax.pvary(x, tuple(varying_axes or (axis_name,)))
+
+    init = (k, v,
+            varying(jnp.zeros((b, h, l, dh), jnp.float32)),
+            varying(jnp.full((b, h, l), -jnp.inf, jnp.float32)),
+            varying(jnp.zeros((b, h, l), jnp.float32)))
+    _, _, acc, _, row_sum = jax.lax.fori_loop(0, axis_size, body, init)
+    out = acc / row_sum[..., None]
+    return jnp.einsum("bhld->blhd", out).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
+    """Sequence-parallel attention over ``mesh[axis_name]``.
+
+    Inputs are global ``[B, T, H, Dh]`` arrays (sharded or shardable on T);
+    output matches :func:`attention_reference` up to float tolerance.
+    ``batch_axis``: mesh axis the batch dim is sharded over (data parallel),
+    so shard_map doesn't force a reshard at the boundary.
+    """
+    from jax import shard_map
+
+    spec = P(batch_axis, axis_name, None, None)
+    varying_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
+    sharded = shard_map(
+        functools.partial(ring_attention_block, axis_name=axis_name,
+                          axis_size=mesh.shape[axis_name],
+                          varying_axes=varying_axes),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return sharded(q, k, v)
+
+
+# --- a small encoder around it -------------------------------------------
+
+def init_seq_params(rng, feature_dim, d_model=64, num_heads=4, num_classes=10,
+                    max_len=512, dtype=jnp.float32):
+    """Parameter pytree: embed → (q,k,v,o) attention → classifier.
+
+    ``num_heads`` is NOT stored in the pytree (a static int inside jit-traced
+    params would poison reshapes); pass it to :func:`apply_seq_model` /
+    :func:`make_seq_train_step`."""
+    del num_heads  # accepted for signature convenience; static, not stored
+    keys = jax.random.split(rng, 7)
+    s = lambda fan: 1.0 / jnp.sqrt(fan)  # noqa: E731
+    return {
+        "embed": jax.random.normal(keys[0], (feature_dim, d_model), dtype) * s(feature_dim),
+        "pos": jax.random.normal(keys[1], (max_len, d_model), dtype) * 0.02,
+        "wq": jax.random.normal(keys[2], (d_model, d_model), dtype) * s(d_model),
+        "wk": jax.random.normal(keys[3], (d_model, d_model), dtype) * s(d_model),
+        "wv": jax.random.normal(keys[4], (d_model, d_model), dtype) * s(d_model),
+        "wo": jax.random.normal(keys[5], (d_model, d_model), dtype) * s(d_model),
+        "cls": jax.random.normal(keys[6], (d_model, num_classes), dtype) * s(d_model),
+    }
+
+
+def seq_param_partition_specs():
+    """PartitionSpecs over a ("data", "sp") mesh: weights replicated (the
+    parallel axis is the sequence, not the model)."""
+    return {"embed": P(), "pos": P(), "wq": P(), "wk": P(), "wv": P(),
+            "wo": P(), "cls": P()}
+
+
+def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
+                    compute_dtype=jnp.bfloat16):
+    """``windows``: [B, T, F] float (NGram windows collated to a time axis).
+
+    With ``mesh``: ring attention sequence-parallel over ``mesh[attn_axis]``
+    (T must divide by the axis size). Without: dense reference attention.
+    Returns f32 logits [B, num_classes].
+    """
+    h = num_heads
+    x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
+    b, t, d = x.shape
+    x = x + params["pos"][:t].astype(compute_dtype)
+
+    def split(w):
+        y = x @ w.astype(compute_dtype)
+        return y.reshape(b, t, h, d // h)
+
+    q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
+    if mesh is not None:
+        batch_axis = "data" if "data" in mesh.axis_names else None
+        attn = ring_attention(q, k, v, mesh, attn_axis, batch_axis=batch_axis)
+    else:
+        attn = attention_reference(q, k, v)
+    attn = attn.reshape(b, t, d) @ params["wo"].astype(compute_dtype)
+    pooled = attn.mean(axis=1)
+    logits = pooled @ params["cls"].astype(compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def make_seq_train_step(learning_rate=0.05, num_heads=4, mesh=None,
+                        attn_axis="sp"):
+    """``step(params, windows, labels, mask) -> (params, loss)`` — masked
+    cross-entropy + SGD, ring attention when a mesh is given. The returned
+    step is jittable as-is (all statics are closed over)."""
+    def loss_fn(params, windows, labels, mask):
+        logits = apply_seq_model(params, windows, num_heads=num_heads,
+                                 mesh=mesh, attn_axis=attn_axis)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        nll = jnp.where(mask, nll, 0.0)
+        return nll.sum() / jnp.maximum(mask.sum(), 1).astype(jnp.float32)
+
+    def step(params, windows, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, windows, labels,
+                                                  mask)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p - learning_rate * g).astype(p.dtype),
+            params, grads)
+        return new_params, loss
+
+    return step

@@ -105,3 +105,20 @@ def decode_row(row, schema):
                 f"Decoding field {field_name!r} failed: {exc}"
             ) from exc
     return decoded_row
+
+
+def run_in_subprocess(func, *args, **kwargs):
+    """Run ``func(*args, **kwargs)`` in a fresh child process and return its
+    result.
+
+    Reference parity: ``petastorm/utils.py::run_in_subprocess`` — used to
+    isolate code that must not pollute the parent (e.g. libhdfs forks, CUDA
+    context in the reference's world; on a TPU host, anything that would
+    initialize a second JAX runtime). ``func`` must be picklable
+    (module-level).
+    """
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("spawn")
+    with ctx.Pool(1) as pool:
+        return pool.apply(func, args, kwargs)
