@@ -85,6 +85,9 @@ def _loader_throughput(reader, warmup, measure, batch_size):
         batch = next(it)
         rows += next(v.shape[0] for v in batch.values() if hasattr(v, "shape"))
     duration = time.perf_counter() - t0
+    # The generator is suspended at its last yield; wall_s/input_stall_pct are
+    # only computed in its finally block, so close it before reading them.
+    it.close()
     loader.stop()
     loader.join()
     return BenchmarkResult(rows_per_second=rows / duration, rows_count=rows,

@@ -47,7 +47,7 @@ def generate_petastorm_metadata(dataset_url, unischema_class=None,
         schema = (_load_unischema_by_name(unischema_class)
                   if isinstance(unischema_class, str) else unischema_class)
     else:
-        schema = etl_metadata.infer_or_load_unischema(fs, path)
+        schema, _ = etl_metadata.infer_or_load_unischema(fs, path)
 
     with etl_metadata.materialize_dataset(
             None, dataset_url, schema,

@@ -55,12 +55,34 @@ def _func_fingerprint(func):
         (name, _stable_repr(func_globals[name]))
         for name in code.co_names if name in func_globals)
     digest = hashlib.sha256(
-        code.co_code + repr(code.co_consts).encode("utf-8")
+        code.co_code + _consts_fingerprint(code.co_consts).encode("utf-8")
         + repr(code.co_names).encode("utf-8")  # attribute/builtin names
         + repr(globals_used).encode("utf-8")
         + repr(cells).encode("utf-8") + defaults.encode("utf-8")
     ).hexdigest()[:16]
     return f"{getattr(func, '__qualname__', '<fn>')}:{digest}"
+
+
+def _consts_fingerprint(consts):
+    """Address-free fingerprint of ``co_consts``. Nested lambdas and
+    comprehensions place code objects in co_consts whose repr embeds a memory
+    address — recurse into their own code/consts instead, or the persistent
+    disk cache misses on every new process."""
+    parts = []
+    for const in consts:
+        if hasattr(const, "co_code"):  # a nested code object
+            parts.append(f"code({const.co_name},"
+                         f"{const.co_code!r},"
+                         f"{_consts_fingerprint(const.co_consts)},"
+                         f"{const.co_names!r})")
+        elif isinstance(const, frozenset):
+            # repr order follows randomized string hashing — sort, or the
+            # fingerprint changes per process (`x in {...}` lambdas).
+            parts.append("frozenset(" + ",".join(sorted(
+                repr(item) for item in const)) + ")")
+        else:
+            parts.append(repr(const))
+    return "(" + ",".join(parts) + ")"
 
 
 _DEFAULT_OBJECT_REPR = re.compile(r"<.+ at 0x[0-9a-fA-F]+>")

@@ -196,7 +196,12 @@ class BatchedDataLoader(_LoaderBase):
                     arr = arr.copy()  # arrow-backed buffers are read-only
                 tensors[name] = torch.as_tensor(arr)
             buffer.add_many(tensors)
-            while not buffer.can_add() and buffer.can_retrieve():
+            # Per-buffer drain policy: the noop buffer streams every
+            # retrievable batch (its can_add() only goes False at finish(), so
+            # an infinite reader would otherwise accumulate forever and never
+            # yield); the random buffer holds until capacity to keep the full
+            # shuffle window.
+            while buffer.should_drain():
                 yield self._emit(buffer.retrieve())
         buffer.finish()
         while buffer.can_retrieve():

@@ -28,6 +28,15 @@ class BatchedShufflingBufferBase:
     def can_retrieve(self):
         raise NotImplementedError
 
+    def should_drain(self):
+        """True while the producer loop should keep retrieving between adds.
+
+        Default: drain whenever a batch is retrievable (FIFO semantics — the
+        noop buffer must stream, since its ``can_add`` only goes False at
+        ``finish()``). Buffers that gain quality from staying full override
+        this to hold back until capacity pressure."""
+        return self.can_retrieve()
+
     def add_many(self, items):
         raise NotImplementedError
 
@@ -126,6 +135,13 @@ class BatchedRandomShufflingBuffer(BatchedShufflingBufferBase):
         if self._done:
             return self.size > 0
         return self.size > self._min_after_retrieve
+
+    def should_drain(self):
+        # Hold batches until the buffer is at capacity: draining as soon as
+        # can_retrieve() allows would steady-state the reservoir at
+        # min_after_retrieve and halve the effective shuffle window. can_add()
+        # goes False at capacity, so the producer loop never hangs here.
+        return not self.can_add() and self.can_retrieve()
 
     def retrieve(self):
         import torch

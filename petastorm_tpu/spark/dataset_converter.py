@@ -94,23 +94,46 @@ def _to_arrow_table(df, dtype=None):
     return table
 
 
+class _HashingSink:
+    """File-like sink that feeds written bytes straight into a hasher —
+    lets us hash an Arrow IPC stream without materializing a copy."""
+
+    def __init__(self, hasher):
+        self._hasher = hasher
+
+    def write(self, data):
+        self._hasher.update(data)
+        return len(data)
+
+    def flush(self):
+        pass
+
+    @property
+    def closed(self):
+        return False
+
+    def close(self):
+        pass
+
+
 def _content_hash(table, row_group_size_bytes, compression_codec, dtype):
     """Content hash of the materialized bytes-to-be (dedup key).
 
-    Hashes the Arrow buffers directly — works for list/array-valued columns
-    (pandas hashing can't) and avoids a full to_pandas round-trip. Tables
-    with identical logical content but different chunking can hash
+    Hashes the table's Arrow IPC serialization, which normalizes away
+    zero-copy slicing at EVERY nesting level (IPC truncates buffers to the
+    slice): ``table.slice`` views and ListArray children sliced from a shared
+    buffer hash by logical content, never by parent-buffer identity. Tables
+    with identical logical content but different chunking can still hash
     differently; that only costs an extra cache dir, never wrong reuse.
     """
+    import pyarrow as pa
+
     hasher = hashlib.sha256()
     hasher.update(str(table.schema).encode("utf-8"))
     hasher.update(f"{row_group_size_bytes}|{compression_codec}|{dtype}|"
                   f"{table.num_rows}".encode("utf-8"))
-    for column in table.columns:
-        for chunk in column.chunks:
-            for buf in chunk.buffers():
-                if buf is not None:
-                    hasher.update(memoryview(buf))
+    with pa.ipc.new_stream(_HashingSink(hasher), table.schema) as writer:
+        writer.write_table(table)
     return hasher.hexdigest()[:32]
 
 

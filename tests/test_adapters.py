@@ -206,6 +206,35 @@ def test_batched_dataloader_end_to_end(scalar_dataset):
     assert batches[0]["id"].shape == (7,)
 
 
+def test_batched_dataloader_yields_from_infinite_reader(scalar_dataset):
+    # Regression: the drain loop used to wait for buffer.can_add() to go
+    # False, which never happens for the noop buffer — with num_epochs=None
+    # the loader accumulated forever and never yielded a batch.
+    import threading
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.pytorch import BatchedDataLoader
+    from petastorm_tpu.schema.transform import TransformSpec
+
+    spec = TransformSpec(removed_fields=["string_col"])
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="dummy",
+                               num_epochs=None, shuffle_row_groups=False,
+                               transform_spec=spec)
+    got = []
+
+    def grab():
+        with BatchedDataLoader(reader, batch_size=7) as loader:
+            it = iter(loader)
+            for _ in range(5):
+                got.append(next(it))
+
+    t = threading.Thread(target=grab, daemon=True)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "BatchedDataLoader hung on an infinite reader"
+    assert len(got) == 5 and all(b["id"].shape == (7,) for b in got)
+
+
 def test_batched_dataloader_shuffled(scalar_dataset):
     from petastorm_tpu import make_batch_reader
     from petastorm_tpu.pytorch import BatchedDataLoader

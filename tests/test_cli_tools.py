@@ -25,7 +25,12 @@ def test_reader_throughput_jax_loader(scalar_dataset):
                                warmup_cycles_count=1, measure_cycles_count=2,
                                apply_jax_loader=True, jax_batch_size=5)
     assert result.rows_per_second > 0
+    # Regression: the stall pct was read while the loader generator was still
+    # suspended (its finally block never ran) and always reported 0.0. The
+    # consumer always waits a nonzero time on the host queue, so a real
+    # measurement is strictly positive.
     assert result.input_stall_pct is not None
+    assert result.input_stall_pct > 0.0
 
 
 def test_benchmark_cli(petastorm_dataset, capsys):
@@ -57,6 +62,28 @@ def test_generate_metadata_restores_deleted_metadata(tmp_path):
                      shuffle_row_groups=False) as reader:
         ids = sorted(row.id for row in reader)
     assert ids == list(range(20))
+
+
+def test_generate_metadata_default_infer_path(tmp_path):
+    # Regression: the no---unischema-class path passed the (schema, bool)
+    # tuple from infer_or_load_unischema straight into materialize_dataset.
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.etl.petastorm_generate_metadata import (
+        generate_petastorm_metadata,
+    )
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    path = tmp_path / "infer_ds"
+    url = f"file://{path}"
+    create_test_scalar_dataset(url, rows_count=12, rows_per_row_group=4)
+    generate_petastorm_metadata(url)  # infer from the arrow schema
+    assert (path / "_common_metadata").exists()
+    with make_batch_reader(url, reader_pool_type="dummy", num_epochs=1,
+                           shuffle_row_groups=False) as reader:
+        ids = sorted(int(v) for b in reader for v in b.id)
+    assert ids == list(range(12))
 
 
 def test_metadata_util_cli(petastorm_dataset, capsys):

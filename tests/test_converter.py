@@ -31,6 +31,18 @@ def _df(n=20):
     })
 
 
+def test_content_hash_distinguishes_slices_of_same_table():
+    # Regression: zero-copy slices share parent buffers; equal-sized slices
+    # of one table used to hash identically and reuse the wrong cache dir.
+    import pyarrow as pa
+
+    table = pa.table({"a": list(range(10))})
+    first, second = table.slice(0, 5), table.slice(5, 5)
+    h1 = dc._content_hash(first, 1 << 20, "snappy", None)
+    h2 = dc._content_hash(second, 1 << 20, "snappy", None)
+    assert h1 != h2
+
+
 def test_requires_cache_dir_config(monkeypatch, tmp_path):
     monkeypatch.setattr(dc, "_parent_cache_dir_url", None)
     monkeypatch.delenv("PETASTORM_TPU_CACHE_DIR", raising=False)

@@ -309,3 +309,19 @@ def test_predicate_reprs_are_deterministic():
     c = in_lambda(["id"], lambda v: v["id"] < 99)
     assert repr(a) == repr(b)
     assert repr(a) != repr(c)
+
+
+def test_predicate_fingerprint_nested_lambdas_stable():
+    """Nested code objects in co_consts used to be fingerprinted via repr()
+    (memory address — new key every process, permanent disk-cache miss).
+    Separately compiled but identical sources must fingerprint identically."""
+    from petastorm_tpu.predicates import in_lambda
+
+    src = "fn = lambda v: any(x > 2 for x in [v['id']])"
+    ns_a, ns_b = {}, {}
+    exec(src, ns_a)
+    exec(src, ns_b)
+    a = in_lambda(["id"], ns_a["fn"])
+    b = in_lambda(["id"], ns_b["fn"])
+    assert "0x" not in repr(a), repr(a)
+    assert repr(a) == repr(b)
