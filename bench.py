@@ -2,29 +2,53 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-What it measures: images/sec through the full delivery path — Parquet row
-groups → decode (PNG via cv2 + np.save payloads) → fixed-size batch collation
-→ async ``jax.device_put`` double-buffered against a jitted CNN train step on
-the TPU — versus a **synchronous** baseline (same reader, same model, but
-read-then-step with no overlap), which is what a reference-style consumer
-does: the reference never owns the device boundary (SURVEY.md §3 boundary
-summary), so its users eat the input stall serially.
+Legs (each runs in its OWN SUBPROCESS so every leg gets a fresh H2D budget —
+the tunneled TPU throttles after ~1.5GB cumulative per-process transfer, so
+in-process leg ordering biases whichever leg runs first; process isolation
+removes the bias the honest way):
 
-Note on parallelism: this container exposes ONE CPU core (nproc=1), so worker
-pools cannot add decode throughput here — the pipelining win is overlapping
-host decode with device compute, reported as ``input_stall_pct`` (the
-north-star metric, BASELINE.md). On multi-core hosts the same loader composes
-with thread/process pools for decode parallelism.
+- ``pipelined`` (headline): ``make_columnar_reader`` (vectorized codec decode
+  into stacked arrays — no per-row python objects) → ``make_jax_dataloader``
+  (decode overlapped with staging/dispatch; uint8 staged — half the H2D bytes
+  — and cast to bf16 INSIDE the jitted step, where the cast is fused and
+  free) → async-dispatched train steps.
+- ``sync_columnar``: same decode+staging, but read-then-step with a blocking
+  ``block_until_ready`` per step — isolates the overlap win on the same path.
+- ``sync_row`` (the ``vs_baseline`` denominator): the reference architecture
+  end-to-end — per-row codec decode (``py_dict`` worker, the upstream
+  ``petastorm/py_dict_reader_worker.py`` design), host-side bf16 cast via
+  TransformSpec (reference users cast on host; the reference has no device
+  path at all — SURVEY.md §3 boundary summary), synchronous
+  read → device_put → blocked step.
+
+Also reported: decode-only ceilings for both reader paths (no device in the
+loop), so the input-bound floor is visible next to the headline
+(input_stall_pct is structural on this 1-core host: the device finishes its
+step orders of magnitude faster than one batch decodes, so the consumer is
+almost always waiting — the number to watch is the headline's distance from
+its own decode ceiling, plus ``stall_pct_at_step_ms`` which reports the
+analytic stall for realistic accelerator step times).
+
+Environment facts this design respects (measured, see memory notes): ONE CPU
+core (pools cannot add decode throughput; the only overlap resource is the
+put path's IO wait), H2D throttle (~1.5GB/process), device compute on the
+tunneled chip is effectively free (a 134M-param train step executes in
+~0.07ms — so "hide compute behind decode" cannot be demonstrated here; "hide
+staging behind decode" can, and is).
 """
 
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
 
-sys.setswitchinterval(0.001)  # cut GIL handoff latency producer <-> consumer
+# NOTE: r02's bench set sys.setswitchinterval(0.001) to "cut GIL handoff
+# latency"; measured, it COSTS ~30% decode throughput on this 1-core host
+# (excess context switches between the decode and consumer threads). The
+# default 5ms interval wins.
 
 import numpy as np
 
@@ -33,7 +57,10 @@ ROWS_PER_RG = 128
 IMAGE_SHAPE = (64, 64, 3)
 BATCH = 128
 EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
+REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "2")))
+ROUNDS = max(1, int(os.environ.get("BENCH_ROUNDS", "2")))
 NUM_CLASSES = 10
+STALL_REFERENCE_STEP_MS = 25.0  # ResNet-50-class step @ B=128 on a v5e chip
 
 
 def _write_dataset(url):
@@ -67,16 +94,16 @@ def _make_model():
     from petastorm_tpu.models.image_classifier import (init_params,
                                                        make_train_step)
 
-    # Sized so one step's device time is comparable to one batch's host
-    # decode time — the regime the overlap design targets (a trivially small
-    # model measures only GIL contention, a huge one only the model).
     params = init_params(jax.random.PRNGKey(0), IMAGE_SHAPE, NUM_CLASSES,
                          conv_features=64, hidden=2048)
+    # apply_model casts inputs to bf16 as its first op, so uint8 batches are
+    # legal step inputs and the cast runs fused on device (measured FASTER
+    # than staging bf16: half the H2D bytes, no host cast).
     step = jax.jit(make_train_step(0.01), donate_argnums=(0,))
     return params, step
 
 
-def _warm(params, step, committed):
+def _warm(params, step, committed, image_dtype):
     """Compile the step against arrays staged EXACTLY like the measured path
     stages them — same dtype AND device commitment, with params in their
     steady-state commitment too (hence two warm steps) — or the first
@@ -86,9 +113,7 @@ def _warm(params, step, committed):
     device = jax.local_devices()[0] if committed else None
     stage = (lambda a: jax.device_put(a, device)) if committed \
         else (lambda a: jax.device_put(a))
-    import ml_dtypes
-
-    images = np.zeros((BATCH,) + IMAGE_SHAPE, ml_dtypes.bfloat16)
+    images = np.zeros((BATCH,) + IMAGE_SHAPE, image_dtype)
     labels = np.zeros((BATCH,), np.int32)
     mask = np.ones((BATCH,), bool)
     for _ in range(2):
@@ -98,17 +123,16 @@ def _warm(params, step, committed):
 
 
 def _cast_image(row):
-    # Worker-side cast: uint8 PNG pixels → bf16 model input. Feeding uint8
-    # straight to the TPU step measured ~12x slower (XLA layout/cast path),
-    # so the cast belongs in the (overlappable) host pipeline; bf16 halves
-    # H2D volume vs f32 and is the model's compute dtype anyway.
+    # Reference-architecture host-side cast (sync_row leg): per-row uint8 →
+    # bf16, the standard practice for a consumer that stages model-dtype
+    # arrays and has no in-jit cast of its own.
     import ml_dtypes
 
     row["image"] = row["image"].astype(ml_dtypes.bfloat16)
     return row
 
 
-def _reader(url):
+def _row_reader(url):
     from petastorm_tpu import make_reader
     from petastorm_tpu.schema.transform import TransformSpec
 
@@ -116,56 +140,174 @@ def _reader(url):
 
     spec = TransformSpec(_cast_image, edit_fields=[
         ("image", ml_dtypes.bfloat16, IMAGE_SHAPE, False)])
-    return make_reader(url, reader_pool_type="dummy", num_epochs=EPOCHS,
-                       shuffle_row_groups=True, transform_spec=spec,
-                       schema_fields=["image", "label"])
+    return make_reader(url, reader_pool_type="thread", workers_count=1,
+                       num_epochs=EPOCHS, shuffle_row_groups=True,
+                       transform_spec=spec, schema_fields=["image", "label"])
 
 
-def _baseline_images_per_sec(url, params, step):
-    """Synchronous read-then-step: no overlap between decode and compute."""
+def _columnar_reader(url):
+    from petastorm_tpu import make_columnar_reader
+
+    return make_columnar_reader(url, reader_pool_type="thread",
+                                workers_count=1, num_epochs=EPOCHS,
+                                shuffle_row_groups=True,
+                                schema_fields=["image", "label"])
+
+
+# --------------------------------------------------------------------------
+# Legs (each returns images/sec; run inside a leg subprocess)
+# --------------------------------------------------------------------------
+
+def _best_of(fn, repeats):
+    """One unmeasured warmup pass + best of ``repeats`` measured passes.
+
+    A cold process measures its own warmup otherwise: page-cache first
+    touches, CPython 3.12 adaptive-interpreter specialization, allocator
+    growth, and the axon client init were measured to cost 2x+ on the first
+    pass through the loop.
+    """
+    fn()  # warmup
+    best = None
+    for _ in range(repeats):
+        result = fn()
+        if best is None or result["images_per_sec"] > best["images_per_sec"]:
+            best = result
+    return best
+
+
+def _decode_leg(make_reader_fn):
+    """Decode-only throughput (no device in the loop)."""
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+    def one():
+        reader = make_reader_fn()
+        n, t0 = 0, time.perf_counter()
+        with reader:
+            for _ in batch_iterator(reader, BATCH, last_batch="drop"):
+                n += BATCH
+        return {"images_per_sec": n / (time.perf_counter() - t0)}
+
+    return _best_of(one, REPEATS)
+
+
+def _sync_leg(make_reader_fn, image_dtype, put_labels_as_int32=False):
+    """Synchronous read → device_put → blocked step."""
     import jax
 
     from petastorm_tpu.jax_utils.batcher import batch_iterator
 
-    reader = _reader(url)
-    mask = jax.device_put(np.ones((BATCH,), bool))
-    n = 0
-    t0 = time.perf_counter()
-    with reader:
-        for batch in batch_iterator(reader, BATCH, last_batch="drop"):
-            images = jax.device_put(batch["image"])  # bf16 (reader transform)
-            labels = jax.device_put(batch["label"].astype(np.int32))
-            params, loss = step(params, images, labels, mask)
-            jax.block_until_ready(loss)  # serialize: read, then compute
-            n += BATCH
-    return n / (time.perf_counter() - t0), params
+    params, step = _make_model()
+    params = _warm(params, step, committed=False, image_dtype=image_dtype)
+    state = {"params": params}
+
+    def one():
+        reader = make_reader_fn()
+        mask = jax.device_put(np.ones((BATCH,), bool))
+        n, t0 = 0, time.perf_counter()
+        params = state["params"]
+        with reader:
+            for batch in batch_iterator(reader, BATCH, last_batch="drop"):
+                images = jax.device_put(batch["image"])
+                labels = batch["label"]
+                if put_labels_as_int32:
+                    labels = labels.astype(np.int32)
+                labels = jax.device_put(labels)
+                params, loss = step(params, images, labels, mask)
+                jax.block_until_ready(loss)  # serialize: read, then compute
+                n += BATCH
+        state["params"] = params  # donated: thread through to the next pass
+        return {"images_per_sec": n / (time.perf_counter() - t0)}
+
+    return _best_of(one, REPEATS)
 
 
-def _pipelined_images_per_sec(url, params, step):
-    """make_jax_dataloader: decode on the producer thread overlaps the
-    device step; double-buffered device_put."""
+def leg_decode_row(url):
+    return _decode_leg(lambda: _row_reader(url))
+
+
+def leg_decode_columnar(url):
+    return _decode_leg(lambda: _columnar_reader(url))
+
+
+def leg_sync_row(url):
+    """Reference architecture: row decode + host cast + sync put + blocked
+    step."""
+    import ml_dtypes
+
+    return _sync_leg(lambda: _row_reader(url),
+                     image_dtype=ml_dtypes.bfloat16, put_labels_as_int32=True)
+
+
+def leg_sync_columnar(url):
+    """Same decode+staging as the headline (uint8, cast in-jit), minus the
+    overlap."""
+    return _sync_leg(lambda: _columnar_reader(url), image_dtype=np.uint8)
+
+
+def leg_pipelined(url):
+    """Headline: columnar decode overlapped with uint8 staging + async
+    dispatch via make_jax_dataloader."""
     import jax
 
-    reader = _reader(url)
     from petastorm_tpu.jax_utils import make_jax_dataloader
 
-    loader = make_jax_dataloader(reader, BATCH, last_batch="drop",
-                                 non_tensor_policy="drop",
-                                 host_prefetch=6, device_prefetch=2)
-    # Committed like every loader-staged array, so the jit cache entry from
-    # _warm(committed=True) is hit.
+    params, step = _make_model()
+    params = _warm(params, step, committed=True, image_dtype=np.uint8)
     mask = jax.device_put(np.ones((BATCH,), bool), jax.local_devices()[0])
-    n = 0
-    loss = None
-    t0 = time.perf_counter()
-    with loader:
-        for batch in loader:
-            params, loss = step(params, batch["image"], batch["label"], mask)
-            n += BATCH
-    if loss is not None:
-        jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return n / dt, loader.diagnostics, params
+    state = {"params": params}
+
+    def one():
+        reader = _columnar_reader(url)
+        loader = make_jax_dataloader(reader, BATCH, last_batch="drop",
+                                     non_tensor_policy="drop",
+                                     host_prefetch=6, device_prefetch=2)
+        n, loss = 0, None
+        params = state["params"]
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                params, loss = step(params, batch["image"], batch["label"],
+                                    mask)
+                n += BATCH
+        if loss is not None:
+            jax.block_until_ready(loss)
+        state["params"] = params
+        return {"images_per_sec": n / (time.perf_counter() - t0),
+                "input_stall_pct": loader.diagnostics["input_stall_pct"]}
+
+    return _best_of(one, REPEATS)
+
+
+LEGS = {
+    "decode_row": leg_decode_row,
+    "decode_columnar": leg_decode_columnar,
+    "sync_row": leg_sync_row,
+    "sync_columnar": leg_sync_columnar,
+    "pipelined": leg_pipelined,
+}
+
+
+def _run_leg_subprocess(leg, url):
+    """Execute one leg in a fresh python process (fresh H2D throttle budget,
+    no cross-leg jit-cache or commitment interference)."""
+    env = dict(os.environ)
+    env["BENCH_LEG"] = leg
+    env["BENCH_URL"] = url
+    result = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                            env=env, capture_output=True, text=True,
+                            timeout=1200)
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"bench leg {leg!r} failed (rc={result.returncode})\n"
+            f"{result.stdout[-2000:]}\n{result.stderr[-2000:]}")
+    return json.loads(result.stdout.strip().splitlines()[-1])
+
+
+def _leg_main():
+    import logging
+
+    logging.disable(logging.WARNING)
+    print(json.dumps(LEGS[os.environ["BENCH_LEG"]](os.environ["BENCH_URL"])))
 
 
 def main():
@@ -176,35 +318,47 @@ def main():
     try:
         url = f"file://{os.path.join(tmpdir, 'ds')}"
         _write_dataset(url)
+        # The host is time-sliced (external load makes any single window
+        # noisy); run the whole leg sequence ROUNDS times and take each leg's
+        # best across rounds, so one noisy window cannot sink one leg's
+        # number while sparing another's.
+        results = {}
+        for _ in range(ROUNDS):
+            for leg in LEGS:
+                r = _run_leg_subprocess(leg, url)
+                if (leg not in results
+                        or r["images_per_sec"]
+                        > results[leg]["images_per_sec"]):
+                    results[leg] = r
+
+        value = results["pipelined"]["images_per_sec"]
+        baseline = results["sync_row"]["images_per_sec"]
+        sync_same = results["sync_columnar"]["images_per_sec"]
+        ceiling = results["decode_columnar"]["images_per_sec"]
+        stall = results["pipelined"]["input_stall_pct"]
+        # Analytic stall at a realistic accelerator step time: decode time
+        # per batch D vs step time S — stall = max(0, D-S)/max(D, S).
+        d_ms = 1000.0 * BATCH / ceiling
+        s_ms = STALL_REFERENCE_STEP_MS
+        stall_at_ref = round(100.0 * max(0.0, d_ms - s_ms) / max(d_ms, s_ms), 2)
+
         import jax
 
-        # The tunneled TPU throttles after ~1.5GB cumulative H2D transfer,
-        # collapsing throughput for the rest of the process — so keep total
-        # volume low (bf16 staging), measure the headline (pipelined) leg
-        # FIRST, and take the best of a small number of repeats.
-        repeats = max(1, int(os.environ.get("BENCH_REPEATS", "2")))
-        # donate_argnums deletes the params passed in, so every repeat must
-        # consume the params the previous repeat returned.
-        params, step = _make_model()
-        params = _warm(params, step, committed=True)
-        value, diag = -1.0, None
-        for _ in range(repeats):
-            v, d, params = _pipelined_images_per_sec(url, params, step)
-            if v > value:
-                value, diag = v, d
-        params, step = _make_model()  # fresh params (prior leg donated them)
-        params = _warm(params, step, committed=False)
-        baseline = -1.0
-        for _ in range(repeats):
-            v, params = _baseline_images_per_sec(url, params, step)
-            baseline = max(baseline, v)
         print(json.dumps({
             "metric": "train_images_per_sec",
             "value": round(value, 1),
             "unit": "images/s",
             "vs_baseline": round(value / baseline, 2),
             "baseline_sync_images_per_sec": round(baseline, 1),
-            "input_stall_pct": diag["input_stall_pct"],
+            "vs_sync_same_decode_path": round(value / sync_same, 2),
+            "sync_columnar_images_per_sec": round(sync_same, 1),
+            "decode_only_images_per_sec": round(ceiling, 1),
+            "decode_only_row_path_images_per_sec": round(
+                results["decode_row"]["images_per_sec"], 1),
+            "pipeline_vs_decode_ceiling": round(value / ceiling, 2),
+            "input_stall_pct": stall,
+            "stall_pct_at_step_ms": {str(STALL_REFERENCE_STEP_MS): stall_at_ref},
+            "legs_isolated_in_subprocesses": True,
             "device": jax.devices()[0].platform,
             "host_cores": os.cpu_count(),
         }))
@@ -213,4 +367,7 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("BENCH_LEG"):
+        _leg_main()
+    else:
+        sys.exit(main())

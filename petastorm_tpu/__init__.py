@@ -23,6 +23,8 @@ __version__ = "0.1.0"
 _LAZY_EXPORTS = {
     "make_reader": ("petastorm_tpu.reader.reader", "make_reader"),
     "make_batch_reader": ("petastorm_tpu.reader.reader", "make_batch_reader"),
+    "make_columnar_reader": ("petastorm_tpu.reader.reader",
+                             "make_columnar_reader"),
     "Reader": ("petastorm_tpu.reader.reader", "Reader"),
     "NoDataAvailableError": ("petastorm_tpu.errors", "NoDataAvailableError"),
     "Unischema": ("petastorm_tpu.schema.unischema", "Unischema"),

@@ -229,7 +229,7 @@ class JaxDataLoader:
             return host_batch
         import jax
 
-        out = {}
+        out, tensors = {}, {}
         for name, col in host_batch.items():
             arr = np.asarray(col)
             if arr.dtype == object or arr.dtype.kind in ("U", "S", "M", "m"):
@@ -249,8 +249,12 @@ class JaxDataLoader:
 
                 out[name] = local_data_to_global_array(self._sharding, arr)
             else:
-                device = self._device or jax.local_devices()[0]
-                out[name] = jax.device_put(arr, device)
+                tensors[name] = arr
+        if tensors:
+            # One device_put for the whole batch pytree: one dispatch, and the
+            # runtime can batch the transfers.
+            device = self._device or jax.local_devices()[0]
+            out.update(jax.device_put(tensors, device))
         return out
 
     # -- lifecycle --------------------------------------------------------

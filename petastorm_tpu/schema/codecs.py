@@ -63,6 +63,17 @@ class DataframeColumnCodec(ABC):
     def decode(self, unischema_field, value):
         """Decode a storage cell back into the field's numpy representation."""
 
+    def decode_column(self, unischema_field, cells):
+        """Decode a whole column of storage cells into one ``[N, *shape]``
+        array (the TPU-native columnar read path — no per-row objects).
+
+        ``cells``: a sequence (typically a numpy object array) of raw storage
+        cells. Default implementation loops :meth:`decode` and stacks;
+        subclasses override with vectorized paths. Returns an object array
+        when cells are ragged or null."""
+        decoded = [self.decode(unischema_field, cell) for cell in cells]
+        return _stack_decoded(decoded)
+
     @abstractmethod
     def arrow_dtype(self):
         """The ``pyarrow.DataType`` of the stored column."""
@@ -147,6 +158,28 @@ class ScalarCodec(DataframeColumnCodec):
             return np.datetime64(value).astype(np.dtype(dtype))
         return np.dtype(dtype).type(value)
 
+    def decode_column(self, unischema_field, cells):
+        """Vectorized decode: numeric/datetime columns are a single astype of
+        the arrow-materialized array; strings/Decimals/nullables loop."""
+        dtype = unischema_field.numpy_dtype
+        if dtype is Decimal or dtype in (str, np.str_, bytes, np.bytes_):
+            return super().decode_column(unischema_field, cells)
+        arr = np.asarray(cells)
+        if arr.dtype == object:  # nulls (or mixed types) present
+            return super().decode_column(unischema_field, cells)
+        target = np.dtype(dtype)
+        if target.kind in "iub" and arr.dtype.kind == "f":
+            # Arrow materializes int-with-nulls as float64 NaN; astype would
+            # silently turn NaN into garbage ints. Match the row path: None
+            # for null cells, via an object array.
+            nan_mask = np.isnan(arr)
+            if nan_mask.any():
+                out = np.empty(len(arr), dtype=object)
+                for i, (v, is_nan) in enumerate(zip(arr, nan_mask)):
+                    out[i] = None if is_nan else target.type(v)
+                return out
+        return arr.astype(target, copy=False)
+
 
 class NdarrayCodec(DataframeColumnCodec):
     """Stores an ndarray as ``np.save`` bytes in a binary column.
@@ -173,6 +206,30 @@ class NdarrayCodec(DataframeColumnCodec):
             return None
         return _fast_npy_load(value)
 
+    def decode_column(self, unischema_field, cells):
+        """Vectorized decode: parse each npy header once (cached) and
+        ``frombuffer`` straight into a preallocated ``[N, *shape]`` array.
+        Falls back to the generic loop for nulls, ragged shapes, or exotic
+        payloads."""
+        n = len(cells)
+        out = None
+        for i, cell in enumerate(cells):
+            parsed = _fast_npy_parse(cell) if isinstance(cell, bytes) else None
+            if parsed is None:
+                return super().decode_column(unischema_field, cells)
+            dtype, fortran, shape, offset = parsed
+            if out is None:
+                if dtype.hasobject:
+                    return super().decode_column(unischema_field, cells)
+                out = np.empty((n,) + shape, dtype=dtype)
+                out_shape, out_dtype = shape, dtype
+            elif shape != out_shape or dtype != out_dtype:
+                return super().decode_column(unischema_field, cells)
+            data = np.frombuffer(cell, dtype=dtype, offset=offset,
+                                 count=int(np.prod(shape)) if shape else 1)
+            out[i] = data.reshape(shape, order="F" if fortran else "C")
+        return out if out is not None else np.empty((0,), dtype=object)
+
 
 # npy headers are identical for every cell of a fixed-shape field, but
 # ``np.load`` re-parses the header dict with ast.literal_eval per cell —
@@ -182,17 +239,18 @@ _NPY_HEADER_CACHE = {}
 _NPY_MAGIC = b"\x93NUMPY"
 
 
-def _fast_npy_load(value):
-    """Decode ``np.save`` bytes with a cached header parse + frombuffer."""
+def _fast_npy_parse(value):
+    """Parse ``np.save`` bytes → ``(dtype, fortran, shape, data_offset)``,
+    with the header-dict parse cached. None when not a plain npy payload."""
     if not isinstance(value, bytes) or not value.startswith(_NPY_MAGIC):
-        return np.load(io.BytesIO(value), allow_pickle=False)
+        return None
     major = value[6]
     if major == 1:
         hlen, offset = int.from_bytes(value[8:10], "little"), 10
     elif major in (2, 3):
         hlen, offset = int.from_bytes(value[8:12], "little"), 12
     else:  # unknown future version — let numpy handle it
-        return np.load(io.BytesIO(value), allow_pickle=False)
+        return None
     header = value[offset:offset + hlen]
     parsed = _NPY_HEADER_CACHE.get(header)
     if parsed is None:
@@ -204,9 +262,18 @@ def _fast_npy_load(value):
         if len(_NPY_HEADER_CACHE) < 4096:
             _NPY_HEADER_CACHE[header] = parsed
     dtype, fortran, shape = parsed
+    return dtype, fortran, shape, offset + hlen
+
+
+def _fast_npy_load(value):
+    """Decode ``np.save`` bytes with a cached header parse + frombuffer."""
+    parsed = _fast_npy_parse(value)
+    if parsed is None:
+        return np.load(io.BytesIO(value), allow_pickle=False)
+    dtype, fortran, shape, offset = parsed
     if dtype.hasobject:  # would need pickle — defer to numpy (which refuses)
         return np.load(io.BytesIO(value), allow_pickle=False)
-    data = np.frombuffer(value, dtype=dtype, offset=offset + hlen,
+    data = np.frombuffer(value, dtype=dtype, offset=offset,
                          count=int(np.prod(shape)) if shape else 1)
     arr = data.reshape(shape, order="F" if fortran else "C")
     # frombuffer views are read-only (backed by the bytes object); consumers
@@ -298,6 +365,28 @@ class CompressedImageCodec(DataframeColumnCodec):
             )
         return self._pil_decode(value)
 
+    def decode_column(self, unischema_field, cells):
+        """Vectorized decode: imdecode each cell straight into a preallocated
+        ``[N, H, W, C]`` array (no per-row python objects). Falls back to the
+        generic loop for nulls or ragged image shapes."""
+        if not _HAVE_CV2:
+            return super().decode_column(unischema_field, cells)
+        n = len(cells)
+        out = None
+        for i, cell in enumerate(cells):
+            if cell is None:
+                return super().decode_column(unischema_field, cells)
+            img = cv2.imdecode(np.frombuffer(cell, dtype=np.uint8),
+                               cv2.IMREAD_UNCHANGED)
+            if img is None:  # corrupt/undecodable bytes — match row path
+                return super().decode_column(unischema_field, cells)
+            if out is None:
+                out = np.empty((n,) + img.shape, dtype=img.dtype)
+            elif img.shape != out.shape[1:] or img.dtype != out.dtype:
+                return super().decode_column(unischema_field, cells)
+            out[i] = img
+        return out if out is not None else np.empty((0,), dtype=object)
+
     def _pil_encode(self, value):  # pragma: no cover - cv2 present in this env
         from PIL import Image
 
@@ -318,6 +407,25 @@ class CompressedImageCodec(DataframeColumnCodec):
         if arr.ndim == 3 and arr.shape[2] == 3:
             arr = arr[:, :, ::-1]
         return arr
+
+
+def _stack_decoded(decoded):
+    """Stack per-cell decoded values into ``[N, ...]``; object array when
+    ragged or containing None (nullable fields)."""
+    if not decoded:
+        return np.empty((0,), dtype=object)
+    first = decoded[0]
+    if isinstance(first, np.ndarray) and first.dtype != object and \
+            all(isinstance(v, np.ndarray) and v.shape == first.shape
+                and v.dtype == first.dtype for v in decoded):
+        return np.stack(decoded)
+    if isinstance(first, (int, float, bool, np.generic)) and \
+            all(v is not None for v in decoded):
+        return np.asarray(decoded)
+    out = np.empty(len(decoded), dtype=object)
+    for i, v in enumerate(decoded):
+        out[i] = v
+    return out
 
 
 def _check_shape_compatible(unischema_field, value):
