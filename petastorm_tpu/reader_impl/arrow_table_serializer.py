@@ -23,3 +23,24 @@ class ArrowTableSerializer:
     def deserialize(self, serialized_rows):
         with pa.ipc.open_stream(pa.BufferReader(serialized_rows)) as reader:
             return reader.read_all()
+
+    # -- zero-copy multipart surface (zmq_copy_buffers=True) ---------------
+
+    def serialize_to_frames(self, table):
+        """One frame per table: the IPC stream buffer, passed as a buffer
+        object (not ``to_pybytes``) so zmq can send it without copying."""
+        if not isinstance(table, pa.Table):
+            raise ValueError(
+                f"ArrowTableSerializer serializes pa.Table, got {type(table)}")
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return [sink.getvalue()]  # pa.Buffer supports the buffer protocol
+
+    def deserialize_from_frames(self, frames):
+        """Map the received frame back to a table; arrow reads the IPC stream
+        directly from the frame's memory (zero-copy column buffers)."""
+        buf = frames[0] if len(frames) == 1 else b"".join(
+            bytes(f) for f in frames)
+        with pa.ipc.open_stream(pa.BufferReader(pa.py_buffer(buf))) as reader:
+            return reader.read_all()

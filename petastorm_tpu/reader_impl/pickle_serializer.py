@@ -1,6 +1,12 @@
 """Pickle payload serializer for the process pool's zmq transport.
 
-Reference parity: ``petastorm/reader_impl/pickle_serializer.py``.
+Reference parity: ``petastorm/reader_impl/pickle_serializer.py`` — plus the
+zero-copy multipart surface backing ``zmq_copy_buffers=True``
+(``petastorm/workers_pool/process_pool.py`` semantics): pickle protocol 5
+emits large contiguous buffers (numpy arrays, arrow buffers) OUT-OF-BAND, so
+the worker can ``send_multipart(copy=False)`` raw array memory and the
+consumer reassembles from received frame buffers without an intermediate
+pickle-bytes copy on either side.
 """
 
 from __future__ import annotations
@@ -14,3 +20,24 @@ class PickleSerializer:
 
     def deserialize(self, serialized_rows):
         return pickle.loads(serialized_rows)  # noqa: S301 - host-local IPC from our own workers
+
+    # -- zero-copy multipart surface (zmq_copy_buffers=True) ---------------
+
+    def serialize_to_frames(self, rows):
+        """Serialize to ``[head, buffer, buffer, ...]`` frames.
+
+        ``head`` is the protocol-5 pickle with out-of-band buffer markers;
+        the remaining frames are the raw buffers themselves (zero-copy views
+        of array memory — keep the source alive until sent).
+        """
+        buffers = []
+        head = pickle.dumps(rows, protocol=5, buffer_callback=buffers.append)
+        return [head] + [b.raw() for b in buffers]
+
+    def deserialize_from_frames(self, frames):
+        """Inverse of :meth:`serialize_to_frames`; ``frames`` may be bytes,
+        memoryviews, or zmq frame buffers."""
+        head, buffers = frames[0], frames[1:]
+        if not isinstance(head, (bytes, bytearray)):
+            head = bytes(head)
+        return pickle.loads(head, buffers=buffers)  # noqa: S301

@@ -106,6 +106,7 @@ class ProcessPool:
                 results_endpoint,
                 control_endpoint,
                 self._results_queue_size,
+                self._zmq_copy_buffers,
             )
             self._processes.append(process)
 
@@ -155,10 +156,24 @@ class ProcessPool:
                         f"{self._ventilated_items} completed={self._completed_items}"
                     )
                 continue
-            frames = self._results_socket.recv_multipart()
-            kind = frames[0]
+            if self._zmq_copy_buffers:
+                # copy=False: RESULT payload frames stay in zmq-owned memory
+                # and deserialization views them directly (arrays keep the
+                # frames alive via the buffer protocol).
+                zmq_frames = self._results_socket.recv_multipart(copy=False)
+                kind = zmq_frames[0].bytes
+                frames = zmq_frames
+            else:
+                frames = self._results_socket.recv_multipart()
+                kind = frames[0]
             if kind == _FRAME_RESULT:
-                payload = b"".join(frames[1:]) if len(frames) > 2 else frames[1]
+                if self._zmq_copy_buffers and hasattr(
+                        self._serializer, "deserialize_from_frames"):
+                    return self._serializer.deserialize_from_frames(
+                        [f.buffer for f in frames[1:]])
+                payload_frames = [getattr(f, "bytes", f) for f in frames[1:]]
+                payload = (b"".join(payload_frames)
+                           if len(payload_frames) > 1 else payload_frames[0])
                 return self._serializer.deserialize(payload)
             if kind == _FRAME_DONE:
                 self._completed_items += 1
@@ -166,7 +181,8 @@ class ProcessPool:
                     self._ventilator.processed_item()
                 continue
             if kind == _FRAME_EXC:
-                exc_repr, tb = pickle.loads(frames[1])
+                exc_repr, tb = pickle.loads(getattr(frames[1], "bytes",
+                                                    frames[1]))
                 raise WorkerException(RuntimeError(exc_repr), tb)
             if kind == _FRAME_EXIT:
                 self._exited_workers += 1
@@ -248,7 +264,7 @@ class _WorkerStopped(Exception):
 
 def _worker_process_main(worker_id, worker_class_payload, serializer_payload,
                          vent_endpoint, results_endpoint, control_endpoint,
-                         results_queue_size):
+                         results_queue_size, zmq_copy_buffers=True):
     """Entry point of one pool worker process (runs in a fresh interpreter)."""
     import zmq
 
@@ -279,19 +295,30 @@ def _worker_process_main(worker_id, worker_class_payload, serializer_payload,
             stop_requested = True
         return stop_requested
 
-    def _send(frames):
+    def _send(frames, copy=True):
         """Send with backpressure that stays responsive to the stop broadcast."""
         while True:
             try:
-                results_socket.send_multipart(frames, flags=zmq.NOBLOCK)
+                results_socket.send_multipart(frames, flags=zmq.NOBLOCK,
+                                              copy=copy)
                 return
             except zmq.Again:
                 if _stop_seen():
                     raise _WorkerStopped() from None
                 time.sleep(0.005)
 
+    use_frames = zmq_copy_buffers and hasattr(serializer,
+                                              "serialize_to_frames")
+
     def publish(data):
-        _send([_FRAME_RESULT, serializer.serialize(data)])
+        if use_frames:
+            # Zero-copy: payload buffers (raw array memory) ride as their own
+            # zmq frames; copy=False hands zmq references instead of copies
+            # (zmq keeps them alive until the frames are flushed).
+            _send([_FRAME_RESULT] + serializer.serialize_to_frames(data),
+                  copy=False)
+        else:
+            _send([_FRAME_RESULT, serializer.serialize(data)])
 
     worker = worker_class(worker_id, publish, worker_setup_args)
     _send([_FRAME_READY, str(worker_id).encode()])
