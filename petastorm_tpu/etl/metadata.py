@@ -679,6 +679,29 @@ class RowGroupPiece:
             return pf.read_row_group(self.row_group, columns=columns)
 
 
+def piece_row_counts(filesystem, pieces):
+    """Resolve ``{(path, row_group): num_rows}`` for every piece.
+
+    Pieces from the metadata fast path carry ``num_rows=None``; those are
+    filled by opening each distinct file's footer exactly once (one footer
+    read per file, not per row group). Pieces that already know their count
+    (fragment-scan path) cost nothing.
+    """
+    counts = {}
+    unresolved = {}
+    for piece in pieces:
+        if piece.num_rows is not None:
+            counts[(piece.path, piece.row_group)] = piece.num_rows
+        else:
+            unresolved.setdefault(piece.path, []).append(piece.row_group)
+    for path, row_groups in unresolved.items():
+        with filesystem.open_input_file(path) as f:
+            file_metadata = pq.ParquetFile(f).metadata
+            for rg in row_groups:
+                counts[(path, rg)] = file_metadata.row_group(rg).num_rows
+    return counts
+
+
 def load_row_groups(filesystem, dataset_path, metadata=None):
     """Enumerate the dataset's row groups as :class:`RowGroupPiece` list.
 

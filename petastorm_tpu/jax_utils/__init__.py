@@ -25,6 +25,8 @@ from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
 from petastorm_tpu.jax_utils.sharding import (
     batch_sharding,
     default_shard_options,
+    derive_equal_step_max_batches,
+    global_step_count,
     local_data_to_global_array,
 )
 
@@ -36,5 +38,7 @@ __all__ = [
     "collate_ngram_rows",
     "default_shard_options",
     "batch_sharding",
+    "global_step_count",
+    "derive_equal_step_max_batches",
     "local_data_to_global_array",
 ]

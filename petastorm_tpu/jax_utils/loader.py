@@ -108,6 +108,19 @@ class JaxDataLoader:
         self._stage_to_device = stage_to_device
         self._shuffle_buffer_size = shuffle_buffer_size
         self._shuffle_seed = shuffle_seed
+        if sharding is not None and max_batches is None:
+            # SPMD lockstep: under a global sharding every host must dispatch
+            # the same number of steps or pjit deadlocks the pod. Derive the
+            # global-min batch count from the reader's shard metadata (each
+            # host computes the same number locally — no collective).
+            from petastorm_tpu.jax_utils.sharding import (
+                derive_equal_step_max_batches,
+            )
+
+            derived = derive_equal_step_max_batches(reader, batch_size,
+                                                    last_batch)
+            if derived is not None:
+                self._max_batches = derived
 
         self._queue = None
         self._producer = None
@@ -119,6 +132,7 @@ class JaxDataLoader:
             "stall_s": 0.0,
             "wall_s": 0.0,
             "input_stall_pct": 0.0,
+            "max_batches": self._max_batches,
         }
 
     # -- producer ---------------------------------------------------------

@@ -12,6 +12,9 @@ step, exactly as the scaling recipe prescribes.
 
 from __future__ import annotations
 
+import random
+import warnings
+
 
 def default_shard_options(cur_shard=None, shard_count=None):
     """Fill (cur_shard, shard_count) from the JAX runtime when unset.
@@ -29,6 +32,118 @@ def default_shard_options(cur_shard=None, shard_count=None):
     except Exception:  # pragma: no cover - jax missing/uninitialized
         pass
     return None, None
+
+
+def split_pieces_for_shards(pieces, shard_count, shard_seed=None):
+    """Partition a row-group piece list into ``shard_count`` shards.
+
+    Single source of truth for the shard arithmetic: the optional
+    ``shard_seed`` pre-shuffle followed by round-robin ``pieces[s::count]`` —
+    exactly what ``Reader`` does (reference parity:
+    ``petastorm/reader.py`` shard logic), so metadata-only step-count
+    computations agree with what each host's reader will actually deliver.
+    """
+    if shard_count is None:
+        return [list(pieces)]
+    if shard_seed is not None:
+        pieces = list(pieces)
+        random.Random(shard_seed).shuffle(pieces)
+    return [pieces[s::shard_count] for s in range(shard_count)]
+
+
+def _batches_for_rows(rows, batch_size, last_batch):
+    """Number of batches ``batch_iterator`` emits for a ``rows``-row stream."""
+    if rows <= 0:
+        return 0
+    if last_batch == "drop":
+        return rows // batch_size
+    # "pad" and "keep" both emit the final partial batch.
+    return -(-rows // batch_size)
+
+
+def global_step_count(dataset_url, batch_size, shard_count,
+                      last_batch="drop", num_epochs=1, shard_seed=None,
+                      filters=None, storage_options=None, filesystem=None,
+                      hdfs_driver="libhdfs"):
+    """Global per-host step count for SPMD lockstep — pure metadata arithmetic.
+
+    pjit programs are SPMD-synchronous: every host must dispatch the same
+    number of steps or the pod deadlocks (SURVEY.md §7 hard-part #2). The
+    reference's round-robin row-group sharding gives *unequal* row counts per
+    shard, so the safe global step count is the **minimum** over shards of the
+    number of batches that shard can produce. This helper computes it from
+    Parquet metadata alone (no data read): per-shard row counts via the same
+    enumeration + shard arithmetic the Reader uses, then the batcher's
+    ``last_batch`` policy.
+
+    Pass the result as ``max_batches`` to every host's
+    :func:`~petastorm_tpu.jax_utils.make_jax_dataloader` (done automatically
+    when a ``sharding`` is given and the reader carries shard metadata — see
+    :func:`derive_equal_step_max_batches`).
+
+    Exact when no row-level ``predicate`` is used (``filters`` prune whole row
+    groups, so metadata counts stay exact). With a predicate the surviving row
+    count is data-dependent — coordinate steps out of band instead.
+
+    :param num_epochs: must be a finite int (``None``/infinite streams have no
+        step count).
+    :return: int — the global minimum number of full batches across shards
+        (0 when any shard is empty: the only safe lockstep count).
+    """
+    if num_epochs is None:
+        raise ValueError(
+            "global_step_count requires a finite num_epochs (an infinite "
+            "stream has no step count)")
+    if shard_count is None or shard_count < 1:
+        raise ValueError("shard_count must be a positive integer")
+    from petastorm_tpu.fs_utils import FilesystemResolver
+    from petastorm_tpu.reader.reader import enumerate_row_group_pieces
+
+    resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
+                                  storage_options=storage_options,
+                                  filesystem=filesystem)
+    from petastorm_tpu.etl.metadata import piece_row_counts
+
+    fs = resolver.filesystem()
+    pieces = enumerate_row_group_pieces(fs, resolver.get_dataset_path(),
+                                        filters)
+    counts = piece_row_counts(fs, pieces)
+    shards = split_pieces_for_shards(pieces, shard_count, shard_seed)
+    return min(
+        _batches_for_rows(
+            sum(counts[(p.path, p.row_group)] for p in shard) * num_epochs,
+            batch_size, last_batch)
+        for shard in shards)
+
+
+def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
+    """Derive a pod-safe ``max_batches`` from a constructed Reader, or None.
+
+    Readers record the row counts of *every* shard at planning time
+    (``Reader.shard_row_counts``) — each host can therefore compute the global
+    minimum locally, with zero cross-host communication (consistent because
+    all hosts enumerate the same store with the same shard_seed). Returns
+    None when the count cannot be known from metadata: row-level predicate,
+    NGram windows (windows per row group are data-dependent), infinite
+    epochs, or a reader type that doesn't expose shard metadata.
+    """
+    counts = getattr(reader, "shard_row_counts", None)
+    if counts is None:
+        return None
+    num_epochs = getattr(reader, "num_epochs", 1)
+    if num_epochs is None:
+        return None
+    if getattr(reader, "ngram", None) is not None:
+        return None
+    if getattr(reader, "_predicate", None) is not None:
+        warnings.warn(
+            "Cannot derive an equal SPMD step count: a row-level predicate "
+            "makes per-shard row counts data-dependent. Pass max_batches "
+            "explicitly (agreed across hosts) or steps may deadlock the pod",
+            UserWarning, stacklevel=3)
+        return None
+    return min(_batches_for_rows(c * num_epochs, batch_size, last_batch)
+               for c in counts)
 
 
 def batch_sharding(mesh, axis="data"):

@@ -19,7 +19,6 @@ checklist), call stacks §3.1/§3.2. TPU-first notes:
 from __future__ import annotations
 
 import logging
-import random
 import warnings
 
 from petastorm_tpu.cache import NullCache
@@ -428,23 +427,8 @@ class Reader:
     # --- planning helpers -----------------------------------------------
 
     def _enumerate_pieces(self, filters):
-        if filters is None and not isinstance(self._dataset_path, list):
-            return load_row_groups(self._filesystem, self._dataset_path)
-        import pyarrow.dataset as pads
-
-        expression = _filters_to_expression(filters) if filters is not None else None
-        dataset = pads.dataset(self._dataset_path, filesystem=self._filesystem,
-                               format="parquet")
-        pieces = []
-        fragments = sorted(dataset.get_fragments(filter=expression),
-                           key=lambda f: f.path)
-        for fragment in fragments:
-            split = (fragment.split_by_row_group(expression)
-                     if expression is not None else fragment.split_by_row_group())
-            for rg_fragment in split:
-                rg = rg_fragment.row_groups[0]
-                pieces.append(RowGroupPiece(fragment.path, rg.id, rg.num_rows))
-        return pieces
+        return enumerate_row_group_pieces(self._filesystem, self._dataset_path,
+                                          filters)
 
     def _apply_selector(self, pieces, rowgroup_selector, canonical=None):
         from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
@@ -465,22 +449,45 @@ class Reader:
                 if (piece.path, piece.row_group) in selected_ids]
 
     def _shard_pieces(self, pieces, cur_shard, shard_count, shard_seed):
+        from petastorm_tpu.jax_utils.sharding import split_pieces_for_shards
+
+        shards = split_pieces_for_shards(pieces, shard_count, shard_seed)
+        # Every shard's piece list is kept so equal-step coordination
+        # (jax_utils.sharding.derive_equal_step_max_batches) can compute the
+        # global-min batch count locally on each host — no collective needed.
+        # Row counts resolve lazily (shard_row_counts property): the metadata
+        # fast path doesn't open footers unless coordination asks for counts.
+        self._shard_piece_lists = shards
+        self._shard_row_counts = None
+        self.cur_shard = cur_shard
+        self.shard_count = shard_count
         if shard_count is None:
-            return pieces
-        if shard_seed is not None:
-            pieces = list(pieces)
-            random.Random(shard_seed).shuffle(pieces)
-        sharded = pieces[cur_shard::shard_count]
+            return shards[0]
+        sharded = shards[cur_shard]
         if not sharded:
             warnings.warn(
                 f"Shard {cur_shard}/{shard_count} received zero row groups "
                 f"(dataset has only {len(pieces)}); this reader yields "
-                f"nothing. SPMD consumers must coordinate per-host step "
-                f"counts themselves (zero rows cannot be padded into "
-                f"batches) — prefer shard_count <= row-group count",
+                f"nothing. SPMD consumers must agree on a global step count "
+                f"— make_jax_dataloader(sharding=...) derives it "
+                f"automatically, or use jax_utils.sharding."
+                f"global_step_count — prefer shard_count <= row-group count",
                 UserWarning, stacklevel=3,
             )
         return sharded
+
+    @property
+    def shard_row_counts(self):
+        """Row count of *every* shard (not just this reader's) — the input to
+        equal-step SPMD coordination. Lazily resolves ``num_rows=None`` pieces
+        with one footer read per file."""
+        if self._shard_row_counts is None:
+            all_pieces = [p for shard in self._shard_piece_lists for p in shard]
+            counts = etl_metadata.piece_row_counts(self._filesystem, all_pieces)
+            self._shard_row_counts = [
+                sum(counts[(p.path, p.row_group)] for p in shard)
+                for shard in self._shard_piece_lists]
+        return self._shard_row_counts
 
     # --- iterator protocol ----------------------------------------------
 
@@ -530,6 +537,31 @@ class Reader:
             )
         self.last_row_consumed = False
         self._ventilator.reset()
+
+
+def enumerate_row_group_pieces(filesystem, dataset_path, filters=None):
+    """Enumerate row-group pieces, optionally pruned by Parquet-stats filters.
+
+    Module-level so metadata-only planning (``jax_utils.sharding.
+    global_step_count``) shares the exact enumeration the Reader plans with.
+    """
+    if filters is None and not isinstance(dataset_path, list):
+        return load_row_groups(filesystem, dataset_path)
+    import pyarrow.dataset as pads
+
+    expression = _filters_to_expression(filters) if filters is not None else None
+    dataset = pads.dataset(dataset_path, filesystem=filesystem,
+                           format="parquet")
+    pieces = []
+    fragments = sorted(dataset.get_fragments(filter=expression),
+                       key=lambda f: f.path)
+    for fragment in fragments:
+        split = (fragment.split_by_row_group(expression)
+                 if expression is not None else fragment.split_by_row_group())
+        for rg_fragment in split:
+            rg = rg_fragment.row_groups[0]
+            pieces.append(RowGroupPiece(fragment.path, rg.id, rg.num_rows))
+    return pieces
 
 
 def _filters_to_expression(filters):
