@@ -272,8 +272,16 @@ def leg_pipelined(url):
         if loss is not None:
             jax.block_until_ready(loss)
         state["params"] = params
+        diag = loader.diagnostics
         return {"images_per_sec": n / (time.perf_counter() - t0),
-                "input_stall_pct": loader.diagnostics["input_stall_pct"]}
+                "input_stall_pct": diag["input_stall_pct"],
+                "stage_breakdown_s": {
+                    "producer_decode": round(diag["producer_decode_s"], 3),
+                    "producer_queue_wait": round(
+                        diag["producer_queue_wait_s"], 3),
+                    "device_dispatch": round(diag["device_dispatch_s"], 3),
+                    "consumer_stall": round(diag["stall_s"], 3),
+                    "wall": round(diag["wall_s"], 3)}}
 
     return _best_of(one, REPEATS)
 
@@ -357,6 +365,7 @@ def main():
                 results["decode_row"]["images_per_sec"], 1),
             "pipeline_vs_decode_ceiling": round(value / ceiling, 2),
             "input_stall_pct": stall,
+            "stage_breakdown_s": results["pipelined"].get("stage_breakdown_s"),
             "stall_pct_at_step_ms": {str(STALL_REFERENCE_STEP_MS): stall_at_ref},
             "legs_isolated_in_subprocesses": True,
             "device": jax.devices()[0].platform,

@@ -40,6 +40,10 @@ from petastorm_tpu.schema import codecs as codecs_mod
 
 # Keys written by the reference (read-compat) — SURVEY.md §2.3:
 ROW_GROUPS_PER_FILE_KEY = b"dataset-toolkit.num_row_groups_per_file.v1"
+# Our extension (no reference analogue): per-row-group row counts, so
+# planning-time arithmetic (equal-step SPMD coordination — SURVEY.md §7
+# hard-part #2) never needs a footer read per file at reader construction.
+ROW_GROUP_ROW_COUNTS_KEY = b"petastorm-tpu.row_group_row_counts.v1"
 UNISCHEMA_KEY = b"dataset-toolkit.unischema.v1"
 UNISCHEMA_KEY_V2 = b"petastorm.unischema.v1"
 # Key this build writes (JSON-serialized schema; safe to load anywhere):
@@ -373,10 +377,21 @@ def add_to_dataset_metadata(filesystem, dataset_path, key, value):
     Reference parity: ``petastorm/utils.py::add_to_dataset_metadata``. ``key``
     and ``value`` are bytes (or str, encoded utf-8).
     """
-    if isinstance(key, str):
-        key = key.encode("utf-8")
-    if isinstance(value, str):
-        value = value.encode("utf-8")
+    add_many_to_dataset_metadata(filesystem, dataset_path, {key: value})
+
+
+def add_many_to_dataset_metadata(filesystem, dataset_path, entries):
+    """Merge several key/values into ``_common_metadata`` in ONE read+rewrite.
+
+    The footer file is fully rewritten on every update (that is how parquet
+    metadata works), so batching keys matters on object stores: one GET + one
+    PUT instead of one pair per key.
+    """
+    entries = {
+        (k.encode("utf-8") if isinstance(k, str) else k):
+        (v.encode("utf-8") if isinstance(v, str) else v)
+        for k, v in entries.items()
+    }
     common_path = _join(dataset_path, _COMMON_METADATA)
     arrow_schema = None
     existing = {}
@@ -392,7 +407,7 @@ def add_to_dataset_metadata(filesystem, dataset_path, key, value):
         dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
         arrow_schema = dataset.schema
         existing = dict(arrow_schema.metadata or {})
-    existing[key] = value
+    existing.update(entries)
     schema_with_meta = arrow_schema.with_metadata(existing)
     with filesystem.open_output_stream(common_path) as out:
         pq.write_metadata(schema_with_meta, out)
@@ -469,24 +484,38 @@ def materialize_dataset(spark, dataset_url, schema, row_group_size_mb=None,
                                       filesystem=filesystem)
         fs = resolver.filesystem()
         path = resolver.get_dataset_path()
-    row_groups_per_file = _enumerate_row_groups_per_file(fs, path)
-    add_to_dataset_metadata(fs, path, ROW_GROUPS_PER_FILE_KEY,
-                            json.dumps(row_groups_per_file))
-    add_to_dataset_metadata(fs, path, UNISCHEMA_JSON_KEY, unischema_to_json(schema))
+    row_groups_per_file, row_counts = _enumerate_row_groups_per_file(fs, path)
+    add_many_to_dataset_metadata(fs, path, {
+        ROW_GROUPS_PER_FILE_KEY: json.dumps(row_groups_per_file),
+        ROW_GROUP_ROW_COUNTS_KEY: json.dumps(row_counts),
+        UNISCHEMA_JSON_KEY: unischema_to_json(schema),
+    })
 
 
 def _enumerate_row_groups_per_file(filesystem, dataset_path):
-    """{relative file path: num_row_groups} for every parquet file in the dataset."""
+    """Per-file row-group stats for every parquet file in the dataset.
+
+    Returns ``({rel path: num_row_groups}, {rel path: [rows per row group]})``.
+    Footers are open here anyway (write time, data is local/warm) — recording
+    the row counts now is what lets readers never open them again.
+    """
     import pyarrow.dataset as pads
 
     dataset = pads.dataset(dataset_path, filesystem=filesystem, format="parquet")
     counts = {}
+    row_counts = {}
     base = dataset_path.rstrip("/") + "/"
     for fragment in dataset.get_fragments():
         rel = fragment.path[len(base):] if fragment.path.startswith(base) else fragment.path
-        counts[rel] = fragment.metadata.num_row_groups if fragment.metadata \
-            else len(fragment.row_groups)
-    return counts
+        meta = fragment.metadata
+        if meta is not None:
+            counts[rel] = meta.num_row_groups
+            row_counts[rel] = [meta.row_group(i).num_rows
+                               for i in range(meta.num_row_groups)]
+        else:  # pragma: no cover - pyarrow always exposes fragment metadata
+            counts[rel] = len(fragment.row_groups)
+            row_counts[rel] = [rg.num_rows for rg in fragment.row_groups]
+    return counts, row_counts
 
 
 # ---------------------------------------------------------------------------
@@ -714,11 +743,18 @@ def load_row_groups(filesystem, dataset_path, metadata=None):
     pieces = []
     if ROW_GROUPS_PER_FILE_KEY in metadata:
         counts = json.loads(metadata[ROW_GROUPS_PER_FILE_KEY].decode("utf-8"))
+        row_counts = {}
+        if ROW_GROUP_ROW_COUNTS_KEY in metadata:
+            row_counts = json.loads(
+                metadata[ROW_GROUP_ROW_COUNTS_KEY].decode("utf-8"))
         base = dataset_path.rstrip("/")
         for rel_path, n_row_groups in sorted(counts.items()):
             full = rel_path if rel_path.startswith(base) else _join(base, rel_path)
+            per_rg = row_counts.get(rel_path)
             for rg in range(n_row_groups):
-                pieces.append(RowGroupPiece(full, rg, None))
+                num_rows = (per_rg[rg] if per_rg is not None
+                            and rg < len(per_rg) else None)
+                pieces.append(RowGroupPiece(full, rg, num_rows))
         return pieces
     import pyarrow.dataset as pads
 

@@ -141,8 +141,14 @@ def batch_iterator(reader, batch_size, last_batch="drop", max_batches=None,
         source = _batch_rows(reader, batch_size, shuffle_buffer_size,
                              shuffle_seed)
 
-    for batch, full in source:
-        if max_batches is not None and produced >= max_batches:
+    # The limit check precedes the source pull: pulling first would decode a
+    # full batch past the limit only to discard it (and with max_batches=0 —
+    # the empty-shard lockstep case — would decode a batch before yielding
+    # nothing at all).
+    while max_batches is None or produced < max_batches:
+        try:
+            batch, full = next(source)
+        except StopIteration:
             return
         if not full:
             if last_batch == "drop":

@@ -133,24 +133,37 @@ class JaxDataLoader:
             "wall_s": 0.0,
             "input_stall_pct": 0.0,
             "max_batches": self._max_batches,
+            # per-stage breakdown (stall root-causing):
+            "producer_decode_s": 0.0,     # reader pull + collation
+            "producer_queue_wait_s": 0.0,  # blocked on full host queue
+            "device_dispatch_s": 0.0,      # device_put / global-array assembly
         }
 
     # -- producer ---------------------------------------------------------
 
     def _produce(self):
         try:
-            for batch in batch_iterator(
-                    self.reader, self._batch_size,
-                    last_batch=self._last_batch,
-                    max_batches=self._max_batches,
-                    shuffle_buffer_size=self._shuffle_buffer_size,
-                    shuffle_seed=self._shuffle_seed):
+            batches = iter(batch_iterator(
+                self.reader, self._batch_size,
+                last_batch=self._last_batch,
+                max_batches=self._max_batches,
+                shuffle_buffer_size=self._shuffle_buffer_size,
+                shuffle_seed=self._shuffle_seed))
+            while True:
+                t0 = time.perf_counter()
+                batch = next(batches, _SENTINEL)
+                self.diagnostics["producer_decode_s"] += time.perf_counter() - t0
+                if batch is _SENTINEL:
+                    break
+                t0 = time.perf_counter()
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)
                         break
                     except queue.Full:
                         continue
+                self.diagnostics["producer_queue_wait_s"] += \
+                    time.perf_counter() - t0
                 if self._stop.is_set():
                     return
         except Exception as exc:  # surfaced on the consumer side
@@ -186,7 +199,9 @@ class JaxDataLoader:
         # Diagnostics are per-iteration: stall/wall must describe one pass or
         # input_stall_pct (the north-star metric) is meaningless.
         self.diagnostics.update(batches=0, rows=0, stall_s=0.0, wall_s=0.0,
-                                input_stall_pct=0.0)
+                                input_stall_pct=0.0, producer_decode_s=0.0,
+                                producer_queue_wait_s=0.0,
+                                device_dispatch_s=0.0)
         self._producer = threading.Thread(target=self._produce, daemon=True,
                                           name="jax-loader-producer")
         self._producer.start()
@@ -208,7 +223,10 @@ class JaxDataLoader:
                         if self._producer_error is not None:
                             raise self._producer_error
                         break
+                    t0 = time.perf_counter()
                     inflight.append(self._stage(host_batch))
+                    self.diagnostics["device_dispatch_s"] += \
+                        time.perf_counter() - t0
                 if not inflight:
                     return
                 batch = inflight.pop(0)

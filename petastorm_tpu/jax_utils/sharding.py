@@ -127,9 +127,9 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
     NGram windows (windows per row group are data-dependent), infinite
     epochs, or a reader type that doesn't expose shard metadata.
     """
-    counts = getattr(reader, "shard_row_counts", None)
-    if counts is None:
-        return None
+    # Cheap disqualifiers first: shard_row_counts is a lazy property that may
+    # open parquet footers (one read per file on an object store) — don't pay
+    # that when derivation is rejected anyway.
     num_epochs = getattr(reader, "num_epochs", 1)
     if num_epochs is None:
         return None
@@ -141,6 +141,23 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
             "makes per-shard row counts data-dependent. Pass max_batches "
             "explicitly (agreed across hosts) or steps may deadlock the pod",
             UserWarning, stacklevel=3)
+        return None
+    transform_spec = getattr(reader, "_transform_spec", None)
+    if transform_spec is not None and getattr(transform_spec, "func",
+                                              None) is not None:
+        # A TransformSpec func may drop/duplicate rows (it rewrites the whole
+        # frame/batch), so metadata row counts no longer predict delivered
+        # rows — same data-dependence hazard as a predicate. Schema-only
+        # specs (func=None, edit/removed fields) cannot change row counts
+        # and keep automatic derivation.
+        warnings.warn(
+            "Cannot derive an equal SPMD step count: a TransformSpec can "
+            "change per-shard row counts. Pass max_batches explicitly "
+            "(agreed across hosts) or steps may deadlock the pod",
+            UserWarning, stacklevel=3)
+        return None
+    counts = getattr(reader, "shard_row_counts", None)
+    if counts is None:
         return None
     return min(_batches_for_rows(c * num_epochs, batch_size, last_batch)
                for c in counts)

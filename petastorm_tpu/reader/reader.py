@@ -418,7 +418,7 @@ class Reader:
                        self.ngram, cache or NullCache(), transform_spec)
         self._workers_pool.start(worker_class, worker_args,
                                  ventilator=self._ventilator)
-        self.diagnostics = {
+        self._static_diagnostics = {
             "rowgroups_total": len(pieces),
             "items_per_epoch": len(items),
             "workers_count": getattr(reader_pool, "workers_count", 1),
@@ -488,6 +488,19 @@ class Reader:
                 sum(counts[(p.path, p.row_group)] for p in shard)
                 for shard in self._shard_piece_lists]
         return self._shard_row_counts
+
+    @property
+    def diagnostics(self):
+        """Live runtime counters (reference ``Reader.diagnostics`` — SURVEY.md
+        §5): items ventilated/in-flight from the ventilator, items processed
+        and results-queue depth from the pool, plus static planning facts.
+        Safe to read mid-iteration; each read is a fresh snapshot."""
+        snapshot = dict(self._static_diagnostics)
+        snapshot.update(getattr(self._workers_pool, "diagnostics", {}) or {})
+        ventilator = getattr(self, "_ventilator", None)
+        if ventilator is not None:
+            snapshot.update(ventilator.diagnostics)
+        return snapshot
 
     # --- iterator protocol ----------------------------------------------
 

@@ -20,8 +20,20 @@ class DummyPool:
         self._worker = None
         self._ventilator = None
         self._stopped = False
+        self._ventilated_items = 0
+        self._completed_items = 0
         self.workers_count = workers_count
-        self.diagnostics = {}
+
+    @property
+    def diagnostics(self):
+        """Live pool counters (same shape as ThreadPool/ProcessPool)."""
+        return {
+            "items_ventilated": self._ventilated_items,
+            "items_processed": self._completed_items,
+            "items_in_flight": self._ventilated_items - self._completed_items,
+            "results_queue_size": len(self._results),
+            "workers_count": self.workers_count,
+        }
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         self._worker = worker_class(0, self._results.append, worker_setup_args)
@@ -33,12 +45,14 @@ class DummyPool:
         import sys
         import traceback
 
+        self._ventilated_items += 1
         try:
             self._worker.process(*args, **kwargs)
         except Exception as exc:  # noqa: BLE001 - forwarded to the consumer
             tb = "".join(traceback.format_exception(*sys.exc_info()))
             self._results.append(WorkerException(exc, tb))
         finally:
+            self._completed_items += 1
             if self._ventilator is not None:
                 self._ventilator.processed_item()
 

@@ -24,7 +24,9 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 import time
+from collections import deque
 
 from petastorm_tpu.workers_pool import (
     DEFAULT_TIMEOUT_S,
@@ -64,11 +66,32 @@ class ProcessPool:
         self._completed_items = 0
         self._exited_workers = 0
         self._stopped = False
-        self.diagnostics = {}
+        # Locally buffered (kind, frames) messages already pulled off the zmq
+        # socket — makes results_qsize a real depth (zmq's internal queue is
+        # not introspectable) and lets diagnostics see pending results.
+        # zmq sockets are NOT thread-safe: every poll/recv on the results
+        # socket happens under _socket_lock so a diagnostics read from a
+        # monitoring thread cannot race the consuming thread's recv.
+        self._pending_frames = deque()
+        self._socket_lock = threading.Lock()
 
     @property
     def workers_count(self):
         return self._workers_count
+
+    @property
+    def diagnostics(self):
+        """Live pool counters (reference ``Reader.diagnostics`` parity:
+        ventilated/processed items and results-queue depth — SURVEY.md §5)."""
+        return {
+            "items_ventilated": self._ventilated_items,
+            "items_processed": self._completed_items,
+            "items_in_flight": self._ventilated_items - self._completed_items,
+            "results_queue_size": self.results_qsize(),
+            "workers_count": self._workers_count,
+            "exited_workers": self._exited_workers,
+            "zmq_copy_buffers": self._zmq_copy_buffers,
+        }
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         import zmq
@@ -140,6 +163,29 @@ class ProcessPool:
         self._ventilated_items += 1
         self._vent_socket.send(payload)
 
+    def _recv_frames(self):
+        """Receive one multipart message off the socket → ``(kind, frames)``."""
+        if self._zmq_copy_buffers:
+            # copy=False: RESULT payload frames stay in zmq-owned memory
+            # and deserialization views them directly (arrays keep the
+            # frames alive via the buffer protocol).
+            zmq_frames = self._results_socket.recv_multipart(copy=False)
+            return zmq_frames[0].bytes, zmq_frames
+        frames = self._results_socket.recv_multipart()
+        return frames[0], frames
+
+    def _drain_socket_into_buffer(self):
+        # Bounded: local buffer + zmq RCVHWM together cap pending results at
+        # ~2x results_queue_size. Draining past the cap would unblock workers
+        # stuck on their SNDHWM and defeat the memory backpressure the HWM
+        # exists to provide (a monitoring loop polling results_qsize must not
+        # grow host memory unboundedly).
+        with self._socket_lock:
+            while (self._results_socket is not None
+                   and len(self._pending_frames) < self._results_queue_size
+                   and self._results_socket.poll(0)):
+                self._pending_frames.append(self._recv_frames())
+
     def get_results(self, timeout=DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout
         while True:
@@ -148,7 +194,16 @@ class ProcessPool:
                 raise RuntimeError(f"Ventilation failed: {error!r}") from error
             if self._all_done():
                 raise EmptyResultError()
-            if not self._results_socket.poll(100):
+            received = None
+            if self._pending_frames:
+                received = self._pending_frames.popleft()
+            else:
+                with self._socket_lock:
+                    if self._pending_frames:  # raced a diagnostics drain
+                        received = self._pending_frames.popleft()
+                    elif self._results_socket.poll(100):
+                        received = self._recv_frames()
+            if received is None:
                 self._check_worker_liveness()
                 if time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError(
@@ -156,16 +211,7 @@ class ProcessPool:
                         f"{self._ventilated_items} completed={self._completed_items}"
                     )
                 continue
-            if self._zmq_copy_buffers:
-                # copy=False: RESULT payload frames stay in zmq-owned memory
-                # and deserialization views them directly (arrays keep the
-                # frames alive via the buffer protocol).
-                zmq_frames = self._results_socket.recv_multipart(copy=False)
-                kind = zmq_frames[0].bytes
-                frames = zmq_frames
-            else:
-                frames = self._results_socket.recv_multipart()
-                kind = frames[0]
+            kind, frames = received
             if kind == _FRAME_RESULT:
                 if self._zmq_copy_buffers and hasattr(
                         self._serializer, "deserialize_from_frames"):
@@ -192,9 +238,12 @@ class ProcessPool:
 
     def _all_done(self):
         ventilation_over = self._ventilator is None or self._ventilator.completed()
-        return (ventilation_over
+        if not (ventilation_over
                 and self._ventilated_items == self._completed_items
-                and not self._results_socket.poll(0))
+                and not self._pending_frames):
+            return False
+        with self._socket_lock:
+            return not self._results_socket.poll(0)
 
     def _check_worker_liveness(self):
         for process in self._processes:
@@ -207,8 +256,17 @@ class ProcessPool:
                 )
 
     def results_qsize(self):
-        # zmq queues are not introspectable; report whether anything is pending.
-        return 1 if self._results_socket is not None and self._results_socket.poll(0) else 0
+        """Number of RESULT payloads ready for :meth:`get_results`.
+
+        zmq's internal queue is not introspectable, so pending messages are
+        pulled into a local buffer (still zero-copy under
+        ``zmq_copy_buffers``) and counted there.
+        """
+        if self._results_socket is None:
+            return 0
+        self._drain_socket_into_buffer()
+        return sum(1 for kind, _ in self._pending_frames
+                   if kind == _FRAME_RESULT)
 
     def stop(self):
         self._stopped = True
@@ -226,9 +284,11 @@ class ProcessPool:
             # and drain results so workers blocked on a full HWM can exit.
             if self._control_socket is not None:
                 self._control_socket.send(_CTRL_STOP)
+            self._pending_frames.clear()
             if self._results_socket is not None:
-                while self._results_socket.poll(0):
-                    self._results_socket.recv_multipart()
+                with self._socket_lock:
+                    while self._results_socket.poll(0):
+                        self._results_socket.recv_multipart()
             time.sleep(0.05)
         for process in self._processes:
             if process.poll() is None:  # pragma: no cover - stragglers only

@@ -42,18 +42,41 @@ class ThreadPool:
         self._stop_event = threading.Event()
         self._ventilated_items = 0
         self._completed_items = 0
+        self._results_pending = 0  # real RESULT payloads in the queue
         self._counter_lock = threading.Lock()
-        self.diagnostics = {}
 
     @property
     def workers_count(self):
         return self._workers_count
 
+    @property
+    def diagnostics(self):
+        """Live pool counters (reference ``Reader.diagnostics`` parity:
+        ventilated/processed items and results-queue depth — SURVEY.md §5)."""
+        with self._counter_lock:
+            ventilated, completed = self._ventilated_items, self._completed_items
+            pending = self._results_pending
+        return {
+            "items_ventilated": ventilated,
+            "items_processed": completed,
+            "items_in_flight": ventilated - completed,
+            "results_queue_size": pending,
+            "workers_count": self._workers_count,
+        }
+
+    def _publish_result(self, item):
+        # Worker-facing publish: counts real payloads so results_qsize /
+        # diagnostics report result depth, not bookkeeping-message depth
+        # (the raw queue also carries DONE markers and exceptions).
+        with self._counter_lock:
+            self._results_pending += 1
+        self._results_queue.put(item)
+
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._threads:
             raise RuntimeError("ThreadPool already started")
         for worker_id in range(self._workers_count):
-            worker = worker_class(worker_id, self._results_queue.put, worker_setup_args)
+            worker = worker_class(worker_id, self._publish_result, worker_setup_args)
             self._workers.append(worker)
             thread = threading.Thread(
                 target=self._worker_loop, args=(worker,), daemon=True,
@@ -123,6 +146,8 @@ class ThreadPool:
                 continue
             if isinstance(result, WorkerException):
                 raise result
+            with self._counter_lock:
+                self._results_pending -= 1
             return result
 
     def _raise_on_ventilator_error(self):
@@ -137,7 +162,11 @@ class ThreadPool:
         return counts_settled and ventilation_over and self._ventilator_queue.empty()
 
     def results_qsize(self):
-        return self._results_queue.qsize()
+        """Real RESULT payloads awaiting :meth:`get_results` (the raw queue
+        also holds DONE bookkeeping markers, which don't count — same
+        semantics as ``ProcessPool.results_qsize``)."""
+        with self._counter_lock:
+            return self._results_pending
 
     def stop(self):
         if self._ventilator is not None:
@@ -155,7 +184,8 @@ class ThreadPool:
                 while True:
                     self._results_queue.get_nowait()
             except queue.Empty:
-                pass
+                with self._counter_lock:
+                    self._results_pending = 0
             if time.monotonic() > deadline:  # pragma: no cover - stuck worker
                 break
             time.sleep(0.01)

@@ -64,12 +64,26 @@ class ConcurrentVentilator(Ventilator):
         self._ventilation_interval = ventilation_interval
 
         self._in_flight = 0
+        self._items_ventilated = 0
+        self._epochs_completed = 0
         self._lock = threading.Lock()
         self._space_available = threading.Condition(self._lock)
         self._stop_requested = False
         self._completed = False
         self._error = None
         self._thread = None
+
+    @property
+    def diagnostics(self):
+        """Live ventilation counters (reference ``Reader.diagnostics`` parity:
+        items ventilated / in flight — SURVEY.md §5)."""
+        with self._lock:
+            return {
+                "items_ventilated": self._items_ventilated,
+                "items_in_flight": self._in_flight,
+                "epochs_completed": self._epochs_completed,
+                "ventilation_completed": self._completed,
+            }
 
     @property
     def error(self):
@@ -110,7 +124,10 @@ class ConcurrentVentilator(Ventilator):
                         self._completed = True
                         return
                     self._in_flight += 1
+                    self._items_ventilated += 1
                 self._ventilate_fn(**item)
+            with self._lock:
+                self._epochs_completed += 1
             if iterations_left is not None:
                 iterations_left -= 1
             if self._stop_requested:

@@ -127,6 +127,16 @@ def test_derive_returns_none_and_warns_with_predicate(ragged_dataset):
             assert derive_equal_step_max_batches(reader, 4) is None
 
 
+def test_derive_returns_none_and_warns_with_transform_spec(ragged_dataset):
+    from petastorm_tpu.schema.transform import TransformSpec
+
+    with make_reader(ragged_dataset, cur_shard=0, shard_count=2, num_epochs=1,
+                     transform_spec=TransformSpec(lambda row: row),
+                     shuffle_row_groups=False) as reader:
+        with pytest.warns(UserWarning, match="TransformSpec"):
+            assert derive_equal_step_max_batches(reader, 4) is None
+
+
 def test_derive_skips_ngram_and_infinite_readers():
     ngramish = SimpleNamespace(shard_row_counts=[10], num_epochs=1,
                                ngram=object(), _predicate=None)

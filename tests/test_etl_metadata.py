@@ -82,6 +82,10 @@ def test_row_group_enumeration_uses_metadata(tmp_path):
     assert sum(counts.values()) == 3  # 10 rows / 4-per-group -> 3 row groups
     pieces = load_row_groups(fs, path)
     assert len(pieces) == 3
+    # Materialization persists per-row-group row counts, so the metadata fast
+    # path yields fully-resolved pieces — planning arithmetic (equal-step
+    # SPMD coordination) never needs a footer read.
+    assert [p.num_rows for p in pieces] == [4, 4, 2]
     table = pieces[0].read(fs, columns=["id"])
     assert table.num_rows == 4
 
