@@ -1,45 +1,216 @@
 """Fast recursive listing for GCS-backed datasets.
 
-Reference parity: ``petastorm/gcsfs_helpers/gcsfs_fast_list.py`` — avoids the
-O(files) sequential stat pattern naive listing produces on GCS, which on a
-TPU pod multiplies across hosts at reader construction. The approach: one
-recursive ``find`` call per prefix (a single paginated objects.list API
-sequence) instead of per-directory ``ls`` recursion, with results reusable as
-an fsspec ``DirCache`` seed.
+Reference parity: ``petastorm/gcsfs_helpers/gcsfs_fast_list.py`` — the
+reference wraps gcsfs so dataset discovery does ONE recursive object-listing
+sweep instead of the O(directories) sequential ``ls`` recursion naive
+discovery produces (each ``ls`` is a network round-trip; on a TPU pod the
+cost multiplies across hosts at reader construction).
+
+GCS has no real directories — objects are flat keys. A recursive ``find``
+therefore returns *files only*; every intermediate "directory" a path-based
+consumer (pyarrow dataset discovery, ``fs.walk``) expects to see must be
+synthesized. That synthesis — flat listing → directory tree with
+pseudo-directory entries → fsspec dircache — is the actual work this module
+does; it is pure logic, unit-testable without a network:
+
+- :func:`fast_list` — one ``find(detail=True)`` sweep (a single paginated
+  ``objects.list`` API sequence inside gcsfs).
+- :func:`build_dircache` — flat ``{path: info}`` → ``{directory: [direct
+  child infos]}`` with pseudo-directory entries for every intermediate level.
+- :func:`seed_listing_cache` — install that tree into an fsspec filesystem's
+  ``dircache`` so subsequent ``ls``/``info``/``isdir`` calls hit memory.
+- :class:`FastListingFilesystem` — a read-through wrapper that serves
+  ``ls``/``info``/``isdir``/``exists``/``walk`` entirely from one warmed
+  sweep.
 
 gcsfs is optional (zero-egress environments): import errors surface as a
-clear message only when the helper is actually used.
+clear message only when no explicit ``filesystem`` is supplied.
 """
 
 from __future__ import annotations
 
+DIRECTORY_TYPE = "directory"
 
-def fast_list(gcs_url, storage_options=None, detail=False):
-    """Recursively list ``gs://bucket/prefix`` with one find() sweep.
 
-    Returns a list of object paths (or ``{path: info}`` when ``detail``).
+def _strip_scheme(url):
+    for scheme in ("gs://", "gcs://"):
+        if url.startswith(scheme):
+            return url[len(scheme):]
+    return url
+
+
+def fast_list(gcs_url, storage_options=None, detail=False, filesystem=None):
+    """Recursively list ``gs://bucket/prefix`` with one ``find()`` sweep.
+
+    ``find`` maps to a single paginated ``objects.list`` API sequence —
+    gcsfs follows ``nextPageToken`` internally, so a million-object prefix is
+    still one logical call, not one per directory.
+
+    :param filesystem: any fsspec-compatible filesystem (tests pass a fake;
+        defaults to a ``gcsfs.GCSFileSystem`` built from ``storage_options``).
+    :param detail: ``True`` → ``{path: info}``; ``False`` → sorted path list.
     """
-    try:
-        import gcsfs
-    except ImportError as exc:  # pragma: no cover - gcsfs absent here
-        raise ImportError(
-            "gcsfs is required for GCS listing; pip install gcsfs"
-        ) from exc
+    if filesystem is None:
+        try:
+            import gcsfs
+        except ImportError as exc:  # pragma: no cover - gcsfs absent here
+            raise ImportError(
+                "gcsfs is required for GCS listing; pip install gcsfs, or "
+                "pass an fsspec filesystem explicitly"
+            ) from exc
 
-    fs = gcsfs.GCSFileSystem(**(storage_options or {}))
-    path = gcs_url[5:] if gcs_url.startswith("gs://") else gcs_url
-    return fs.find(path, detail=detail)
+        filesystem = gcsfs.GCSFileSystem(**(storage_options or {}))
+    path = _strip_scheme(gcs_url)
+    listing = filesystem.find(path, detail=True)
+    if detail:
+        return listing
+    return sorted(listing)
+
+
+def build_dircache(root, detail_listing):
+    """Flat ``{file path: info}`` → ``{directory: [direct child infos]}``.
+
+    Synthesizes the pseudo-directory entries GCS doesn't store: every
+    intermediate path component between ``root`` and each file becomes a
+    ``type="directory"`` entry in its parent's child list and gets a child
+    list of its own. The result is a *complete* dircache — a consumer walking
+    any directory under ``root`` finds an entry, so no listing falls through
+    to the network.
+    """
+    root = _strip_scheme(root).rstrip("/")
+    cache = {root: []}
+    for path in sorted(detail_listing):
+        info = dict(detail_listing[path])
+        info.setdefault("name", path)
+        info.setdefault("type", "file")
+        if path == root or path.endswith("/"):
+            # Zero-byte "directory marker" objects some tools create: the
+            # prefix itself, or nested 'dir/' keys. They are placeholders,
+            # not files — a dircache entry would surface phantom files.
+            continue
+        if not path.startswith(root + "/"):
+            raise ValueError(
+                f"Listed path {path!r} is not under the root {root!r}")
+        rel = path[len(root) + 1:]
+        parts = rel.split("/")
+        # Create every intermediate pseudo-directory exactly once.
+        parent = root
+        for part in parts[:-1]:
+            directory = parent + "/" + part
+            if directory not in cache:
+                cache[directory] = []
+                cache[parent].append({
+                    "name": directory,
+                    "size": 0,
+                    "type": DIRECTORY_TYPE,
+                })
+            parent = directory
+        cache[parent].append(info)
+    return cache
 
 
 def seed_listing_cache(filesystem, prefix, detail_listing):
-    """Seed an fsspec filesystem's dircache from a :func:`fast_list` result so
-    subsequent per-directory ``ls`` calls hit memory, not the network."""
-    from collections import defaultdict
+    """Seed ``filesystem.dircache`` from a :func:`fast_list` detail result.
 
-    by_dir = defaultdict(list)
-    for path, info in detail_listing.items():
-        parent = path.rsplit("/", 1)[0]
-        by_dir[parent].append(info)
-    for parent, infos in by_dir.items():
+    After seeding, per-directory ``ls`` calls on ``filesystem`` for any
+    directory under ``prefix`` resolve from memory (fsspec consults
+    ``dircache`` before the network). Returns ``filesystem``.
+    """
+    for parent, infos in build_dircache(prefix, detail_listing).items():
         filesystem.dircache[parent] = infos
     return filesystem
+
+
+def warm_gcs_listing(filesystem, gcs_url):
+    """One-call convenience: sweep ``gcs_url`` once and seed ``filesystem``'s
+    dircache with the complete tree. Returns the number of files listed."""
+    listing = fast_list(gcs_url, detail=True, filesystem=filesystem)
+    seed_listing_cache(filesystem, _strip_scheme(gcs_url), listing)
+    return len(listing)
+
+
+class FastListingFilesystem:
+    """Serves directory metadata for one prefix from a single listing sweep.
+
+    Wraps any fsspec-compatible filesystem: construction performs one
+    :func:`fast_list` sweep of ``root`` and builds the pseudo-directory tree;
+    ``ls``/``info``/``isdir``/``isfile``/``exists``/``walk`` then answer from
+    memory. File *content* operations (``open``, ``cat``, …) pass through to
+    the wrapped filesystem untouched — only metadata is cached, so readers
+    keep streaming bytes normally.
+
+    This is the reference wrapper's role (``petastorm/gcsfs_helpers``):
+    pyarrow dataset discovery over the wrapper costs one API sweep total
+    instead of one ``ls`` per directory.
+    """
+
+    def __init__(self, filesystem, root):
+        self._fs = filesystem
+        self._root = _strip_scheme(root).rstrip("/")
+        listing = fast_list(self._root, detail=True, filesystem=filesystem)
+        self._cache = build_dircache(self._root, listing)
+        self._info_by_path = {}
+        for infos in self._cache.values():
+            for info in infos:
+                self._info_by_path[info["name"]] = info
+
+    # --- cached metadata surface -----------------------------------------
+
+    def ls(self, path, detail=False):
+        path = _strip_scheme(path).rstrip("/")
+        if path in self._cache:
+            infos = self._cache[path]
+        elif path in self._info_by_path:
+            # fsspec contract: ls of a file path returns that file's entry.
+            infos = [self._info_by_path[path]]
+        else:
+            raise FileNotFoundError(path)
+        return list(infos) if detail else [i["name"] for i in infos]
+
+    def info(self, path):
+        path = _strip_scheme(path).rstrip("/")
+        if path == self._root or path in self._cache:
+            if path in self._info_by_path:
+                return self._info_by_path[path]
+            return {"name": path, "size": 0, "type": DIRECTORY_TYPE}
+        if path in self._info_by_path:
+            return self._info_by_path[path]
+        raise FileNotFoundError(path)
+
+    def isdir(self, path):
+        return _strip_scheme(path).rstrip("/") in self._cache
+
+    def isfile(self, path):
+        info = self._info_by_path.get(_strip_scheme(path).rstrip("/"))
+        return info is not None and info["type"] != DIRECTORY_TYPE
+
+    def exists(self, path):
+        path = _strip_scheme(path).rstrip("/")
+        return path in self._cache or path in self._info_by_path
+
+    def find(self, path, detail=False):
+        path = _strip_scheme(path).rstrip("/")
+        files = {name: info for name, info in self._info_by_path.items()
+                 if info["type"] != DIRECTORY_TYPE
+                 and (name.startswith(path + "/") or name == path)}
+        return files if detail else sorted(files)
+
+    def walk(self, path=None):
+        """Yield ``(dirpath, [subdir names], [file names])`` like ``os.walk``,
+        entirely from the cached tree."""
+        start = _strip_scheme(path).rstrip("/") if path else self._root
+        stack = [start]
+        while stack:
+            current = stack.pop(0)
+            infos = self._cache.get(current, [])
+            dirs = [i["name"] for i in infos if i["type"] == DIRECTORY_TYPE]
+            files = [i["name"] for i in infos if i["type"] != DIRECTORY_TYPE]
+            yield (current,
+                   [d.rsplit("/", 1)[1] for d in dirs],
+                   [f.rsplit("/", 1)[1] for f in files])
+            stack.extend(dirs)
+
+    # --- content operations pass through ---------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._fs, name)

@@ -1,0 +1,211 @@
+"""GCS fast-listing tests — all against a fake fsspec filesystem (no network).
+
+Reference analogue: ``petastorm/gcsfs_helpers/gcsfs_fast_list.py`` (SURVEY.md
+§2.4): one recursive listing sweep + pseudo-directory synthesis replaces
+per-directory ``ls`` round-trips during dataset discovery.
+"""
+
+import pytest
+
+from petastorm_tpu.gcsfs_helpers.gcsfs_fast_list import (
+    FastListingFilesystem,
+    build_dircache,
+    fast_list,
+    seed_listing_cache,
+    warm_gcs_listing,
+)
+
+
+class FakeGCSFileSystem:
+    """Flat-key store mimicking gcsfs's listing surface.
+
+    ``find`` assembles its result from fixed-size pages the way gcsfs follows
+    ``nextPageToken`` — tests assert multi-page listings come back complete.
+    Every API entry point counts its calls so tests can prove "one sweep,
+    zero per-directory round-trips".
+    """
+
+    PAGE_SIZE = 100
+
+    def __init__(self, keys):
+        self._objects = {k: {"name": k, "size": 11, "type": "file"}
+                         for k in keys}
+        self.dircache = {}
+        self.find_calls = 0
+        self.pages_served = 0
+        self.ls_network_calls = 0
+
+    def find(self, path, detail=False):
+        self.find_calls += 1
+        names = sorted(k for k in self._objects
+                       if k == path or k.startswith(path.rstrip("/") + "/"))
+        listing = {}
+        for start in range(0, len(names), self.PAGE_SIZE):
+            self.pages_served += 1  # one objects.list page per PAGE_SIZE keys
+            for name in names[start:start + self.PAGE_SIZE]:
+                listing[name] = dict(self._objects[name])
+        return listing if detail else sorted(listing)
+
+    def ls(self, path, detail=False):
+        path = path.rstrip("/")
+        if path in self.dircache:  # fsspec semantics: cache first
+            infos = self.dircache[path]
+            return list(infos) if detail else [i["name"] for i in infos]
+        self.ls_network_calls += 1
+        raise AssertionError(f"network ls({path!r}) — dircache incomplete")
+
+
+DATASET_KEYS = [
+    "bucket/ds/_common_metadata",
+    "bucket/ds/part-00000.parquet",
+    "bucket/ds/part-00001.parquet",
+    "bucket/ds/year=2024/month=1/part-00002.parquet",
+    "bucket/ds/year=2024/month=2/part-00003.parquet",
+    "bucket/ds/year=2025/month=1/part-00004.parquet",
+]
+
+
+def test_fast_list_is_one_find_sweep():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    paths = fast_list("gs://bucket/ds", filesystem=fs)
+    assert paths == sorted(DATASET_KEYS)
+    assert fs.find_calls == 1
+
+
+def test_fast_list_detail_and_scheme_stripping():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    listing = fast_list("gcs://bucket/ds", filesystem=fs, detail=True)
+    assert set(listing) == set(DATASET_KEYS)
+    assert listing["bucket/ds/_common_metadata"]["type"] == "file"
+
+
+def test_fast_list_paginates_completely():
+    # 2.5 pages worth of objects — result must span every page.
+    keys = [f"bucket/big/part-{i:05d}.parquet" for i in range(250)]
+    fs = FakeGCSFileSystem(keys)
+    paths = fast_list("gs://bucket/big", filesystem=fs)
+    assert len(paths) == 250
+    assert fs.find_calls == 1
+    assert fs.pages_served == 3  # 100 + 100 + 50
+
+
+def test_build_dircache_synthesizes_intermediate_directories():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    cache = build_dircache("bucket/ds", fs.find("bucket/ds", detail=True))
+    # Every intermediate level exists, including dirs holding only dirs.
+    assert set(cache) == {
+        "bucket/ds", "bucket/ds/year=2024", "bucket/ds/year=2024/month=1",
+        "bucket/ds/year=2024/month=2", "bucket/ds/year=2025",
+        "bucket/ds/year=2025/month=1",
+    }
+    root_names = {i["name"]: i["type"] for i in cache["bucket/ds"]}
+    assert root_names["bucket/ds/year=2024"] == "directory"
+    assert root_names["bucket/ds/part-00000.parquet"] == "file"
+    # A directory containing only directories still lists its children.
+    y2025 = cache["bucket/ds/year=2025"]
+    assert [i["name"] for i in y2025] == ["bucket/ds/year=2025/month=1"]
+
+
+def test_build_dircache_skips_root_marker_and_rejects_foreign_paths():
+    cache = build_dircache("bucket/ds", {
+        "bucket/ds": {"name": "bucket/ds", "size": 0, "type": "file"},
+        "bucket/ds/a.parquet": {"name": "bucket/ds/a.parquet", "size": 1,
+                                "type": "file"},
+    })
+    assert [i["name"] for i in cache["bucket/ds"]] == ["bucket/ds/a.parquet"]
+    with pytest.raises(ValueError, match="not under the root"):
+        build_dircache("bucket/ds", {"bucket/other/x": {"size": 1}})
+
+
+def test_build_dircache_skips_nested_directory_markers():
+    # GCS console creates zero-byte 'dir/' placeholder objects; they must not
+    # become phantom files in the cache.
+    cache = build_dircache("bucket/ds", {
+        "bucket/ds/sub/": {"name": "bucket/ds/sub/", "size": 0,
+                           "type": "file"},
+        "bucket/ds/sub/a.parquet": {"name": "bucket/ds/sub/a.parquet",
+                                    "size": 1, "type": "file"},
+    })
+    names = [i["name"] for i in cache["bucket/ds/sub"]]
+    assert names == ["bucket/ds/sub/a.parquet"]
+
+
+def test_fast_listing_filesystem_ls_of_file_path():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    wrapped = FastListingFilesystem(fs, "gs://bucket/ds")
+    # fsspec contract: ls of a file returns that file's own entry.
+    assert wrapped.ls("bucket/ds/part-00000.parquet") == \
+        ["bucket/ds/part-00000.parquet"]
+    assert wrapped.ls("bucket/ds/part-00000.parquet",
+                      detail=True)[0]["size"] == 11
+
+
+def test_seed_listing_cache_makes_every_ls_hit_memory():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    files = warm_gcs_listing(fs, "gs://bucket/ds")
+    assert files == len(DATASET_KEYS)
+    assert fs.find_calls == 1
+    # Walk the whole tree through ls() — the fake raises on any network ls.
+    to_visit = ["bucket/ds"]
+    seen_files = []
+    while to_visit:
+        for info in fs.ls(to_visit.pop(), detail=True):
+            if info["type"] == "directory":
+                to_visit.append(info["name"])
+            else:
+                seen_files.append(info["name"])
+    assert sorted(seen_files) == sorted(DATASET_KEYS)
+    assert fs.ls_network_calls == 0
+
+
+def test_seed_listing_cache_direct():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    listing = fast_list("gs://bucket/ds", filesystem=fs, detail=True)
+    seed_listing_cache(fs, "gs://bucket/ds", listing)
+    assert fs.ls("bucket/ds/year=2024") == [
+        "bucket/ds/year=2024/month=1", "bucket/ds/year=2024/month=2"]
+
+
+def test_fast_listing_filesystem_metadata_surface():
+    fs = FakeGCSFileSystem(DATASET_KEYS)
+    wrapped = FastListingFilesystem(fs, "gs://bucket/ds")
+    assert fs.find_calls == 1
+
+    assert wrapped.isdir("bucket/ds/year=2024")
+    assert not wrapped.isdir("bucket/ds/part-00000.parquet")
+    assert wrapped.isfile("bucket/ds/part-00000.parquet")
+    assert wrapped.exists("bucket/ds/year=2025/month=1/part-00004.parquet")
+    assert not wrapped.exists("bucket/ds/nope")
+    assert wrapped.info("bucket/ds/part-00000.parquet")["size"] == 11
+    assert wrapped.info("bucket/ds/year=2024")["type"] == "directory"
+    with pytest.raises(FileNotFoundError):
+        wrapped.ls("bucket/ds/absent")
+
+    files = wrapped.find("bucket/ds/year=2024")
+    assert files == ["bucket/ds/year=2024/month=1/part-00002.parquet",
+                     "bucket/ds/year=2024/month=2/part-00003.parquet"]
+
+    walked = list(wrapped.walk())
+    dirpaths = [d for d, _, _ in walked]
+    assert dirpaths[0] == "bucket/ds"
+    assert set(dirpaths) == {
+        "bucket/ds", "bucket/ds/year=2024", "bucket/ds/year=2025",
+        "bucket/ds/year=2024/month=1", "bucket/ds/year=2024/month=2",
+        "bucket/ds/year=2025/month=1",
+    }
+    all_files = [f for _, _, fnames in walked for f in fnames]
+    assert len(all_files) == len(DATASET_KEYS)
+    # After construction, zero further API calls were made.
+    assert fs.find_calls == 1
+    assert fs.ls_network_calls == 0
+
+
+def test_fast_listing_filesystem_passes_content_ops_through():
+    class FakeWithOpen(FakeGCSFileSystem):
+        def open(self, path, mode="rb"):
+            return ("opened", path, mode)
+
+    fs = FakeWithOpen(DATASET_KEYS)
+    wrapped = FastListingFilesystem(fs, "gs://bucket/ds")
+    assert wrapped.open("bucket/ds/part-00000.parquet") == \
+        ("opened", "bucket/ds/part-00000.parquet", "rb")
