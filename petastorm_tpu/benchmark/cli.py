@@ -11,8 +11,13 @@ import sys
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "scenario":
+        return _scenario_main(argv[1:])
     parser = argparse.ArgumentParser(
-        description="Measure Reader throughput (rows/sec) on a dataset")
+        description="Measure Reader throughput (rows/sec) on a dataset; or "
+                    "run a named workload: `scenario {tabular,ngram}`")
     parser.add_argument("dataset_url")
     parser.add_argument("--field-regex", nargs="*", default=None,
                         help="read only fields matching these regexes")
@@ -42,6 +47,28 @@ def main(argv=None):
              if result.input_stall_pct is not None else "")
     print(f"{result.rows_per_second:.1f} rows/sec "
           f"({result.rows_count} rows in {result.duration_s:.2f}s{stall})")
+    return 0
+
+
+def _scenario_main(argv):
+    import json
+
+    from petastorm_tpu.benchmark.scenarios import SCENARIOS
+
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-throughput scenario",
+        description="Run a named benchmark scenario on synthetic data "
+                    "(BASELINE.md configs #3/#4)")
+    parser.add_argument("name", choices=sorted(SCENARIOS))
+    parser.add_argument("--dataset-url", default=None,
+                        help="reuse an existing dataset instead of "
+                             "synthesizing one")
+    parser.add_argument("--workers", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    result = SCENARIOS[args.name](dataset_url=args.dataset_url,
+                                  workers=args.workers)
+    print(json.dumps(result))
     return 0
 
 

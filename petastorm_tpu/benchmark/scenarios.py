@@ -1,0 +1,182 @@
+"""Named benchmark scenarios from BASELINE.md's config list.
+
+Two of the reference's headline workload shapes, runnable on synthetic data
+via ``python -m petastorm_tpu.benchmark scenario <name>``:
+
+- ``tabular`` — BASELINE.md config #3 (Criteo-DLRM-like): a wide Arrow
+  schema (dense floats + integer categoricals) read through
+  ``make_batch_reader``, measuring the row-group predicate-pushdown win:
+  ``filters`` prune row groups from Parquet statistics before any byte of
+  data is read, so a selective scan should approach
+  (selected fraction)⁻¹ × full-scan throughput per *matching* row.
+- ``ngram`` — BASELINE.md config #4 (multi-frame video/lidar): timestamped
+  ``NdarrayCodec`` frames windowed by :class:`~petastorm_tpu.ngram.NGram`
+  with a ``delta_threshold``, measuring windows/sec through ``make_reader``.
+
+Each scenario materializes its own synthetic dataset (unless given a url),
+runs the measurement, and returns a flat dict of numbers (the CLI prints it
+as one JSON line, same contract as the repo-root ``bench.py``).
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+DEFAULT_TABULAR_ROWS = 40_000
+DEFAULT_TABULAR_DAYS = 8
+DEFAULT_NGRAM_FRAMES = 2_000
+
+
+# ---------------------------------------------------------------------------
+# Scenario: wide-schema tabular with predicate pushdown (config #3)
+# ---------------------------------------------------------------------------
+
+def make_tabular_dataset(dataset_url, rows=DEFAULT_TABULAR_ROWS,
+                         dense_cols=13, sparse_cols=26,
+                         days=DEFAULT_TABULAR_DAYS):
+    """Materialize a Criteo-shaped plain-Parquet dataset.
+
+    Rows are written clustered by ``day`` (one row group per day chunk), so a
+    ``filters=[("day", "=", k)]`` scan can prune (days-1)/days of the file
+    from statistics alone — the property the scenario measures.
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.fs_utils import FilesystemResolver
+
+    resolver = FilesystemResolver(dataset_url)
+    fs = resolver.filesystem()
+    path = resolver.get_dataset_path()
+    fs.create_dir(path, recursive=True)
+
+    rng = np.random.RandomState(7)
+    day = np.repeat(np.arange(days, dtype=np.int32), rows // days)
+    rows = len(day)  # trim to an exact multiple
+    columns = {"day": day,
+               "label": rng.randint(0, 2, rows).astype(np.int32)}
+    for i in range(dense_cols):
+        columns[f"dense_{i}"] = rng.rand(rows).astype(np.float32)
+    for i in range(sparse_cols):
+        columns[f"cat_{i}"] = rng.randint(0, 10_000, rows).astype(np.int64)
+    table = pa.table(columns)
+    with fs.open_output_stream(path.rstrip("/") + "/part-00000.parquet") as f:
+        # One row group per day: clustering is what makes stats selective.
+        pq.write_table(table, f, row_group_size=rows // days)
+    return rows
+
+
+def tabular_predicate_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
+                               days=DEFAULT_TABULAR_DAYS, workers=3):
+    """Full scan vs predicate-pushdown scan over the wide tabular dataset."""
+    from petastorm_tpu.reader.reader import make_batch_reader
+
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_tabular_")
+        dataset_url = f"file://{tmpdir}/ds"
+        rows = make_tabular_dataset(dataset_url, rows=rows, days=days)
+
+    def scan(**kwargs):
+        seen = 0
+        t0 = time.perf_counter()
+        with make_batch_reader(dataset_url, reader_pool_type="thread",
+                               workers_count=workers, num_epochs=1,
+                               shuffle_row_groups=False, **kwargs) as reader:
+            rowgroups = reader.diagnostics["rowgroups_total"]
+            for batch in reader:
+                # column-batch namedtuple: every field is an equal-length array
+                seen += len(batch[0])
+        return seen, time.perf_counter() - t0, rowgroups
+
+    try:
+        full_rows, full_s, full_rg = scan()
+        sel_rows, sel_s, sel_rg = scan(filters=[("day", "=", 1)])
+        return {
+            "scenario": "tabular_predicate_pushdown",
+            "rows": full_rows,
+            "full_scan_rows_per_sec": round(full_rows / full_s, 1),
+            "pushdown_rows_per_sec": round(sel_rows / sel_s, 1),
+            "full_scan_rowgroups": full_rg,
+            "pushdown_rowgroups": sel_rg,
+            "rowgroups_pruned_pct": round(100.0 * (1 - sel_rg / full_rg), 1),
+            "pushdown_wall_speedup": round(full_s / sel_s, 2),
+        }
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Scenario: NGram multi-frame windows (config #4)
+# ---------------------------------------------------------------------------
+
+def make_ngram_dataset(dataset_url, frames=DEFAULT_NGRAM_FRAMES,
+                       frame_shape=(32, 32, 3)):
+    """Materialize a timestamped frame sequence (video/lidar stand-in)."""
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("FrameSchema", [
+        UnischemaField("ts", np.int64, (), ScalarCodec(), False),
+        UnischemaField("frame", np.float32, frame_shape, NdarrayCodec(), False),
+        UnischemaField("ego_speed", np.float32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(11)
+
+    def rows():
+        for t in range(frames):
+            yield {"ts": np.int64(t),
+                   "frame": rng.rand(*frame_shape).astype(np.float32),
+                   "ego_speed": np.float32(rng.rand())}
+
+    materialize_rows(dataset_url, schema, rows(), rows_per_row_group=256)
+    return schema
+
+
+def ngram_window_scenario(dataset_url=None, frames=DEFAULT_NGRAM_FRAMES,
+                          window=5, workers=3):
+    """Windows/sec through make_reader + NGram (sort + delta_threshold)."""
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader.reader import make_reader
+
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_ngram_")
+        dataset_url = f"file://{tmpdir}/ds"
+        make_ngram_dataset(dataset_url, frames=frames)
+
+    fields = {i: ["ts", "frame", "ego_speed"] for i in range(window)}
+    ngram = NGram(fields, delta_threshold=1, timestamp_field="ts")
+    try:
+        windows = 0
+        t0 = time.perf_counter()
+        with make_reader(dataset_url, schema_fields=ngram, num_epochs=1,
+                         reader_pool_type="thread", workers_count=workers,
+                         shuffle_row_groups=False) as reader:
+            for w in reader:
+                windows += 1
+                assert len(w) == window
+        wall = time.perf_counter() - t0
+        return {
+            "scenario": "ngram_windows",
+            "frames": frames,
+            "window_length": window,
+            "windows": windows,
+            "windows_per_sec": round(windows / wall, 1),
+            "frames_per_sec": round(windows * window / wall, 1),
+        }
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+SCENARIOS = {
+    "tabular": tabular_predicate_scenario,
+    "ngram": ngram_window_scenario,
+}

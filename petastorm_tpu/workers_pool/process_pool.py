@@ -174,17 +174,16 @@ class ProcessPool:
         frames = self._results_socket.recv_multipart()
         return frames[0], frames
 
-    def _drain_socket_into_buffer(self):
-        # Bounded: local buffer + zmq RCVHWM together cap pending results at
-        # ~2x results_queue_size. Draining past the cap would unblock workers
-        # stuck on their SNDHWM and defeat the memory backpressure the HWM
-        # exists to provide (a monitoring loop polling results_qsize must not
-        # grow host memory unboundedly).
-        with self._socket_lock:
-            while (self._results_socket is not None
-                   and len(self._pending_frames) < self._results_queue_size
-                   and self._results_socket.poll(0)):
-                self._pending_frames.append(self._recv_frames())
+    def _drain_socket_locked(self):
+        # Caller must hold _socket_lock. Bounded: local buffer + zmq RCVHWM
+        # together cap pending results at ~2x results_queue_size. Draining
+        # past the cap would unblock workers stuck on their SNDHWM and defeat
+        # the memory backpressure the HWM exists to provide (a monitoring
+        # loop polling results_qsize must not grow host memory unboundedly).
+        while (self._results_socket is not None
+               and len(self._pending_frames) < self._results_queue_size
+               and self._results_socket.poll(0)):
+            self._pending_frames.append(self._recv_frames())
 
     def get_results(self, timeout=DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout
@@ -195,14 +194,11 @@ class ProcessPool:
             if self._all_done():
                 raise EmptyResultError()
             received = None
-            if self._pending_frames:
-                received = self._pending_frames.popleft()
-            else:
-                with self._socket_lock:
-                    if self._pending_frames:  # raced a diagnostics drain
-                        received = self._pending_frames.popleft()
-                    elif self._results_socket.poll(100):
-                        received = self._recv_frames()
+            with self._socket_lock:
+                if self._pending_frames:
+                    received = self._pending_frames.popleft()
+                elif self._results_socket.poll(100):
+                    received = self._recv_frames()
             if received is None:
                 self._check_worker_liveness()
                 if time.monotonic() > deadline:
@@ -239,11 +235,11 @@ class ProcessPool:
     def _all_done(self):
         ventilation_over = self._ventilator is None or self._ventilator.completed()
         if not (ventilation_over
-                and self._ventilated_items == self._completed_items
-                and not self._pending_frames):
+                and self._ventilated_items == self._completed_items):
             return False
         with self._socket_lock:
-            return not self._results_socket.poll(0)
+            return (not self._pending_frames
+                    and not self._results_socket.poll(0))
 
     def _check_worker_liveness(self):
         for process in self._processes:
@@ -264,9 +260,10 @@ class ProcessPool:
         """
         if self._results_socket is None:
             return 0
-        self._drain_socket_into_buffer()
-        return sum(1 for kind, _ in self._pending_frames
-                   if kind == _FRAME_RESULT)
+        with self._socket_lock:
+            self._drain_socket_locked()
+            return sum(1 for kind, _ in self._pending_frames
+                       if kind == _FRAME_RESULT)
 
     def stop(self):
         self._stopped = True
@@ -284,9 +281,9 @@ class ProcessPool:
             # and drain results so workers blocked on a full HWM can exit.
             if self._control_socket is not None:
                 self._control_socket.send(_CTRL_STOP)
-            self._pending_frames.clear()
             if self._results_socket is not None:
                 with self._socket_lock:
+                    self._pending_frames.clear()
                     while self._results_socket.poll(0):
                         self._results_socket.recv_multipart()
             time.sleep(0.05)

@@ -1,0 +1,38 @@
+"""Smoke tests for the named benchmark scenarios (BASELINE.md configs #3/#4)."""
+
+import json
+
+from petastorm_tpu.benchmark.cli import main
+from petastorm_tpu.benchmark.scenarios import (
+    ngram_window_scenario,
+    tabular_predicate_scenario,
+)
+
+
+def test_tabular_scenario_prunes_row_groups():
+    result = tabular_predicate_scenario(rows=4000, days=4, workers=2)
+    assert result["rows"] == 4000
+    assert result["full_scan_rowgroups"] == 4
+    assert result["pushdown_rowgroups"] == 1
+    assert result["rowgroups_pruned_pct"] == 75.0
+    assert result["full_scan_rows_per_sec"] > 0
+    assert result["pushdown_rows_per_sec"] > 0
+
+
+def test_ngram_scenario_counts_windows():
+    result = ngram_window_scenario(frames=200, window=3, workers=2)
+    # 200 contiguous timestamps, stride 1 → frames - window + 1 windows,
+    # minus windows broken at row-group boundaries (rows_per_row_group=256 >
+    # 200 here, so none are broken).
+    assert result["windows"] == 198
+    assert result["windows_per_sec"] > 0
+
+
+def test_scenario_cli_prints_json(capsys, monkeypatch):
+    import petastorm_tpu.benchmark.scenarios as scenarios
+
+    monkeypatch.setitem(scenarios.SCENARIOS, "tabular",
+                        lambda dataset_url=None, workers=3: {"ok": True})
+    assert main(["scenario", "tabular"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert json.loads(out) == {"ok": True}
