@@ -126,6 +126,8 @@ class JaxDataLoader:
         self._producer = None
         self._producer_error = None
         self._stop = threading.Event()
+        self._total_rows_yielded = 0  # cumulative, pad-aware (resume support)
+        self._yield_count_tracker = None  # tracker the count is relative to
         self.diagnostics = {
             "batches": 0,
             "rows": 0,
@@ -196,6 +198,13 @@ class JaxDataLoader:
         self._queue = queue.Queue(maxsize=self._host_prefetch)
         self._stop.clear()
         self._producer_error = None
+        # Yielded-row accounting is relative to the reader's delivery
+        # tracker; reader.reset() installs a fresh tracker (counts restart
+        # at zero), so the yielded counter must restart with it.
+        tracker = getattr(self.reader, "_delivery_tracker", None)
+        if tracker is not self._yield_count_tracker:
+            self._yield_count_tracker = tracker
+            self._total_rows_yielded = 0
         # Diagnostics are per-iteration: stall/wall must describe one pass or
         # input_stall_pct (the north-star metric) is meaningless.
         self.diagnostics.update(batches=0, rows=0, stall_s=0.0, wall_s=0.0,
@@ -231,7 +240,15 @@ class JaxDataLoader:
                     return
                 batch = inflight.pop(0)
                 self.diagnostics["batches"] += 1
-                self.diagnostics["rows"] += self._batch_rows(batch)
+                rows_in_batch = self._batch_rows(batch)
+                self.diagnostics["rows"] += rows_in_batch
+                if PAD_MASK_KEY in batch:
+                    # Count only real rows toward resume accounting (the
+                    # device pull happens at most once, on the padded final
+                    # batch of a stream).
+                    rows_in_batch = int(np.asarray(
+                        batch[PAD_MASK_KEY]).sum())
+                self._total_rows_yielded += rows_in_batch
                 yield batch
         finally:
             self.diagnostics["wall_s"] = time.perf_counter() - start
@@ -288,6 +305,35 @@ class JaxDataLoader:
             device = self._device or jax.local_devices()[0]
             out.update(jax.device_put(tensors, device))
         return out
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    def state_dict(self):
+        """Input-pipeline checkpoint aligned to what this loader has YIELDED.
+
+        The producer thread pulls rows from the reader ahead of the training
+        loop (host queue + device prefetch + shuffle buffer), so the reader's
+        own ``state_dict()`` would over-count by whatever is buffered. This
+        method subtracts the buffered rows (recorded-by-reader minus
+        yielded-by-loader) so buffered rows are re-read on resume
+        (at-least-once). Call it between steps from the training thread, then
+        pass the result as ``resume_state=`` to the reader factory feeding a
+        fresh loader.
+        """
+        tracker = getattr(self.reader, "_delivery_tracker", None)
+        if tracker is None or not hasattr(self.reader, "state_dict"):
+            raise TypeError(
+                "state_dict requires a petastorm_tpu Reader (got "
+                f"{type(self.reader).__name__})")
+        if self._shuffle_buffer_size:
+            raise ValueError(
+                "state_dict is not supported with shuffle_buffer_size > 0: "
+                "the shuffle buffer reorders rows, so buffered rows cannot "
+                "be attributed to recent deliveries (an old row may still "
+                "be held while newer row groups drained). Shuffle with "
+                "shuffle_row_groups/shard_seed instead, or checkpoint at "
+                "an epoch boundary with the reader's state_dict()")
+        return self.reader.state_dict(yielded_rows=self._total_rows_yielded)
 
     # -- lifecycle --------------------------------------------------------
 

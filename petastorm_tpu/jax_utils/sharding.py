@@ -135,6 +135,13 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
         return None
     if getattr(reader, "ngram", None) is not None:
         return None
+    if getattr(reader, "_resume_state", None) is not None:
+        warnings.warn(
+            "Cannot derive an equal SPMD step count for a resumed reader: "
+            "remaining rows are checkpoint-dependent. Pass max_batches "
+            "explicitly (agreed across hosts)",
+            UserWarning, stacklevel=3)
+        return None
     if getattr(reader, "_predicate", None) is not None:
         warnings.warn(
             "Cannot derive an equal SPMD step count: a row-level predicate "

@@ -17,6 +17,9 @@ from collections import deque
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.reader_impl.delivery_tracker import (item_key,
+                                                        read_table_tag,
+                                                        tag_table)
 from petastorm_tpu.schema.transform import transform_schema
 from petastorm_tpu.schema.unischema import Unischema
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
@@ -46,7 +49,10 @@ class ArrowReaderWorker(WorkerBase):
                                      shuffle_row_drop_partition),
         )
         if table is not None and table.num_rows > 0:
-            self.publish_func(table)
+            # Tag rides in schema metadata (not a wrapper object) so the
+            # Arrow-IPC serializer keeps transporting plain tables.
+            self.publish_func(tag_table(
+                table, item_key(piece_index, shuffle_row_drop_partition[0])))
 
     def _load_table(self, piece, worker_predicate, shuffle_row_drop_partition):
         columns = sorted(self._read_schema.fields)
@@ -105,6 +111,7 @@ class ArrowResultsQueueReader:
 
     def __init__(self):
         self._buffer = deque()
+        self.delivery_tracker = None  # set by Reader for resumable iteration
 
     @property
     def batched_output(self):
@@ -112,6 +119,10 @@ class ArrowResultsQueueReader:
 
     def read_next(self, pool, schema, ngram):
         table = pool.get_results()  # raises EmptyResultError at end of data
+        if self.delivery_tracker is not None:
+            key = read_table_tag(table)
+            if key is not None:
+                self.delivery_tracker.record(key, table.num_rows)
         return table_to_batch(table, schema)
 
 

@@ -25,6 +25,7 @@ from collections import OrderedDict
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.reader_impl.delivery_tracker import PiecePayload, item_key
 from petastorm_tpu.schema.transform import transform_schema
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
@@ -52,7 +53,8 @@ class ColumnarDecodeWorker(WorkerBase):
                                      shuffle_row_drop_partition),
         )
         if batch and len(next(iter(batch.values()))) > 0:
-            self.publish_func(batch)
+            self.publish_func(PiecePayload(
+                item_key(piece_index, shuffle_row_drop_partition[0]), batch))
 
     def _load_batch(self, piece, worker_predicate, shuffle_row_drop_partition):
         columns = sorted(self._read_schema.fields)
@@ -144,10 +146,18 @@ def _column_cells(column):
 class ColumnarResultsQueueReader:
     """Consumer-side: decoded column dict → namedtuple of column arrays."""
 
+    def __init__(self):
+        self.delivery_tracker = None  # set by Reader for resumable iteration
+
     @property
     def batched_output(self):
         return True
 
     def read_next(self, pool, schema, ngram):
         batch = pool.get_results()  # raises EmptyResultError at end of data
+        if isinstance(batch, PiecePayload):
+            if self.delivery_tracker is not None:
+                num_rows = len(next(iter(batch.payload.values()), ()))
+                self.delivery_tracker.record(batch.item_key, num_rows)
+            batch = batch.payload
         return schema.make_namedtuple(**batch)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from collections import deque
 
+from petastorm_tpu.reader_impl.delivery_tracker import PiecePayload, item_key
 from petastorm_tpu.schema.transform import transform_schema
 from petastorm_tpu.utils import decode_row, decode_table
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
@@ -44,7 +45,8 @@ class PyDictReaderWorker(WorkerBase):
                                     shuffle_row_drop_partition),
         )
         if rows:
-            self.publish_func(rows)
+            self.publish_func(PiecePayload(
+                item_key(piece_index, shuffle_row_drop_partition[0]), rows))
 
     def _cache_key(self, piece, worker_predicate, shuffle_row_drop_partition):
         # Cached rows are POST-transform: the transform repr must be in the
@@ -152,6 +154,8 @@ class PyDictResultsQueueReader:
 
     def __init__(self):
         self._buffer = deque()
+        self.delivery_tracker = None  # set by Reader for resumable iteration
+        self._pending_item = None  # (item_key, num_rows) awaiting last row
 
     @property
     def batched_output(self):
@@ -160,6 +164,14 @@ class PyDictResultsQueueReader:
     def read_next(self, pool, schema, ngram):
         while not self._buffer:
             rows = pool.get_results()  # raises EmptyResultError at end of data
+            if isinstance(rows, PiecePayload):
+                # Delivery is recorded only when the payload's LAST row is
+                # handed out (bottom of this method): rows still buffered at
+                # checkpoint time must be re-read on resume (at-least-once).
+                self._pending_item = (rows.item_key, len(rows.payload))
+                rows = rows.payload
+            else:
+                self._pending_item = None
             # Convert the whole delivered row-group at once: namedtuple
             # construction via map(row.get, fields) is the consumer's hot
             # loop and caps pool throughput (it is serial no matter how many
@@ -169,4 +181,9 @@ class PyDictResultsQueueReader:
                     ngram.make_namedtuple(schema, row) for row in rows)
             else:
                 self._buffer.extend(schema.make_namedtuples(rows))
-        return self._buffer.popleft()
+        row = self._buffer.popleft()
+        if not self._buffer and self._pending_item is not None:
+            if self.delivery_tracker is not None:
+                self.delivery_tracker.record(*self._pending_item)
+            self._pending_item = None
+        return row

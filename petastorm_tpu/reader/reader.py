@@ -66,7 +66,8 @@ def make_reader(dataset_url,
                 storage_options=None,
                 zmq_copy_buffers=True,
                 filesystem=None,
-                reader_engine=None):
+                reader_engine=None,
+                resume_state=None):
     """Reader for **petastorm-format** datasets (Unischema + codecs attached).
 
     Reference parity: ``petastorm/reader.py::make_reader`` — same knob surface.
@@ -122,7 +123,8 @@ def make_reader(dataset_url,
                   shard_seed=shard_seed,
                   cache=cache,
                   transform_spec=transform_spec,
-                  filters=filters)
+                  filters=filters,
+                  resume_state=resume_state)
 
 
 def make_columnar_reader(dataset_url,
@@ -143,7 +145,8 @@ def make_columnar_reader(dataset_url,
                          filters=None,
                          storage_options=None,
                          zmq_copy_buffers=True,
-                         filesystem=None):
+                         filesystem=None,
+                         resume_state=None):
     """Columnar reader for **petastorm-format** datasets — the TPU-native
     fast path feeding :func:`petastorm_tpu.jax_utils.make_jax_dataloader`.
 
@@ -203,7 +206,8 @@ def make_columnar_reader(dataset_url,
                   shard_seed=shard_seed,
                   cache=cache,
                   transform_spec=transform_spec,
-                  filters=filters)
+                  filters=filters,
+                  resume_state=resume_state)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -223,7 +227,8 @@ def make_batch_reader(dataset_url_or_urls,
                       filters=None,
                       storage_options=None,
                       zmq_copy_buffers=True,
-                      filesystem=None):
+                      filesystem=None,
+                      resume_state=None):
     """Batch reader for **plain Parquet** stores (no petastorm metadata needed).
 
     Reference parity: ``petastorm/reader.py::make_batch_reader``. Yields
@@ -273,7 +278,8 @@ def make_batch_reader(dataset_url_or_urls,
                   shard_seed=shard_seed,
                   cache=cache,
                   transform_spec=transform_spec,
-                  filters=filters)
+                  filters=filters,
+                  resume_state=resume_state)
 
 
 def _default_shard_options(cur_shard, shard_count):
@@ -329,7 +335,8 @@ class Reader:
                  shuffle_row_groups=True, shuffle_row_drop_partitions=1,
                  predicate=None, rowgroup_selector=None, num_epochs=1,
                  cur_shard=None, shard_count=None, shard_seed=None,
-                 cache=None, transform_spec=None, filters=None):
+                 cache=None, transform_spec=None, filters=None,
+                 resume_state=None):
         if predicate is not None and not isinstance(predicate, PredicateBase):
             raise ValueError("predicate must be an instance of PredicateBase")
         if (cur_shard is None) != (shard_count is None):
@@ -406,13 +413,48 @@ class Reader:
             for piece_index in range(len(pieces))
             for drop_partition in range(shuffle_row_drop_partitions)
         ]
+
+        # --- resumable iteration (no reference analogue — SURVEY.md §5) ---
+        # Payloads arrive tagged with their work-item identity; the tracker
+        # counts deliveries at consumption time. state_dict() exports the
+        # counts; resume_state re-ventilates each item only for its remaining
+        # epochs (at-least-once at row-group granularity — see
+        # reader_impl/delivery_tracker.py for the exact semantics).
+        from petastorm_tpu.reader_impl.delivery_tracker import (
+            DeliveryTracker, item_key)
+
+        self._shard_seed = shard_seed
+        self._shuffle_row_drop_partitions = shuffle_row_drop_partitions
+        self._resume_state = resume_state
+        self._num_items = len(items)  # full item universe (pre-resume trim)
+        iterations = num_epochs
+        per_item_iterations = None
+        prior_counts = None
+        if resume_state is not None:
+            self._validate_resume_state(resume_state, items)
+            delivered = resume_state["delivered"]
+            keys = [item_key(it["piece_index"],
+                             it["shuffle_row_drop_partition"][0])
+                    for it in items]
+            per_item_iterations = [
+                max(0, num_epochs - delivered.get(k, 0)) for k in keys]
+            prior_counts = dict(delivered)
+            iterations = max(per_item_iterations, default=0)
+            if iterations == 0:
+                # Everything already delivered: a valid reader yielding
+                # nothing more (mirrors an exhausted stream).
+                items, per_item_iterations = [], None
+        self._delivery_tracker = DeliveryTracker(preload=prior_counts)
+        self._results_queue_reader.delivery_tracker = self._delivery_tracker
+
         self._ventilator = ConcurrentVentilator(
             self._workers_pool.ventilate,
             items,
-            iterations=num_epochs,
+            iterations=iterations if items else 1,
             randomize_item_order=shuffle_row_groups,
             random_seed=shard_seed,
-            max_ventilation_queue_size=min(len(items), 1000),
+            max_ventilation_queue_size=min(len(items), 1000) or 1,
+            per_item_iterations=per_item_iterations,
         )
         worker_args = (pyarrow_filesystem, pieces, schema, read_schema,
                        self.ngram, cache or NullCache(), transform_spec)
@@ -489,6 +531,66 @@ class Reader:
                 for shard in self._shard_piece_lists]
         return self._shard_row_counts
 
+    def state_dict(self, yielded_rows=None):
+        """Snapshot of iteration progress for checkpoint/resume.
+
+        Returns a JSON-serializable dict; persist it with your model
+        checkpoint and pass it back as ``resume_state=`` to the same factory
+        with the same arguments. Semantics: at-least-once at row-group
+        granularity — fully-delivered row groups are never re-read; the row
+        group being consumed at snapshot time is re-read on resume. Requires
+        finite ``num_epochs`` to resume (an infinite stream restarts
+        instead). Safe to call mid-iteration from another thread.
+
+        ``yielded_rows``: for a downstream consumer that prefetches past the
+        reader interface — the number of rows it has actually surfaced. The
+        newest deliveries beyond that count are excluded from the snapshot
+        (atomically, so concurrent pulls only widen the re-read window) —
+        ``JaxDataLoader.state_dict()`` passes this for you.
+        """
+        delivered = (
+            self._delivery_tracker.counts_rolled_back_to(yielded_rows)
+            if yielded_rows is not None
+            else self._delivery_tracker.counts())
+        return {
+            "version": 1,
+            "dataset_path": self._dataset_path_signature(),
+            "num_items": self._num_items,
+            "num_epochs": self.num_epochs,
+            "shard": [self.cur_shard, self.shard_count, self._shard_seed],
+            "drop_partitions": self._shuffle_row_drop_partitions,
+            "delivered": delivered,
+        }
+
+    def _dataset_path_signature(self):
+        path = self._dataset_path
+        return sorted(str(p) for p in path) if isinstance(path, list) \
+            else str(path)
+
+    def _validate_resume_state(self, state, items):
+        if state.get("version") != 1:
+            raise ValueError(
+                f"Unsupported resume_state version {state.get('version')!r}")
+        if self.num_epochs is None:
+            raise ValueError(
+                "resume_state requires finite num_epochs (an infinite stream "
+                "has no resumable endpoint — just restart it)")
+        expected = {
+            "dataset_path": self._dataset_path_signature(),
+            "num_items": len(items),
+            "num_epochs": self.num_epochs,
+            "shard": [self.cur_shard, self.shard_count, self._shard_seed],
+            "drop_partitions": self._shuffle_row_drop_partitions,
+        }
+        for key, want in expected.items():
+            got = state.get(key)
+            got = list(got) if isinstance(got, tuple) else got
+            if got != want:
+                raise ValueError(
+                    f"resume_state mismatch on {key!r}: checkpoint has "
+                    f"{got!r}, this reader has {want!r} — resume requires "
+                    f"the same dataset and reader configuration")
+
     @property
     def diagnostics(self):
         """Live runtime counters (reference ``Reader.diagnostics`` — SURVEY.md
@@ -548,7 +650,21 @@ class Reader:
                 "Currently, reset() can only be called after all rows were "
                 "consumed"
             )
+        if self._resume_state is not None:
+            # The resumed ventilation plan is trimmed to the checkpoint's
+            # remaining work; replaying it would NOT be a full pass (items
+            # already delivered before the checkpoint would be skipped).
+            raise NotImplementedError(
+                "reset() is not supported on a resumed reader — construct a "
+                "fresh reader (without resume_state) for a new full pass")
         self.last_row_consumed = False
+        # Reset delivery accounting with the epochs: a state_dict() taken
+        # after reset() must describe the new pass, not accumulate the
+        # finished one (stale counts would make resume yield nothing).
+        from petastorm_tpu.reader_impl.delivery_tracker import DeliveryTracker
+
+        self._delivery_tracker = DeliveryTracker()
+        self._results_queue_reader.delivery_tracker = self._delivery_tracker
         self._ventilator.reset()
 
 

@@ -1,0 +1,140 @@
+"""Delivery tracking for resumable reader iteration.
+
+The reference has no checkpoint/resume for readers (SURVEY.md §5: "no
+iterator state save" — flagged there as the rebuild opportunity). On a TPU
+pod, model state checkpoints through orbax; without input-pipeline state a
+restart replays or skips data. This module is the accounting half of
+``Reader.state_dict()`` / ``make_reader(..., resume_state=...)``:
+
+- Workers tag each published payload with the identity of the ventilated
+  work item that produced it (``(piece_index, drop_partition)`` — one row
+  group, one drop partition).
+- The consumer-side results-queue readers record the tag **when the payload
+  is handed to the consumer** (not when the worker finishes — a payload
+  still sitting in a queue at checkpoint time must be re-read on resume).
+- ``DeliveryTracker`` keeps ``{item_key: times_delivered}``; resume
+  re-ventilates each item ``num_epochs - times_delivered`` more times.
+
+Semantics: **at-least-once at row-group granularity.** Rows from a row group
+that was partially consumed (or decoded but not yet consumed) at checkpoint
+time are seen again after resume; fully-delivered row groups are never
+re-read. Work items whose rows were all filtered by a predicate publish
+nothing, so they re-run on resume and re-filter to nothing — harmless.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def item_key(piece_index, drop_partition):
+    """Stable JSON-friendly identity of one ventilated work item."""
+    return f"{piece_index}:{drop_partition}"
+
+
+class PiecePayload:
+    """A worker's published payload tagged with its work-item identity.
+
+    Used for pickle-serialized payload types (row lists, column dicts);
+    ``pa.Table`` payloads carry the tag in their schema metadata instead so
+    the Arrow-IPC serializer keeps working on plain tables.
+    """
+
+    __slots__ = ("item_key", "payload")
+
+    def __init__(self, item_key, payload):
+        self.item_key = item_key
+        self.payload = payload
+
+    def __reduce__(self):  # keep pickling cheap and explicit
+        return (PiecePayload, (self.item_key, self.payload))
+
+
+#: Schema-metadata key carrying the work-item tag on ``pa.Table`` payloads.
+TABLE_ITEM_KEY = b"petastorm_tpu.delivery_item.v1"
+
+
+def tag_table(table, key):
+    """Return ``table`` with the work-item tag merged into schema metadata."""
+    metadata = dict(table.schema.metadata or {})
+    metadata[TABLE_ITEM_KEY] = key.encode("utf-8")
+    return table.replace_schema_metadata(metadata)
+
+
+def read_table_tag(table):
+    """Extract the work-item tag from a table (None when untagged)."""
+    metadata = table.schema.metadata or {}
+    raw = metadata.get(TABLE_ITEM_KEY)
+    return raw.decode("utf-8") if raw is not None else None
+
+
+class DeliveryTracker:
+    """Thread-safe ``{item_key: times_delivered}`` counter with a rollback log.
+
+    ``record`` is called from whatever thread iterates the reader (e.g. the
+    JAX loader's producer thread); ``state_dict`` from the checkpointing
+    thread — hence the lock.
+
+    The ordered ``(key, num_rows)`` log supports downstream-buffer
+    compensation: a consumer that buffers rows past the reader interface
+    (``JaxDataLoader``'s host queue + device prefetch) checkpoints via
+    ``counts_rolled_back_to(yielded_rows)``, which un-counts the newest
+    deliveries until only the rows actually surfaced remain — buffered rows
+    re-read on resume (at-least-once). Valid only while rows flow FIFO from
+    the reader through the consumer; a reordering stage (the loader's
+    row-level ``shuffle_buffer_size``) can hold an OLD row while newer
+    deliveries drain, which tail-rollback cannot reach — the loader
+    therefore refuses to checkpoint in that configuration.
+    """
+
+    #: Rollback log cap. Rollback depth is bounded by the loader's buffered
+    #: rows (a handful of batches), which can never span this many distinct
+    #: deliveries; the cap keeps memory O(1) over long runs.
+    MAX_LOG_ENTRIES = 100_000
+
+    def __init__(self, preload=None):
+        self._lock = threading.Lock()
+        self._counts = dict(preload or {})
+        self._log = []  # ordered (key, num_rows) of this run's deliveries
+        self._total_rows = 0
+
+    def record(self, key, num_rows=1):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._log.append((key, num_rows))
+            if len(self._log) > self.MAX_LOG_ENTRIES:
+                del self._log[:len(self._log) // 2]
+            self._total_rows += num_rows
+
+    def counts(self):
+        with self._lock:
+            return dict(self._counts)
+
+    def total_rows_recorded(self):
+        """Rows delivered through the reader interface during this run
+        (excludes preloaded prior-run counts)."""
+        with self._lock:
+            return self._total_rows
+
+    def counts_rolled_back_to(self, yielded_rows):
+        """Counts as if only the first ``yielded_rows`` delivered rows had
+        happened: the newest deliveries are un-counted (whole deliveries at
+        a time) until the remaining recorded rows are <= ``yielded_rows``.
+
+        Computed atomically under the tracker lock — the consumer may keep
+        recording concurrently; deliveries recorded after the caller read
+        its yielded-row count land at the log tail and are rolled back
+        first, which only widens the re-read window (conservative).
+        Partially-consumed deliveries roll back entirely (at-least-once).
+        """
+        with self._lock:
+            counts = dict(self._counts)
+            remaining = self._total_rows
+            for key, num_rows in reversed(self._log):
+                if remaining <= yielded_rows:
+                    break
+                counts[key] = counts.get(key, 0) - 1
+                if counts[key] <= 0:
+                    counts.pop(key)
+                remaining -= num_rows
+            return counts

@@ -44,15 +44,33 @@ class ConcurrentVentilator(Ventilator):
     ``iterations=None`` ventilates forever (infinite epochs).
     ``randomize_item_order`` reshuffles the item order every epoch.
     Items are dicts passed as kwargs to ``ventilate_fn`` (reference semantics).
+
+    ``per_item_iterations`` (resume support): a list parallel to
+    ``items_to_ventilate`` giving how many more epochs each item should be
+    ventilated for; epoch ``e`` (0-based) ventilates the items with
+    ``per_item_iterations[i] > e``. Requires finite ``iterations`` equal to
+    ``max(per_item_iterations)``.
     """
 
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
                  randomize_item_order=False, random_seed=None,
-                 max_ventilation_queue_size=None, ventilation_interval=0.01):
+                 max_ventilation_queue_size=None, ventilation_interval=0.01,
+                 per_item_iterations=None):
         super().__init__(ventilate_fn)
         if iterations is not None and iterations <= 0:
             raise ValueError(f"iterations must be positive or None, got {iterations}")
         self._items_to_ventilate = list(items_to_ventilate)
+        if per_item_iterations is not None:
+            if iterations is None:
+                raise ValueError(
+                    "per_item_iterations requires finite iterations")
+            if len(per_item_iterations) != len(self._items_to_ventilate):
+                raise ValueError(
+                    "per_item_iterations must parallel items_to_ventilate")
+            if max(per_item_iterations, default=0) != iterations:
+                raise ValueError(
+                    "iterations must equal max(per_item_iterations)")
+        self._per_item_iterations = per_item_iterations
         self._iterations = iterations
         self._randomize_item_order = randomize_item_order
         self._random = random.Random(random_seed)
@@ -111,8 +129,14 @@ class ConcurrentVentilator(Ventilator):
 
     def _run_inner(self):
         iterations_left = self._iterations
+        epoch = 0
         while iterations_left is None or iterations_left > 0:
-            items = list(self._items_to_ventilate)
+            if self._per_item_iterations is not None:
+                items = [item for item, n in zip(self._items_to_ventilate,
+                                                 self._per_item_iterations)
+                         if n > epoch]
+            else:
+                items = list(self._items_to_ventilate)
             if self._randomize_item_order:
                 self._random.shuffle(items)
             for item in items:
@@ -128,6 +152,7 @@ class ConcurrentVentilator(Ventilator):
                 self._ventilate_fn(**item)
             with self._lock:
                 self._epochs_completed += 1
+            epoch += 1
             if iterations_left is not None:
                 iterations_left -= 1
             if self._stop_requested:
