@@ -14,6 +14,13 @@ from petastorm_tpu.models.image_classifier import (
     make_train_step,
     param_partition_specs,
 )
+from petastorm_tpu.models.tabular_dlrm import (
+    apply_dlrm,
+    dlrm_partition_specs,
+    init_dlrm_params,
+    make_dlrm_train_step,
+)
 
 __all__ = ["init_params", "apply_model", "make_train_step",
-           "param_partition_specs"]
+           "param_partition_specs", "init_dlrm_params", "apply_dlrm",
+           "make_dlrm_train_step", "dlrm_partition_specs"]

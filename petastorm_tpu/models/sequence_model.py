@@ -130,12 +130,14 @@ def seq_param_partition_specs():
 
 
 def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
-                    compute_dtype=jnp.bfloat16):
+                    compute_dtype=jnp.bfloat16, attn_impl="dense"):
     """``windows``: [B, T, F] float (NGram windows collated to a time axis).
 
     With ``mesh``: ring attention sequence-parallel over ``mesh[attn_axis]``
-    (T must divide by the axis size). Without: dense reference attention.
-    Returns f32 logits [B, num_classes].
+    (T must divide by the axis size). Without: single-shard attention —
+    ``attn_impl="dense"`` (XLA einsum softmax) or ``"flash"`` (the Pallas
+    tiled kernel, ``petastorm_tpu.ops.flash_attention`` — O(block²) memory,
+    the TPU choice for long windows). Returns f32 logits [B, num_classes].
     """
     h = num_heads
     x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
@@ -150,6 +152,11 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     if mesh is not None:
         batch_axis = "data" if "data" in mesh.axis_names else None
         attn = ring_attention(q, k, v, mesh, attn_axis, batch_axis=batch_axis)
+    elif attn_impl == "flash":
+        from petastorm_tpu.ops import flash_attention
+
+        block = min(128, t)
+        attn = flash_attention(q, k, v, block_q=block, block_k=block)
     else:
         attn = attention_reference(q, k, v)
     attn = attn.reshape(b, t, d) @ params["wo"].astype(compute_dtype)

@@ -1,0 +1,156 @@
+"""DLRM-style tabular recommender, SPMD-sharded (data + model parallel).
+
+The model consumer for BASELINE.md config #3 (Criteo-1TB-class tabular data —
+the dataset shape ``benchmark.scenarios.make_tabular_dataset`` writes and
+``make_batch_reader`` streams). The reference ships no model code (SURVEY.md
+§0); this exists to exercise the wide-schema path end-to-end: Parquet →
+``make_batch_reader`` → ``make_jax_dataloader`` → sharded pjit train step.
+
+TPU-first choices:
+
+- **Embedding tables are the memory problem** (Criteo-scale tables dwarf
+  HBM), so they shard **table-wise over the ``"model"`` mesh axis**: the
+  stacked ``[num_tables, vocab, dim]`` tensor splits on its leading axis.
+  Lookups are a pure ``take`` along the vocab axis of each local table —
+  with batch data-parallel and tables model-parallel, XLA turns the
+  gather + feature-interaction contraction into an all-to-all-shaped
+  exchange over ICI (the hand-written NCCL all-to-all of GPU DLRM
+  implementations, recovered from sharding annotations alone).
+- Dense/top MLPs compute in **bfloat16** on the MXU (params f32, cast
+  per-step, f32 loss accumulation) — same convention as
+  ``models/image_classifier.py``.
+- **Static shapes**, hashed categorical ids (``ids % vocab``) so any int64
+  column feeds the same trace; pad-mask aware loss for the loader's
+  ``last_batch="pad"`` lockstep policy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_dlrm_params(rng, num_dense, num_sparse, vocab_size=1024,
+                     embed_dim=16, bottom_hidden=64, top_hidden=64,
+                     dtype=jnp.float32):
+    """Initialize the DLRM parameter pytree.
+
+    :param num_dense: count of dense float features (Criteo: 13).
+    :param num_sparse: count of categorical features = embedding tables
+        (Criteo: 26) — the ``"model"``-sharded dimension; keep it a multiple
+        of the mesh's model-axis size.
+    :param vocab_size: rows per table (ids are hashed into this range).
+    :param embed_dim: embedding width; also the bottom MLP's output width so
+        dense features join the feature interaction as one more "table".
+    """
+    k_emb, k_b1, k_b2, k_t1, k_t2 = jax.random.split(rng, 5)
+    scale = lambda fan_in: 1.0 / jnp.sqrt(fan_in)  # noqa: E731
+    num_features = num_sparse + 1  # +1: bottom-MLP output joins interaction
+    interact = (num_features * (num_features - 1)) // 2 + embed_dim
+    return {
+        "embeddings": jax.random.normal(
+            k_emb, (num_sparse, vocab_size, embed_dim), dtype) * 0.05,
+        "bottom1": {
+            "kernel": jax.random.normal(k_b1, (num_dense, bottom_hidden),
+                                        dtype) * scale(num_dense),
+            "bias": jnp.zeros((bottom_hidden,), dtype),
+        },
+        "bottom2": {
+            "kernel": jax.random.normal(k_b2, (bottom_hidden, embed_dim),
+                                        dtype) * scale(bottom_hidden),
+            "bias": jnp.zeros((embed_dim,), dtype),
+        },
+        "top1": {
+            "kernel": jax.random.normal(k_t1, (interact, top_hidden),
+                                        dtype) * scale(interact),
+            "bias": jnp.zeros((top_hidden,), dtype),
+        },
+        "top2": {
+            "kernel": jax.random.normal(k_t2, (top_hidden, 1),
+                                        dtype) * scale(top_hidden),
+            "bias": jnp.zeros((1,), dtype),
+        },
+    }
+
+
+def dlrm_partition_specs():
+    """PartitionSpecs for a ``("data", "model")`` mesh.
+
+    Only the embedding stack is model-sharded (table-wise on the leading
+    axis); the MLPs are small and replicate. Activations follow from the
+    batch's ``P("data")`` sharding.
+    """
+    return {
+        "embeddings": P("model", None, None),
+        "bottom1": {"kernel": P(None, None), "bias": P(None)},
+        "bottom2": {"kernel": P(None, None), "bias": P(None)},
+        "top1": {"kernel": P(None, None), "bias": P(None)},
+        "top2": {"kernel": P(None, None), "bias": P(None)},
+    }
+
+
+def apply_dlrm(params, dense, sparse_ids, compute_dtype=jnp.bfloat16):
+    """Forward pass → logits ``[B]``.
+
+    :param dense: float ``[B, num_dense]``.
+    :param sparse_ids: int ``[B, num_sparse]`` raw ids (hashed internally).
+    """
+    dense = dense.astype(compute_dtype)
+    emb = params["embeddings"].astype(compute_dtype)
+    num_sparse, vocab, embed_dim = emb.shape
+
+    # Bottom MLP over dense features → one pseudo-embedding.
+    x = dense @ params["bottom1"]["kernel"].astype(compute_dtype)
+    x = jax.nn.relu(x + params["bottom1"]["bias"].astype(compute_dtype))
+    x = x @ params["bottom2"]["kernel"].astype(compute_dtype)
+    dense_vec = jax.nn.relu(
+        x + params["bottom2"]["bias"].astype(compute_dtype))  # [B, D]
+
+    # Table-wise lookups: one take per table along its vocab axis. vmap over
+    # the (model-sharded) table axis keeps the gather local to each shard.
+    ids = (sparse_ids % vocab).astype(jnp.int32).T  # [num_sparse, B]
+    looked_up = jax.vmap(lambda table, i: jnp.take(table, i, axis=0))(
+        emb, ids)  # [num_sparse, B, D]
+    features = jnp.concatenate(
+        [dense_vec[None], looked_up], axis=0)  # [F, B, D]
+
+    # Pairwise dot-product interaction (the DLRM signature op): one batched
+    # matmul on the MXU, upper triangle taken with a static mask.
+    feats_b = jnp.transpose(features, (1, 0, 2))  # [B, F, D]
+    inter = feats_b @ jnp.transpose(feats_b, (0, 2, 1))  # [B, F, F]
+    f = feats_b.shape[1]
+    iu, ju = jnp.triu_indices(f, k=1)
+    pairwise = inter[:, iu, ju]  # [B, F*(F-1)/2]
+
+    top_in = jnp.concatenate([dense_vec, pairwise], axis=1)
+    x = top_in @ params["top1"]["kernel"].astype(compute_dtype)
+    x = jax.nn.relu(x + params["top1"]["bias"].astype(compute_dtype))
+    x = x @ params["top2"]["kernel"].astype(compute_dtype)
+    logits = x + params["top2"]["bias"].astype(compute_dtype)
+    return logits[:, 0].astype(jnp.float32)
+
+
+def make_dlrm_train_step(learning_rate=0.01):
+    """SGD step on masked binary cross-entropy; jit/pjit-ready.
+
+    Signature: ``step(params, dense, sparse_ids, labels, mask) ->
+    (params, loss)`` — ``mask`` is the loader's ``__pad_mask__`` (all-True
+    when unpadded) so padded rows contribute zero gradient.
+    """
+
+    def loss_fn(params, dense, sparse_ids, labels, mask):
+        logits = apply_dlrm(params, dense, sparse_ids)
+        losses = jnp.maximum(logits, 0) - logits * labels + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))  # stable BCE-with-logits
+        mask = mask.astype(jnp.float32)
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def step(params, dense, sparse_ids, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, dense, sparse_ids, labels.astype(jnp.float32), mask)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - learning_rate * g.astype(p.dtype), params, grads)
+        return params, loss
+
+    return step

@@ -1,0 +1,11 @@
+"""TPU kernels (Pallas) for the framework's hot compute ops.
+
+The reference has no accelerator code at all (SURVEY.md §0); these kernels
+back the model layer's hottest op — attention over NGram windows — with a
+hand-tiled Pallas implementation where XLA's default fusion leaves MXU
+utilization on the table.
+"""
+
+from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

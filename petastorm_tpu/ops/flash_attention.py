@@ -1,0 +1,190 @@
+"""Pallas TPU flash attention: tiled online-softmax attention in VMEM.
+
+The hot op of the sequence model (``models/sequence_model.py`` — NGram
+``[B, T, H, D]`` windows). The reference has no accelerator code; this is
+the TPU-native answer to "where do the FLOPs go": Q/K/V tiles stream
+HBM → VMEM block by block, scores hit the MXU per tile
+(``preferred_element_type=f32``), and the online softmax keeps running
+``(max, sum, acc)`` statistics in VMEM scratch so the [T, T] score matrix is
+NEVER materialized — memory O(block_q × block_k) instead of O(T²).
+
+Layout/tiling choices (pallas_guide.md):
+
+- grid = (batch·heads, Tq/block_q, Tk/block_k) — the last axis iterates
+  innermost and sequentially on TPU, which is what makes scratch
+  accumulation across K blocks valid;
+- softmax statistics live in ``(block_q, 128)`` f32 scratch (lane-broadcast:
+  min tile is 8×128, a [block_q]-vector would not tile);
+- block sizes default to 128 to match the MXU's 128×128 systolic array; the
+  head dim should be a multiple of 128 for full MXU rate (Mosaic pads
+  smaller dims at reduced efficiency);
+- sequence lengths that don't divide the block are zero-padded in the
+  wrapper and masked to -inf inside the kernel via a 2D
+  ``broadcasted_iota`` (1D iota does not lower on TPU).
+
+Backward: ``jax.custom_vjp`` with a recompute-from-residuals backward
+through the reference formulation — flash recomputation traded for XLA
+autodiff simplicity (the standard rematerialization trade; a hand-tiled
+backward kernel is the remaining headroom).
+
+Off-TPU (tests, CPU dev) the kernel runs in interpret mode, so numerics are
+validated everywhere while the Mosaic lowering is exercised on real TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128  # TPU lane width: scratch min-tile last dim
+
+
+def _attention_reference(q, k, v):
+    """Unfused oracle over ``[B, T, H, D]`` (same numerics contract as the
+    kernel); used by the recompute backward."""
+    scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
+                  acc_scratch, *, sm_scale, block_k, kv_len):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(2)
+    last_kb = pl.num_programs(2) - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    # Mask padded key rows (wrapper zero-pads KV up to the block multiple).
+    col_ids = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1)
+    s = jnp.where(col_ids < kv_len, s, -jnp.inf)
+
+    m_prev = m_scratch[...][:, :1]            # [block_q, 1]
+    l_prev = l_scratch[...][:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                    # [block_q, block_k]
+    l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
+
+    acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scratch[...] = jnp.broadcast_to(m_new, m_scratch.shape)
+    l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(kb == last_kb)
+    def _emit():
+        l = l_scratch[...][:, :1]
+        o_ref[0] = (acc_scratch[...] / jnp.maximum(l, 1e-30)) \
+            .astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_dtype = q.dtype
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+
+    # [B, T, H, D] → [B·H, T, D] (attention is independent per batch·head).
+    def to_bh(x, t):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
+
+    qf, kf, vf = to_bh(q, t_q), to_bh(k, t_kv), to_bh(v, t_kv)
+
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_kv) % block_k
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    tq_p, tk_p = t_q + pad_q, t_kv + pad_k
+
+    grid = (b * h, tq_p // block_q, tk_p // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        sm_scale=1.0 / float(d) ** 0.5,
+        block_k=block_k,
+        kv_len=t_kv,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), orig_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :t_q, :]
+    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+def _should_interpret():
+    """Mosaic lowering on real TPU; interpreter elsewhere (CPU tests)."""
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None):
+    """Tiled attention over ``[B, T, H, D]`` tensors; matches
+    ``attention_reference`` numerics (f32 softmax) without materializing the
+    ``[T, T]`` score matrix.
+
+    :param block_q / block_k: VMEM tile sizes; keep at 128 (MXU-shaped)
+        unless T is small.
+    :param interpret: force the pallas interpreter (None = auto: interpret
+        off-TPU, Mosaic on TPU).
+    """
+    if interpret is None:
+        interpret = _should_interpret()
+    return _flash_forward(q, k, v, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _should_interpret()
+    return _flash_forward(q, k, v, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(block_q, block_k, interpret, residuals, g):
+    # Recompute-from-residuals backward via the reference formulation: the
+    # O(T²) score matrix exists only inside XLA's fused backward, and only
+    # for the backward pass (standard flash rematerialization trade).
+    q, k, v = residuals
+    _, vjp = jax.vjp(_attention_reference, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

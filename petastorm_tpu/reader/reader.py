@@ -425,6 +425,9 @@ class Reader:
 
         self._shard_seed = shard_seed
         self._shuffle_row_drop_partitions = shuffle_row_drop_partitions
+        # filters/selector change which pieces the positional item keys
+        # denote — they must be part of the resume fingerprint.
+        self._planning_repr = repr((filters, rowgroup_selector))
         self._resume_state = resume_state
         self._num_items = len(items)  # full item universe (pre-resume trim)
         iterations = num_epochs
@@ -559,6 +562,7 @@ class Reader:
             "num_epochs": self.num_epochs,
             "shard": [self.cur_shard, self.shard_count, self._shard_seed],
             "drop_partitions": self._shuffle_row_drop_partitions,
+            "planning": self._planning_repr,
             "delivered": delivered,
         }
 
@@ -581,6 +585,7 @@ class Reader:
             "num_epochs": self.num_epochs,
             "shard": [self.cur_shard, self.shard_count, self._shard_seed],
             "drop_partitions": self._shuffle_row_drop_partitions,
+            "planning": self._planning_repr,
         }
         for key, want in expected.items():
             got = state.get(key)

@@ -44,6 +44,12 @@ class SingleIndexSelector(RowGroupSelectorBase):
             row_groups |= indexer.get_row_group_indexes(value)
         return row_groups
 
+    def __repr__(self):
+        # Stable (no object address): selectors are part of the resume-state
+        # fingerprint (Reader._planning_repr).
+        return (f"SingleIndexSelector({self._index_name!r}, "
+                f"{self._values!r})")
+
 
 class IntersectIndexSelector(RowGroupSelectorBase):
     """Row groups selected by ALL of the given single-index selectors."""
@@ -57,6 +63,9 @@ class IntersectIndexSelector(RowGroupSelectorBase):
     def select_row_groups(self, index_dict):
         sets = [s.select_row_groups(index_dict) for s in self._selectors]
         return set.intersection(*sets) if sets else set()
+
+    def __repr__(self):
+        return f"IntersectIndexSelector({self._selectors!r})"
 
 
 class UnionIndexSelector(RowGroupSelectorBase):
@@ -73,3 +82,6 @@ class UnionIndexSelector(RowGroupSelectorBase):
         for selector in self._selectors:
             result |= selector.select_row_groups(index_dict)
         return result
+
+    def __repr__(self):
+        return f"UnionIndexSelector({self._selectors!r})"

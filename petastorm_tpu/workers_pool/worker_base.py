@@ -8,7 +8,13 @@ from __future__ import annotations
 
 class WorkerBase:
     """A pool worker. Subclasses implement :meth:`process`; results are
-    emitted via ``publish_func`` (possibly several per ventilated item)."""
+    emitted via ``publish_func`` (possibly several per ventilated item).
+
+    Reader workers that tag payloads for resumable iteration
+    (``reader_impl/delivery_tracker.py``) must publish AT MOST ONE tagged
+    payload per ventilated item: the tracker counts one delivery per tag, so
+    chunked publishes would over-count and make resume skip epochs. Untagged
+    payloads (plain pool users) are unconstrained."""
 
     def __init__(self, worker_id, publish_func, args):
         self.worker_id = worker_id

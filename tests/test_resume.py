@@ -290,6 +290,20 @@ def test_reset_resets_delivery_accounting(petastorm_dataset):
         assert sum(1 for _ in reader) > 0
 
 
+def test_resume_rejects_different_filters(scalar_dataset):
+    from petastorm_tpu import make_batch_reader
+
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           reader_pool_type="dummy",
+                           filters=[("id", "<", 20)]) as reader:
+        next(iter(reader))
+        state = reader.state_dict()
+    with pytest.raises(ValueError, match="planning"):
+        make_batch_reader(scalar_dataset.url, num_epochs=1,
+                          reader_pool_type="dummy",
+                          filters=[("id", ">=", 10)], resume_state=state)
+
+
 def test_resume_rejects_different_dataset(petastorm_dataset, tmp_path):
     from petastorm_tpu.test_util.dataset_factory import create_test_dataset
 
