@@ -17,7 +17,8 @@ def main(argv=None):
         return _scenario_main(argv[1:])
     parser = argparse.ArgumentParser(
         description="Measure Reader throughput (rows/sec) on a dataset; or "
-                    "run a named workload: `scenario {tabular,ngram}`")
+                    "run a named workload: "
+                    "`scenario {tabular,ngram,image,weighted}`")
     parser.add_argument("dataset_url")
     parser.add_argument("--field-regex", nargs="*", default=None,
                         help="read only fields matching these regexes")
@@ -58,11 +59,13 @@ def _scenario_main(argv):
     parser = argparse.ArgumentParser(
         prog="petastorm-tpu-throughput scenario",
         description="Run a named benchmark scenario on synthetic data "
-                    "(BASELINE.md configs #3/#4)")
+                    "(BASELINE.md configs #2-#5)")
     parser.add_argument("name", choices=sorted(SCENARIOS))
     parser.add_argument("--dataset-url", default=None,
                         help="reuse an existing dataset instead of "
-                             "synthesizing one")
+                             "synthesizing one (weighted: a base url "
+                             "holding corpus_<i> datasets with a 'corpus' "
+                             "column)")
     parser.add_argument("--workers", type=int, default=3)
     args = parser.parse_args(argv)
 

@@ -1,17 +1,19 @@
 """Named benchmark scenarios from BASELINE.md's config list.
 
-Two of the reference's headline workload shapes, runnable on synthetic data
-via ``python -m petastorm_tpu.benchmark scenario <name>``:
+The reference's headline workload shapes, runnable on synthetic data via
+``python -m petastorm_tpu.benchmark scenario <name>``:
 
-- ``tabular`` — BASELINE.md config #3 (Criteo-DLRM-like): a wide Arrow
-  schema (dense floats + integer categoricals) read through
-  ``make_batch_reader``, measuring the row-group predicate-pushdown win:
-  ``filters`` prune row groups from Parquet statistics before any byte of
-  data is read, so a selective scan should approach
-  (selected fraction)⁻¹ × full-scan throughput per *matching* row.
-- ``ngram`` — BASELINE.md config #4 (multi-frame video/lidar): timestamped
+- ``tabular`` — config #3 (Criteo-DLRM-like): a wide Arrow schema (dense
+  floats + integer categoricals) read through ``make_batch_reader``,
+  measuring the row-group predicate-pushdown win: ``filters`` prune row
+  groups from Parquet statistics before any byte of data is read.
+- ``ngram`` — config #4 (multi-frame video/lidar): timestamped
   ``NdarrayCodec`` frames windowed by :class:`~petastorm_tpu.ngram.NGram`
   with a ``delta_threshold``, measuring windows/sec through ``make_reader``.
+- ``image`` — config #2 (ImageNet-shaped ``CompressedImageCodec``): row vs
+  columnar decode images/sec plus the loader's input-stall %.
+- ``weighted`` — config #5 (multi-corpus shuffle): throughput and empirical
+  mix ratio through ``WeightedSamplingReader``.
 
 Each scenario materializes its own synthetic dataset (unless given a url),
 runs the measurement, and returns a flat dict of numbers (the CLI prints it
@@ -176,7 +178,161 @@ def ngram_window_scenario(dataset_url=None, frames=DEFAULT_NGRAM_FRAMES,
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Scenario: image classification input pipeline (config #2)
+# ---------------------------------------------------------------------------
+
+def make_image_dataset(dataset_url, rows=1024, image_shape=(64, 64, 3),
+                       num_classes=10):
+    """Materialize an ImageNet-shaped dataset (CompressedImageCodec)."""
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import (CompressedImageCodec,
+                                             ScalarCodec)
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("ImageSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("image", np.uint8, image_shape,
+                       CompressedImageCodec("jpeg"), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(3)
+
+    def rows_gen():
+        for i in range(rows):
+            yield {"id": i,
+                   "image": rng.randint(0, 255, image_shape, dtype=np.uint8),
+                   "label": np.int32(i % num_classes)}
+
+    materialize_rows(dataset_url, schema, rows_gen(),
+                     rows_per_row_group=128)
+
+
+def image_pipeline_scenario(dataset_url=None, rows=1024, workers=3,
+                            batch_size=128):
+    """Row vs columnar decode throughput + loader stall on an image schema.
+
+    The config-#2 shape (ImageNet + CompressedImageCodec): the number that
+    matters is images/sec through the full delivery path and the columnar
+    path's decode advantage over the reference's per-row architecture.
+    """
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+    from petastorm_tpu.reader.reader import make_columnar_reader, make_reader
+
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_image_")
+        dataset_url = f"file://{tmpdir}/ds"
+        make_image_dataset(dataset_url, rows=rows)
+
+    def decode_leg(factory):
+        reader = factory(dataset_url, num_epochs=1, shuffle_row_groups=False,
+                         reader_pool_type="thread", workers_count=workers,
+                         schema_fields=["image", "label"])
+        n, t0 = 0, time.perf_counter()
+        with reader:
+            for batch in batch_iterator(reader, batch_size,
+                                        last_batch="drop"):
+                n += batch_size
+        return n, n / (time.perf_counter() - t0)
+
+    try:
+        measured_rows, row_ips = decode_leg(make_reader)
+        _, col_ips = decode_leg(make_columnar_reader)
+        reader = make_columnar_reader(dataset_url, num_epochs=1,
+                                      shuffle_row_groups=False,
+                                      reader_pool_type="thread",
+                                      workers_count=workers,
+                                      schema_fields=["image", "label"])
+        with make_jax_dataloader(reader, batch_size,
+                                 stage_to_device=False) as loader:
+            n = sum(1 for _ in loader)
+            stall = loader.diagnostics["input_stall_pct"]
+        return {
+            "scenario": "image_pipeline",
+            "rows": measured_rows,  # full batches measured (drop policy)
+            "row_decode_images_per_sec": round(row_ips, 1),
+            "columnar_decode_images_per_sec": round(col_ips, 1),
+            "columnar_vs_row": round(col_ips / row_ips, 2),
+            "loader_batches": n,
+            "loader_input_stall_pct": stall,
+        }
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Scenario: weighted multi-corpus mixing (config #5)
+# ---------------------------------------------------------------------------
+
+def weighted_mixing_scenario(dataset_url=None, rows=8_192, workers=2,
+                             weights=(0.8, 0.2)):
+    """Throughput + empirical mix ratio through WeightedSamplingReader.
+
+    The config-#5 shape: several corpora mixed by sampling probability, each
+    corpus row-group-sharded per host (here: two synthetic corpora tagged by
+    a ``corpus`` column; the reported ratio should track ``weights``).
+    ``dataset_url``: optional base url; corpora are written under it.
+    """
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    schema = Unischema("MixSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("corpus", np.int32, (), ScalarCodec(), False),
+        UnischemaField("value", np.float32, (32,), None, False),
+    ])
+
+    urls = [f"{dataset_url.rstrip('/')}/corpus_{c}"
+            for c in range(len(weights))] if dataset_url else None
+    tmpdir = None
+    if dataset_url is None:
+        # Synthesize only when no url is given (a provided url must already
+        # hold corpus_<i> datasets — never overwritten).
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_mix_")
+        rng = np.random.RandomState(13)
+        urls = []
+        per_corpus = rows // len(weights)
+        for corpus in range(len(weights)):
+            url = f"file://{tmpdir}/corpus_{corpus}"
+            rows_gen = ({"id": i, "corpus": np.int32(corpus),
+                         "value": rng.rand(32).astype(np.float32)}
+                        for i in range(per_corpus))
+            materialize_rows(url, schema, rows_gen, rows_per_row_group=256)
+            urls.append(url)
+
+    try:
+        readers = [make_reader(u, num_epochs=None, reader_pool_type="thread",
+                               workers_count=workers) for u in urls]
+        draws = min(rows, 4_096)
+        counts = np.zeros(len(weights), np.int64)
+        with WeightedSamplingReader(readers, list(weights),
+                                    random_seed=17) as mixed:
+            t0 = time.perf_counter()
+            for _ in range(draws):
+                counts[int(next(mixed).corpus)] += 1
+            wall = time.perf_counter() - t0
+        ratio = (counts / counts.sum()).round(3).tolist()
+        return {
+            "scenario": "weighted_mixing",
+            "rows_drawn": int(counts.sum()),
+            "rows_per_sec": round(counts.sum() / wall, 1),
+            "target_weights": list(weights),
+            "empirical_mix": ratio,
+        }
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "tabular": tabular_predicate_scenario,
     "ngram": ngram_window_scenario,
+    "image": image_pipeline_scenario,
+    "weighted": weighted_mixing_scenario,
 }

@@ -57,4 +57,4 @@ def test_documented_apis_exist():
     )
     from petastorm_tpu.benchmark.scenarios import SCENARIOS
 
-    assert set(SCENARIOS) == {"tabular", "ngram"}
+    assert set(SCENARIOS) == {"tabular", "ngram", "image", "weighted"}

@@ -4,8 +4,10 @@ import json
 
 from petastorm_tpu.benchmark.cli import main
 from petastorm_tpu.benchmark.scenarios import (
+    image_pipeline_scenario,
     ngram_window_scenario,
     tabular_predicate_scenario,
+    weighted_mixing_scenario,
 )
 
 
@@ -26,6 +28,24 @@ def test_ngram_scenario_counts_windows():
     # 200 here, so none are broken).
     assert result["windows"] == 198
     assert result["windows_per_sec"] > 0
+
+
+def test_image_scenario_reports_both_decode_paths():
+    result = image_pipeline_scenario(rows=256, workers=2, batch_size=64)
+    assert result["row_decode_images_per_sec"] > 0
+    assert result["columnar_decode_images_per_sec"] > 0
+    assert result["loader_batches"] == 256 // 64
+    assert 0 <= result["loader_input_stall_pct"] <= 100
+
+
+def test_weighted_scenario_tracks_target_mix():
+    result = weighted_mixing_scenario(rows=2048, workers=1,
+                                      weights=(0.75, 0.25))
+    assert result["rows_drawn"] > 0
+    assert result["rows_per_sec"] > 0
+    empirical = result["empirical_mix"]
+    assert abs(empirical[0] - 0.75) < 0.05
+    assert abs(empirical[1] - 0.25) < 0.05
 
 
 def test_scenario_cli_prints_json(capsys, monkeypatch):
