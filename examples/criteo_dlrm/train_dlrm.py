@@ -1,0 +1,107 @@
+"""Criteo-shaped DLRM training with checkpoint/resume of the input pipeline.
+
+BASELINE.md config #3 end-to-end: a wide tabular Parquet store streams
+through ``make_batch_reader`` → ``make_jax_dataloader`` into a DLRM train
+step, and the input pipeline checkpoints alongside the model
+(``loader.state_dict()`` / ``resume_state=``) so a preempted job resumes
+without replaying or skipping data.
+
+Run: ``python -m examples.criteo_dlrm.train_dlrm`` (synthesizes a small
+dataset under a temp dir).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+NUM_DENSE, NUM_SPARSE = 13, 26
+
+
+def generate_criteo_dataset(dataset_url, rows=4096, days=8):
+    """Write the synthetic Criteo-shaped dataset (plain Parquet, clustered
+    by day so ``filters`` can prune row groups)."""
+    from petastorm_tpu.benchmark.scenarios import make_tabular_dataset
+
+    return make_tabular_dataset(dataset_url, rows=rows,
+                                dense_cols=NUM_DENSE,
+                                sparse_cols=NUM_SPARSE, days=days)
+
+
+def _collate(batch):
+    import jax.numpy as jnp
+
+    dense = jnp.stack([batch[f"dense_{i}"] for i in range(NUM_DENSE)], axis=1)
+    sparse = jnp.stack([batch[f"cat_{i}"] for i in range(NUM_SPARSE)], axis=1)
+    return dense, sparse, batch["label"]
+
+
+def train_dlrm(dataset_url, batch_size=256, epochs=2, interrupt_after=None,
+               resume_state=None, params=None):
+    """Train; optionally stop after ``interrupt_after`` steps and return the
+    input-pipeline checkpoint alongside the params.
+
+    Returns ``(params, input_state_or_None, steps_run, last_loss)``.
+    """
+    import jax
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.models.tabular_dlrm import (init_dlrm_params,
+                                                   make_dlrm_train_step)
+
+    if params is None:
+        params = init_dlrm_params(jax.random.PRNGKey(0), NUM_DENSE,
+                                  NUM_SPARSE)
+    step = jax.jit(make_dlrm_train_step(0.05))
+
+    reader = make_batch_reader(dataset_url, num_epochs=epochs,
+                               shuffle_row_groups=True, shard_seed=7,
+                               resume_state=resume_state)
+    steps, loss = 0, float("nan")
+    with make_jax_dataloader(reader, batch_size, last_batch="drop",
+                             stage_to_device=False) as loader:
+        for batch in loader:
+            dense, sparse, labels = _collate(batch)
+            mask = np.ones(dense.shape[0], bool)
+            params, loss = step(params, dense, sparse, labels, mask)
+            steps += 1
+            if interrupt_after and steps >= interrupt_after:
+                # Preemption point: snapshot the INPUT pipeline (the model
+                # params would be checkpointed next to it, e.g. via orbax).
+                state = loader.state_dict()
+                return params, state, steps, float(loss)
+    return params, None, steps, float(loss)
+
+
+def main(dataset_url=None, rows=4096):
+    import shutil
+    import tempfile
+
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="criteo_dlrm_")
+        dataset_url = f"file://{tmpdir}/criteo"
+        generate_criteo_dataset(dataset_url, rows=rows)
+
+    try:
+        # Simulated preemption mid-run...
+        params, state, steps, loss = train_dlrm(dataset_url,
+                                                interrupt_after=4)
+        print(f"interrupted after {steps} steps, loss={loss:.4f}")
+        print("input checkpoint:", json.dumps(state)[:120], "...")
+        # ...and resume: the input stream continues where it left off
+        # (at-least-once at row-group granularity — no data skipped).
+        params, _, more_steps, loss = train_dlrm(dataset_url,
+                                                 resume_state=state,
+                                                 params=params)
+        print(f"resumed for {more_steps} steps, final loss={loss:.4f}")
+        return steps + more_steps
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

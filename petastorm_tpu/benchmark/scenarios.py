@@ -239,6 +239,10 @@ def image_pipeline_scenario(dataset_url=None, rows=1024, workers=3,
 
     try:
         measured_rows, row_ips = decode_leg(make_reader)
+        if measured_rows == 0:
+            raise ValueError(
+                f"Dataset at {dataset_url} yields no full batch of "
+                f"{batch_size} rows — pass a smaller batch size")
         _, col_ips = decode_leg(make_columnar_reader)
         reader = make_columnar_reader(dataset_url, num_epochs=1,
                                       shuffle_row_groups=False,

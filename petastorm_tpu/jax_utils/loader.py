@@ -22,7 +22,9 @@ default), drops them ("drop"), or rejects them ("error").
 
 from __future__ import annotations
 
+import contextlib
 import queue
+import sys
 import threading
 import time
 
@@ -31,6 +33,20 @@ import numpy as np
 from petastorm_tpu.jax_utils.batcher import PAD_MASK_KEY, batch_iterator
 
 _SENTINEL = object()
+
+
+def _trace_span(name):
+    """``jax.profiler.TraceAnnotation`` when jax is already loaded, else a
+    no-op — the loader's pipeline stages show up in profiler traces
+    (SURVEY.md §5 tracing note) without forcing a jax import on the
+    numpy-only path (``stage_to_device=False``)."""
+    jax = sys.modules.get("jax")
+    # getattr guard: another thread may be mid-way through `import jax`, in
+    # which case sys.modules already holds a partially-initialized module.
+    profiler = getattr(jax, "profiler", None) if jax is not None else None
+    if profiler is None:
+        return contextlib.nullcontext()
+    return profiler.TraceAnnotation(name)
 
 
 def make_jax_dataloader(reader, batch_size,
@@ -153,7 +169,8 @@ class JaxDataLoader:
                 shuffle_seed=self._shuffle_seed))
             while True:
                 t0 = time.perf_counter()
-                batch = next(batches, _SENTINEL)
+                with _trace_span("petastorm_tpu.loader.decode"):
+                    batch = next(batches, _SENTINEL)
                 self.diagnostics["producer_decode_s"] += time.perf_counter() - t0
                 if batch is _SENTINEL:
                     break
@@ -225,7 +242,8 @@ class JaxDataLoader:
                 # Keep device_prefetch batches in flight.
                 while not done and len(inflight) < self._device_prefetch:
                     t0 = time.perf_counter()
-                    host_batch = self._queue.get()
+                    with _trace_span("petastorm_tpu.loader.wait"):
+                        host_batch = self._queue.get()
                     self.diagnostics["stall_s"] += time.perf_counter() - t0
                     if host_batch is _SENTINEL:
                         done = True
@@ -233,7 +251,8 @@ class JaxDataLoader:
                             raise self._producer_error
                         break
                     t0 = time.perf_counter()
-                    inflight.append(self._stage(host_batch))
+                    with _trace_span("petastorm_tpu.loader.device_put"):
+                        inflight.append(self._stage(host_batch))
                     self.diagnostics["device_dispatch_s"] += \
                         time.perf_counter() - t0
                 if not inflight:

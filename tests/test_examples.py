@@ -57,6 +57,18 @@ def test_mnist_jax_training_converges_shape(tmp_path, capsys):
     assert params["dense2"]["kernel"].shape[-1] == 10
 
 
+def test_criteo_dlrm_trains_and_resumes(tmp_path, capsys):
+    from examples.criteo_dlrm.train_dlrm import main
+
+    total_steps = main(rows=1024)
+    out = capsys.readouterr().out
+    assert "interrupted after 4 steps" in out
+    assert "resumed for" in out
+    # 1024 rows x 2 epochs / 256 batch = 8 total steps; the mid-row-group
+    # interrupt may re-read one row group (at-least-once), so allow 8 or 9.
+    assert total_steps in (8, 9)
+
+
 def test_imagenet_schema_materializes(tmp_path):
     from examples.imagenet.generate_petastorm_imagenet import (
         generate_petastorm_imagenet,
