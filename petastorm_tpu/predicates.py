@@ -34,6 +34,14 @@ class PredicateBase(ABC):
         """``values`` maps each field from :meth:`get_fields` to the row's
         value; return True to keep the row."""
 
+    def do_include_vectorized(self, columns, num_rows):
+        """Optional columnar evaluation: ``columns`` maps each field to a
+        whole numpy column; return a bool mask of ``num_rows``, or ``None``
+        to signal "evaluate row by row" (the default). Batch/columnar
+        workers try this first — on wide tabular scans the per-row Python
+        loop is the predicate cost, not the comparison itself."""
+        return None
+
 
 def _func_fingerprint(func):
     """Stable fingerprint of a callable: qualname + bytecode + consts +
@@ -128,6 +136,20 @@ class in_set(PredicateBase):
     def do_include(self, values):
         return values[self._predicate_field] in self._inclusion_values
 
+    def do_include_vectorized(self, columns, num_rows):
+        import numpy as np
+
+        column = np.asarray(columns[self._predicate_field])
+        if column.dtype == object:
+            # Object cells may be unhashable (lists): np.isin would silently
+            # compare elementwise to all-False where the row path raises a
+            # loud TypeError — decline and keep the row-path semantics.
+            return None
+        try:
+            return np.isin(column, list(self._inclusion_values))
+        except (TypeError, ValueError):  # exotic value types: row path
+            return None
+
     def __repr__(self):
         return (f"in_set({sorted(map(repr, self._inclusion_values))}, "
                 f"{self._predicate_field!r})")
@@ -169,6 +191,10 @@ class in_negate(PredicateBase):
     def do_include(self, values):
         return not self._predicate.do_include(values)
 
+    def do_include_vectorized(self, columns, num_rows):
+        mask = self._predicate.do_include_vectorized(columns, num_rows)
+        return None if mask is None else ~mask
+
     def __repr__(self):
         return f"in_negate({self._predicate!r})"
 
@@ -193,6 +219,25 @@ class in_reduce(PredicateBase):
         return self._reduce_func(
             [p.do_include(values) for p in self._predicate_list]
         )
+
+    def do_include_vectorized(self, columns, num_rows):
+        # Vectorizable only for the all/any builtins (arbitrary reductions
+        # see a list of booleans, not arrays).
+        import builtins
+
+        import numpy as np
+
+        if self._reduce_func is builtins.all:
+            combine = np.logical_and.reduce
+        elif self._reduce_func is builtins.any:
+            combine = np.logical_or.reduce
+        else:
+            return None
+        masks = [p.do_include_vectorized(columns, num_rows)
+                 for p in self._predicate_list]
+        if not masks or any(m is None for m in masks):
+            return None
+        return combine(masks)
 
     def __repr__(self):
         return (f"in_reduce({self._predicate_list!r}, "

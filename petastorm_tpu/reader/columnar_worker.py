@@ -97,7 +97,8 @@ class ColumnarDecodeWorker(WorkerBase):
         return batch
 
     def _predicate_mask(self, table, worker_predicate, predicate_fields):
-        """Decode only the predicate fields, evaluate row-wise → bool mask.
+        """Decode only the predicate fields → bool mask (vectorized when the
+        predicate supports it, row-wise otherwise).
 
         Predicate fields are decoded (they may be codec columns) but the
         payload columns are not touched until the mask is known — the
@@ -112,13 +113,9 @@ class ColumnarDecodeWorker(WorkerBase):
                 decoded[name] = field.codec.decode_column(field, cells)
             else:
                 decoded[name] = cells
-        n = table.num_rows
-        mask = np.empty(n, dtype=bool)
-        names = list(decoded)
-        for i in range(n):
-            mask[i] = bool(worker_predicate.do_include(
-                {name: decoded[name][i] for name in names}))
-        return mask
+        from petastorm_tpu.reader.arrow_worker import _vectorized_mask
+
+        return _vectorized_mask(worker_predicate, decoded, table.num_rows)
 
     def _drop_partition(self, table, shuffle_row_drop_partition):
         this_partition, num_partitions = shuffle_row_drop_partition

@@ -50,6 +50,49 @@ def test_predicate_combinators():
     assert neg.do_include({"x": 3}) and not neg.do_include({"x": 4})
 
 
+def test_vectorized_predicate_masks_match_row_path():
+    import numpy as np
+
+    column = np.array([1, 4, 7, 9, 12, 15])
+    columns = {"x": column}
+    small = in_set([1, 7, 12], "x")
+    neg = in_negate(small)
+    even = in_lambda(["x"], lambda v: v["x"] % 2 == 0)
+    both = in_reduce([small, in_set(range(10), "x")], all)
+    either = in_reduce([small, in_set([15], "x")], any)
+
+    for predicate in (small, neg, both, either):
+        mask = predicate.do_include_vectorized(columns, len(column))
+        assert mask is not None
+        expected = [predicate.do_include({"x": v}) for v in column]
+        np.testing.assert_array_equal(mask, expected)
+    # Row-only predicates decline (and combinators containing them too).
+    assert even.do_include_vectorized(columns, len(column)) is None
+    assert in_reduce([small, even], all) \
+        .do_include_vectorized(columns, len(column)) is None
+    # Non-builtin reductions decline.
+    assert in_reduce([small], lambda bools: bools[0]) \
+        .do_include_vectorized(columns, len(column)) is None
+
+
+def test_batch_reader_uses_vectorized_in_set(scalar_dataset, monkeypatch):
+    from petastorm_tpu import make_batch_reader
+
+    row_calls = []
+    monkeypatch.setattr(
+        in_set, "do_include",
+        lambda self, values: row_calls.append(1) or True)
+    wanted = {0, 5, 10, 15, 20, 25}
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           reader_pool_type="dummy",
+                           predicate=in_set(wanted, "id")) as reader:
+        ids = {int(i) for batch in reader for i in batch.id}
+    assert ids == wanted
+    # The vectorized mask handled everything: the row path never ran (if it
+    # had, the patched do_include would also have kept every row).
+    assert not row_calls
+
+
 def test_pseudorandom_split_fractions():
     split = [0.6, 0.2, 0.2]
     counts = [0, 0, 0]
