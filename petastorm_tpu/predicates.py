@@ -145,6 +145,13 @@ class in_set(PredicateBase):
             # compare elementwise to all-False where the row path raises a
             # loud TypeError — decline and keep the row-path semantics.
             return None
+        if column.dtype.kind == "f" and any(
+                isinstance(v, int) and abs(v) > 2 ** 53
+                for v in self._inclusion_values):
+            # np.isin would cast such ints to float64 and lose precision
+            # (9007199254740993 -> ...992.0, matching rows the exact Python
+            # comparison of the row path rejects) — decline.
+            return None
         try:
             return np.isin(column, list(self._inclusion_values))
         except (TypeError, ValueError):  # exotic value types: row path
@@ -192,8 +199,12 @@ class in_negate(PredicateBase):
         return not self._predicate.do_include(values)
 
     def do_include_vectorized(self, columns, num_rows):
+        import numpy as np
+
         mask = self._predicate.do_include_vectorized(columns, num_rows)
-        return None if mask is None else ~mask
+        # asarray: the contract allows any bool-mask sequence (a list would
+        # crash unary ~).
+        return None if mask is None else ~np.asarray(mask, dtype=bool)
 
     def __repr__(self):
         return f"in_negate({self._predicate!r})"
@@ -233,10 +244,14 @@ class in_reduce(PredicateBase):
             combine = np.logical_or.reduce
         else:
             return None
-        masks = [p.do_include_vectorized(columns, num_rows)
-                 for p in self._predicate_list]
-        if not masks or any(m is None for m in masks):
+        if not self._predicate_list:
             return None
+        masks = []
+        for predicate in self._predicate_list:
+            mask = predicate.do_include_vectorized(columns, num_rows)
+            if mask is None:  # short-circuit: don't waste the others' work
+                return None
+            masks.append(mask)
         return combine(masks)
 
     def __repr__(self):
@@ -276,9 +291,43 @@ class in_pseudorandom_split(PredicateBase):
         high = low + self._fraction_list[self._subset_index]
         return low <= position < high
 
+    def do_include_vectorized(self, columns, num_rows):
+        # md5 itself cannot be numpy-vectorized, but hashing the bare column
+        # values skips the per-row dict assembly + dispatch of the row path
+        # (the actual cost on wide tabular scans).
+        import numpy as np
+
+        column = columns[self._predicate_field]
+        low = sum(self._fraction_list[: self._subset_index])
+        high = low + self._fraction_list[self._subset_index]
+        mask = np.empty(num_rows, dtype=bool)
+        for i in range(num_rows):
+            position = _hash_to_unit_interval(column[i])
+            mask[i] = low <= position < high
+        return mask
+
     def __repr__(self):
         return (f"in_pseudorandom_split({self._fraction_list!r}, "
                 f"{self._subset_index!r}, {self._predicate_field!r})")
+
+
+def evaluate_predicate_mask(predicate, columns, num_rows):
+    """Boolean keep-mask for ``num_rows`` rows of ``columns`` (name→array).
+
+    Tries the predicate's columnar fast path (``do_include_vectorized``)
+    first; falls back to the per-row ``do_include`` loop. Shared by the
+    batch and columnar workers."""
+    import numpy as np
+
+    vectorized = predicate.do_include_vectorized(columns, num_rows)
+    if vectorized is not None:
+        return np.asarray(vectorized, dtype=bool)
+    mask = np.empty(num_rows, dtype=bool)
+    names = list(columns)
+    for i in range(num_rows):
+        mask[i] = bool(predicate.do_include(
+            {name: columns[name][i] for name in names}))
+    return mask
 
 
 def _hash_to_unit_interval(value):

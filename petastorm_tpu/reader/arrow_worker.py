@@ -94,24 +94,13 @@ class ArrowReaderWorker(WorkerBase):
 
 
 def _vectorized_mask(predicate, column_values, num_rows):
-    """Evaluate a row predicate over pandas columns → bool mask.
+    """Evaluate a row predicate over pandas columns → bool mask (shared
+    engine: ``predicates.evaluate_predicate_mask``)."""
+    from petastorm_tpu.predicates import evaluate_predicate_mask
 
-    Columnar fast path first (``do_include_vectorized`` — one numpy op for
-    in_set/in_negate/all-any in_reduce trees), falling back to the row-wise
-    loop for predicates that only define ``do_include``."""
-    names = list(column_values)
-    columns = {n: (column_values[n].to_numpy()
-                   if hasattr(column_values[n], "to_numpy")
-                   else np.asarray(column_values[n])) for n in names}
-    vectorized = predicate.do_include_vectorized(columns, num_rows)
-    if vectorized is not None:
-        return np.asarray(vectorized, dtype=bool)
-    mask = np.empty(num_rows, dtype=bool)
-    for i in range(num_rows):
-        mask[i] = bool(predicate.do_include(
-            {name: columns[name][i] for name in names}
-        ))
-    return mask
+    columns = {n: (c.to_numpy() if hasattr(c, "to_numpy") else np.asarray(c))
+               for n, c in column_values.items()}
+    return evaluate_predicate_mask(predicate, columns, num_rows)
 
 
 class ArrowResultsQueueReader:

@@ -113,9 +113,10 @@ class ColumnarDecodeWorker(WorkerBase):
                 decoded[name] = field.codec.decode_column(field, cells)
             else:
                 decoded[name] = cells
-        from petastorm_tpu.reader.arrow_worker import _vectorized_mask
+        from petastorm_tpu.predicates import evaluate_predicate_mask
 
-        return _vectorized_mask(worker_predicate, decoded, table.num_rows)
+        return evaluate_predicate_mask(worker_predicate, decoded,
+                                       table.num_rows)
 
     def _drop_partition(self, table, shuffle_row_drop_partition):
         this_partition, num_partitions = shuffle_row_drop_partition

@@ -66,6 +66,11 @@ def test_vectorized_predicate_masks_match_row_path():
         assert mask is not None
         expected = [predicate.do_include({"x": v}) for v in column]
         np.testing.assert_array_equal(mask, expected)
+    # in_pseudorandom_split vectorizes too (column-loop hashing).
+    split = in_pseudorandom_split([0.5, 0.5], 0, "x")
+    mask = split.do_include_vectorized(columns, len(column))
+    np.testing.assert_array_equal(
+        mask, [split.do_include({"x": v}) for v in column])
     # Row-only predicates decline (and combinators containing them too).
     assert even.do_include_vectorized(columns, len(column)) is None
     assert in_reduce([small, even], all) \
@@ -73,6 +78,19 @@ def test_vectorized_predicate_masks_match_row_path():
     # Non-builtin reductions decline.
     assert in_reduce([small], lambda bools: bools[0]) \
         .do_include_vectorized(columns, len(column)) is None
+    # Float column + >2**53 int inclusion value: np.isin would lose
+    # precision, so vectorization declines (row path stays exact).
+    float_cols = {"x": column.astype(np.float64)}
+    assert in_set([2 ** 53 + 1], "x") \
+        .do_include_vectorized(float_cols, len(column)) is None
+    # in_negate tolerates list-returning user predicates.
+    class ListMask(in_set):
+        def do_include_vectorized(self, columns, n):
+            return [True] * n
+    neg_list = in_negate(ListMask([1], "x"))
+    np.testing.assert_array_equal(
+        neg_list.do_include_vectorized(columns, len(column)),
+        [False] * len(column))
 
 
 def test_batch_reader_uses_vectorized_in_set(scalar_dataset, monkeypatch):
