@@ -145,15 +145,31 @@ class in_set(PredicateBase):
             # compare elementwise to all-False where the row path raises a
             # loud TypeError — decline and keep the row-path semantics.
             return None
-        if column.dtype.kind == "f" and any(
-                isinstance(v, int) and abs(v) > 2 ** 53
-                for v in self._inclusion_values):
-            # np.isin would cast such ints to float64 and lose precision
-            # (9007199254740993 -> ...992.0, matching rows the exact Python
-            # comparison of the row path rejects) — decline.
-            return None
+        values = list(self._inclusion_values)
         try:
-            return np.isin(column, list(self._inclusion_values))
+            values_arr = np.asarray(values)
+        except (TypeError, ValueError):
+            return None
+        if values_arr.dtype == object:
+            return None
+        # np.isin compares in the promoted dtype; when that promotion turns
+        # ints into float64, magnitudes past 2**53 collapse
+        # (9007199254740993 -> ...992.0) and the mask matches rows the exact
+        # Python comparison of the row path rejects. Both directions are
+        # lossy (int column vs float values, float column vs int values) —
+        # decline whenever any int on either side exceeds the exact range.
+        limit = 2 ** 53
+        promoted = np.result_type(column.dtype, values_arr.dtype)
+        if promoted.kind == "f":
+            if any(isinstance(v, (int, np.integer))
+                   and not isinstance(v, bool) and abs(int(v)) > limit
+                   for v in values):
+                return None
+            if (column.dtype.kind in "iu" and column.size
+                    and int(np.abs(column).max()) > limit):
+                return None
+        try:
+            return np.isin(column, values_arr)
         except (TypeError, ValueError):  # exotic value types: row path
             return None
 
@@ -163,14 +179,24 @@ class in_set(PredicateBase):
 
 
 class in_lambda(PredicateBase):
-    """Keep rows for which ``predicate_func(values [, state])`` is truthy."""
+    """Keep rows for which ``predicate_func(values [, state])`` is truthy.
 
-    def __init__(self, predicate_fields, predicate_func, state_arg=None):
+    ``vectorized=True`` (our extension; no reference analogue) declares that
+    ``predicate_func`` operates on whole numpy columns and returns a boolean
+    mask — batch/columnar workers then evaluate it in one call instead of
+    once per row: ``in_lambda(["x"], lambda cols: cols["x"] % 2 == 0,
+    vectorized=True)``. Row readers still call it per row with scalar
+    values; a numpy-ufunc-style function works for both.
+    """
+
+    def __init__(self, predicate_fields, predicate_func, state_arg=None,
+                 vectorized=False):
         if not isinstance(predicate_fields, (list, tuple, set)):
             raise ValueError("predicate_fields must be a list/tuple/set of names")
         self._predicate_fields = set(predicate_fields)
         self._predicate_func = predicate_func
         self._state_arg = state_arg
+        self._vectorized = vectorized
 
     def get_fields(self):
         return set(self._predicate_fields)
@@ -180,10 +206,27 @@ class in_lambda(PredicateBase):
             return self._predicate_func(values, self._state_arg)
         return self._predicate_func(values)
 
+    def do_include_vectorized(self, columns, num_rows):
+        if not self._vectorized:
+            return None
+        import numpy as np
+
+        if self._state_arg is not None:
+            mask = self._predicate_func(columns, self._state_arg)
+        else:
+            mask = self._predicate_func(columns)
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (num_rows,):
+            raise ValueError(
+                f"vectorized predicate_func returned shape {mask.shape}, "
+                f"expected ({num_rows},)")
+        return mask
+
     def __repr__(self):
         return (f"in_lambda({sorted(self._predicate_fields)}, "
                 f"{_func_fingerprint(self._predicate_func)}, "
-                f"{_stable_repr(self._state_arg)})")
+                f"{_stable_repr(self._state_arg)}, "
+                f"vectorized={self._vectorized})")
 
 
 class in_negate(PredicateBase):

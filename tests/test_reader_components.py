@@ -66,6 +66,16 @@ def test_vectorized_predicate_masks_match_row_path():
         assert mask is not None
         expected = [predicate.do_include({"x": v}) for v in column]
         np.testing.assert_array_equal(mask, expected)
+    # in_lambda(vectorized=True): the func sees whole columns.
+    vec_even = in_lambda(["x"], lambda cols: cols["x"] % 2 == 0,
+                         vectorized=True)
+    np.testing.assert_array_equal(
+        vec_even.do_include_vectorized(columns, len(column)),
+        column % 2 == 0)
+    with pytest.raises(ValueError, match="expected"):
+        in_lambda(["x"], lambda cols: np.ones(3, bool),
+                  vectorized=True).do_include_vectorized(
+                      columns, len(column))
     # in_pseudorandom_split vectorizes too (column-loop hashing).
     split = in_pseudorandom_split([0.5, 0.5], 0, "x")
     mask = split.do_include_vectorized(columns, len(column))
@@ -78,11 +88,17 @@ def test_vectorized_predicate_masks_match_row_path():
     # Non-builtin reductions decline.
     assert in_reduce([small], lambda bools: bools[0]) \
         .do_include_vectorized(columns, len(column)) is None
-    # Float column + >2**53 int inclusion value: np.isin would lose
-    # precision, so vectorization declines (row path stays exact).
+    # Int<->float promotion past 2**53 loses exactness; vectorization
+    # declines in every lossy direction (row path stays exact).
     float_cols = {"x": column.astype(np.float64)}
-    assert in_set([2 ** 53 + 1], "x") \
+    big = 2 ** 53 + 1
+    assert in_set([big], "x") \
         .do_include_vectorized(float_cols, len(column)) is None
+    assert in_set([np.int64(big)], "x") \
+        .do_include_vectorized(float_cols, len(column)) is None
+    big_int_cols = {"x": np.array([big, 5], dtype=np.int64)}
+    assert in_set([float(2 ** 53)], "x") \
+        .do_include_vectorized(big_int_cols, 2) is None
     # in_negate tolerates list-returning user predicates.
     class ListMask(in_set):
         def do_include_vectorized(self, columns, n):
