@@ -68,7 +68,11 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None):
     # the (sequence-varying) K/V blocks; mark them varying over the ring axis
     # so the fori_loop carry types line up under shard_map's vma typing.
     def varying(x):
-        return jax.lax.pvary(x, tuple(varying_axes or (axis_name,)))
+        axes = tuple(varying_axes or (axis_name,))
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            return pcast(x, axes, to="varying")
+        return jax.lax.pvary(x, axes)  # pre-pcast jax versions
 
     init = (k, v,
             varying(jnp.zeros((b, h, l, dh), jnp.float32)),
@@ -95,6 +99,58 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
         functools.partial(ring_attention_block, axis_name=axis_name,
                           axis_size=mesh.shape[axis_name],
                           varying_axes=varying_axes),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return sharded(q, k, v)
+
+
+def ulysses_attention_block(q, k, v, axis_name, axis_size):
+    """Per-shard Ulysses (all-to-all) attention body (runs inside shard_map).
+
+    Input: the local sequence slice ``[B, L, H, Dh]`` with ``L = T/sp``.
+    The DeepSpeed-Ulysses recipe, JAX-style: an all-to-all reshards from
+    sequence-sharded/head-replicated to head-sharded/sequence-complete, each
+    device runs DENSE attention over the full sequence for its ``H/sp``
+    heads, and a reverse all-to-all restores sequence sharding. Two
+    all-to-alls per attention vs the ring's ``sp`` permutes — better when
+    heads divide evenly and the full-sequence [T, T] block fits (pair with
+    the Pallas flash kernel for the local attention when it doesn't).
+    """
+    b, l, h, dh = q.shape
+    if h % axis_size:
+        raise ValueError(
+            f"ulysses attention needs heads ({h}) divisible by the mesh "
+            f"axis ({axis_size}); use ring attention otherwise")
+
+    def to_heads(x):
+        # [B, L, H, Dh] -> all_to_all over the head axis: each device trades
+        # its sequence slice of all heads for the full sequence of its
+        # H/axis_size heads -> [B, L*axis_size = T, H/axis_size, Dh].
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_sequence(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    out = attention_reference(to_heads(q), to_heads(k), to_heads(v))
+    return to_sequence(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
+    """All-to-all sequence-parallel attention over ``mesh[axis_name]``.
+
+    Same contract as :func:`ring_attention` (global ``[B, T, H, Dh]`` in,
+    matches :func:`attention_reference` numerics); requires ``H`` divisible
+    by the axis size. The two collectives ride ICI like the ring's permutes
+    — pick by workload: Ulysses moves ``O(T·Dh·H/sp)`` twice, the ring moves
+    K/V ``sp`` times but never needs the full sequence on one device.
+    """
+    from jax import shard_map
+
+    spec = P(batch_axis, axis_name, None, None)
+    sharded = shard_map(
+        functools.partial(ulysses_attention_block, axis_name=axis_name,
+                          axis_size=mesh.shape[axis_name]),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return sharded(q, k, v)
 
@@ -133,11 +189,14 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
                     compute_dtype=jnp.bfloat16, attn_impl="dense"):
     """``windows``: [B, T, F] float (NGram windows collated to a time axis).
 
-    With ``mesh``: ring attention sequence-parallel over ``mesh[attn_axis]``
-    (T must divide by the axis size). Without: single-shard attention —
-    ``attn_impl="dense"`` (XLA einsum softmax) or ``"flash"`` (the Pallas
-    tiled kernel, ``petastorm_tpu.ops.flash_attention`` — O(block²) memory,
-    the TPU choice for long windows). Returns f32 logits [B, num_classes].
+    With ``mesh``: sequence-parallel attention over ``mesh[attn_axis]`` (T
+    must divide by the axis size) — ``attn_impl="ring"`` (default; K/V
+    ppermute ring, online softmax) or ``"ulysses"`` (all-to-all head
+    resharding; needs heads divisible by the axis). Without a mesh:
+    single-shard attention — ``attn_impl="dense"`` (XLA einsum softmax) or
+    ``"flash"`` (the Pallas tiled kernel,
+    ``petastorm_tpu.ops.flash_attention`` — O(block²) memory, the TPU
+    choice for long windows). Returns f32 logits [B, num_classes].
     """
     h = num_heads
     x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
@@ -150,15 +209,28 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
 
     q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
     if mesh is not None:
+        if attn_impl == "dense":  # the no-mesh default: means "ring" here
+            attn_impl = "ring"
+        if attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attn_impl {attn_impl!r} is not a sequence-parallel "
+                f"implementation; with a mesh use 'ring' or 'ulysses'")
         batch_axis = "data" if "data" in mesh.axis_names else None
-        attn = ring_attention(q, k, v, mesh, attn_axis, batch_axis=batch_axis)
+        parallel_attn = (ulysses_attention if attn_impl == "ulysses"
+                         else ring_attention)
+        attn = parallel_attn(q, k, v, mesh, attn_axis,
+                             batch_axis=batch_axis)
     elif attn_impl == "flash":
         from petastorm_tpu.ops import flash_attention
 
         block = min(128, t)
         attn = flash_attention(q, k, v, block_q=block, block_k=block)
-    else:
+    elif attn_impl == "dense":
         attn = attention_reference(q, k, v)
+    else:
+        raise ValueError(
+            f"attn_impl {attn_impl!r} needs a mesh ('ring'/'ulysses'); "
+            f"without one use 'dense' or 'flash'")
     attn = attn.reshape(b, t, d) @ params["wo"].astype(compute_dtype)
     pooled = attn.mean(axis=1)
     logits = pooled @ params["cls"].astype(compute_dtype)
@@ -166,13 +238,15 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
 
 
 def make_seq_train_step(learning_rate=0.05, num_heads=4, mesh=None,
-                        attn_axis="sp"):
+                        attn_axis="sp", attn_impl="ring"):
     """``step(params, windows, labels, mask) -> (params, loss)`` — masked
-    cross-entropy + SGD, ring attention when a mesh is given. The returned
-    step is jittable as-is (all statics are closed over)."""
+    cross-entropy + SGD, sequence-parallel attention (ring or ulysses) when
+    a mesh is given. The returned step is jittable as-is (all statics are
+    closed over)."""
     def loss_fn(params, windows, labels, mask):
         logits = apply_seq_model(params, windows, num_heads=num_heads,
-                                 mesh=mesh, attn_axis=attn_axis)
+                                 mesh=mesh, attn_axis=attn_axis,
+                                 attn_impl=attn_impl)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         nll = jnp.where(mask, nll, 0.0)

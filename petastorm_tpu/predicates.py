@@ -159,16 +159,19 @@ class in_set(PredicateBase):
         # lossy (int column vs float values, float column vs int values) —
         # decline whenever any int on either side exceeds the exact range.
         limit = 2 ** 53
-        promoted = np.result_type(column.dtype, values_arr.dtype)
-        if promoted.kind == "f":
-            if any(isinstance(v, (int, np.integer))
-                   and not isinstance(v, bool) and abs(int(v)) > limit
-                   for v in values):
-                return None
-            if (column.dtype.kind in "iu" and column.size
-                    and int(np.abs(column).max()) > limit):
-                return None
         try:
+            # result_type raises DTypePromotionError (a TypeError) for
+            # non-promotable pairs (e.g. datetime64 vs float) — decline to
+            # the exact row path, same as np.isin failures.
+            promoted = np.result_type(column.dtype, values_arr.dtype)
+            if promoted.kind == "f":
+                if any(isinstance(v, (int, np.integer))
+                       and not isinstance(v, bool) and abs(int(v)) > limit
+                       for v in values):
+                    return None
+                if (column.dtype.kind in "iu" and column.size
+                        and int(np.abs(column).max()) > limit):
+                    return None
             return np.isin(column, values_arr)
         except (TypeError, ValueError):  # exotic value types: row path
             return None

@@ -49,6 +49,77 @@ def test_ring_attention_with_sharded_inputs():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_ulysses_attention_matches_reference():
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(4)
+    q, k, v = (jnp.asarray(rng.randn(2, 32, 8, 16).astype(np.float32))
+               for _ in range(3))
+    got = ulysses_attention(q, k, v, mesh, "sp")
+    expected = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_attention_sharded_and_jitted():
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((2, 4), ("data", "sp"))
+    spec = NamedSharding(mesh, P("data", "sp", None, None))
+    rng = np.random.RandomState(5)
+    arrs = [jax.device_put(rng.randn(2, 32, 4, 8).astype(np.float32), spec)
+            for _ in range(3)]
+    out = jax.jit(lambda a, b, c: ulysses_attention(
+        a, b, c, mesh, "sp", batch_axis="data"))(*arrs)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(attention_reference(*arrs)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_requires_divisible_heads():
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(6)
+    q, k, v = (jnp.asarray(rng.randn(1, 16, 3, 8).astype(np.float32))
+               for _ in range(3))  # 3 heads over an 8-way axis
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh, "sp")
+
+
+def test_seq_train_step_ulysses():
+    from petastorm_tpu.models.sequence_model import (init_seq_params,
+                                                     make_seq_train_step)
+
+    mesh = _mesh((2, 4), ("data", "sp"))
+    params = init_seq_params(jax.random.PRNGKey(3), feature_dim=4,
+                             d_model=32, num_heads=4, num_classes=3)
+    step = jax.jit(make_seq_train_step(0.05, num_heads=4, mesh=mesh,
+                                       attn_impl="ulysses"))
+    windows = jnp.asarray(np.random.RandomState(7)
+                          .randn(4, 16, 4).astype(np.float32))
+    labels = jnp.zeros(4, jnp.int32)
+    mask = jnp.ones(4, bool)
+    params, loss = step(params, windows, labels, mask)
+    assert np.isfinite(float(loss))
+
+
+def test_apply_seq_model_rejects_unknown_attn_impl():
+    from petastorm_tpu.models.sequence_model import (apply_seq_model,
+                                                     init_seq_params)
+
+    params = init_seq_params(jax.random.PRNGKey(0), feature_dim=4,
+                             d_model=16, num_heads=2)
+    windows = jnp.zeros((2, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="attn_impl"):
+        apply_seq_model(params, windows, num_heads=2, attn_impl="ulyses")
+    mesh = _mesh((8,), ("sp",))
+    with pytest.raises(ValueError, match="attn_impl"):
+        apply_seq_model(params, windows, num_heads=2, mesh=mesh,
+                        attn_impl="flash")
+
+
 def test_seq_model_forward_dense_vs_ring():
     mesh = _mesh((8,), ("sp",))
     params = init_seq_params(jax.random.PRNGKey(0), feature_dim=6,
