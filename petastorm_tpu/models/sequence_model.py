@@ -220,6 +220,11 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
                          else ring_attention)
         attn = parallel_attn(q, k, v, mesh, attn_axis,
                              batch_axis=batch_axis)
+    elif attn_impl == "ring":
+        # Symmetric remap: "ring" is the mesh-side default (the train-step
+        # factory passes it unconditionally); without a mesh it means plain
+        # dense attention on the single shard.
+        attn = attention_reference(q, k, v)
     elif attn_impl == "flash":
         from petastorm_tpu.ops import flash_attention
 

@@ -90,6 +90,27 @@ def test_row_group_enumeration_uses_metadata(tmp_path):
     assert table.num_rows == 4
 
 
+def test_parallel_encode_write_matches_serial(tmp_path):
+    """encode_workers > 1 must produce the identical dataset (ordered row
+    groups, same file rotation) as the serial path."""
+    import pyarrow.parquet as pq_mod
+
+    schema = _toy_schema()
+    serial_url = f"file://{tmp_path}/serial"
+    parallel_url = f"file://{tmp_path}/parallel"
+    write_rows(serial_url, schema, _toy_rows(25), rows_per_row_group=4,
+               rows_per_file=12)
+    write_rows(parallel_url, schema, _toy_rows(25), rows_per_row_group=4,
+               rows_per_file=12, encode_workers=4)
+    for name in ("serial", "parallel"):
+        files = sorted(p.name for p in (tmp_path / name).iterdir()
+                       if p.name.endswith(".parquet"))
+        assert len(files) == 3  # 12 + 12 + 1 rows
+    serial = pq_mod.read_table(str(tmp_path / "serial")).to_pylist()
+    parallel = pq_mod.read_table(str(tmp_path / "parallel")).to_pylist()
+    assert serial == parallel
+
+
 def test_load_row_groups_fallback_scan(tmp_path):
     """Without _common_metadata, row groups come from a fragment scan."""
     url = f"file://{tmp_path}/plain"

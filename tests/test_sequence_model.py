@@ -105,6 +105,19 @@ def test_seq_train_step_ulysses():
     assert np.isfinite(float(loss))
 
 
+def test_seq_train_step_default_works_without_mesh():
+    from petastorm_tpu.models.sequence_model import (init_seq_params,
+                                                     make_seq_train_step)
+
+    params = init_seq_params(jax.random.PRNGKey(0), feature_dim=4,
+                             d_model=16, num_heads=2, num_classes=3)
+    step = make_seq_train_step(0.05, num_heads=2)  # no mesh, defaults
+    windows = jnp.zeros((2, 8, 4), jnp.float32)
+    params, loss = step(params, windows, jnp.zeros(2, jnp.int32),
+                        jnp.ones(2, bool))
+    assert np.isfinite(float(loss))
+
+
 def test_apply_seq_model_rejects_unknown_attn_impl():
     from petastorm_tpu.models.sequence_model import (apply_seq_model,
                                                      init_seq_params)
