@@ -132,8 +132,18 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
     # that when derivation is rejected anyway.
     num_epochs = getattr(reader, "num_epochs", 1)
     if num_epochs is None:
+        warnings.warn(
+            "Cannot derive an equal SPMD step count for an infinite stream "
+            "(num_epochs=None). Pass max_batches explicitly (agreed across "
+            "hosts) or steps may deadlock the pod",
+            UserWarning, stacklevel=3)
         return None
     if getattr(reader, "ngram", None) is not None:
+        warnings.warn(
+            "Cannot derive an equal SPMD step count for an NGram reader: "
+            "windows per row group are data-dependent. Pass max_batches "
+            "explicitly (agreed across hosts) or steps may deadlock the pod",
+            UserWarning, stacklevel=3)
         return None
     if getattr(reader, "_resume_state", None) is not None:
         warnings.warn(

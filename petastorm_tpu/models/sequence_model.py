@@ -193,7 +193,8 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     must divide by the axis size) — ``attn_impl="ring"`` (default; K/V
     ppermute ring, online softmax) or ``"ulysses"`` (all-to-all head
     resharding; needs heads divisible by the axis). Without a mesh:
-    single-shard attention — ``attn_impl="dense"`` (XLA einsum softmax) or
+    single-shard attention — ``attn_impl="dense"`` (XLA einsum softmax;
+    ``"ring"`` also maps here, being its exact single-shard equivalent) or
     ``"flash"`` (the Pallas tiled kernel,
     ``petastorm_tpu.ops.flash_attention`` — O(block²) memory, the TPU
     choice for long windows). Returns f32 logits [B, num_classes].
@@ -234,8 +235,8 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
         attn = attention_reference(q, k, v)
     else:
         raise ValueError(
-            f"attn_impl {attn_impl!r} needs a mesh ('ring'/'ulysses'); "
-            f"without one use 'dense' or 'flash'")
+            f"attn_impl {attn_impl!r} is not valid without a mesh "
+            f"('ulysses' needs one); use 'dense', 'ring', or 'flash'")
     attn = attn.reshape(b, t, d) @ params["wo"].astype(compute_dtype)
     pooled = attn.mean(axis=1)
     logits = pooled @ params["cls"].astype(compute_dtype)

@@ -140,10 +140,12 @@ def test_derive_returns_none_and_warns_with_transform_spec(ragged_dataset):
 def test_derive_skips_ngram_and_infinite_readers():
     ngramish = SimpleNamespace(shard_row_counts=[10], num_epochs=1,
                                ngram=object(), _predicate=None)
-    assert derive_equal_step_max_batches(ngramish, 4) is None
+    with pytest.warns(UserWarning, match="NGram"):
+        assert derive_equal_step_max_batches(ngramish, 4) is None
     infinite = SimpleNamespace(shard_row_counts=[10], num_epochs=None,
                                ngram=None, _predicate=None)
-    assert derive_equal_step_max_batches(infinite, 4) is None
+    with pytest.warns(UserWarning, match="infinite"):
+        assert derive_equal_step_max_batches(infinite, 4) is None
     plain = SimpleNamespace(shard_row_counts=[10, 9], num_epochs=2,
                             ngram=None, _predicate=None)
     assert derive_equal_step_max_batches(plain, 4) == 4  # min(20//4, 18//4)

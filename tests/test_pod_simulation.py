@@ -1,0 +1,121 @@
+"""Pod-simulation integration: sharding × equal-step × resume together.
+
+Simulates a multi-host pod with one reader+loader per virtual host (the way
+each real host constructs its own pipeline) and checks the three invariants
+that keep a pjit pod alive and correct:
+
+1. disjoint, exhaustive row coverage across shards;
+2. identical step counts on every host (SPMD lockstep), even with ragged
+   shards;
+3. after a mid-training interrupt + resume on EVERY host, rows are still
+   delivered at-least-once with bounded over-delivery.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax_utils import make_jax_dataloader
+
+
+HOSTS = 2
+
+
+@pytest.fixture(scope="module")
+def ragged_pod_dataset(tmp_path_factory):
+    """5 row groups of 8 rows: 2 hosts get 3 and 2 groups (ragged)."""
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("PodSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("vec", np.float32, (4,), None, False),
+    ])
+    path = tmp_path_factory.mktemp("pod") / "ds"
+    url = f"file://{path}"
+    materialize_rows(url, schema,
+                     ({"id": i, "vec": np.full(4, i, np.float32)}
+                      for i in range(40)),
+                     rows_per_row_group=8)
+    return url
+
+
+def _host_loader(url, host, batch_size=4, resume_state=None, epochs=1):
+    reader = make_reader(url, reader_pool_type="thread", workers_count=2,
+                         num_epochs=epochs, shuffle_row_groups=True,
+                         shard_seed=3, cur_shard=host, shard_count=HOSTS,
+                         resume_state=resume_state)
+    return reader, make_jax_dataloader(reader, batch_size, last_batch="pad",
+                                       stage_to_device=False)
+
+
+def test_pod_lockstep_coverage_and_resume(ragged_pod_dataset):
+    url = ragged_pod_dataset
+    from petastorm_tpu.jax_utils.sharding import global_step_count
+
+    steps = global_step_count(url, batch_size=4, shard_count=HOSTS,
+                              last_batch="pad", shard_seed=3)
+
+    # --- phase 1: every host runs `interrupt` steps, checkpoints ----------
+    interrupt = steps // 2
+    assert interrupt >= 1
+    seen = collections.Counter()
+    states = []
+    for host in range(HOSTS):
+        reader, loader = _host_loader(url, host)
+        with loader:
+            it = iter(loader)
+            for _ in range(interrupt):
+                batch = next(it)
+                mask = batch.get("__pad_mask__",
+                                 np.ones(len(batch["id"]), bool))
+                seen.update(np.asarray(batch["id"])[mask].tolist())
+            states.append(loader.state_dict())
+
+    # --- phase 2: every host resumes and drains -------------------------
+    host_steps = []
+    for host in range(HOSTS):
+        reader, loader = _host_loader(url, host, resume_state=states[host])
+        n = 0
+        with loader:
+            for batch in loader:
+                mask = batch.get("__pad_mask__",
+                                 np.ones(len(batch["id"]), bool))
+                seen.update(np.asarray(batch["id"])[mask].tolist())
+                n += 1
+        host_steps.append(n)
+
+    # Coverage: every row delivered at least once across the pod.
+    assert set(seen) == set(range(40))
+    # At-least-once with bounded duplication: only the row groups in flight
+    # at the interrupt may repeat (≤ one per host here), and the shards are
+    # disjoint so no row crosses hosts.
+    over = [k for k, c in seen.items() if c > 1]
+    assert len(over) <= HOSTS * 8
+    assert all(seen[k] == 2 for k in over)
+
+
+def test_pod_equal_steps_without_interrupt(ragged_pod_dataset):
+    url = ragged_pod_dataset
+    counts = []
+    for host in range(HOSTS):
+        from petastorm_tpu.jax_utils.sharding import batch_sharding  # noqa: F401
+        reader, loader = _host_loader(url, host)
+        # Auto-derivation needs a sharding= to trigger; emulate by passing
+        # max_batches from the same metadata arithmetic every host runs.
+        from petastorm_tpu.jax_utils.sharding import (
+            derive_equal_step_max_batches,
+        )
+
+        derived = derive_equal_step_max_batches(reader, 4, last_batch="pad")
+        with loader:
+            steps = 0
+            for _ in loader:
+                steps += 1
+                if derived is not None and steps >= derived:
+                    break
+        counts.append(steps)
+    assert len(set(counts)) == 1, f"hosts diverged: {counts}"
