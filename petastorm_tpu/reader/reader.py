@@ -153,9 +153,10 @@ def make_columnar_reader(dataset_url,
     Decodes codec columns **vectorized** (``codec.decode_column``: imdecode /
     frombuffer straight into preallocated ``[N, *shape]`` arrays — no per-row
     python objects) and yields column-batch namedtuples like
-    :func:`make_batch_reader` (``batched_output=True``). 2-3x the row path's
-    decode throughput on image/tensor schemas, which directly raises the
-    input-bound training ceiling (BASELINE.md north star).
+    :func:`make_batch_reader` (``batched_output=True``). Measured ~1.3-1.4x
+    the row path's decode throughput on png/ndarray schemas (the advantage
+    shrinks when a heavy per-cell codec like jpeg dominates), which directly
+    raises the input-bound training ceiling (BASELINE.md north star).
 
     Differences from :func:`make_reader` (row path, reference architecture —
     ``petastorm/py_dict_reader_worker.py``):
