@@ -41,21 +41,37 @@ import jax.numpy as jnp
 _LANES = 128  # TPU lane width: scratch min-tile last dim
 
 
-def _attention_reference(q, k, v):
+def _attention_reference(q, k, v, causal=False):
     """Unfused oracle over ``[B, T, H, D]`` (same numerics contract as the
     kernel); used by the recompute backward."""
     scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        row = jnp.arange(t_q)[:, None] + (t_k - t_q)  # align last positions
+        mask = jnp.arange(t_k)[None, :] <= row
+        # Rows with no valid key (t_q > t_kv suffix alignment) must produce
+        # ZERO output, nan-free in both forward and vjp: substitute finite
+        # scores for those rows, then zero their probabilities.
+        row_valid = mask.any(axis=-1, keepdims=True)
+        scores = jnp.where(mask, scores, -jnp.inf)
+        scores = jnp.where(row_valid, scores, 0.0)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(row_valid, probs, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                          v.astype(jnp.float32)).astype(q.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
-                  acc_scratch, *, sm_scale, block_k, kv_len):
+                  acc_scratch, *, sm_scale, block_q, block_k, kv_len,
+                  causal_offset):
     from jax.experimental import pallas as pl
 
+    qb = pl.program_id(1)
     kb = pl.program_id(2)
     last_kb = pl.num_programs(2) - 1
 
@@ -65,28 +81,52 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         l_scratch[...] = jnp.zeros_like(l_scratch)
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
-    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)
+    def compute_block():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
-    # Mask padded key rows (wrapper zero-pads KV up to the block multiple).
-    col_ids = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, dimension=1)
-    s = jnp.where(col_ids < kv_len, s, -jnp.inf)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        # Mask padded key rows (wrapper zero-pads KV to the block multiple).
+        col_ids = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1)
+        s = jnp.where(col_ids < kv_len, s, -jnp.inf)
+        if causal_offset is not None:
+            # Causal: key position must not exceed this query row's aligned
+            # position (offset aligns the LAST query with the LAST key when
+            # T_q != T_kv — decoder-style suffix queries).
+            row_ids = (qb * block_q + causal_offset
+                       + jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                                  dimension=0))
+            s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
 
-    m_prev = m_scratch[...][:, :1]            # [block_q, 1]
-    l_prev = l_scratch[...][:, :1]
-    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                    # [block_q, block_k]
-    l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
+        m_prev = m_scratch[...][:, :1]            # [block_q, 1]
+        l_prev = l_scratch[...][:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        # A row can be fully masked in this block (causal + partial-overlap
+        # K blocks): m_new stays -inf and the raw exponent would be
+        # (-inf) - (-inf) = nan.
+        fully_masked = m_new == -jnp.inf
+        m_safe = jnp.where(fully_masked, 0.0, m_new)
+        alpha = jnp.where(fully_masked, 1.0, jnp.exp(m_prev - m_safe))
+        p = jnp.exp(s - m_safe)               # [block_q, block_k]; -inf -> 0
+        l_new = alpha * l_prev + p.sum(axis=1, keepdims=True)
 
-    acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_scratch[...] = jnp.broadcast_to(m_new, m_scratch.shape)
-    l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+        acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scratch[...] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    if causal_offset is None:
+        compute_block()
+    else:
+        # Skip K blocks that lie entirely above the causal boundary for this
+        # Q block (the grid's last axis runs sequentially, so scratch state
+        # carries across the skipped steps) — ~2x compute saved at large T.
+        last_valid_col = qb * block_q + causal_offset + block_q - 1
+        pl.when(kb * block_k <= last_valid_col)(compute_block)
 
     @pl.when(kb == last_kb)
     def _emit():
@@ -95,7 +135,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
             .astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, block_q, block_k, interpret):
+def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -122,8 +162,11 @@ def _flash_forward(q, k, v, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=1.0 / float(d) ** 0.5,
+        block_q=block_q,
         block_k=block_k,
         kv_len=t_kv,
+        # Align the LAST query with the LAST key (suffix-query convention).
+        causal_offset=(t_kv - t_q) if causal else None,
     )
     out = pl.pallas_call(
         kernel,
@@ -156,8 +199,9 @@ def _should_interpret():
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
+                    causal=False):
     """Tiled attention over ``[B, T, H, D]`` tensors; matches
     ``attention_reference`` numerics (f32 softmax) without materializing the
     ``[T, T]`` score matrix.
@@ -166,24 +210,28 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None):
         unless T is small.
     :param interpret: force the pallas interpreter (None = auto: interpret
         off-TPU, Mosaic on TPU).
+    :param causal: mask key positions after each query's (last-aligned)
+        position — decoder-style attention.
     """
     if interpret is None:
         interpret = _should_interpret()
-    return _flash_forward(q, k, v, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, block_q, block_k, interpret, causal)
 
 
-def _fwd(q, k, v, block_q, block_k, interpret):
+def _fwd(q, k, v, block_q, block_k, interpret, causal):
     if interpret is None:
         interpret = _should_interpret()
-    return _flash_forward(q, k, v, block_q, block_k, interpret), (q, k, v)
+    return (_flash_forward(q, k, v, block_q, block_k, interpret, causal),
+            (q, k, v))
 
 
-def _bwd(block_q, block_k, interpret, residuals, g):
+def _bwd(block_q, block_k, interpret, causal, residuals, g):
     # Recompute-from-residuals backward via the reference formulation: the
     # O(T²) score matrix exists only inside XLA's fused backward, and only
     # for the backward pass (standard flash rematerialization trade).
     q, k, v = residuals
-    _, vjp = jax.vjp(_attention_reference, q, k, v)
+    _, vjp = jax.vjp(
+        functools.partial(_attention_reference, causal=causal), q, k, v)
     return vjp(g)
 
 

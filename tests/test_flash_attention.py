@@ -79,6 +79,71 @@ def test_gradients_flow_and_match_reference():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_causal_matches_masked_reference():
+    from petastorm_tpu.ops.flash_attention import _attention_reference
+
+    q, k, v = _qkv(t=48, seed=8)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, causal=True)
+    ref = _attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # Causal must differ from full attention (sanity that the mask bites).
+    full = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert not np.allclose(np.asarray(out), np.asarray(full))
+
+
+def test_causal_cross_lengths_suffix_alignment():
+    from petastorm_tpu.ops.flash_attention import _attention_reference
+
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))   # suffix
+    k = jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32))
+    out = flash_attention(q, k, v, block_q=8, block_k=16, causal=True)
+    ref = _attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_causal_more_queries_than_keys_is_nan_free():
+    from petastorm_tpu.ops.flash_attention import _attention_reference
+
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    # Suffix alignment: the first 8 query rows precede every key -> fully
+    # masked -> must be exactly zero, nan-free, in forward AND backward,
+    # and kernel and oracle must agree.
+    out = flash_attention(q, k, v, block_q=8, block_k=8, causal=True)
+    ref = _attention_reference(q, k, v, causal=True)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(ref)).all()
+    np.testing.assert_allclose(np.asarray(out[:, :8]), 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    grads = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, 8, 8, None, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_causal_gradients_match_reference():
+    from petastorm_tpu.ops.flash_attention import _attention_reference
+
+    q, k, v = _qkv(t=32, d=8, seed=10)
+    g_flash = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, 16, 16, None, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        _attention_reference(a, b, c, causal=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_seq_model_flash_path_matches_dense():
     from petastorm_tpu.models.sequence_model import (apply_seq_model,
                                                      init_seq_params)
