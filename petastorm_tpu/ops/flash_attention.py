@@ -47,6 +47,7 @@ def _attention_reference(q, k, v, causal=False):
     scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    row_valid = None
     if causal:
         t_q, t_k = scores.shape[-2], scores.shape[-1]
         row = jnp.arange(t_q)[:, None] + (t_k - t_q)  # align last positions
@@ -57,11 +58,9 @@ def _attention_reference(q, k, v, causal=False):
         row_valid = mask.any(axis=-1, keepdims=True)
         scores = jnp.where(mask, scores, -jnp.inf)
         scores = jnp.where(row_valid, scores, 0.0)
-        probs = jax.nn.softmax(scores, axis=-1)
-        probs = jnp.where(row_valid, probs, 0.0)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs,
-                          v.astype(jnp.float32)).astype(q.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
+    if row_valid is not None:
+        probs = jnp.where(row_valid, probs, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -159,6 +158,7 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
     tq_p, tk_p = t_q + pad_q, t_kv + pad_k
 
     grid = (b * h, tq_p // block_q, tk_p // block_k)
+    causal_offset = (t_kv - t_q) if causal else None
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=1.0 / float(d) ** 0.5,
@@ -166,17 +166,29 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
         block_k=block_k,
         kv_len=t_kv,
         # Align the LAST query with the LAST key (suffix-query convention).
-        causal_offset=(t_kv - t_q) if causal else None,
+        causal_offset=causal_offset,
     )
+    if causal_offset is None:
+        kv_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+    else:
+        def kv_index(bh, i, j):
+            # Clamp skipped (fully-above-causal-boundary) K/V fetches to the
+            # last USEFUL block for this Q block: pl.when skips their
+            # compute, and an unchanged block index lets the pipeline skip
+            # the HBM->VMEM copy too — the skip saves bandwidth, not just
+            # MXU time.
+            last = (i * block_q + causal_offset + block_q - 1) // block_k
+            return (bh, jnp.minimum(j, jnp.maximum(last, 0)), 0)
+
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),

@@ -100,6 +100,43 @@ def test_tf_dataset_end_to_end(petastorm_dataset):
     assert rows[0].matrix.shape == (4, 8)
 
 
+def test_tf_dataset_over_columnar_reader(petastorm_dataset):
+    """The TPU fast-path reader feeds the TF adapter too (batched elements)."""
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    reader = make_columnar_reader(petastorm_dataset.url,
+                                  reader_pool_type="dummy",
+                                  schema_fields=["id", "matrix"],
+                                  num_epochs=1, shuffle_row_groups=False)
+    with reader:
+        total = 0
+        for batch in make_petastorm_dataset(reader):
+            total += int(batch.id.shape[0])
+            assert batch.matrix.shape[1:] == (4, 8)
+    assert total == 30
+
+
+def test_batched_dataloader_over_columnar_reader(petastorm_dataset):
+    """The TPU fast-path reader feeds the torch BatchedDataLoader too."""
+    import torch
+
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.pytorch import BatchedDataLoader
+
+    reader = make_columnar_reader(petastorm_dataset.url,
+                                  reader_pool_type="dummy",
+                                  schema_fields=["id", "matrix"],
+                                  num_epochs=1, shuffle_row_groups=False)
+    with BatchedDataLoader(reader, batch_size=8) as loader:
+        ids = []
+        for batch in loader:
+            assert torch.is_tensor(batch["matrix"])
+            ids.extend(int(v) for v in batch["id"])
+    # 30 rows -> 3 full batches of 8 plus the trailing partial batch of 6
+    assert sorted(ids) == list(range(30))
+
+
 def test_tf_dataset_ngram(petastorm_dataset):
     from petastorm_tpu import make_reader
     from petastorm_tpu.ngram import NGram
