@@ -57,6 +57,17 @@ def test_mnist_jax_training_converges_shape(tmp_path, capsys):
     assert params["dense2"]["kernel"].shape[-1] == 10
 
 
+def test_sequence_example_trains_on_windows(capsys):
+    from examples.sequence.train_sequence import main
+
+    import math
+
+    loss = main(frames=256)
+    assert math.isfinite(loss)
+    out = capsys.readouterr().out
+    assert "5-frame windows" in out
+
+
 def test_criteo_dlrm_trains_and_resumes(tmp_path, capsys):
     from examples.criteo_dlrm.train_dlrm import main
 
