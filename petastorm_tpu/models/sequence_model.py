@@ -229,8 +229,13 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     elif attn_impl == "flash":
         from petastorm_tpu.ops import flash_attention
 
-        block = min(128, t)
-        attn = flash_attention(q, k, v, block_q=block, block_k=block)
+        if t < 8:
+            # Below the TPU min sublane tile the kernel's (block, 128)
+            # scratch would not tile for Mosaic; dense is cheaper anyway.
+            attn = attention_reference(q, k, v)
+        else:
+            block = min(128, t)
+            attn = flash_attention(q, k, v, block_q=block, block_k=block)
     elif attn_impl == "dense":
         attn = attention_reference(q, k, v)
     else:

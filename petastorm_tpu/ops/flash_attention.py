@@ -22,10 +22,14 @@ Layout/tiling choices (pallas_guide.md):
   wrapper and masked to -inf inside the kernel via a 2D
   ``broadcasted_iota`` (1D iota does not lower on TPU).
 
-Backward: ``jax.custom_vjp`` with a recompute-from-residuals backward
-through the reference formulation — flash recomputation traded for XLA
-autodiff simplicity (the standard rematerialization trade; a hand-tiled
-backward kernel is the remaining headroom).
+Backward: hand-tiled flash-2 style ``jax.custom_vjp`` — the forward emits
+the per-row log-sum-exp as a residual, and two Pallas kernels recompute the
+probabilities per (Q-block, K-block) tile from (q, k, lse): one sweep
+accumulates dQ over K blocks, the other accumulates dK/dV over Q blocks.
+Like the forward, no kernel ever materializes the [T, T] score matrix, so
+training memory is O(block_q × block_k) + O(T·D) residuals — not O(T²).
+The pre-round-4 recompute-through-the-reference backward is kept as a
+correctness oracle behind ``bwd_impl="reference"``.
 
 Off-TPU (tests, CPU dev) the kernel runs in interpret mode, so numerics are
 validated everywhere while the Mosaic lowering is exercised on real TPU.
@@ -65,10 +69,23 @@ def _attention_reference(q, k, v, causal=False):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
-                  acc_scratch, *, sm_scale, block_q, block_k, kv_len,
-                  causal_offset):
+def _dot_precision(dtype):
+    """MXU multiply precision: f32 inputs get the full-precision passes
+    (DEFAULT is single-pass bf16 — ~1e-2 relative error that softmax's exp
+    amplifies); bf16 inputs are exact at DEFAULT (they started as bf16)."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, block_q,
+                  block_k, kv_len, causal_offset, emit_lse, precision):
     from jax.experimental import pallas as pl
+
+    if emit_lse:
+        lse_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        lse_ref = None
+        m_scratch, l_scratch, acc_scratch = rest
 
     qb = pl.program_id(1)
     kb = pl.program_id(2)
@@ -85,20 +102,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         k = k_ref[0].astype(jnp.float32)          # [block_k, d]
         v = v_ref[0].astype(jnp.float32)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        # Mask padded key rows (wrapper zero-pads KV to the block multiple).
-        col_ids = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, dimension=1)
-        s = jnp.where(col_ids < kv_len, s, -jnp.inf)
-        if causal_offset is not None:
-            # Causal: key position must not exceed this query row's aligned
-            # position (offset aligns the LAST query with the LAST key when
-            # T_q != T_kv — decoder-style suffix queries).
-            row_ids = (qb * block_q + causal_offset
-                       + jax.lax.broadcasted_iota(jnp.int32, s.shape,
-                                                  dimension=0))
-            s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
+        s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
+                           block_k=block_k, kv_len=kv_len,
+                           causal_offset=causal_offset,
+                           precision=precision)
 
         m_prev = m_scratch[...][:, :1]            # [block_q, 1]
         l_prev = l_scratch[...][:, :1]
@@ -114,7 +121,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
 
         acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+                                 precision=precision)
         m_scratch[...] = jnp.broadcast_to(m_new, m_scratch.shape)
         l_scratch[...] = jnp.broadcast_to(l_new, l_scratch.shape)
 
@@ -132,9 +140,37 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         l = l_scratch[...][:, :1]
         o_ref[0] = (acc_scratch[...] / jnp.maximum(l, 1e-30)) \
             .astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Per-row log-sum-exp residual for the flash backward. Rows with
+            # no valid key (causal cross-length) have l == 0: +inf makes the
+            # backward's exp(s - lse) an exact zero with no inf-inf nan.
+            lf = l_scratch[...]
+            lse_ref[0] = jnp.where(
+                lf > 0.0,
+                m_scratch[...] + jnp.log(jnp.maximum(lf, 1e-37)),
+                jnp.inf)
 
 
-def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
+def _to_bh(x):
+    """[B, T, H, D] → [B·H, T, D] (attention is independent per batch·head)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _pad_t(x, block):
+    pad = (-x.shape[1]) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
+                   return_residuals=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -142,20 +178,10 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
     b, t_q, h, d = q.shape
     t_kv = k.shape[1]
 
-    # [B, T, H, D] → [B·H, T, D] (attention is independent per batch·head).
-    def to_bh(x, t):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, x.shape[-1])
-
-    qf, kf, vf = to_bh(q, t_q), to_bh(k, t_kv), to_bh(v, t_kv)
-
-    pad_q = (-t_q) % block_q
-    pad_k = (-t_kv) % block_k
-    if pad_q:
-        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
-    tq_p, tk_p = t_q + pad_q, t_kv + pad_k
+    qf = _pad_t(_to_bh(q), block_q)
+    kf = _pad_t(_to_bh(k), block_k)
+    vf = _pad_t(_to_bh(v), block_k)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
 
     grid = (b * h, tq_p // block_q, tk_p // block_k)
     causal_offset = (t_kv - t_q) if causal else None
@@ -167,6 +193,8 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
         kv_len=t_kv,
         # Align the LAST query with the LAST key (suffix-query convention).
         causal_offset=causal_offset,
+        emit_lse=return_residuals,
+        precision=_dot_precision(orig_dtype),
     )
     if causal_offset is None:
         kv_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
@@ -180,20 +208,30 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
             last = (i * block_q + causal_offset + block_q - 1) // block_k
             return (bh, jnp.minimum(j, jnp.maximum(last, 0)), 0)
 
+    q_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
+    out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), orig_dtype)
+    out_specs = pl.BlockSpec((1, block_q, d), q_index,
+                             memory_space=pltpu.VMEM)
+    if return_residuals:
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((b * h, tq_p, _LANES), jnp.float32))
+        out_specs = (out_specs,
+                     pl.BlockSpec((1, block_q, _LANES), q_index,
+                                  memory_space=pltpu.VMEM))
+
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
+            pl.BlockSpec((1, block_q, d), q_index,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), orig_dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
@@ -202,8 +240,240 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False):
         interpret=interpret,
     )(qf, kf, vf)
 
-    out = out[:, :t_q, :]
-    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+    if return_residuals:
+        out_padded, lse = out
+        # lse is lane-broadcast (all 128 lanes equal); store one column.
+        return out_padded, lse[:, :, 0]
+    return _from_bh(out[:, :t_q, :], b, h)
+
+
+def _masked_scores(q, k, kb, qb, *, sm_scale, block_q, block_k, kv_len,
+                   causal_offset, precision):
+    """Recompute the masked score tile s = mask(scale·q kᵀ) for one
+    (Q-block, K-block) pair — shared by both backward kernels; identical
+    masking semantics to the forward kernel."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                                 precision=precision) * sm_scale
+    col_ids = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1)
+    s = jnp.where(col_ids < kv_len, s, -jnp.inf)
+    if causal_offset is not None:
+        row_ids = (qb * block_q + causal_offset
+                   + jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                              dimension=0))
+        s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
+    return s
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                         dq_acc, *, sm_scale, block_q, block_k, kv_len,
+                         causal_offset, precision):
+    """dQ sweep: grid (B·H, Tq/block_q, Tk/block_k) — K blocks iterate
+    innermost, dq accumulates in VMEM scratch. Per tile:
+    p = exp(s - lse); ds = p·(do·vᵀ - Δ)·scale; dq += ds·k, with
+    Δ = rowsum(do ∘ o) recomputed from the residuals (O(block·d), cheaper
+    than staging a third stats tensor)."""
+    from jax.experimental import pallas as pl
+
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    last_kb = pl.num_programs(2) - 1
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute_block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+
+        s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
+                           block_k=block_k, kv_len=kv_len,
+                           causal_offset=causal_offset,
+                           precision=precision)
+        # lse is +inf for rows with no valid key, so every term is an exact
+        # zero (finite-or-(-inf) minus +inf → -inf → exp 0; never inf-inf).
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        delta = (do * o).sum(axis=1, keepdims=True)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+                                 precision=precision)
+
+    if causal_offset is None:
+        compute_block()
+    else:
+        last_valid_col = qb * block_q + causal_offset + block_q - 1
+        pl.when(kb * block_k <= last_valid_col)(compute_block)
+
+    @pl.when(kb == last_kb)
+    def _emit():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale,
+                          block_q, block_k, kv_len, causal_offset,
+                          precision):
+    """dK/dV sweep: grid (B·H, Tk/block_k, Tq/block_q) — Q blocks iterate
+    innermost, dk/dv accumulate in VMEM scratch. Per tile:
+    dv += pᵀ·do; dk += dsᵀ·q (same recomputed p/ds as the dQ sweep)."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    last_qb = pl.num_programs(2) - 1
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute_block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+
+        s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
+                           block_k=block_k, kv_len=kv_len,
+                           causal_offset=causal_offset,
+                           precision=precision)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+                                 precision=precision)
+        delta = (do * o).sum(axis=1, keepdims=True)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=precision)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+                                 precision=precision)
+
+    if causal_offset is None:
+        compute_block()
+    else:
+        # Q block qb touches K block kb iff its causal boundary reaches it.
+        last_valid_col = qb * block_q + causal_offset + block_q - 1
+        pl.when(last_valid_col >= kb * block_k)(compute_block)
+
+    @pl.when(qb == last_qb)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
+                    causal):
+    """Flash-2 backward: two pallas sweeps, O(block²) VMEM, no [T, T]
+    buffer. ``o_padded``/``lse`` are [B·H, Tq_padded(, )] residuals from the
+    forward; q/k/v are the user-shaped [B, T, H, D] primals."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+
+    qf = _pad_t(_to_bh(q), block_q)
+    kf = _pad_t(_to_bh(k), block_k)
+    vf = _pad_t(_to_bh(v), block_k)
+    dof = _pad_t(_to_bh(g), block_q)
+    tq_p, tk_p = qf.shape[1], kf.shape[1]
+    n_qb, n_kb = tq_p // block_q, tk_p // block_k
+
+    # Rebroadcast the stored lse column across the lane dim so backward
+    # loads see the same Mosaic-friendly (block_q, 128) layout the forward
+    # scratch used (a [block_q]-vector would not tile).
+    lse_b = jnp.broadcast_to(lse[:, :, None], (b * h, tq_p, _LANES))
+
+    causal_offset = (t_kv - t_q) if causal else None
+    common = dict(sm_scale=1.0 / float(d) ** 0.5, block_q=block_q,
+                  block_k=block_k, kv_len=t_kv, causal_offset=causal_offset,
+                  precision=_dot_precision(q.dtype))
+
+    q_spec = lambda ix: pl.BlockSpec((1, block_q, d), ix,  # noqa: E731
+                                     memory_space=pltpu.VMEM)
+    kv_spec = lambda ix: pl.BlockSpec((1, block_k, d), ix,  # noqa: E731
+                                      memory_space=pltpu.VMEM)
+
+    # --- dQ sweep: (bh, qb, kb), K innermost --------------------------------
+    dq_q_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
+    if causal_offset is None:
+        dq_kv_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+    else:
+        def dq_kv_index(bh, i, j):
+            # Clamp fetches of skipped (fully-future) K/V blocks, exactly as
+            # in the forward, so the pipeline skips the copy too.
+            last = (i * block_q + causal_offset + block_q - 1) // block_k
+            return (bh, jnp.minimum(j, jnp.maximum(last, 0)), 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[
+            q_spec(dq_q_index),
+            kv_spec(dq_kv_index),
+            kv_spec(dq_kv_index),
+            q_spec(dq_q_index),                      # do
+            q_spec(dq_q_index),                      # o
+            pl.BlockSpec((1, block_q, _LANES), dq_q_index,
+                         memory_space=pltpu.VMEM),   # lse
+        ],
+        out_specs=q_spec(dq_q_index),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, o_padded, lse_b)
+
+    # --- dK/dV sweep: (bh, kb, qb), Q innermost -----------------------------
+    dkv_kv_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
+    if causal_offset is None:
+        dkv_q_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+    else:
+        def dkv_q_index(bh, i, j):
+            # First Q block whose causal boundary reaches K block i; clamp
+            # skipped earlier-Q fetches to it (ceil with floor-division).
+            first = -((causal_offset + block_q - 1 - i * block_k) // block_q)
+            first = jnp.clip(first, 0, n_qb - 1)
+            return (bh, jnp.maximum(j, first), 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(b * h, n_kb, n_qb),
+        in_specs=[
+            q_spec(dkv_q_index),
+            kv_spec(dkv_kv_index),
+            kv_spec(dkv_kv_index),
+            q_spec(dkv_q_index),                     # do
+            q_spec(dkv_q_index),                     # o
+            pl.BlockSpec((1, block_q, _LANES), dkv_q_index,
+                         memory_space=pltpu.VMEM),   # lse
+        ],
+        out_specs=(kv_spec(dkv_kv_index), kv_spec(dkv_kv_index)),
+        out_shape=(jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, o_padded, lse_b)
+
+    dq = _from_bh(dq[:, :t_q], b, h)
+    dk = _from_bh(dk[:, :t_kv], b, h)
+    dv = _from_bh(dv[:, :t_kv], b, h)
+    return dq, dk, dv
 
 
 def _should_interpret():
@@ -211,12 +481,12 @@ def _should_interpret():
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
-                    causal=False):
+                    causal=False, bwd_impl="flash"):
     """Tiled attention over ``[B, T, H, D]`` tensors; matches
     ``attention_reference`` numerics (f32 softmax) without materializing the
-    ``[T, T]`` score matrix.
+    ``[T, T]`` score matrix — in the forward OR the backward.
 
     :param block_q / block_k: VMEM tile sizes; keep at 128 (MXU-shaped)
         unless T is small.
@@ -224,27 +494,51 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
         off-TPU, Mosaic on TPU).
     :param causal: mask key positions after each query's (last-aligned)
         position — decoder-style attention.
+    :param bwd_impl: ``"flash"`` (hand-tiled dq + dk/dv Pallas sweeps,
+        O(block²) memory) or ``"reference"`` (XLA autodiff through the dense
+        oracle — materializes [T, T] in the backward; kept for debugging and
+        as the numerics oracle).
     """
+    _check_bwd_impl(bwd_impl)
     if interpret is None:
         interpret = _should_interpret()
     return _flash_forward(q, k, v, block_q, block_k, interpret, causal)
 
 
-def _fwd(q, k, v, block_q, block_k, interpret, causal):
+def _check_bwd_impl(bwd_impl):
+    if bwd_impl not in ("flash", "reference"):
+        raise ValueError(
+            f"bwd_impl {bwd_impl!r} is not 'flash' or 'reference'")
+
+
+def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
+    _check_bwd_impl(bwd_impl)
     if interpret is None:
         interpret = _should_interpret()
-    return (_flash_forward(q, k, v, block_q, block_k, interpret, causal),
-            (q, k, v))
+    if bwd_impl == "reference":
+        out = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+        return out, (q, k, v, None, None)
+    out_padded, lse = _flash_forward(q, k, v, block_q, block_k, interpret,
+                                     causal, return_residuals=True)
+    b, t_q, h, _ = q.shape
+    out = _from_bh(out_padded[:, :t_q], b, h)
+    # o is saved PADDED in [B·H, T, D] form: the backward consumes it block
+    # by block in exactly this layout, so nothing is re-transposed there.
+    return out, (q, k, v, out_padded, lse)
 
 
-def _bwd(block_q, block_k, interpret, causal, residuals, g):
-    # Recompute-from-residuals backward via the reference formulation: the
-    # O(T²) score matrix exists only inside XLA's fused backward, and only
-    # for the backward pass (standard flash rematerialization trade).
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        functools.partial(_attention_reference, causal=causal), q, k, v)
-    return vjp(g)
+def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
+    if interpret is None:
+        interpret = _should_interpret()
+    q, k, v, o_padded, lse = residuals
+    if bwd_impl == "reference":
+        # Recompute-through-the-oracle backward: XLA materializes the [T, T]
+        # scores inside its fused backward. Correctness oracle only.
+        _, vjp = jax.vjp(
+            functools.partial(_attention_reference, causal=causal), q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k,
+                           interpret, causal)
 
 
 flash_attention.defvjp(_fwd, _bwd)

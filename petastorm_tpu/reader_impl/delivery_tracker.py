@@ -137,4 +137,17 @@ class DeliveryTracker:
                 if counts[key] <= 0:
                     counts.pop(key)
                 remaining -= num_rows
+            if remaining > yielded_rows:
+                # The rollback log was truncated (MAX_LOG_ENTRIES) past the
+                # point this snapshot needs: the counts would over-report
+                # deliveries and a resume would SKIP buffered-but-unyielded
+                # rows, silently breaking at-least-once. Refuse to produce a
+                # lossy checkpoint.
+                raise RuntimeError(
+                    "delivery log exhausted while rolling back to "
+                    f"{yielded_rows} yielded rows ({remaining} still "
+                    "recorded): snapshot taken too long after the rows were "
+                    "buffered (log capped at "
+                    f"{self.MAX_LOG_ENTRIES} entries); checkpoint earlier "
+                    "or raise MAX_LOG_ENTRIES")
             return counts

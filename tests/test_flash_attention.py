@@ -165,3 +165,88 @@ def test_jit_composes():
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(attention_reference(q, k, v)),
                                rtol=1e-5, atol=1e-5)
+
+
+# --- hand-tiled flash backward (round 4) ----------------------------------
+
+def _ref_grads(q, k, v, causal=False):
+    from petastorm_tpu.ops.flash_attention import _attention_reference
+    return jax.grad(lambda a, b, c: jnp.sum(
+        _attention_reference(a, b, c, causal=causal).astype(jnp.float32)
+        ** 2), argnums=(0, 1, 2))(q, k, v)
+
+
+def _flash_grads(q, k, v, bq, bk, causal=False, bwd_impl="flash"):
+    return jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, bq, bk, None, causal, bwd_impl) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+
+
+def test_flash_bwd_ragged_lengths():
+    q, k, v = _qkv(t=50, d=8, seed=21)  # t does not divide the block
+    for a, b in zip(_flash_grads(q, k, v, 16, 16), _ref_grads(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_causal_ragged():
+    q, k, v = _qkv(t=50, d=8, seed=22)
+    for a, b in zip(_flash_grads(q, k, v, 16, 16, causal=True),
+                    _ref_grads(q, k, v, causal=True)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_causal_cross_lengths():
+    rng = np.random.RandomState(23)
+    q = jnp.asarray(rng.randn(2, 24, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 40, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 40, 2, 8).astype(np.float32))
+    for a, b in zip(_flash_grads(q, k, v, 8, 16, causal=True),
+                    _ref_grads(q, k, v, causal=True)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_causal_more_queries_than_keys():
+    rng = np.random.RandomState(24)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    flash = _flash_grads(q, k, v, 8, 8, causal=True)
+    for g in flash:
+        assert np.isfinite(np.asarray(g)).all()
+    for a, b in zip(flash, _ref_grads(q, k, v, causal=True)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_bfloat16():
+    q, k, v = _qkv(t=32, d=8, seed=25)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    flash = _flash_grads(qb, kb, vb, 16, 16)
+    ref = _ref_grads(q, k, v)
+    for a, b in zip(flash, ref):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=6e-2, atol=6e-2)
+
+
+def test_flash_bwd_reference_oracle_path():
+    q, k, v = _qkv(t=48, d=8, seed=26)
+    flash = _flash_grads(q, k, v, 16, 16, causal=True, bwd_impl="flash")
+    oracle = _flash_grads(q, k, v, 16, 16, causal=True,
+                          bwd_impl="reference")
+    for a, b in zip(flash, oracle):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_under_jit():
+    q, k, v = _qkv(t=32, d=8, seed=27)
+    f = jax.jit(lambda a, b, c: jax.grad(
+        lambda x, y, z: jnp.sum(flash_attention(x, y, z, 16, 16) ** 2),
+        argnums=(0, 1, 2))(a, b, c))
+    for a, b in zip(f(q, k, v), _ref_grads(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
