@@ -26,43 +26,131 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def attention_reference(q, k, v):
+def attention_reference(q, k, v, causal=False, lengths=None):
     """Plain (unsharded) scaled-dot-product attention — numerics oracle for
-    the ring version. Shapes: [B, T, H, Dh]."""
+    the ring version. Shapes: [B, T, H, Dh].
+
+    ``causal``: mask keys after each query's position (decoder style).
+    ``lengths``: optional per-example valid key counts [B] — keys at or past
+    ``lengths[b]`` are masked out (NGram windows shorter than T).
+    """
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    t_q, t_kv = q.shape[1], k.shape[1]
+    neg_inf = jnp.array(-jnp.inf, scores.dtype)
+    mask = None
+    if causal:
+        row = jnp.arange(t_q)[:, None] + (t_kv - t_q)  # last-aligned
+        mask = (jnp.arange(t_kv)[None, :] <= row)[None, None]  # [1,1,Tq,Tkv]
+    if lengths is not None:
+        valid = (jnp.arange(t_kv)[None, :]
+                 < lengths[:, None])[:, None, None, :]         # [B,1,1,Tkv]
+        mask = valid if mask is None else mask & valid
+    row_valid = None
+    if mask is not None:
+        # Rows with no valid key (lengths[b] == 0, or causal cross-length
+        # suffix alignment) must yield ZERO output nan-free in forward AND
+        # vjp — same guard as the flash kernel's oracle: substitute finite
+        # scores, then zero the probabilities.
+        row_valid = mask.any(axis=-1, keepdims=True)
+        scores = jnp.where(mask, scores, neg_inf)
+        scores = jnp.where(row_valid, scores, 0.0)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if row_valid is not None:
+        probs = jnp.where(row_valid, probs, 0.0)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 
 
-def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None):
+def _stripe(x, sp):
+    """Permute the T axis of [B, T, ...] so that a contiguous shard r over
+    the permuted axis holds the STRIDED positions r, r+sp, r+2·sp, … of the
+    original sequence (striped placement for balanced causal ring)."""
+    b, t = x.shape[:2]
+    return (x.reshape((b, t // sp, sp) + x.shape[2:])
+            .swapaxes(1, 2).reshape(x.shape))
+
+
+def _unstripe(x, sp):
+    b, t = x.shape[:2]
+    return (x.reshape((b, sp, t // sp) + x.shape[2:])
+            .swapaxes(1, 2).reshape(x.shape))
+
+
+def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
+                         causal=False, placement="contiguous"):
     """Per-shard ring attention body (runs inside shard_map).
 
     ``q, k, v``: the local sequence slice, [B, L, H, Dh] with L = T/sp.
     K/V blocks rotate ``axis_size`` times around ``axis_name``; an online
     softmax (running max + running sum, f32) makes the result exactly equal
     to attention over the full sequence.
+
+    ``causal``: at ring step ``i`` the resident K/V block originated on
+    device ``src = (r - i) mod sp``, so global key positions are known and
+    the causal mask is applied per block. Placement decides who owns which
+    positions:
+
+    - ``"contiguous"``: device r owns positions [r·L, (r+1)·L). Blocks with
+      src > r are fully future — their matmuls are skipped via ``lax.cond``
+      — but the ppermute barrier makes each ring step as slow as its
+      busiest device, so the skip saves energy/MXU slots, not wall-clock
+      (device sp-1 computes sp blocks, device 0 computes 1).
+    - ``"striped"``: device r owns positions r, r+sp, r+2·sp, … (use the
+      :func:`ring_attention` wrapper, which pre/post-permutes). Every block
+      on every device is then ~half-causal-valid — perfectly balanced; no
+      block is skippable but no device idles.
     """
     b, l, h, dh = q.shape
     scale = 1.0 / jnp.sqrt(jnp.array(dh, jnp.float32))
     qf = q.astype(jnp.float32)
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    r = jax.lax.axis_index(axis_name)
+    row_ids = jnp.arange(l)
 
-    def body(_, carry):
-        k_cur, v_cur, acc, row_max, row_sum = carry
+    def block_update(k_cur, v_cur, acc, row_max, row_sum, src):
         scores = jnp.einsum("blhd,bmhd->bhlm", qf,
                             k_cur.astype(jnp.float32)) * scale
+        if causal:
+            if placement == "striped":
+                # global position of local index j on device d is d + sp·j
+                q_pos = r + axis_size * row_ids
+                k_pos = src + axis_size * row_ids
+            else:
+                q_pos = r * l + row_ids
+                k_pos = src * l + row_ids
+            mask = k_pos[None, :] <= q_pos[:, None]            # [L, L]
+            scores = jnp.where(mask, scores, -jnp.inf)
         blk_max = scores.max(axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[..., None])
+        # A block can be fully masked for some rows (causal): keep the raw
+        # -inf running max but exponentiate against a finite substitute so
+        # no (-inf) - (-inf) nan appears; those rows contribute zeros.
+        safe_max = jnp.where(jnp.isneginf(new_max), 0.0, new_max)
+        correction = jnp.where(jnp.isneginf(row_max), 0.0,
+                               jnp.exp(row_max - safe_max))
+        probs = jnp.exp(scores - safe_max[..., None])
         acc = acc * correction[..., None] + jnp.einsum(
             "bhlm,bmhd->bhld", probs, v_cur.astype(jnp.float32))
         row_sum = row_sum * correction + probs.sum(axis=-1)
+        return acc, new_max, row_sum
+
+    def body(i, carry):
+        k_cur, v_cur, acc, row_max, row_sum = carry
+        src = (r - i) % axis_size
+        if causal and placement == "contiguous":
+            # Fully-future block for this device: skip both matmuls.
+            acc, row_max, row_sum = jax.lax.cond(
+                src > r,
+                lambda *args: args[2:],
+                lambda *args: block_update(*args, src=src),
+                k_cur, v_cur, acc, row_max, row_sum)
+        else:
+            acc, row_max, row_sum = block_update(k_cur, v_cur, acc, row_max,
+                                                 row_sum, src=src)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, acc, new_max, row_sum
+        return k_nxt, v_nxt, acc, row_max, row_sum
 
     # The softmax stats start as constants but the loop body mixes them with
     # the (sequence-varying) K/V blocks; mark them varying over the ring axis
@@ -79,41 +167,70 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None):
             varying(jnp.full((b, h, l), -jnp.inf, jnp.float32)),
             varying(jnp.zeros((b, h, l), jnp.float32)))
     _, _, acc, _, row_sum = jax.lax.fori_loop(0, axis_size, body, init)
-    out = acc / row_sum[..., None]
+    out = acc / jnp.maximum(row_sum, 1e-30)[..., None]
     return jnp.einsum("bhld->blhd", out).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
+def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
+                   causal=False, placement="striped"):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Inputs are global ``[B, T, H, Dh]`` arrays (sharded or shardable on T);
     output matches :func:`attention_reference` up to float tolerance.
     ``batch_axis``: mesh axis the batch dim is sharded over (data parallel),
     so shard_map doesn't force a reshard at the boundary.
+
+    ``causal``: decoder-style masking. ``placement`` (causal only) picks the
+    position→device layout: ``"striped"`` (default) pre-permutes so every
+    device does equal causal work per ring step; ``"contiguous"`` keeps the
+    natural layout and skips fully-future blocks (imbalanced — see
+    :func:`ring_attention_block`). Output always returns in natural order.
     """
     from jax import shard_map
+
+    sp = mesh.shape[axis_name]
+    if causal and q.shape[1] != k.shape[1]:
+        # Both placements derive key positions from q's local length, and
+        # contiguous's full-skip condition assumes the same partitioning.
+        raise ValueError(
+            "causal ring attention requires T_q == T_kv "
+            f"(got {q.shape[1]} vs {k.shape[1]})")
+    striped = causal and placement == "striped"
+    if striped:
+        q, k, v = _stripe(q, sp), _stripe(k, sp), _stripe(v, sp)
 
     spec = P(batch_axis, axis_name, None, None)
     varying_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
     sharded = shard_map(
         functools.partial(ring_attention_block, axis_name=axis_name,
-                          axis_size=mesh.shape[axis_name],
-                          varying_axes=varying_axes),
+                          axis_size=sp, varying_axes=varying_axes,
+                          causal=causal, placement=placement),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return sharded(q, k, v)
+    out = sharded(q, k, v)
+    return _unstripe(out, sp) if striped else out
 
 
-def ulysses_attention_block(q, k, v, axis_name, axis_size):
+# Full-sequence length at/above which the Ulysses local attention switches
+# from dense (one [T, T] block) to the Pallas flash kernel (O(block²)).
+ULYSSES_FLASH_THRESHOLD = 1024
+
+
+def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
+                            local_attn="auto"):
     """Per-shard Ulysses (all-to-all) attention body (runs inside shard_map).
 
     Input: the local sequence slice ``[B, L, H, Dh]`` with ``L = T/sp``.
     The DeepSpeed-Ulysses recipe, JAX-style: an all-to-all reshards from
     sequence-sharded/head-replicated to head-sharded/sequence-complete, each
-    device runs DENSE attention over the full sequence for its ``H/sp``
-    heads, and a reverse all-to-all restores sequence sharding. Two
-    all-to-alls per attention vs the ring's ``sp`` permutes — better when
-    heads divide evenly and the full-sequence [T, T] block fits (pair with
-    the Pallas flash kernel for the local attention when it doesn't).
+    device runs attention over the full sequence for its ``H/sp`` heads,
+    and a reverse all-to-all restores sequence sharding. Two all-to-alls
+    per attention vs the ring's ``sp`` permutes.
+
+    ``local_attn`` picks the per-head-group attention: ``"dense"`` (one
+    [T, T] block), ``"flash"`` (the Pallas tiled kernel — no [T, T] buffer,
+    the point of Ulysses at long T), or ``"auto"`` (flash once the full
+    sequence reaches ``ULYSSES_FLASH_THRESHOLD``, dense below — short
+    sequences fit comfortably and dodge the kernel's fixed overhead).
     """
     b, l, h, dh = q.shape
     if h % axis_size:
@@ -132,11 +249,36 @@ def ulysses_attention_block(q, k, v, axis_name, axis_size):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    out = attention_reference(to_heads(q), to_heads(k), to_heads(v))
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    local_attn = _resolve_ulysses_local(l * axis_size, local_attn)
+    if local_attn == "flash":
+        from petastorm_tpu.ops import flash_attention
+
+        block = min(128, l * axis_size)
+        out = flash_attention(qh, kh, vh, block_q=block, block_k=block,
+                              causal=causal)
+    else:
+        out = attention_reference(qh, kh, vh, causal=causal)
     return to_sequence(out)
 
 
-def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
+def _resolve_ulysses_local(t_full, local_attn):
+    """Resolve ``local_attn`` ("auto" by T threshold; "flash" falls back to
+    dense below the TPU min sublane tile, where the kernel's (block, 128)
+    scratch would not tile for Mosaic)."""
+    if local_attn == "auto":
+        local_attn = ("flash" if t_full >= ULYSSES_FLASH_THRESHOLD
+                      else "dense")
+    if local_attn not in ("dense", "flash"):
+        raise ValueError(f"local_attn {local_attn!r} is not 'auto', "
+                         "'dense', or 'flash'")
+    if local_attn == "flash" and t_full < 8:
+        local_attn = "dense"
+    return local_attn
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
+                      causal=False, local_attn="auto"):
     """All-to-all sequence-parallel attention over ``mesh[axis_name]``.
 
     Same contract as :func:`ring_attention` (global ``[B, T, H, Dh]`` in,
@@ -144,14 +286,23 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None):
     by the axis size. The two collectives ride ICI like the ring's permutes
     — pick by workload: Ulysses moves ``O(T·Dh·H/sp)`` twice, the ring moves
     K/V ``sp`` times but never needs the full sequence on one device.
+    ``causal`` masks decoder-style; ``local_attn`` as in
+    :func:`ulysses_attention_block` (``"flash"``/long-T ``"auto"`` keeps the
+    per-head-group attention free of [T, T] buffers too).
     """
     from jax import shard_map
 
+    local_attn = _resolve_ulysses_local(q.shape[1], local_attn)
     spec = P(batch_axis, axis_name, None, None)
     sharded = shard_map(
         functools.partial(ulysses_attention_block, axis_name=axis_name,
-                          axis_size=mesh.shape[axis_name]),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          axis_size=mesh.shape[axis_name], causal=causal,
+                          local_attn=local_attn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes annotation, which
+        # the vma checker rejects — opt out only when the flash kernel
+        # actually runs, keeping the check live for the dense path.
+        check_vma=local_attn != "flash")
     return sharded(q, k, v)
 
 
@@ -186,7 +337,8 @@ def seq_param_partition_specs():
 
 
 def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
-                    compute_dtype=jnp.bfloat16, attn_impl="dense"):
+                    compute_dtype=jnp.bfloat16, attn_impl="dense",
+                    causal=False, lengths=None):
     """``windows``: [B, T, F] float (NGram windows collated to a time axis).
 
     With ``mesh``: sequence-parallel attention over ``mesh[attn_axis]`` (T
@@ -198,6 +350,13 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     ``"flash"`` (the Pallas tiled kernel,
     ``petastorm_tpu.ops.flash_attention`` — O(block²) memory, the TPU
     choice for long windows). Returns f32 logits [B, num_classes].
+
+    ``causal``: decoder-style attention masking (all impls, incl. the
+    sequence-parallel ones). ``lengths``: per-example valid timestep counts
+    [B] int — positions at/after ``lengths[b]`` neither attend nor are
+    attended to nor pooled, so a ragged window padded to T produces exactly
+    the logits of its unpadded self (supported on the single-shard impls;
+    the sequence-parallel impls reject it for now).
     """
     h = num_heads
     x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
@@ -216,56 +375,73 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
             raise ValueError(
                 f"attn_impl {attn_impl!r} is not a sequence-parallel "
                 f"implementation; with a mesh use 'ring' or 'ulysses'")
+        if lengths is not None:
+            raise NotImplementedError(
+                "per-example lengths with sequence-parallel attention is "
+                "not supported yet; use the single-shard impls")
         batch_axis = "data" if "data" in mesh.axis_names else None
         parallel_attn = (ulysses_attention if attn_impl == "ulysses"
                          else ring_attention)
         attn = parallel_attn(q, k, v, mesh, attn_axis,
-                             batch_axis=batch_axis)
+                             batch_axis=batch_axis, causal=causal)
     elif attn_impl == "ring":
         # Symmetric remap: "ring" is the mesh-side default (the train-step
         # factory passes it unconditionally); without a mesh it means plain
         # dense attention on the single shard.
-        attn = attention_reference(q, k, v)
+        attn = attention_reference(q, k, v, causal=causal, lengths=lengths)
     elif attn_impl == "flash":
         from petastorm_tpu.ops import flash_attention
 
         if t < 8:
             # Below the TPU min sublane tile the kernel's (block, 128)
             # scratch would not tile for Mosaic; dense is cheaper anyway.
-            attn = attention_reference(q, k, v)
+            attn = attention_reference(q, k, v, causal=causal,
+                                       lengths=lengths)
         else:
             block = min(128, t)
-            attn = flash_attention(q, k, v, block_q=block, block_k=block)
+            attn = flash_attention(q, k, v, block_q=block, block_k=block,
+                                   causal=causal, kv_lengths=lengths)
     elif attn_impl == "dense":
-        attn = attention_reference(q, k, v)
+        attn = attention_reference(q, k, v, causal=causal, lengths=lengths)
     else:
         raise ValueError(
             f"attn_impl {attn_impl!r} is not valid without a mesh "
             f"('ulysses' needs one); use 'dense', 'ring', or 'flash'")
     attn = attn.reshape(b, t, d) @ params["wo"].astype(compute_dtype)
-    pooled = attn.mean(axis=1)
+    if lengths is None:
+        pooled = attn.mean(axis=1)
+    else:
+        # Masked mean over the valid prefix: padded positions contribute
+        # exact zeros to the sum, so logits for a padded batch are
+        # bit-identical to the unpadded batch's.
+        valid = (jnp.arange(t)[None, :] < lengths[:, None])
+        pooled = ((attn * valid[..., None].astype(attn.dtype)).sum(axis=1)
+                  / jnp.maximum(lengths[:, None], 1).astype(attn.dtype))
     logits = pooled @ params["cls"].astype(compute_dtype)
     return logits.astype(jnp.float32)
 
 
 def make_seq_train_step(learning_rate=0.05, num_heads=4, mesh=None,
-                        attn_axis="sp", attn_impl="ring"):
-    """``step(params, windows, labels, mask) -> (params, loss)`` — masked
-    cross-entropy + SGD, sequence-parallel attention (ring or ulysses) when
-    a mesh is given. The returned step is jittable as-is (all statics are
-    closed over)."""
-    def loss_fn(params, windows, labels, mask):
+                        attn_axis="sp", attn_impl="ring", causal=False):
+    """``step(params, windows, labels, mask[, lengths]) -> (params, loss)``
+    — masked cross-entropy + SGD, sequence-parallel attention (ring or
+    ulysses) when a mesh is given, decoder-style masking with ``causal``.
+    ``lengths`` (optional, [B] int): per-example valid timesteps — attention
+    and pooling ignore the padded tail. The returned step is jittable as-is
+    (all statics are closed over)."""
+    def loss_fn(params, windows, labels, mask, lengths):
         logits = apply_seq_model(params, windows, num_heads=num_heads,
                                  mesh=mesh, attn_axis=attn_axis,
-                                 attn_impl=attn_impl)
+                                 attn_impl=attn_impl, causal=causal,
+                                 lengths=lengths)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         nll = jnp.where(mask, nll, 0.0)
         return nll.sum() / jnp.maximum(mask.sum(), 1).astype(jnp.float32)
 
-    def step(params, windows, labels, mask):
+    def step(params, windows, labels, mask, lengths=None):
         loss, grads = jax.value_and_grad(loss_fn)(params, windows, labels,
-                                                  mask)
+                                                  mask, lengths)
         new_params = jax.tree_util.tree_map(
             lambda p, g: (p - learning_rate * g).astype(p.dtype),
             params, grads)

@@ -77,10 +77,31 @@ def _dot_precision(dtype):
             else jax.lax.Precision.DEFAULT)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, block_q,
-                  block_k, kv_len, causal_offset, emit_lse, precision):
+def _kv_limit(lens_ref, kv_len):
+    """Effective key-count bound for the column mask: the static padded-KV
+    bound, or — with per-example lengths — this batch·head's dynamic count
+    read as an SMEM scalar (broadcasts against the (block_q, block_k) ids
+    exactly like the static int)."""
+    if lens_ref is None:
+        return kv_len
     from jax.experimental import pallas as pl
 
+    return jnp.minimum(lens_ref[pl.program_id(0)], kv_len)
+
+
+def _flash_kernel(*refs, sm_scale, block_q, block_k, kv_len, causal_offset,
+                  emit_lse, has_lens, precision):
+    from jax.experimental import pallas as pl
+
+    if has_lens:
+        q_ref, k_ref, v_ref, lens_ref = refs[:4]
+        rest = refs[4:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        lens_ref = None
+        rest = refs[3:]
+    o_ref = rest[0]
+    rest = rest[1:]
     if emit_lse:
         lse_ref, m_scratch, l_scratch, acc_scratch = rest
     else:
@@ -90,6 +111,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, block_q,
     qb = pl.program_id(1)
     kb = pl.program_id(2)
     last_kb = pl.num_programs(2) - 1
+    # Read outside the pl.when wrapper: program_id inside a when-body does
+    # not lower in interpret mode.
+    kv_limit = _kv_limit(lens_ref, kv_len)
 
     @pl.when(kb == 0)
     def _init():
@@ -103,7 +127,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale, block_q,
         v = v_ref[0].astype(jnp.float32)
 
         s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
-                           block_k=block_k, kv_len=kv_len,
+                           block_k=block_k, kv_len=kv_limit,
                            causal_offset=causal_offset,
                            precision=precision)
 
@@ -169,8 +193,24 @@ def _pad_t(x, block):
     return x
 
 
+def _lens_to_bh(kv_lengths, b, h):
+    """[B] int lengths → per-batch·head [B·H] int32 (bh index is
+    batch-major, matching :func:`_to_bh`); consumed as SMEM scalars."""
+    return jnp.repeat(kv_lengths.astype(jnp.int32), h)
+
+
+def _lens_spec(pl, pltpu, n_bh):
+    # The whole [B·H] vector in SMEM every step (rank-1 blocks must be the
+    # full array); the kernel indexes it with program_id(0). A scalar read
+    # broadcasts natively in the comparison against the id tiles (a VMEM
+    # (1, 1) tile would need a both-axes broadcast Mosaic doesn't
+    # implement).
+    return pl.BlockSpec((n_bh,), lambda bh, i, j: (0,),
+                        memory_space=pltpu.SMEM)
+
+
 def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
-                   return_residuals=False):
+                   return_residuals=False, kv_lengths=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -194,6 +234,7 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
         # Align the LAST query with the LAST key (suffix-query convention).
         causal_offset=causal_offset,
         emit_lse=return_residuals,
+        has_lens=kv_lengths is not None,
         precision=_dot_precision(orig_dtype),
     )
     if causal_offset is None:
@@ -219,17 +260,23 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
                      pl.BlockSpec((1, block_q, _LANES), q_index,
                                   memory_space=pltpu.VMEM))
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), kv_index,
+                     memory_space=pltpu.VMEM),
+    ]
+    inputs = [qf, kf, vf]
+    if kv_lengths is not None:
+        in_specs.append(_lens_spec(pl, pltpu, b * h))
+        inputs.append(_lens_to_bh(kv_lengths, b, h))
+
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), kv_index,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -238,7 +285,7 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
             pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
 
     if return_residuals:
         out_padded, lse = out
@@ -266,15 +313,22 @@ def _masked_scores(q, k, kb, qb, *, sm_scale, block_q, block_k, kv_len,
     return s
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-                         dq_acc, *, sm_scale, block_q, block_k, kv_len,
-                         causal_offset, precision):
+def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
+                         causal_offset, has_lens, precision):
     """dQ sweep: grid (B·H, Tq/block_q, Tk/block_k) — K blocks iterate
     innermost, dq accumulates in VMEM scratch. Per tile:
     p = exp(s - lse); ds = p·(do·vᵀ - Δ)·scale; dq += ds·k, with
     Δ = rowsum(do ∘ o) recomputed from the residuals (O(block·d), cheaper
     than staging a third stats tensor)."""
     from jax.experimental import pallas as pl
+
+    if has_lens:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_acc = refs
+        lens_ref = None
+    kv_len = _kv_limit(lens_ref, kv_len)
 
     qb = pl.program_id(1)
     kb = pl.program_id(2)
@@ -319,14 +373,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale,
-                          block_q, block_k, kv_len, causal_offset,
-                          precision):
+def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
+                          causal_offset, has_lens, precision):
     """dK/dV sweep: grid (B·H, Tk/block_k, Tq/block_q) — Q blocks iterate
     innermost, dk/dv accumulate in VMEM scratch. Per tile:
     dv += pᵀ·do; dk += dsᵀ·q (same recomputed p/ds as the dQ sweep)."""
     from jax.experimental import pallas as pl
+
+    if has_lens:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        lens_ref = None
+    kv_len = _kv_limit(lens_ref, kv_len)
 
     kb = pl.program_id(1)
     qb = pl.program_id(2)
@@ -377,7 +438,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
-                    causal):
+                    causal, kv_lengths=None):
     """Flash-2 backward: two pallas sweeps, O(block²) VMEM, no [T, T]
     buffer. ``o_padded``/``lse`` are [B·H, Tq_padded(, )] residuals from the
     forward; q/k/v are the user-shaped [B, T, H, D] primals."""
@@ -399,9 +460,15 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
     # scratch used (a [block_q]-vector would not tile).
     lse_b = jnp.broadcast_to(lse[:, :, None], (b * h, tq_p, _LANES))
 
+    lens_inputs, lens_specs = [], []
+    if kv_lengths is not None:
+        lens_inputs = [_lens_to_bh(kv_lengths, b, h)]
+        lens_specs = [_lens_spec(pl, pltpu, b * h)]
+
     causal_offset = (t_kv - t_q) if causal else None
     common = dict(sm_scale=1.0 / float(d) ** 0.5, block_q=block_q,
                   block_k=block_k, kv_len=t_kv, causal_offset=causal_offset,
+                  has_lens=kv_lengths is not None,
                   precision=_dot_precision(q.dtype))
 
     q_spec = lambda ix: pl.BlockSpec((1, block_q, d), ix,  # noqa: E731
@@ -431,12 +498,12 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             q_spec(dq_q_index),                      # o
             pl.BlockSpec((1, block_q, _LANES), dq_q_index,
                          memory_space=pltpu.VMEM),   # lse
-        ],
+        ] + lens_specs,
         out_specs=q_spec(dq_q_index),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs)
 
     # --- dK/dV sweep: (bh, kb, qb), Q innermost -----------------------------
     dkv_kv_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
@@ -461,14 +528,14 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             q_spec(dkv_q_index),                     # o
             pl.BlockSpec((1, block_q, _LANES), dkv_q_index,
                          memory_space=pltpu.VMEM),   # lse
-        ],
+        ] + lens_specs,
         out_specs=(kv_spec(dkv_kv_index), kv_spec(dkv_kv_index)),
         out_shape=(jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs)
 
     dq = _from_bh(dq[:, :t_q], b, h)
     dk = _from_bh(dk[:, :t_kv], b, h)
@@ -481,9 +548,8 @@ def _should_interpret():
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
-                    causal=False, bwd_impl="flash"):
+                    causal=False, bwd_impl="flash", kv_lengths=None):
     """Tiled attention over ``[B, T, H, D]`` tensors; matches
     ``attention_reference`` numerics (f32 softmax) without materializing the
     ``[T, T]`` score matrix — in the forward OR the backward.
@@ -498,11 +564,17 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
         O(block²) memory) or ``"reference"`` (XLA autodiff through the dense
         oracle — materializes [T, T] in the backward; kept for debugging and
         as the numerics oracle).
+    :param kv_lengths: optional per-example valid key counts [B] (int) —
+        keys at or past ``kv_lengths[b]`` are masked out for example ``b``
+        (ragged NGram windows padded to a common T). With ``causal``, the
+        causal alignment still uses the STATIC T_q/T_kv shapes.
     """
     _check_bwd_impl(bwd_impl)
-    if interpret is None:
-        interpret = _should_interpret()
-    return _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+    if kv_lengths is None:
+        return _flash_static(q, k, v, block_q, block_k, interpret, causal,
+                             bwd_impl)
+    return _flash_lens(q, k, v, kv_lengths, block_q, block_k, interpret,
+                       causal, bwd_impl)
 
 
 def _check_bwd_impl(bwd_impl):
@@ -511,15 +583,24 @@ def _check_bwd_impl(bwd_impl):
             f"bwd_impl {bwd_impl!r} is not 'flash' or 'reference'")
 
 
-def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
-    _check_bwd_impl(bwd_impl)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_static(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
+    if interpret is None:
+        interpret = _should_interpret()
+    return _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+
+
+def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl,
+         kv_lengths=None):
     if interpret is None:
         interpret = _should_interpret()
     if bwd_impl == "reference":
-        out = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+        out = _flash_forward(q, k, v, block_q, block_k, interpret, causal,
+                             kv_lengths=kv_lengths)
         return out, (q, k, v, None, None)
     out_padded, lse = _flash_forward(q, k, v, block_q, block_k, interpret,
-                                     causal, return_residuals=True)
+                                     causal, return_residuals=True,
+                                     kv_lengths=kv_lengths)
     b, t_q, h, _ = q.shape
     out = _from_bh(out_padded[:, :t_q], b, h)
     # o is saved PADDED in [B·H, T, D] form: the backward consumes it block
@@ -527,7 +608,8 @@ def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
     return out, (q, k, v, out_padded, lse)
 
 
-def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
+def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g,
+         kv_lengths=None):
     if interpret is None:
         interpret = _should_interpret()
     q, k, v, o_padded, lse = residuals
@@ -538,7 +620,50 @@ def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
             functools.partial(_attention_reference, causal=causal), q, k, v)
         return vjp(g)
     return _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k,
-                           interpret, causal)
+                           interpret, causal, kv_lengths=kv_lengths)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+def _static_fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
+    return _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl)
+
+
+def _static_bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
+    return _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g)
+
+
+_flash_static.defvjp(_static_fwd, _static_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_lens(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
+                bwd_impl):
+    if interpret is None:
+        interpret = _should_interpret()
+    return _flash_forward(q, k, v, block_q, block_k, interpret, causal,
+                          kv_lengths=kv_lengths)
+
+
+def _lens_fwd(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
+              bwd_impl):
+    if bwd_impl == "reference":
+        raise NotImplementedError(
+            "bwd_impl='reference' does not support kv_lengths; the dense "
+            "oracle for lengths lives in "
+            "models.sequence_model.attention_reference")
+    out, residuals = _fwd(q, k, v, block_q, block_k, interpret, causal,
+                          bwd_impl, kv_lengths=kv_lengths)
+    return out, residuals + (kv_lengths,)
+
+
+def _lens_bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
+    kv_lengths = residuals[-1]
+    dq, dk, dv = _bwd(block_q, block_k, interpret, causal, bwd_impl,
+                      residuals[:-1], g, kv_lengths=kv_lengths)
+    # Integer lengths carry no gradient: the float0 zero cotangent.
+    import numpy as np
+
+    dlens = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash_lens.defvjp(_lens_fwd, _lens_bwd)

@@ -196,3 +196,205 @@ def test_ngram_windows_feed_sequence_model(petastorm_dataset):
                              compute_dtype=jnp.float32)
     assert logits.shape == (windows.shape[0], 10)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+# --- causal sequence parallelism (round 4) --------------------------------
+
+def test_causal_ring_striped_matches_reference():
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(10)
+    q, k, v = (jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+               for _ in range(3))
+    expected = attention_reference(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, "sp", causal=True,
+                         placement="striped")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+    # causal must differ from bidirectional (mask sanity)
+    full = ring_attention(q, k, v, mesh, "sp")
+    assert not np.allclose(np.asarray(got), np.asarray(full))
+
+
+def test_causal_ring_contiguous_matches_reference():
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(11)
+    q, k, v = (jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+               for _ in range(3))
+    expected = attention_reference(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, "sp", causal=True,
+                         placement="contiguous")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_causal_ring_jitted_on_data_sp_mesh():
+    mesh = _mesh((2, 4), ("data", "sp"))
+    spec = NamedSharding(mesh, P("data", "sp", None, None))
+    rng = np.random.RandomState(12)
+    arrs = [jax.device_put(rng.randn(2, 32, 2, 8).astype(np.float32), spec)
+            for _ in range(3)]
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, "sp", batch_axis="data", causal=True))(*arrs)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attention_reference(*arrs, causal=True)),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_causal_ulysses_matches_reference():
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(13)
+    q, k, v = (jnp.asarray(rng.randn(2, 32, 8, 16).astype(np.float32))
+               for _ in range(3))
+    got = ulysses_attention(q, k, v, mesh, "sp", causal=True)
+    expected = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_flash_local_attention_matches():
+    """Forcing the flash local attention (below the auto threshold) must
+    match dense — the long-T path with no [T, T] buffer, causal and not."""
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((4,), ("sp",))
+    rng = np.random.RandomState(14)
+    q, k, v = (jnp.asarray(rng.randn(1, 64, 4, 8).astype(np.float32))
+               for _ in range(3))
+    for causal in (False, True):
+        flash = ulysses_attention(q, k, v, mesh, "sp", causal=causal,
+                                  local_attn="flash")
+        dense = ulysses_attention(q, k, v, mesh, "sp", causal=causal,
+                                  local_attn="dense")
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(flash),
+            np.asarray(attention_reference(q, k, v, causal=causal)),
+            rtol=2e-4, atol=2e-4)
+
+
+def test_causal_seq_train_step_descends():
+    mesh = _mesh((2, 4), ("data", "sp"))
+    params = init_seq_params(jax.random.PRNGKey(0), feature_dim=5,
+                             d_model=16, num_heads=4, num_classes=3)
+    for attn_impl in ("ring", "ulysses"):
+        step = jax.jit(make_seq_train_step(0.1, num_heads=4, mesh=mesh,
+                                           attn_impl=attn_impl, causal=True))
+        windows = jax.device_put(
+            np.random.RandomState(3).randn(4, 8, 5).astype(np.float32),
+            NamedSharding(mesh, P("data", "sp", None)))
+        labels = jax.device_put(np.array([0, 1, 2, 1], np.int32),
+                                NamedSharding(mesh, P("data")))
+        mask = jax.device_put(np.ones(4, bool),
+                              NamedSharding(mesh, P("data")))
+        p, losses = dict(params), []
+        for _ in range(4):
+            p, loss = step(p, windows, labels, mask)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], (attn_impl, losses)
+
+
+def test_striped_causal_requires_equal_lengths():
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(15)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="T_q == T_kv"):
+        ring_attention(q, k, k, mesh, "sp", causal=True, placement="striped")
+
+
+# --- per-example length masking (round 4) ---------------------------------
+
+def _padded_vs_unpadded(attn_impl, t_full=24, t_real=16):
+    params = init_seq_params(jax.random.PRNGKey(2), feature_dim=6,
+                             d_model=32, num_heads=4, max_len=64)
+    rng = np.random.RandomState(20)
+    real = rng.randn(3, t_real, 6).astype(np.float32)
+    padded = np.concatenate(
+        [real, np.full((3, t_full - t_real, 6), 7.7, np.float32)], axis=1)
+    unpadded_logits = apply_seq_model(
+        params, jnp.asarray(real), num_heads=4, compute_dtype=jnp.float32,
+        attn_impl=attn_impl)
+    padded_logits = apply_seq_model(
+        params, jnp.asarray(padded), num_heads=4, compute_dtype=jnp.float32,
+        attn_impl=attn_impl, lengths=jnp.full(3, t_real, jnp.int32))
+    return np.asarray(unpadded_logits), np.asarray(padded_logits)
+
+
+def test_lengths_dense_padded_logits_match_unpadded():
+    # Ulp-level, not bitwise: XLA's reduction tree (softmax denominator,
+    # einsum contraction) associates differently for T=24 than T=16, so the
+    # zero-contribution terms shift rounding by ~1e-7. Exact invariance at
+    # EQUAL shapes is covered by
+    # test_lengths_train_step_gradients_ignore_padding.
+    unpadded, padded = _padded_vs_unpadded("dense")
+    np.testing.assert_allclose(padded, unpadded, rtol=1e-6, atol=1e-6)
+
+
+def test_lengths_flash_padded_logits_match_unpadded():
+    unpadded, padded = _padded_vs_unpadded("flash")
+    np.testing.assert_allclose(padded, unpadded, rtol=1e-5, atol=1e-6)
+
+
+def test_lengths_train_step_gradients_ignore_padding():
+    """Gradients must not depend on values in the padded tail."""
+    step = make_seq_train_step(0.05, num_heads=2)
+    params = init_seq_params(jax.random.PRNGKey(4), feature_dim=4,
+                             d_model=16, num_heads=2, num_classes=3)
+    rng = np.random.RandomState(21)
+    w1 = rng.randn(2, 12, 4).astype(np.float32)
+    w2 = w1.copy()
+    w2[:, 8:, :] = 123.0  # different garbage in the padded tail
+    lengths = jnp.full(2, 8, jnp.int32)
+    labels, mask = jnp.zeros(2, jnp.int32), jnp.ones(2, bool)
+    p1, l1 = step(params, jnp.asarray(w1), labels, mask, lengths)
+    p2, l2 = step(params, jnp.asarray(w2), labels, mask, lengths)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero_length_example_is_nan_free_in_grads():
+    """A fully-padded example (lengths[b]=0, mask[b]=False) must not poison
+    the other examples' gradients with NaN."""
+    step = make_seq_train_step(0.05, num_heads=2)
+    params = init_seq_params(jax.random.PRNGKey(5), feature_dim=4,
+                             d_model=16, num_heads=2, num_classes=3)
+    windows = jnp.asarray(np.random.RandomState(22)
+                          .randn(3, 8, 4).astype(np.float32))
+    lengths = jnp.asarray([8, 0, 5], jnp.int32)
+    mask = jnp.asarray([True, False, True])
+    new_params, loss = step(params, windows, jnp.zeros(3, jnp.int32), mask,
+                            lengths)
+    assert np.isfinite(float(loss))
+    for leaf in jax.tree_util.tree_leaves(new_params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_causal_ring_rejects_cross_lengths_any_placement():
+    mesh = _mesh((4,), ("sp",))
+    rng = np.random.RandomState(16)
+    q = jnp.asarray(rng.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 32, 2, 8).astype(np.float32))
+    for placement in ("striped", "contiguous"):
+        with pytest.raises(ValueError, match="T_q == T_kv"):
+            ring_attention(q, k, k, mesh, "sp", causal=True,
+                           placement=placement)
+
+
+def test_ulysses_flash_tiny_t_falls_back_to_dense():
+    from petastorm_tpu.models.sequence_model import ulysses_attention
+
+    mesh = _mesh((2,), ("sp",))
+    rng = np.random.RandomState(17)
+    q, k, v = (jnp.asarray(rng.randn(1, 4, 2, 8).astype(np.float32))
+               for _ in range(3))  # t_full=4 < 8: must not hit the kernel
+    out = ulysses_attention(q, k, v, mesh, "sp", local_attn="flash")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(attention_reference(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
