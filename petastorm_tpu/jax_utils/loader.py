@@ -59,7 +59,8 @@ def make_jax_dataloader(reader, batch_size,
                         non_tensor_policy="host",
                         stage_to_device=True,
                         shuffle_buffer_size=0,
-                        shuffle_seed=None):
+                        shuffle_seed=None,
+                        stage_in_producer=False):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -87,6 +88,16 @@ def make_jax_dataloader(reader, batch_size,
         top of row-group shuffling (reference ``shuffling_queue_capacity``
         semantics; row readers only).
     :param shuffle_seed: seed for the shuffle buffer.
+    :param stage_in_producer: run ``device_put`` dispatch on the producer
+        thread instead of the consumer: while the training thread blocks on
+        a device step (a GIL-released window), the producer both decodes and
+        dispatches H2D, shrinking the consumer's per-step input cost to a
+        queue get. Best when steps are long enough to hide decode+dispatch;
+        not supported with ``sharding``. In this mode the queue holds
+        device-resident batches, so its depth is bounded by
+        ``device_prefetch`` (not ``host_prefetch``): total in-flight device
+        batches stay ≤ 2·``device_prefetch`` + 1 — raise ``device_prefetch``
+        for deeper jitter absorption.
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -95,7 +106,8 @@ def make_jax_dataloader(reader, batch_size,
                          non_tensor_policy=non_tensor_policy,
                          stage_to_device=stage_to_device,
                          shuffle_buffer_size=shuffle_buffer_size,
-                         shuffle_seed=shuffle_seed)
+                         shuffle_seed=shuffle_seed,
+                         stage_in_producer=stage_in_producer)
 
 
 class JaxDataLoader:
@@ -105,9 +117,14 @@ class JaxDataLoader:
                  device=None, sharding=None, host_prefetch=4,
                  device_prefetch=2, non_tensor_policy="host",
                  stage_to_device=True, shuffle_buffer_size=0,
-                 shuffle_seed=None):
+                 shuffle_seed=None, stage_in_producer=False):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
+        if stage_in_producer and sharding is not None:
+            raise ValueError(
+                "stage_in_producer is not supported with a global sharding "
+                "(make_array_from_process_local_data must run on the thread "
+                "driving the pjit steps)")
         if non_tensor_policy not in ("host", "drop", "error"):
             raise ValueError("non_tensor_policy must be host|drop|error")
         if device_prefetch < 1:
@@ -122,6 +139,7 @@ class JaxDataLoader:
         self._device_prefetch = device_prefetch
         self._non_tensor_policy = non_tensor_policy
         self._stage_to_device = stage_to_device
+        self._stage_in_producer = stage_in_producer and stage_to_device
         self._shuffle_buffer_size = shuffle_buffer_size
         self._shuffle_seed = shuffle_seed
         if sharding is not None and max_batches is None:
@@ -174,6 +192,17 @@ class JaxDataLoader:
                 self.diagnostics["producer_decode_s"] += time.perf_counter() - t0
                 if batch is _SENTINEL:
                     break
+                if self._stage_in_producer:
+                    # device_put dispatch runs HERE, off the consumer's
+                    # critical path: while the consumer waits on the device
+                    # step (a GIL-released window), this thread both decodes
+                    # the next batch and dispatches its H2D — the consumer's
+                    # per-step cost shrinks to queue-get + step dispatch.
+                    t0 = time.perf_counter()
+                    with _trace_span("petastorm_tpu.loader.device_put"):
+                        batch = self._stage(batch)
+                    self.diagnostics["device_dispatch_s"] += \
+                        time.perf_counter() - t0
                 t0 = time.perf_counter()
                 while not self._stop.is_set():
                     try:
@@ -212,7 +241,15 @@ class JaxDataLoader:
                 raise RuntimeError(
                     "Previous iteration's producer thread did not stop within "
                     "30s (reader blocked on I/O?); cannot safely re-iterate")
-        self._queue = queue.Queue(maxsize=self._host_prefetch)
+        # With producer-side staging the queue holds DEVICE-resident batches,
+        # so its depth must be bounded by the device budget (device_prefetch),
+        # not the host budget — otherwise device-resident batches grow to
+        # host_prefetch + device_prefetch and can OOM a model that fit with
+        # consumer-side staging. Total in-flight device batches stay
+        # <= 2 * device_prefetch (+1 in the producer's hand).
+        maxsize = (max(1, self._device_prefetch) if self._stage_in_producer
+                   else self._host_prefetch)
+        self._queue = queue.Queue(maxsize=maxsize)
         self._stop.clear()
         self._producer_error = None
         # Yielded-row accounting is relative to the reader's delivery
@@ -250,11 +287,14 @@ class JaxDataLoader:
                         if self._producer_error is not None:
                             raise self._producer_error
                         break
-                    t0 = time.perf_counter()
-                    with _trace_span("petastorm_tpu.loader.device_put"):
-                        inflight.append(self._stage(host_batch))
-                    self.diagnostics["device_dispatch_s"] += \
-                        time.perf_counter() - t0
+                    if self._stage_in_producer:
+                        inflight.append(host_batch)  # already on device
+                    else:
+                        t0 = time.perf_counter()
+                        with _trace_span("petastorm_tpu.loader.device_put"):
+                            inflight.append(self._stage(host_batch))
+                        self.diagnostics["device_dispatch_s"] += \
+                            time.perf_counter() - t0
                 if not inflight:
                     return
                 batch = inflight.pop(0)
