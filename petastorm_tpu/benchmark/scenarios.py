@@ -14,6 +14,9 @@ The reference's headline workload shapes, runnable on synthetic data via
   columnar decode images/sec plus the loader's input-stall %.
 - ``weighted`` — config #5 (multi-corpus shuffle): throughput and empirical
   mix ratio through ``WeightedSamplingReader``.
+- ``converter_mixing`` — config #5 end-to-end: ``make_spark_converter``
+  materialization -> per-corpus batch readers -> weighted mix ->
+  ``make_jax_dataloader`` (the whole pipeline, not just the sampler).
 
 Each scenario materializes its own synthetic dataset (unless given a url),
 runs the measurement, and returns a flat dict of numbers (the CLI prints it
@@ -334,9 +337,91 @@ def weighted_mixing_scenario(dataset_url=None, rows=8_192, workers=2,
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Scenario: converter-driven multi-corpus mixing (config #5, full pipeline)
+# ---------------------------------------------------------------------------
+
+def converter_mixing_scenario(dataset_url=None, rows=8_192,
+                              weights=(0.8, 0.2), batch_size=256,
+                              batches=24, workers=2):
+    """Config #5 measured END-TO-END through the converter: N in-memory
+    frames -> ``make_spark_converter`` (content-hash materialization) ->
+    ``make_batch_reader`` per corpus -> ``WeightedSamplingReader`` mix ->
+    ``make_jax_dataloader`` — throughput and empirical mix ratio of what the
+    training loop actually receives (``weighted_mixing_scenario`` benches
+    the sampler alone; this one pays the whole pipeline).
+
+    ``dataset_url``: optional parent cache directory for the converter's
+    materialization (default: a fresh tmpdir, removed afterwards).
+    """
+    import pandas as pd
+
+    import petastorm_tpu.spark.dataset_converter as dc
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.spark.dataset_converter import (
+        make_spark_converter, set_parent_cache_dir_url)
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    prev_cache_dir = dc._parent_cache_dir_url
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_convmix_")
+        set_parent_cache_dir_url(f"file://{tmpdir}")
+    else:
+        set_parent_cache_dir_url(dataset_url)
+    rng = np.random.RandomState(29)
+    per_corpus = rows // len(weights)
+    converters, readers = [], []
+    try:
+        for corpus in range(len(weights)):
+            frame = pd.DataFrame({
+                "id": np.arange(per_corpus, dtype=np.int64),
+                "corpus": np.full(per_corpus, corpus, np.int32),
+                "value": rng.rand(per_corpus).astype(np.float32),
+            })
+            converters.append(make_spark_converter(
+                frame, parquet_row_group_size_bytes=4096))
+        readers = [make_batch_reader(c.cache_dir_url, num_epochs=None,
+                                     reader_pool_type="thread",
+                                     workers_count=workers)
+                   for c in converters]
+        counts = np.zeros(len(weights), np.int64)
+        n_batches = 0
+        with WeightedSamplingReader(readers, list(weights),
+                                    random_seed=31) as mixed:
+            loader = make_jax_dataloader(mixed, batch_size,
+                                         max_batches=batches,
+                                         stage_to_device=False)
+            t0 = time.perf_counter()
+            with loader:
+                for batch in loader:
+                    tags, tag_counts = np.unique(batch["corpus"],
+                                                 return_counts=True)
+                    counts[tags] += tag_counts
+                    n_batches += 1
+            wall = time.perf_counter() - t0
+        ratio = (counts / counts.sum()).round(3).tolist()
+        return {
+            "scenario": "converter_mixing",
+            "batches": n_batches,
+            "rows_drawn": int(counts.sum()),
+            "rows_per_sec": round(counts.sum() / wall, 1),
+            "target_weights": list(weights),
+            "empirical_mix": ratio,
+        }
+    finally:
+        for c in converters:
+            c.delete()
+        set_parent_cache_dir_url(prev_cache_dir)  # restore the global
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "tabular": tabular_predicate_scenario,
     "ngram": ngram_window_scenario,
     "image": image_pipeline_scenario,
     "weighted": weighted_mixing_scenario,
+    "converter_mixing": converter_mixing_scenario,
 }

@@ -15,6 +15,7 @@ filesystem independently; there is no cross-host data-plane traffic
 
 from __future__ import annotations
 
+import warnings
 from urllib.parse import urlparse
 
 import pyarrow.fs as pafs
@@ -26,16 +27,25 @@ class FilesystemResolver:
     Supported: local paths, ``file://``, ``hdfs://host:port``, ``s3://``,
     ``gs://``/``gcs://``, plus anything fsspec can open (via
     ``storage_options``). A pre-built ``filesystem`` short-circuits resolution.
+
+    ``fast_gcs_listing=True`` (reader construction): ``gs://`` URLs resolve
+    through :class:`~petastorm_tpu.gcsfs_helpers.gcsfs_fast_list.
+    FastListingFilesystem` — ONE recursive listing sweep at construction
+    serves all of dataset discovery's ``ls``/``info``/``walk`` traffic from
+    memory instead of one network round-trip per directory. Read-only
+    contexts only (the cached tree would be stale under concurrent writes —
+    the ETL writer never sets it).
     """
 
     def __init__(self, dataset_url, hadoop_configuration=None, connector=None,
                  hdfs_driver="libhdfs", user=None, storage_options=None,
-                 filesystem=None):
+                 filesystem=None, fast_gcs_listing=False):
         if not isinstance(dataset_url, str):
             raise ValueError(f"dataset_url must be a string, got {type(dataset_url)}")
         self._dataset_url = dataset_url.rstrip("/")
         self._user = user
         self._storage_options = storage_options or {}
+        self._fast_gcs_listing = fast_gcs_listing
 
         parsed = urlparse(self._dataset_url)
         self._scheme = parsed.scheme
@@ -72,6 +82,10 @@ class FilesystemResolver:
             url = "s3" + url[len(self._scheme):]
         if self._scheme in ("gcs",):
             url = "gs" + url[len(self._scheme):]
+        if self._scheme in ("gs", "gcs") and self._fast_gcs_listing:
+            resolved = self._resolve_gcs_fast(url)
+            if resolved is not None:
+                return resolved
         if self._storage_options:
             # fsspec honors storage_options; wrap the result for pyarrow.
             import fsspec
@@ -80,6 +94,28 @@ class FilesystemResolver:
             return _ensure_arrow_filesystem(fs), path
         fs, path = pafs.FileSystem.from_uri(url)
         return fs, path
+
+    def _resolve_gcs_fast(self, url):
+        """gs:// through the one-sweep listing wrapper (or None to fall back
+        to the default resolution when no fsspec GCS implementation is
+        available — e.g. gcsfs not installed)."""
+        from petastorm_tpu.gcsfs_helpers.gcsfs_fast_list import (
+            FastListingFilesystem,
+        )
+
+        try:
+            import fsspec
+
+            # Dispatches to whatever implements the "gs" protocol (gcsfs in
+            # production; tests register a fake).
+            fs, path = fsspec.core.url_to_fs(url, **self._storage_options)
+        except (ImportError, ValueError) as exc:
+            warnings.warn(
+                f"fast GCS listing unavailable ({exc}); falling back to "
+                "per-directory discovery", stacklevel=3)
+            return None
+        fast = FastListingFilesystem(fs, path)
+        return _ensure_arrow_filesystem(fast), path
 
     def filesystem(self):
         return self._filesystem
@@ -113,7 +149,8 @@ def _strip_scheme(url):
 
 
 def get_filesystem_and_path_or_paths(url_or_urls, hdfs_driver="libhdfs",
-                                     storage_options=None, filesystem=None):
+                                     storage_options=None, filesystem=None,
+                                     fast_gcs_listing=False):
     """Reference parity: ``petastorm/fs_utils.py::get_filesystem_and_path_or_paths``.
 
     Accepts one URL or a list; all must share a scheme. Returns
@@ -127,7 +164,9 @@ def get_filesystem_and_path_or_paths(url_or_urls, hdfs_driver="libhdfs",
         raise ValueError(f"All dataset URLs must share one scheme, got {schemes}")
     resolvers = [
         FilesystemResolver(u, hdfs_driver=hdfs_driver,
-                           storage_options=storage_options, filesystem=filesystem)
+                           storage_options=storage_options,
+                           filesystem=filesystem,
+                           fast_gcs_listing=fast_gcs_listing)
         for u in urls
     ]
     fs = resolvers[0].filesystem()

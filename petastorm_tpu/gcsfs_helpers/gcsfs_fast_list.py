@@ -188,12 +188,29 @@ class FastListingFilesystem:
         path = _strip_scheme(path).rstrip("/")
         return path in self._cache or path in self._info_by_path
 
-    def find(self, path, detail=False):
+    def find(self, path, maxdepth=None, withdirs=False, detail=False,
+             **kwargs):
+        """fsspec ``find`` signature (pyarrow's ``FSSpecHandler`` drives
+        recursive ``FileSelector`` traffic through it with
+        ``maxdepth``/``withdirs``) — answered from the cached tree."""
         path = _strip_scheme(path).rstrip("/")
-        files = {name: info for name, info in self._info_by_path.items()
-                 if info["type"] != DIRECTORY_TYPE
-                 and (name.startswith(path + "/") or name == path)}
-        return files if detail else sorted(files)
+
+        def within(name):
+            if not (name.startswith(path + "/") or name == path):
+                return False
+            if maxdepth is None or name == path:
+                return True
+            rel_depth = name[len(path) + 1:].count("/") + 1
+            return rel_depth <= maxdepth
+
+        out = {name: info for name, info in self._info_by_path.items()
+               if (withdirs or info["type"] != DIRECTORY_TYPE)
+               and within(name)}
+        if withdirs and path in self._cache and path not in out:
+            # fsspec includes the base directory itself when withdirs=True.
+            out[path] = self.info(path)
+        out = dict(sorted(out.items()))
+        return out if detail else list(out)
 
     def walk(self, path=None):
         """Yield ``(dirpath, [subdir names], [file names])`` like ``os.walk``,

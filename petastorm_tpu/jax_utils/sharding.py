@@ -149,14 +149,16 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
         warnings.warn(
             "Cannot derive an equal SPMD step count for a resumed reader: "
             "remaining rows are checkpoint-dependent. Pass max_batches "
-            "explicitly (agreed across hosts)",
+            "explicitly (observe via count_deliverable_batches, agree via "
+            "agree_max_batches)",
             UserWarning, stacklevel=3)
         return None
     if getattr(reader, "_predicate", None) is not None:
         warnings.warn(
             "Cannot derive an equal SPMD step count: a row-level predicate "
             "makes per-shard row counts data-dependent. Pass max_batches "
-            "explicitly (agreed across hosts) or steps may deadlock the pod",
+            "explicitly (observe via count_deliverable_batches, agree via "
+            "agree_max_batches) or steps may deadlock the pod",
             UserWarning, stacklevel=3)
         return None
     transform_spec = getattr(reader, "_transform_spec", None)
@@ -170,7 +172,8 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
         warnings.warn(
             "Cannot derive an equal SPMD step count: a TransformSpec can "
             "change per-shard row counts. Pass max_batches explicitly "
-            "(agreed across hosts) or steps may deadlock the pod",
+            "(observe via count_deliverable_batches, agree via "
+            "agree_max_batches) or steps may deadlock the pod",
             UserWarning, stacklevel=3)
         return None
     counts = getattr(reader, "shard_row_counts", None)
@@ -178,6 +181,70 @@ def derive_equal_step_max_batches(reader, batch_size, last_batch="drop"):
         return None
     return min(_batches_for_rows(c * num_epochs, batch_size, last_batch)
                for c in counts)
+
+
+def agree_max_batches(local_count, reduce="min"):
+    """Agree a pod-safe ``max_batches`` from per-host OBSERVED batch counts.
+
+    Closes the loop for every case :func:`derive_equal_step_max_batches`
+    declines (row-level predicate, NGram windows, TransformSpec funcs,
+    resumed readers): each host observes how many batches it can actually
+    deliver — e.g. one ``stage_to_device=False`` counting pass over its
+    reader, or an application-side row count — and this helper agrees the
+    global value with ONE tiny collective (``jax.experimental.
+    multihost_utils.process_allgather`` of a single int64; control plane
+    only, no data moves).
+
+    :param local_count: this host's locally-observed deliverable batch count.
+    :param reduce: ``"min"`` (default — the only *safe* lockstep count with
+        ragged shards: every host can deliver at least the minimum) or
+        ``"host0"`` (adopt host 0's count — only when the caller guarantees
+        every host can deliver it, e.g. a deliberately truncated run).
+    :return: the agreed global count (``local_count`` unchanged when
+        running single-process).
+    """
+    if reduce not in ("min", "host0"):
+        raise ValueError(f"reduce {reduce!r} is not 'min' or 'host0'")
+    local_count = int(local_count)
+    try:
+        import jax
+
+        if jax.process_count() == 1:
+            return local_count
+    except Exception:  # pragma: no cover - jax missing/uninitialized
+        return local_count
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([local_count], np.int64)))
+    return int(counts.min()) if reduce == "min" else int(counts.flat[0])
+
+
+def count_deliverable_batches(reader, batch_size, last_batch="drop"):
+    """Count the batches ``reader`` can deliver by DRAINING it once (a
+    host-side counting pass — no device, no decode retention).
+
+    The observation half of :func:`agree_max_batches` for data-dependent
+    pipelines (predicates, NGram): run this on a *separately constructed*
+    reader with the same arguments, agree the result across hosts, then pass
+    it as ``max_batches`` to the real loader. The counting pass pays one
+    decode sweep — worth it once per training run when the alternative is a
+    pod deadlock.
+    """
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+    if getattr(reader, "num_epochs", 1) is None:
+        raise ValueError(
+            "count_deliverable_batches would never terminate on an infinite "
+            "reader (num_epochs=None): construct the counting reader with "
+            "num_epochs=1 and scale the agreed count by your epoch budget")
+    n = 0
+    with reader:
+        for _ in batch_iterator(reader, batch_size, last_batch=last_batch):
+            n += 1
+    return n
 
 
 def batch_sharding(mesh, axis="data"):

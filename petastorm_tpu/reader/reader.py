@@ -67,7 +67,8 @@ def make_reader(dataset_url,
                 zmq_copy_buffers=True,
                 filesystem=None,
                 reader_engine=None,
-                resume_state=None):
+                resume_state=None,
+                fast_gcs_listing=True):
     """Reader for **petastorm-format** datasets (Unischema + codecs attached).
 
     Reference parity: ``petastorm/reader.py::make_reader`` — same knob surface.
@@ -90,7 +91,8 @@ def make_reader(dataset_url,
     cur_shard, shard_count = _default_shard_options(cur_shard, shard_count)
     resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
                                   storage_options=storage_options,
-                                  filesystem=filesystem)
+                                  filesystem=filesystem,
+                                  fast_gcs_listing=fast_gcs_listing)
     fs = resolver.filesystem()
     path = resolver.get_dataset_path()
     try:
@@ -146,7 +148,8 @@ def make_columnar_reader(dataset_url,
                          storage_options=None,
                          zmq_copy_buffers=True,
                          filesystem=None,
-                         resume_state=None):
+                         resume_state=None,
+                         fast_gcs_listing=True):
     """Columnar reader for **petastorm-format** datasets — the TPU-native
     fast path feeding :func:`petastorm_tpu.jax_utils.make_jax_dataloader`.
 
@@ -174,7 +177,8 @@ def make_columnar_reader(dataset_url,
     cur_shard, shard_count = _default_shard_options(cur_shard, shard_count)
     resolver = FilesystemResolver(dataset_url, hdfs_driver=hdfs_driver,
                                   storage_options=storage_options,
-                                  filesystem=filesystem)
+                                  filesystem=filesystem,
+                                  fast_gcs_listing=fast_gcs_listing)
     fs = resolver.filesystem()
     path = resolver.get_dataset_path()
     try:
@@ -229,7 +233,8 @@ def make_batch_reader(dataset_url_or_urls,
                       storage_options=None,
                       zmq_copy_buffers=True,
                       filesystem=None,
-                      resume_state=None):
+                      resume_state=None,
+                      fast_gcs_listing=True):
     """Batch reader for **plain Parquet** stores (no petastorm metadata needed).
 
     Reference parity: ``petastorm/reader.py::make_batch_reader``. Yields
@@ -242,7 +247,8 @@ def make_batch_reader(dataset_url_or_urls,
     cur_shard, shard_count = _default_shard_options(cur_shard, shard_count)
     fs, path_or_paths = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, hdfs_driver=hdfs_driver,
-        storage_options=storage_options, filesystem=filesystem)
+        storage_options=storage_options, filesystem=filesystem,
+        fast_gcs_listing=fast_gcs_listing)
     paths = path_or_paths if isinstance(path_or_paths, list) else [path_or_paths]
 
     try:

@@ -209,3 +209,115 @@ def test_fast_listing_filesystem_passes_content_ops_through():
     wrapped = FastListingFilesystem(fs, "gs://bucket/ds")
     assert wrapped.open("bucket/ds/part-00000.parquet") == \
         ("opened", "bucket/ds/part-00000.parquet", "rb")
+
+
+# --- resolver integration (round 4): gs:// URLs get the fast path ---------
+
+class LocalBackedGCSFake(FakeGCSFileSystem):
+    """FakeGCSFileSystem plus content ops: keys map onto a local directory,
+    so pyarrow can actually read parquet bytes through the wrapper while the
+    listing counters prove discovery never touched "the network"."""
+
+    local_root = None  # set by the test (class-level: fsspec instantiates)
+    instances = []
+
+    # minimal fsspec class contract for url_to_fs dispatch
+    protocol = "gs"
+
+    @classmethod
+    def _get_kwargs_from_urls(cls, url):
+        return {}
+
+    @classmethod
+    def _strip_protocol(cls, path):
+        for scheme in ("gs://", "gcs://"):
+            if path.startswith(scheme):
+                return path[len(scheme):]
+        return path
+
+    def __init__(self, *args, **kwargs):
+        import os
+
+        keys = []
+        for dirpath, _, files in os.walk(self.local_root):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, self.local_root)
+                keys.append("bucket/ds/" + rel.replace(os.sep, "/"))
+        super().__init__(keys)
+        for k in list(self._objects):
+            self._objects[k]["size"] = os.path.getsize(self._local(k))
+        LocalBackedGCSFake.instances.append(self)
+
+    def _local(self, path):
+        import os
+
+        rel = path[len("bucket/ds/"):]
+        return os.path.join(self.local_root, rel.replace("/", os.sep))
+
+    def open(self, path, mode="rb", **kwargs):
+        return open(self._local(path.rstrip("/")), mode)
+
+    def cat_file(self, path, start=None, end=None, **kwargs):
+        with open(self._local(path), "rb") as f:
+            data = f.read()
+        return data[start:end]
+
+    def size(self, path):
+        import os
+
+        return os.path.getsize(self._local(path))
+
+
+@pytest.fixture
+def gs_registered(petastorm_dataset, monkeypatch):
+    import fsspec
+
+    LocalBackedGCSFake.local_root = petastorm_dataset.path
+    LocalBackedGCSFake.instances = []
+    # Register the fake as the "gs" protocol implementation; url_to_fs will
+    # instantiate it (clobber gcsfs if present).
+    fsspec.register_implementation("gs", LocalBackedGCSFake, clobber=True)
+    yield
+    fsspec.register_implementation("gs", None, clobber=True)
+
+
+def test_resolver_wraps_gs_in_fast_listing(gs_registered):
+    from petastorm_tpu.fs_utils import FilesystemResolver
+
+    resolver = FilesystemResolver("gs://bucket/ds", fast_gcs_listing=True)
+    fs = resolver.filesystem()
+    assert resolver.get_dataset_path() == "bucket/ds"
+    (fake,) = LocalBackedGCSFake.instances
+    assert fake.find_calls == 1          # exactly one sweep at construction
+    assert fake.ls_network_calls == 0    # nothing fell through
+    # discovery-style traffic resolves from the cached tree
+    infos = fs.get_file_info(
+        __import__("pyarrow").fs.FileSelector("bucket/ds", recursive=True))
+    assert any(i.path.endswith(".parquet") for i in infos)
+    assert fake.find_calls == 1 and fake.ls_network_calls == 0
+
+
+def test_make_reader_over_gs_uses_one_sweep(gs_registered):
+    from petastorm_tpu import make_reader
+
+    with make_reader("gs://bucket/ds", reader_pool_type="dummy",
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        rows = sum(1 for _ in reader)
+    assert rows > 0
+    (fake,) = LocalBackedGCSFake.instances
+    assert fake.find_calls == 1, "discovery must be ONE listing sweep"
+    assert fake.ls_network_calls == 0, "no per-directory network ls"
+
+
+def test_make_reader_gs_opt_out_skips_wrapper(gs_registered):
+    from petastorm_tpu.fs_utils import FilesystemResolver
+
+    # Opt-out: no sweep is performed at construction (resolution falls back
+    # to the default path, which for the registered fake protocol errors or
+    # lists lazily — just assert no eager sweep happened).
+    try:
+        FilesystemResolver("gs://bucket/ds", fast_gcs_listing=False)
+    except Exception:
+        pass  # pyarrow's native gs resolution may be unavailable here
+    assert all(f.find_calls == 0 for f in LocalBackedGCSFake.instances)

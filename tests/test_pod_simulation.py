@@ -119,3 +119,82 @@ def test_pod_equal_steps_without_interrupt(ragged_pod_dataset):
                     break
         counts.append(steps)
     assert len(set(counts)) == 1, f"hosts diverged: {counts}"
+
+
+def test_predicate_ragged_pod_locksteps_via_agreement(ragged_pod_dataset):
+    """The equal-step DECLINE case (row-level predicate) closed by the
+    observe→agree loop: each host counts its deliverable batches with a
+    counting pass, agrees the minimum, and every host then steps exactly
+    that many times."""
+    from petastorm_tpu.jax_utils.sharding import (agree_max_batches,
+                                                  count_deliverable_batches)
+    from petastorm_tpu.predicates import in_lambda
+
+    url = ragged_pod_dataset
+    pred = lambda v: v["id"] % 3 != 0  # noqa: E731 - data-dependent filter
+
+    def host_reader(host):
+        return make_reader(url, reader_pool_type="thread", workers_count=2,
+                           num_epochs=1, shuffle_row_groups=True,
+                           shard_seed=3, cur_shard=host, shard_count=HOSTS,
+                           predicate=in_lambda(["id"], pred))
+
+    # observe (one counting pass per host; warns about the declined
+    # derivation are not emitted here — max_batches comes from agreement)
+    local_counts = [count_deliverable_batches(host_reader(h), 4,
+                                              last_batch="drop")
+                    for h in range(HOSTS)]
+    assert all(c > 0 for c in local_counts)
+    # agree (single-process: agree_max_batches(min) == local min)
+    agreed = min(agree_max_batches(c) for c in local_counts)
+    assert agreed == min(local_counts)
+
+    # lockstep: every host delivers exactly `agreed` batches
+    seen = collections.Counter()
+    for host in range(HOSTS):
+        reader = host_reader(host)
+        loader = make_jax_dataloader(reader, 4, last_batch="drop",
+                                     max_batches=agreed,
+                                     stage_to_device=False)
+        steps = 0
+        with loader:
+            for batch in loader:
+                steps += 1
+                seen.update(batch["id"].tolist())
+        assert steps == agreed, (host, steps, agreed)
+    # every delivered row satisfies the predicate; no duplicates pre-cap
+    assert all(pred({"id": i}) for i in seen)
+    assert max(seen.values()) == 1
+
+
+def test_agree_max_batches_multihost_semantics(monkeypatch):
+    """min / host0 reduction over the (mocked) pod collective."""
+    import types
+
+    import petastorm_tpu.jax_utils.sharding as sh
+
+    class _FakeJax:
+        @staticmethod
+        def process_count():
+            return 3
+
+    monkeypatch.setitem(
+        __import__("sys").modules, "jax", _FakeJax())
+    fake_mh = types.SimpleNamespace(
+        process_allgather=lambda x: np.asarray([[7], [4], [9]]))
+    monkeypatch.setitem(
+        __import__("sys").modules, "jax.experimental", types.SimpleNamespace(
+            multihost_utils=fake_mh))
+    monkeypatch.setitem(
+        __import__("sys").modules, "jax.experimental.multihost_utils",
+        fake_mh)
+    assert sh.agree_max_batches(7) == 4
+    assert sh.agree_max_batches(7, reduce="host0") == 7
+    with pytest.raises(ValueError, match="reduce"):
+        sh.agree_max_batches(7, reduce="max")
+
+
+def test_agree_max_batches_single_process_identity():
+    from petastorm_tpu.jax_utils.sharding import agree_max_batches
+
+    assert agree_max_batches(11) == 11

@@ -56,3 +56,17 @@ def test_scenario_cli_prints_json(capsys, monkeypatch):
     assert main(["scenario", "tabular"]) == 0
     out = capsys.readouterr().out.strip()
     assert json.loads(out) == {"ok": True}
+
+
+def test_converter_mixing_scenario_end_to_end():
+    from petastorm_tpu.benchmark.scenarios import converter_mixing_scenario
+
+    result = converter_mixing_scenario(rows=4096, weights=(0.7, 0.3),
+                                       batch_size=128, batches=32, workers=1)
+    assert result["batches"] == 32
+    assert result["rows_drawn"] == 32 * 128
+    assert result["rows_per_sec"] > 0
+    empirical = result["empirical_mix"]
+    # coarse granularity (row-group-sized draws): wide tolerance
+    assert abs(empirical[0] - 0.7) < 0.15
+    assert abs(empirical[1] - 0.3) < 0.15
