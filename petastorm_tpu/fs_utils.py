@@ -15,7 +15,6 @@ filesystem independently; there is no cross-host data-plane traffic
 
 from __future__ import annotations
 
-import warnings
 from urllib.parse import urlparse
 
 import pyarrow.fs as pafs
@@ -98,7 +97,18 @@ class FilesystemResolver:
     def _resolve_gcs_fast(self, url):
         """gs:// through the one-sweep listing wrapper (or None to fall back
         to the default resolution when no fsspec GCS implementation is
-        available — e.g. gcsfs not installed)."""
+        available — e.g. gcsfs not installed).
+
+        Trade-off (reference parity — upstream petastorm routes GCS through
+        gcsfs too): the wrapped filesystem serves CONTENT reads through
+        fsspec/gcsfs rather than pyarrow's native C++ GCS client. Discovery
+        becomes one listing sweep instead of one round-trip per directory —
+        the dominant cost at reader construction on a pod — while parquet
+        byte-range reads go through gcsfs's HTTP client. Prefer
+        ``fast_gcs_listing=False`` if your deployment depends on
+        arrow-native GCS auth or its C++ read path."""
+        import logging
+
         from petastorm_tpu.gcsfs_helpers.gcsfs_fast_list import (
             FastListingFilesystem,
         )
@@ -110,9 +120,11 @@ class FilesystemResolver:
             # production; tests register a fake).
             fs, path = fsspec.core.url_to_fs(url, **self._storage_options)
         except (ImportError, ValueError) as exc:
-            warnings.warn(
-                f"fast GCS listing unavailable ({exc}); falling back to "
-                "per-directory discovery", stacklevel=3)
+            # gcsfs absent is the normal state of arrow-native installs; a
+            # per-reader-construction UserWarning would be noise.
+            logging.getLogger(__name__).debug(
+                "fast GCS listing unavailable (%s); falling back to "
+                "per-directory discovery", exc)
             return None
         fast = FastListingFilesystem(fs, path)
         return _ensure_arrow_filesystem(fast), path
@@ -166,7 +178,13 @@ def get_filesystem_and_path_or_paths(url_or_urls, hdfs_driver="libhdfs",
         FilesystemResolver(u, hdfs_driver=hdfs_driver,
                            storage_options=storage_options,
                            filesystem=filesystem,
-                           fast_gcs_listing=fast_gcs_listing)
+                           # The fast-listing wrapper's cache is rooted at
+                           # ONE url's prefix, and only resolvers[0]'s
+                           # filesystem is returned — with several URLs the
+                           # other prefixes would be unlisted. Multi-URL
+                           # reads keep default resolution.
+                           fast_gcs_listing=fast_gcs_listing
+                           and len(urls) == 1)
         for u in urls
     ]
     fs = resolvers[0].filesystem()

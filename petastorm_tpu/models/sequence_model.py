@@ -77,7 +77,8 @@ def _unstripe(x, sp):
 
 
 def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
-                         causal=False, placement="contiguous"):
+                         causal=False, placement="contiguous",
+                         lengths=None):
     """Per-shard ring attention body (runs inside shard_map).
 
     ``q, k, v``: the local sequence slice, [B, L, H, Dh] with L = T/sp.
@@ -111,7 +112,9 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
     def block_update(k_cur, v_cur, acc, row_max, row_sum, src):
         scores = jnp.einsum("blhd,bmhd->bhlm", qf,
                             k_cur.astype(jnp.float32)) * scale
-        if causal:
+        if causal or lengths is not None:
+            # ORIGINAL global positions of the resident block's keys (the
+            # striped wrapper permuted the sequence; these formulas undo it).
             if placement == "striped":
                 # global position of local index j on device d is d + sp·j
                 q_pos = r + axis_size * row_ids
@@ -119,8 +122,12 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
             else:
                 q_pos = r * l + row_ids
                 k_pos = src * l + row_ids
+        if causal:
             mask = k_pos[None, :] <= q_pos[:, None]            # [L, L]
             scores = jnp.where(mask, scores, -jnp.inf)
+        if lengths is not None:
+            valid = k_pos[None, :] < lengths[:, None]          # [B, L]
+            scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
         blk_max = scores.max(axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         # A block can be fully masked for some rows (causal): keep the raw
@@ -172,7 +179,7 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
-                   causal=False, placement="striped"):
+                   causal=False, placement="striped", lengths=None):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Inputs are global ``[B, T, H, Dh]`` arrays (sharded or shardable on T);
@@ -185,15 +192,18 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     device does equal causal work per ring step; ``"contiguous"`` keeps the
     natural layout and skips fully-future blocks (imbalanced — see
     :func:`ring_attention_block`). Output always returns in natural order.
+    ``lengths`` ([B] int, optional): keys at or past ``lengths[b]`` are
+    masked for example ``b`` — masking is by ORIGINAL position, so it
+    composes with the striped permutation.
     """
     from jax import shard_map
 
     sp = mesh.shape[axis_name]
-    if causal and q.shape[1] != k.shape[1]:
+    if (causal or lengths is not None) and q.shape[1] != k.shape[1]:
         # Both placements derive key positions from q's local length, and
         # contiguous's full-skip condition assumes the same partitioning.
         raise ValueError(
-            "causal ring attention requires T_q == T_kv "
+            "causal/lengths ring attention requires T_q == T_kv "
             f"(got {q.shape[1]} vs {k.shape[1]})")
     striped = causal and placement == "striped"
     if striped:
@@ -201,12 +211,24 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
 
     spec = P(batch_axis, axis_name, None, None)
     varying_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
-    sharded = shard_map(
-        functools.partial(ring_attention_block, axis_name=axis_name,
-                          axis_size=sp, varying_axes=varying_axes,
-                          causal=causal, placement=placement),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    out = sharded(q, k, v)
+    # The block's position formulas must describe the ACTUAL data layout:
+    # striping is only applied above (causal), so a lengths-only call with
+    # the default placement="striped" still holds contiguous data.
+    block = functools.partial(ring_attention_block, axis_name=axis_name,
+                              axis_size=sp, varying_axes=varying_axes,
+                              causal=causal,
+                              placement="striped" if striped
+                              else "contiguous")
+    if lengths is None:
+        sharded = shard_map(block, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)
+        out = sharded(q, k, v)
+    else:
+        sharded = shard_map(
+            lambda a, b, c, le: block(a, b, c, lengths=le),
+            mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
+            out_specs=spec)
+        out = sharded(q, k, v, lengths)
     return _unstripe(out, sp) if striped else out
 
 
@@ -216,7 +238,7 @@ ULYSSES_FLASH_THRESHOLD = 1024
 
 
 def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
-                            local_attn="auto"):
+                            local_attn="auto", lengths=None):
     """Per-shard Ulysses (all-to-all) attention body (runs inside shard_map).
 
     Input: the local sequence slice ``[B, L, H, Dh]`` with ``L = T/sp``.
@@ -250,15 +272,18 @@ def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
                                   tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # After to_heads each device holds the FULL sequence for its head group,
+    # so per-example lengths apply directly to the local attention.
     local_attn = _resolve_ulysses_local(l * axis_size, local_attn)
     if local_attn == "flash":
         from petastorm_tpu.ops import flash_attention
 
         block = min(128, l * axis_size)
         out = flash_attention(qh, kh, vh, block_q=block, block_k=block,
-                              causal=causal)
+                              causal=causal, kv_lengths=lengths)
     else:
-        out = attention_reference(qh, kh, vh, causal=causal)
+        out = attention_reference(qh, kh, vh, causal=causal,
+                                  lengths=lengths)
     return to_sequence(out)
 
 
@@ -278,7 +303,7 @@ def _resolve_ulysses_local(t_full, local_attn):
 
 
 def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
-                      causal=False, local_attn="auto"):
+                      causal=False, local_attn="auto", lengths=None):
     """All-to-all sequence-parallel attention over ``mesh[axis_name]``.
 
     Same contract as :func:`ring_attention` (global ``[B, T, H, Dh]`` in,
@@ -294,16 +319,22 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
 
     local_attn = _resolve_ulysses_local(q.shape[1], local_attn)
     spec = P(batch_axis, axis_name, None, None)
+    block = functools.partial(ulysses_attention_block, axis_name=axis_name,
+                              axis_size=mesh.shape[axis_name], causal=causal,
+                              local_attn=local_attn)
+    # pallas_call outputs carry no varying-mesh-axes annotation, which
+    # the vma checker rejects — opt out only when the flash kernel
+    # actually runs, keeping the check live for the dense path.
+    check_vma = local_attn != "flash"
+    if lengths is None:
+        sharded = shard_map(block, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=check_vma)
+        return sharded(q, k, v)
     sharded = shard_map(
-        functools.partial(ulysses_attention_block, axis_name=axis_name,
-                          axis_size=mesh.shape[axis_name], causal=causal,
-                          local_attn=local_attn),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        # pallas_call outputs carry no varying-mesh-axes annotation, which
-        # the vma checker rejects — opt out only when the flash kernel
-        # actually runs, keeping the check live for the dense path.
-        check_vma=local_attn != "flash")
-    return sharded(q, k, v)
+        lambda a, b, c, le: block(a, b, c, lengths=le),
+        mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
+        out_specs=spec, check_vma=check_vma)
+    return sharded(q, k, v, lengths)
 
 
 # --- a small encoder around it -------------------------------------------
@@ -355,8 +386,8 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     sequence-parallel ones). ``lengths``: per-example valid timestep counts
     [B] int — positions at/after ``lengths[b]`` neither attend nor are
     attended to nor pooled, so a ragged window padded to T produces exactly
-    the logits of its unpadded self (supported on the single-shard impls;
-    the sequence-parallel impls reject it for now).
+    the logits of its unpadded self (all impls, single-shard AND
+    sequence-parallel).
     """
     h = num_heads
     x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
@@ -375,15 +406,12 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
             raise ValueError(
                 f"attn_impl {attn_impl!r} is not a sequence-parallel "
                 f"implementation; with a mesh use 'ring' or 'ulysses'")
-        if lengths is not None:
-            raise NotImplementedError(
-                "per-example lengths with sequence-parallel attention is "
-                "not supported yet; use the single-shard impls")
         batch_axis = "data" if "data" in mesh.axis_names else None
         parallel_attn = (ulysses_attention if attn_impl == "ulysses"
                          else ring_attention)
         attn = parallel_attn(q, k, v, mesh, attn_axis,
-                             batch_axis=batch_axis, causal=causal)
+                             batch_axis=batch_axis, causal=causal,
+                             lengths=lengths)
     elif attn_impl == "ring":
         # Symmetric remap: "ring" is the mesh-side default (the train-step
         # factory passes it unconditionally); without a mesh it means plain

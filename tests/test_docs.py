@@ -57,4 +57,5 @@ def test_documented_apis_exist():
     )
     from petastorm_tpu.benchmark.scenarios import SCENARIOS
 
-    assert set(SCENARIOS) == {"tabular", "ngram", "image", "weighted"}
+    assert set(SCENARIOS) == {"tabular", "ngram", "image", "weighted",
+                              "converter_mixing"}

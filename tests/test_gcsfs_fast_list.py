@@ -321,3 +321,16 @@ def test_make_reader_gs_opt_out_skips_wrapper(gs_registered):
     except Exception:
         pass  # pyarrow's native gs resolution may be unavailable here
     assert all(f.find_calls == 0 for f in LocalBackedGCSFake.instances)
+
+
+def test_multi_url_gs_list_skips_fast_listing(gs_registered):
+    from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+
+    # Two URLs: the wrapper would be rooted at one prefix, so resolution
+    # must fall back — and no eager sweep should happen.
+    try:
+        get_filesystem_and_path_or_paths(
+            ["gs://bucket/ds", "gs://bucket/ds"], fast_gcs_listing=True)
+    except Exception:
+        pass  # default gs resolution may be unavailable here
+    assert all(f.find_calls == 0 for f in LocalBackedGCSFake.instances)
