@@ -63,6 +63,71 @@ def train_sequence(dataset_url, batch_size=16, steps=8, attn_impl="dense"):
     return float(loss)
 
 
+def generate_ragged_dataset(dataset_url, rows=256, max_len=24):
+    """Variable-length sequences stored PADDED with a ``length`` column —
+    the standard ragged-sequence layout (shapes in Parquet must be static;
+    the true length rides along as data)."""
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.schema.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("RaggedSeq", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("seq", np.float32, (max_len, 6), NdarrayCodec(),
+                       False),
+        UnischemaField("length", np.int32, (), ScalarCodec(), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(7)
+
+    def rows_gen():
+        for i in range(rows):
+            n = int(rng.randint(4, max_len + 1))
+            seq = np.zeros((max_len, 6), np.float32)
+            seq[:n] = rng.randn(n, 6)
+            yield {"id": i, "seq": seq, "length": np.int32(n),
+                   "label": np.int32(i % 3)}
+
+    materialize_rows(dataset_url, schema, rows_gen(), rows_per_row_group=64)
+    return dataset_url
+
+
+def train_ragged_causal(dataset_url, batch_size=16, steps=8, mesh=None,
+                        attn_impl=None):
+    """Decoder-style (causal) training on ragged sequences: the ``length``
+    column flows into the model so padded positions neither attend nor pool.
+    ``attn_impl`` defaults to the Pallas flash kernel single-device and to
+    the K/V-ppermute ring when a ``mesh`` is given (sequence parallelism
+    over long windows)."""
+    if attn_impl is None:
+        attn_impl = "ring" if mesh is not None else "flash"
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.models.sequence_model import (init_seq_params,
+                                                     make_seq_train_step)
+
+    reader = make_columnar_reader(dataset_url, num_epochs=None,
+                                  shuffle_row_groups=True,
+                                  schema_fields=["seq", "length", "label"])
+    params = init_seq_params(jax.random.PRNGKey(1), feature_dim=6,
+                             d_model=32, num_heads=4, num_classes=3)
+    step = jax.jit(make_seq_train_step(0.05, num_heads=4, mesh=mesh,
+                                       attn_impl=attn_impl, causal=True))
+    loss = float("nan")
+    with make_jax_dataloader(reader, batch_size, max_batches=steps,
+                             stage_to_device=False) as loader:
+        for batch in loader:
+            windows = jnp.asarray(batch["seq"])
+            lengths = jnp.asarray(batch["length"])
+            labels = jnp.asarray(batch["label"]).astype(jnp.int32)
+            mask = jnp.ones(windows.shape[0], bool)
+            params, loss = step(params, windows, labels, mask, lengths)
+    return float(loss)
+
+
 def main(dataset_url=None, frames=1024):
     import shutil
     import tempfile
@@ -75,6 +140,14 @@ def main(dataset_url=None, frames=1024):
     try:
         loss = train_sequence(dataset_url)
         print(f"trained {WINDOW}-frame windows, final loss={loss:.4f}")
+        # The ragged demo writes its own dataset — always under a tmpdir,
+        # never beside a caller-supplied URL (which may be read-only).
+        with tempfile.TemporaryDirectory(
+                prefix="sequence_example_ragged_") as ragged_dir:
+            ragged_url = f"file://{ragged_dir}/ragged"
+            generate_ragged_dataset(ragged_url)
+            ragged_loss = train_ragged_causal(ragged_url)
+        print(f"trained ragged causal sequences, final loss={ragged_loss:.4f}")
         return loss
     finally:
         if tmpdir:

@@ -66,6 +66,7 @@ def test_sequence_example_trains_on_windows(capsys):
     assert math.isfinite(loss)
     out = capsys.readouterr().out
     assert "5-frame windows" in out
+    assert "ragged causal sequences" in out
 
 
 def test_criteo_dlrm_trains_and_resumes(tmp_path, capsys):
