@@ -1,0 +1,15 @@
+"""shard_map version-compat shims shared by the parallel model families."""
+
+from __future__ import annotations
+
+import jax
+
+
+def mark_varying(x, axis_names):
+    """Mark ``x`` as varying over ``axis_names`` for shard_map's vma typing
+    (constants mixed with per-shard data inside loop carries need this).
+    Handles the pcast→pvary API split across JAX versions in ONE place."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    return jax.lax.pvary(x, tuple(axis_names))  # pre-pcast jax versions

@@ -1,0 +1,186 @@
+"""Pipeline-parallel encoder stack — the pp axis of the parallelism story.
+
+The reference has no model compute at all (SURVEY.md §2: petastorm is a
+data-input library); this module exists so the TPU delivery path exercises
+every parallelism family a training stack uses: dp (batch sharding), tp
+(tensor-parallel MLP in ``image_classifier``), sp (ring/Ulysses in
+``sequence_model``), ep/model-parallel tables (``tabular_dlrm``) — and pp,
+here.
+
+The construction is the idiomatic JAX pipeline (scaling-book recipe):
+
+- the stack's S homogeneous residual blocks live STACKED ``[S, ...]`` and
+  shard over the mesh's ``"pp"`` axis — each device holds one stage's
+  weights;
+- inside ``shard_map``, a ``lax.scan`` over ``M + S - 1`` ticks runs the
+  classic GPipe schedule: every tick each device applies its block to its
+  current microbatch and ``ppermute``-shifts the activation to the next
+  stage. Stage 0 injects microbatch ``t`` during the fill phase; stage
+  S-1 records finished microbatches after the ``S-1``-tick bubble;
+- ``lax.scan`` (not ``fori_loop``) keeps the whole schedule
+  reverse-differentiable — backward is the same pipeline run by scan's
+  transpose, with ``ppermute``'s transpose shifting gradients the other
+  way. No hand-written backward schedule;
+- warmup/drain ticks compute on clamped (repeated) microbatches whose
+  outputs are never recorded, so they contribute exactly zero gradient.
+
+Embed and classifier head are replicated (tiny next to the stack) and run
+outside the shard_map; the pipeline maps ``[M, mb, d_model] →
+[M, mb, d_model]``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_pipeline_params(rng, feature_dim, d_model=64, d_hidden=128,
+                         num_stages=4, num_classes=10, dtype=jnp.float32):
+    """Parameter pytree: replicated embed/head + ``[S, ...]``-stacked
+    residual MLP blocks (shard the leading axis over ``"pp"``)."""
+    keys = jax.random.split(rng, 4)
+    s = lambda fan: 1.0 / jnp.sqrt(fan)  # noqa: E731
+    return {
+        "embed": jax.random.normal(keys[0], (feature_dim, d_model),
+                                   dtype) * s(feature_dim),
+        "w1": jax.random.normal(keys[1], (num_stages, d_model, d_hidden),
+                                dtype) * s(d_model),
+        "w2": jax.random.normal(keys[2], (num_stages, d_hidden, d_model),
+                                dtype) * s(d_hidden),
+        "head": jax.random.normal(keys[3], (d_model, num_classes),
+                                  dtype) * s(d_model),
+    }
+
+
+def pipeline_param_partition_specs():
+    """PartitionSpecs over a mesh with a ``"pp"`` axis: one stage's block
+    per device; embed/head replicated."""
+    return {"embed": P(), "w1": P("pp"), "w2": P("pp"), "head": P()}
+
+
+def _block(w1, w2, x):
+    """One pipeline stage: residual two-layer MLP (the stand-in for a
+    transformer block — the schedule is what's under test here)."""
+    return x + jax.nn.relu(x @ w1) @ w2
+
+
+def _pipeline_body(w1, w2, x_mb, axis_name, num_stages, num_microbatches):
+    """Per-device pipeline schedule (runs inside shard_map).
+
+    ``w1``/``w2``: this stage's block, ``[1, d, h]`` / ``[1, h, d]``.
+    ``x_mb``: ``[M, mb, d]`` microbatches (replicated — every stage sees
+    them, only stage 0 consumes them).
+    Returns ``[1, M, mb, d]`` — garbage except on the last stage, whose
+    copy the wrapper selects from the stacked ``out_specs=P("pp")`` result.
+    """
+    stage = jax.lax.axis_index(axis_name)
+    last = num_stages - 1
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    mb_shape = x_mb.shape[1:]
+
+    def tick(carry, t):
+        act, outs = carry
+        idx = jnp.clip(t, 0, num_microbatches - 1)
+        inp = jnp.where(stage == 0,
+                        jax.lax.dynamic_index_in_dim(x_mb, idx, axis=0,
+                                                     keepdims=False),
+                        act)
+        out = _block(w1[0], w2[0], inp)
+        # Record finished microbatch t-(S-1) on the last stage only; the
+        # masked update keeps warmup/drain compute out of the loss (and
+        # therefore out of the gradients).
+        out_t = t - last
+        out_idx = jnp.clip(out_t, 0, num_microbatches - 1)
+        record = (out_t >= 0) & (stage == last)
+        current = jax.lax.dynamic_index_in_dim(outs, out_idx, axis=0,
+                                               keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(record, out, current), out_idx, axis=0)
+        act_next = jax.lax.ppermute(out, axis_name, perm)
+        return (act_next, outs), None
+
+    init_act = jnp.zeros(mb_shape, x_mb.dtype)
+    init_outs = jnp.zeros_like(x_mb)
+
+    from petastorm_tpu.models._shard_compat import mark_varying
+
+    def varying(v):
+        return mark_varying(v, (axis_name,))
+
+    (_, outs), _ = jax.lax.scan(
+        tick, (varying(init_act), varying(init_outs)),
+        jnp.arange(num_microbatches + num_stages - 1))
+    return outs[None]
+
+
+def pipeline_forward(params, x_mb, mesh, axis_name="pp"):
+    """``[M, mb, d_model]`` microbatches → ``[M, mb, d_model]`` through the
+    S-stage pipeline sharded over ``mesh[axis_name]``."""
+    from jax import shard_map
+
+    num_stages = mesh.shape[axis_name]
+    if params["w1"].shape[0] != num_stages:
+        raise ValueError(
+            f"params stack {params['w1'].shape[0]} stages but the mesh's "
+            f"{axis_name!r} axis has {num_stages} devices")
+    body = functools.partial(_pipeline_body, axis_name=axis_name,
+                             num_stages=num_stages,
+                             num_microbatches=x_mb.shape[0])
+    stacked = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P()),
+        out_specs=P(axis_name))(params["w1"], params["w2"], x_mb)
+    return stacked[-1]  # the last stage's copy holds the real outputs
+
+
+def apply_pipeline_model(params, features, mesh, axis_name="pp",
+                         num_microbatches=4):
+    """``features``: [B, F] → f32 logits [B, C]; B must divide into
+    ``num_microbatches`` equal microbatches."""
+    b = features.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} does not divide into "
+                         f"{num_microbatches} microbatches")
+    x = features @ params["embed"]
+    x_mb = x.reshape(num_microbatches, b // num_microbatches, -1)
+    out = pipeline_forward(params, x_mb, mesh, axis_name)
+    logits = out.reshape(b, -1) @ params["head"]
+    return logits.astype(jnp.float32)
+
+
+def reference_forward(params, features):
+    """Sequential oracle: the same stack applied block by block on one
+    device — the pipeline must match it exactly."""
+    x = features @ params["embed"]
+    for i in range(params["w1"].shape[0]):
+        x = _block(params["w1"][i], params["w2"][i], x)
+    return (x @ params["head"]).astype(jnp.float32)
+
+
+def make_pipeline_train_step(learning_rate=0.05, mesh=None, axis_name="pp",
+                             num_microbatches=4):
+    """``step(params, features, labels, mask) -> (params, loss)`` — masked
+    cross-entropy + SGD through the pipeline schedule (backward runs the
+    transposed pipeline; no hand-written schedule)."""
+    def loss_fn(params, features, labels, mask):
+        logits = apply_pipeline_model(params, features, mesh,
+                                      axis_name=axis_name,
+                                      num_microbatches=num_microbatches)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        nll = jnp.where(mask, nll, 0.0)
+        return nll.sum() / jnp.maximum(mask.sum(), 1).astype(jnp.float32)
+
+    def step(params, features, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, features, labels,
+                                                  mask)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p - learning_rate * g).astype(p.dtype),
+            params, grads)
+        return new_params, loss
+
+    return step

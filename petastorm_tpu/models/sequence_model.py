@@ -162,12 +162,10 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
     # The softmax stats start as constants but the loop body mixes them with
     # the (sequence-varying) K/V blocks; mark them varying over the ring axis
     # so the fori_loop carry types line up under shard_map's vma typing.
+    from petastorm_tpu.models._shard_compat import mark_varying
+
     def varying(x):
-        axes = tuple(varying_axes or (axis_name,))
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
-            return pcast(x, axes, to="varying")
-        return jax.lax.pvary(x, axes)  # pre-pcast jax versions
+        return mark_varying(x, varying_axes or (axis_name,))
 
     init = (k, v,
             varying(jnp.zeros((b, h, l, dh), jnp.float32)),

@@ -1,0 +1,110 @@
+"""Pipeline-parallel schedule tests over the virtual CPU mesh: the shard_map
++ ppermute + scan GPipe schedule must match the sequential stack exactly,
+forward and backward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from petastorm_tpu.models.pipeline import (
+    apply_pipeline_model,
+    init_pipeline_params,
+    make_pipeline_train_step,
+    pipeline_param_partition_specs,
+    reference_forward,
+)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("pp",))
+
+
+def _params(n_stages, seed=0):
+    return init_pipeline_params(jax.random.PRNGKey(seed), feature_dim=6,
+                                d_model=16, d_hidden=32,
+                                num_stages=n_stages, num_classes=3)
+
+
+def test_pipeline_forward_matches_sequential_stack():
+    mesh = _mesh(4)
+    params = _params(4)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 6).astype(np.float32))
+    got = apply_pipeline_model(params, x, mesh, num_microbatches=4)
+    want = reference_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_forward_more_microbatches_than_stages():
+    mesh = _mesh(2)
+    params = _params(2, seed=1)
+    x = jnp.asarray(np.random.RandomState(1).randn(12, 6).astype(np.float32))
+    got = apply_pipeline_model(params, x, mesh, num_microbatches=6)
+    want = reference_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential_stack():
+    """The transposed schedule (scan+ppermute autodiff) must equal the
+    sequential stack's gradients — including zero contribution from
+    warmup/drain bubble compute."""
+    mesh = _mesh(4)
+    params = _params(4, seed=2)
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 6).astype(np.float32))
+    labels = jnp.asarray(np.arange(8) % 3, jnp.int32)
+
+    def loss_pp(p):
+        logits = apply_pipeline_model(p, x, mesh, num_microbatches=4)
+        return -jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                    labels[:, None], 1).mean()
+
+    def loss_ref(p):
+        logits = reference_forward(p, x)
+        return -jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                    labels[:, None], 1).mean()
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for key in params:
+        np.testing.assert_allclose(np.asarray(g_pp[key]),
+                                   np.asarray(g_ref[key]),
+                                   rtol=1e-4, atol=1e-5, err_msg=key)
+
+
+def test_pipeline_train_step_descends_sharded():
+    mesh = _mesh(4)
+    params = _params(4, seed=3)
+    specs = pipeline_param_partition_specs()
+    params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    step = jax.jit(make_pipeline_train_step(0.1, mesh=mesh,
+                                            num_microbatches=4))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 6).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 3, 8), jnp.int32)
+    mask = jnp.ones(8, bool)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, x, labels, mask)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_rejects_mismatched_stage_count():
+    mesh = _mesh(4)
+    params = _params(2)
+    x = jnp.zeros((8, 6), jnp.float32)
+    with pytest.raises(ValueError, match="stages"):
+        apply_pipeline_model(params, x, mesh, num_microbatches=4)
+
+
+def test_pipeline_rejects_indivisible_batch():
+    mesh = _mesh(2)
+    params = _params(2)
+    with pytest.raises(ValueError, match="microbatches"):
+        apply_pipeline_model(params, jnp.zeros((7, 6), jnp.float32), mesh,
+                             num_microbatches=4)
