@@ -21,9 +21,15 @@ from petastorm_tpu.jax_utils.batcher import (
     collate_ngram_rows,
     collate_rows,
 )
+from petastorm_tpu.jax_utils.checkpoint import (
+    restore_training_state,
+    save_training_state,
+)
 from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
 from petastorm_tpu.jax_utils.sharding import (
+    agree_max_batches,
     batch_sharding,
+    count_deliverable_batches,
     default_shard_options,
     derive_equal_step_max_batches,
     global_step_count,
@@ -40,5 +46,9 @@ __all__ = [
     "batch_sharding",
     "global_step_count",
     "derive_equal_step_max_batches",
+    "agree_max_batches",
+    "count_deliverable_batches",
     "local_data_to_global_array",
+    "save_training_state",
+    "restore_training_state",
 ]

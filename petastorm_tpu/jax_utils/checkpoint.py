@@ -1,0 +1,99 @@
+"""Joint model + input-pipeline checkpointing (orbax + reader state).
+
+The reference has no checkpointable reader state at all (SURVEY.md §5
+"Checkpoint / resume: absent for readers"); this framework added resumable
+iteration (``Reader.state_dict`` / ``resume_state=``,
+``JaxDataLoader.state_dict``). What was still the user's job is gluing that
+to MODEL checkpointing so a preempted training job restores both halves
+consistently — this module is that glue:
+
+- model arrays (params / optimizer state — any pytree of jax/numpy arrays)
+  go through ``orbax.checkpoint`` (async-capable, TPU-aware restore);
+- the loader/reader input state (a small JSON-serializable dict) rides in
+  the same checkpoint directory as a JSON file, captured BETWEEN steps from
+  the training thread — the consistency point the resume machinery is
+  specified against (at-least-once delivery on restore).
+
+On a pod every host checkpoints its OWN input state (shard identity is part
+of it) while orbax handles the array layout; restore hands each host back
+the state it saved (``input_state.<process_index>.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_INPUT_STATE_TMPL = "input_state.{}.json"
+_ARRAYS_DIR = "arrays"
+
+
+def _process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax missing/uninitialized
+        return 0
+
+
+def save_training_state(directory, arrays, loader=None, input_state=None,
+                        force=True):
+    """Write ``arrays`` (pytree) + the input-pipeline state under
+    ``directory``.
+
+    :param arrays: pytree of params / optimizer state (jax or numpy arrays).
+    :param loader: a :class:`~petastorm_tpu.jax_utils.loader.JaxDataLoader`
+        to snapshot via its ``state_dict()`` (call between steps). Mutually
+        exclusive with ``input_state``.
+    :param input_state: a pre-captured reader/loader state dict.
+    :param force: overwrite an existing checkpoint at ``directory``.
+    """
+    if loader is not None and input_state is not None:
+        raise ValueError("pass loader OR input_state, not both")
+    if loader is not None:
+        input_state = loader.state_dict()
+
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(directory, _ARRAYS_DIR), arrays, force=force)
+    ckptr.wait_until_finished()
+    if input_state is not None:
+        path = os.path.join(directory,
+                            _INPUT_STATE_TMPL.format(_process_index()))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(input_state, f)
+        os.replace(tmp, path)  # atomic publish
+    return directory
+
+
+def restore_training_state(directory, abstract_arrays=None):
+    """Restore ``(arrays, input_state)`` from ``directory``.
+
+    :param abstract_arrays: optional pytree of ``jax.ShapeDtypeStruct`` (or
+        concrete arrays) guiding orbax's typed/sharded restore; ``None``
+        restores as saved.
+    :return: ``(arrays, input_state_or_None)`` — pass the input state as
+        ``resume_state=`` to the reader factory feeding a fresh loader
+        (buffered-but-unyielded rows are re-read: at-least-once).
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    ckptr = ocp.StandardCheckpointer()
+    arrays_path = os.path.join(directory, _ARRAYS_DIR)
+    if abstract_arrays is None:
+        arrays = ckptr.restore(arrays_path)
+    else:
+        arrays = ckptr.restore(arrays_path, abstract_arrays)
+    path = os.path.join(directory,
+                        _INPUT_STATE_TMPL.format(_process_index()))
+    input_state = None
+    if os.path.exists(path):
+        with open(path) as f:
+            input_state = json.load(f)
+    return arrays, input_state

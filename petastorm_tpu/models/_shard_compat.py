@@ -8,8 +8,13 @@ import jax
 def mark_varying(x, axis_names):
     """Mark ``x`` as varying over ``axis_names`` for shard_map's vma typing
     (constants mixed with per-shard data inside loop carries need this).
-    Handles the pcast→pvary API split across JAX versions in ONE place."""
+    Idempotent — axes ``x`` already varies over are skipped (pcast rejects
+    re-casting). Handles the pcast→pvary API split in ONE place."""
     pcast = getattr(jax.lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, tuple(axis_names), to="varying")
-    return jax.lax.pvary(x, tuple(axis_names))  # pre-pcast jax versions
+    if pcast is None:
+        return jax.lax.pvary(x, tuple(axis_names))  # pre-pcast jax versions
+    current = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in axis_names if a not in current)
+    if not missing:
+        return x
+    return pcast(x, missing, to="varying")

@@ -68,7 +68,8 @@ def _block(w1, w2, x):
     return x + jax.nn.relu(x @ w1) @ w2
 
 
-def _pipeline_body(w1, w2, x_mb, axis_name, num_stages, num_microbatches):
+def _pipeline_body(w1, w2, x_mb, axis_name, num_stages, num_microbatches,
+                   batch_axis=None):
     """Per-device pipeline schedule (runs inside shard_map).
 
     ``w1``/``w2``: this stage's block, ``[1, d, h]`` / ``[1, h, d]``.
@@ -109,7 +110,8 @@ def _pipeline_body(w1, w2, x_mb, axis_name, num_stages, num_microbatches):
     from petastorm_tpu.models._shard_compat import mark_varying
 
     def varying(v):
-        return mark_varying(v, (axis_name,))
+        axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
+        return mark_varying(v, axes)
 
     (_, outs), _ = jax.lax.scan(
         tick, (varying(init_act), varying(init_outs)),
@@ -117,9 +119,14 @@ def _pipeline_body(w1, w2, x_mb, axis_name, num_stages, num_microbatches):
     return outs[None]
 
 
-def pipeline_forward(params, x_mb, mesh, axis_name="pp"):
+def pipeline_forward(params, x_mb, mesh, axis_name="pp", batch_axis=None):
     """``[M, mb, d_model]`` microbatches → ``[M, mb, d_model]`` through the
-    S-stage pipeline sharded over ``mesh[axis_name]``."""
+    S-stage pipeline sharded over ``mesh[axis_name]``.
+
+    ``batch_axis``: mesh axis the microbatch dim (axis 1) is sharded over —
+    dp × pp: each (data, pp) device runs the same schedule on its slice of
+    every microbatch; the ``ppermute`` shifts stay within each data group.
+    """
     from jax import shard_map
 
     num_stages = mesh.shape[axis_name]
@@ -129,25 +136,30 @@ def pipeline_forward(params, x_mb, mesh, axis_name="pp"):
             f"{axis_name!r} axis has {num_stages} devices")
     body = functools.partial(_pipeline_body, axis_name=axis_name,
                              num_stages=num_stages,
-                             num_microbatches=x_mb.shape[0])
+                             num_microbatches=x_mb.shape[0],
+                             batch_axis=batch_axis)
+    x_spec = P(None, batch_axis, None)
     stacked = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P()),
-        out_specs=P(axis_name))(params["w1"], params["w2"], x_mb)
+        in_specs=(P(axis_name), P(axis_name), x_spec),
+        out_specs=P(axis_name, None, batch_axis, None))(
+        params["w1"], params["w2"], x_mb)
     return stacked[-1]  # the last stage's copy holds the real outputs
 
 
 def apply_pipeline_model(params, features, mesh, axis_name="pp",
-                         num_microbatches=4):
+                         num_microbatches=4, batch_axis=None):
     """``features``: [B, F] → f32 logits [B, C]; B must divide into
-    ``num_microbatches`` equal microbatches."""
+    ``num_microbatches`` equal microbatches. ``batch_axis``: mesh axis for
+    data parallelism over the microbatch dim (dp × pp)."""
     b = features.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} does not divide into "
                          f"{num_microbatches} microbatches")
     x = features @ params["embed"]
     x_mb = x.reshape(num_microbatches, b // num_microbatches, -1)
-    out = pipeline_forward(params, x_mb, mesh, axis_name)
+    out = pipeline_forward(params, x_mb, mesh, axis_name,
+                           batch_axis=batch_axis)
     logits = out.reshape(b, -1) @ params["head"]
     return logits.astype(jnp.float32)
 
@@ -162,14 +174,15 @@ def reference_forward(params, features):
 
 
 def make_pipeline_train_step(learning_rate=0.05, mesh=None, axis_name="pp",
-                             num_microbatches=4):
+                             num_microbatches=4, batch_axis=None):
     """``step(params, features, labels, mask) -> (params, loss)`` — masked
     cross-entropy + SGD through the pipeline schedule (backward runs the
     transposed pipeline; no hand-written schedule)."""
     def loss_fn(params, features, labels, mask):
         logits = apply_pipeline_model(params, features, mesh,
                                       axis_name=axis_name,
-                                      num_microbatches=num_microbatches)
+                                      num_microbatches=num_microbatches,
+                                      batch_axis=batch_axis)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         nll = jnp.where(mask, nll, 0.0)

@@ -108,3 +108,32 @@ def test_pipeline_rejects_indivisible_batch():
     with pytest.raises(ValueError, match="microbatches"):
         apply_pipeline_model(params, jnp.zeros((7, 6), jnp.float32), mesh,
                              num_microbatches=4)
+
+
+def test_pipeline_dp_x_pp_mesh():
+    """Combined data x pipeline mesh: batch sharded over "data", stages
+    over "pp" — must still match the sequential stack, and train."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "pp"))
+    params = _params(4, seed=5)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(8, 6).astype(np.float32))
+    got = apply_pipeline_model(params, x, mesh, num_microbatches=4,
+                               batch_axis="data")
+    want = reference_forward(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    specs = pipeline_param_partition_specs()
+    sharded_params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                      for k, v in params.items()}
+    step = jax.jit(make_pipeline_train_step(0.1, mesh=mesh,
+                                            num_microbatches=4,
+                                            batch_axis="data"))
+    labels = jnp.asarray(rng.randint(0, 3, 8), jnp.int32)
+    losses = []
+    p = sharded_params
+    for _ in range(4):
+        p, loss = step(p, x, labels, jnp.ones(8, bool))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
