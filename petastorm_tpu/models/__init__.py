@@ -20,7 +20,15 @@ from petastorm_tpu.models.tabular_dlrm import (
     init_dlrm_params,
     make_dlrm_train_step,
 )
+from petastorm_tpu.models.moe import (
+    apply_moe_model,
+    init_moe_params,
+    make_moe_train_step,
+    moe_param_partition_specs,
+)
 
 __all__ = ["init_params", "apply_model", "make_train_step",
            "param_partition_specs", "init_dlrm_params", "apply_dlrm",
-           "make_dlrm_train_step", "dlrm_partition_specs"]
+           "make_dlrm_train_step", "dlrm_partition_specs",
+           "init_moe_params", "apply_moe_model", "make_moe_train_step",
+           "moe_param_partition_specs"]

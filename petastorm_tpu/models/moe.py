@@ -1,0 +1,232 @@
+"""Expert-parallel mixture-of-experts — the ep axis of the parallelism story.
+
+The reference ships no model compute (SURVEY.md §2: petastorm is an
+input-data library); this module completes the parallelism families the TPU
+delivery path exercises end-to-end — dp (batch sharding), tp
+(``image_classifier``), sp (``sequence_model``), pp (``pipeline``),
+model-parallel tables (``tabular_dlrm``) — with true token-routed expert
+parallelism.
+
+The construction is the canonical TPU MoE (GShard/Switch recipe):
+
+- the E experts' FFN weights live STACKED ``[E, ...]`` and shard over the
+  mesh's ``"ep"`` axis; tokens shard over the same axis (each device is both
+  a data shard and an expert host, as in GShard);
+- routing is **top-1 with a fixed capacity** ``C`` per (expert, data shard):
+  static shapes throughout — tokens beyond capacity are *dropped* (their MoE
+  output is exactly zero, so the surrounding residual connection passes them
+  through unchanged). Dispatch/combine are one-hot einsum contractions, so
+  the scatter/gather the routing implies runs as batched matmuls on the MXU
+  instead of dynamic scatters XLA can't tile;
+- inside ``shard_map``, two ``lax.all_to_all`` collectives over ``"ep"``
+  move ``[E, C, d]`` token slots to their expert owners and back — the ICI
+  realization of the NCCL all-to-all GPU MoE stacks hand-write. Backward is
+  the same pair of all_to_alls run by transposition — no custom gradient;
+- the Switch load-balancing auxiliary loss (num_experts ×
+  Σ_e fraction_routed_e · mean_gate_e, = 1 at perfect balance) is returned
+  alongside the output so training can keep the router from collapsing.
+
+``reference_forward`` runs the identical routing math (including capacity
+drops) densely on one device — the sharded path must match it exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe_params(rng, feature_dim, d_model=32, d_hidden=64,
+                    num_experts=8, num_classes=10, dtype=jnp.float32):
+    """Parameter pytree: replicated embed/router/head + ``[E, ...]``-stacked
+    expert FFNs (shard the leading axis over ``"ep"``).
+
+    Keep ``num_experts`` a multiple of the mesh's ep-axis size.
+    """
+    keys = jax.random.split(rng, 5)
+    s = lambda fan: 1.0 / jnp.sqrt(fan)  # noqa: E731
+    return {
+        "embed": jax.random.normal(keys[0], (feature_dim, d_model),
+                                   dtype) * s(feature_dim),
+        "router": jax.random.normal(keys[1], (d_model, num_experts),
+                                    dtype) * s(d_model),
+        "w1": jax.random.normal(keys[2], (num_experts, d_model, d_hidden),
+                                dtype) * s(d_model),
+        "w2": jax.random.normal(keys[3], (num_experts, d_hidden, d_model),
+                                dtype) * s(d_hidden),
+        "head": jax.random.normal(keys[4], (d_model, num_classes),
+                                  dtype) * s(d_model),
+    }
+
+
+def moe_param_partition_specs():
+    """PartitionSpecs over a mesh with an ``"ep"`` axis: expert stacks split
+    on their leading (expert) axis; embed/router/head replicated (tiny)."""
+    return {"embed": P(), "router": P(),
+            "w1": P("ep", None, None), "w2": P("ep", None, None),
+            "head": P()}
+
+
+def _route_top1(gates, capacity):
+    """Top-1 routing with a fixed per-expert capacity.
+
+    ``gates``: ``[n, E]`` router softmax.  Returns ``(dispatch, combine,
+    aux)`` where ``dispatch`` is the ``[n, E, C]`` one-hot token→slot
+    assignment, ``combine = dispatch * gate`` carries the router weight back
+    to the token, and ``aux`` is the Switch load-balance loss. Tokens whose
+    expert queue is already full get all-zero rows (dropped).
+    """
+    n, num_experts = gates.shape
+    expert_idx = jnp.argmax(gates, axis=1)  # [n]
+    # Routing bookkeeping stays int32/f32 regardless of the gate dtype: a
+    # bf16 cumsum is exact only to 256, which would collide queue positions
+    # (two tokens in one slot) once capacity grows past it.
+    onehot_i = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)
+    # Queue position of each token within its chosen expert (0-based):
+    # cumsum over the token axis counts earlier claims on the same expert.
+    pos = (jnp.cumsum(onehot_i, axis=0) - 1) * onehot_i  # [n, E]
+    keep = (pos < capacity) & (onehot_i > 0)  # [n, E] bool
+    slot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, E, C]
+    dispatch = slot * keep.astype(gates.dtype)[..., None]
+    onehot = onehot_i.astype(gates.dtype)
+    gate_val = (gates * onehot).sum(axis=1)  # [n] chosen gate prob
+    combine = dispatch * gate_val[:, None, None]
+    # Switch aux loss: E * Σ_e (fraction of tokens routed to e) * (mean gate
+    # prob of e). 1.0 at perfect balance; grows as routing collapses.
+    # Accumulated in f32 — a bf16 mean over many tokens loses the signal.
+    fraction = onehot_i.astype(jnp.float32).mean(axis=0)
+    importance = gates.astype(jnp.float32).mean(axis=0)
+    aux = num_experts * jnp.sum(fraction * importance)
+    return dispatch, combine, aux
+
+
+def _moe_body(w1, w2, router, x, axis_name, capacity, batch_axis=None):
+    """Per-device MoE layer (runs inside shard_map over ``"ep"``).
+
+    ``w1``/``w2``: this device's expert slice, ``[E_local, d, h]`` /
+    ``[E_local, h, d]``. ``x``: local tokens ``[n_local, d]``. Returns the
+    local tokens' MoE output (zero rows for dropped tokens) + aux loss.
+    """
+    gates = jax.nn.softmax(x @ router)  # [n_local, E]
+    dispatch, combine, aux = _route_top1(gates, capacity)
+    # Local contribution to every expert's queue, then all_to_all so each
+    # device receives its experts' slots from all data shards: [E, C, d] →
+    # [E_local, ep*C, d]. The transpose (backward) is the reverse exchange.
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)
+    expert_in = jax.lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    h = jax.nn.relu(jnp.einsum("egd,edh->egh", expert_in, w1))
+    out = jnp.einsum("egh,ehd->egd", h, w2)  # [E_local, ep*C, d]
+    out = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                             tiled=True)  # [E, C, d] back at the data owner
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+    aux = jax.lax.pmean(aux, axis_name)
+    if batch_axis is not None:
+        aux = jax.lax.pmean(aux, batch_axis)
+    return y, aux
+
+
+def _capacity(tokens_per_shard, num_experts, capacity_factor):
+    """Static per-(expert, data-shard) queue length."""
+    return max(1, int(tokens_per_shard * capacity_factor / num_experts))
+
+
+def moe_ffn(params, x, mesh, axis_name="ep", capacity_factor=2.0,
+            batch_axis=None):
+    """Routed expert FFN over tokens ``x`` ``[N, d_model]`` → ``(y, aux)``.
+
+    ``N`` must divide by the mesh's token-sharding extent (ep × optional
+    ``batch_axis`` for dp × ep — routing and the capacity budget are then
+    per (dp, ep) shard, with expert weights replicated over dp).
+    """
+    from jax import shard_map
+
+    ep = mesh.shape[axis_name]
+    if params["w1"].shape[0] % ep:
+        raise ValueError(
+            f"{params['w1'].shape[0]} experts do not split over the mesh's "
+            f"{axis_name!r} axis of {ep} devices")
+    token_axes = ((batch_axis,) if batch_axis else ()) + (axis_name,)
+    shards = 1
+    for a in token_axes:
+        shards *= mesh.shape[a]
+    if x.shape[0] % shards:
+        raise ValueError(f"{x.shape[0]} tokens do not shard over {shards} "
+                         f"devices ({token_axes})")
+    capacity = _capacity(x.shape[0] // shards, params["w1"].shape[0],
+                         capacity_factor)
+    body = functools.partial(_moe_body, axis_name=axis_name,
+                             capacity=capacity, batch_axis=batch_axis)
+    x_spec = P(token_axes)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name, None, None), P(axis_name, None, None), P(),
+                  x_spec),
+        out_specs=(x_spec, P()))(
+        params["w1"], params["w2"], params["router"], x)
+
+
+def apply_moe_model(params, features, mesh, axis_name="ep",
+                    capacity_factor=2.0, batch_axis=None):
+    """``features`` ``[B, F]`` → ``(logits [B, C] f32, aux)`` through
+    embed → residual MoE FFN → head."""
+    x = features @ params["embed"]
+    y, aux = moe_ffn(params, x, mesh, axis_name=axis_name,
+                     capacity_factor=capacity_factor, batch_axis=batch_axis)
+    x = x + y  # dropped tokens pass through the residual unchanged
+    return (x @ params["head"]).astype(jnp.float32), aux
+
+
+def reference_forward(params, features, num_shards=1, capacity_factor=2.0):
+    """Dense single-device oracle running the IDENTICAL routing math —
+    including per-shard capacity drops when ``num_shards`` matches the
+    sharded run's token-shard count — that the ep-sharded path must match."""
+    x = features @ params["embed"]
+    n, d = x.shape
+    capacity = _capacity(n // num_shards, params["w1"].shape[0],
+                         capacity_factor)
+    outs = []
+    auxes = []
+    for shard in range(num_shards):
+        xs = x[shard * (n // num_shards):(shard + 1) * (n // num_shards)]
+        gates = jax.nn.softmax(xs @ params["router"])
+        dispatch, combine, aux = _route_top1(gates, capacity)
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xs)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
+        out = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+        outs.append(jnp.einsum("ecd,nec->nd", out, combine))
+        auxes.append(aux)
+    y = x + jnp.concatenate(outs, axis=0)
+    logits = (y @ params["head"]).astype(jnp.float32)
+    return logits, jnp.mean(jnp.stack(auxes))
+
+
+def make_moe_train_step(learning_rate=0.05, aux_weight=0.01, mesh=None,
+                        axis_name="ep", capacity_factor=2.0,
+                        batch_axis=None):
+    """``step(params, features, labels, mask) -> (params, loss)`` — masked
+    cross-entropy + Switch aux loss, SGD through both all_to_alls."""
+
+    def loss_fn(params, features, labels, mask):
+        logits, aux = apply_moe_model(params, features, mesh,
+                                      axis_name=axis_name,
+                                      capacity_factor=capacity_factor,
+                                      batch_axis=batch_axis)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        nll = jnp.where(mask, nll, 0.0)
+        ce = nll.sum() / jnp.maximum(mask.sum(), 1).astype(jnp.float32)
+        return ce + aux_weight * aux
+
+    def step(params, features, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, features, labels,
+                                                  mask)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: (p - learning_rate * g).astype(p.dtype),
+            params, grads)
+        return new_params, loss
+
+    return step

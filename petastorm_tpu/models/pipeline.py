@@ -156,6 +156,12 @@ def apply_pipeline_model(params, features, mesh, axis_name="pp",
     if b % num_microbatches:
         raise ValueError(f"batch {b} does not divide into "
                          f"{num_microbatches} microbatches")
+    if batch_axis is not None and mesh is not None:
+        data = mesh.shape[batch_axis]
+        if (b // num_microbatches) % data:
+            raise ValueError(
+                f"microbatch size {b // num_microbatches} does not shard "
+                f"over the {data}-device {batch_axis!r} axis")
     x = features @ params["embed"]
     x_mb = x.reshape(num_microbatches, b // num_microbatches, -1)
     out = pipeline_forward(params, x_mb, mesh, axis_name,

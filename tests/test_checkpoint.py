@@ -57,3 +57,122 @@ def test_restore_without_input_state(tmp_path):
     arrays, state = restore_training_state(ckpt)
     np.testing.assert_array_equal(np.asarray(arrays["x"]), np.arange(4.0))
     assert state is None
+
+
+def _current_version_dir(ckpt):
+    import os
+
+    with open(os.path.join(ckpt, "CURRENT")) as f:
+        return os.path.join(ckpt, f.read().strip())
+
+
+def test_restore_rejects_torn_checkpoint(tmp_path):
+    """A published version missing this host's commit marker must raise,
+    not silently restore arrays next to stale/missing input state."""
+    import os
+
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)},
+                               input_state={"kind": "reader", "v": 1})
+    vdir = _current_version_dir(ckpt)
+    marker = [f for f in os.listdir(vdir) if f.startswith("COMMITTED.")]
+    assert len(marker) == 1
+    os.remove(os.path.join(vdir, marker[0]))  # simulate the torn save
+    with pytest.raises(RuntimeError, match="torn"):
+        restore_training_state(ckpt)
+
+
+def test_restore_rejects_host_count_mismatch(tmp_path, monkeypatch):
+    """A checkpoint saved by N hosts refuses to restore under a different
+    process count — the other hosts' reader positions would silently drop."""
+    import petastorm_tpu.jax_utils.checkpoint as cp
+
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)},
+                               input_state={"step": 1})
+    monkeypatch.setattr(cp, "_process_count", lambda: 4)
+    with pytest.raises(RuntimeError, match="saved by 1 host"):
+        restore_training_state(ckpt)
+
+
+def test_unpublished_directory_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="no published checkpoint"):
+        restore_training_state(tmp_path / "nothing_here")
+
+
+def test_prune_spares_user_directories(tmp_path):
+    """Only strict v<int> names are this module's to prune; a user's
+    'vocab/' or 'v1_backup/' under the checkpoint root must survive."""
+    import os
+
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)})
+    os.makedirs(os.path.join(ckpt, "vocab"))
+    os.makedirs(os.path.join(ckpt, "v1_backup"))
+    save_training_state(tmp_path / "c", {"x": np.arange(4.0) * 2})
+    assert os.path.isdir(os.path.join(ckpt, "vocab"))
+    assert os.path.isdir(os.path.join(ckpt, "v1_backup"))
+    arrays, _ = restore_training_state(ckpt)
+    np.testing.assert_array_equal(np.asarray(arrays["x"]),
+                                  np.arange(4.0) * 2)
+
+
+def test_resave_over_existing_checkpoint_stays_committed(tmp_path):
+    """force=True overwrite of a complete checkpoint yields a complete
+    checkpoint (staged in a sibling dir, swapped in whole)."""
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)},
+                               input_state={"step": 1})
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0) * 2},
+                               input_state={"step": 2})
+    arrays, state = restore_training_state(ckpt)
+    np.testing.assert_array_equal(np.asarray(arrays["x"]),
+                                  np.arange(4.0) * 2)
+    assert state == {"step": 2}
+
+
+def test_refused_save_leaves_existing_checkpoint_intact(tmp_path):
+    """force=False against an existing checkpoint must refuse BEFORE
+    touching anything — the original stays fully restorable."""
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)},
+                               input_state={"step": 1})
+    with pytest.raises(ValueError, match="already exists"):
+        save_training_state(tmp_path / "c", {"x": np.arange(4.0) * 2},
+                            input_state={"step": 2}, force=False)
+    arrays, state = restore_training_state(ckpt)
+    np.testing.assert_array_equal(np.asarray(arrays["x"]), np.arange(4.0))
+    assert state == {"step": 1}
+
+
+def test_crash_during_overwrite_preserves_last_good_checkpoint(tmp_path,
+                                                               monkeypatch):
+    """A crash at ANY point before the CURRENT pointer moves loses only the
+    new save; the previous good checkpoint still restores, and the next
+    successful save prunes the crashed version's debris."""
+    import os
+
+    import petastorm_tpu.jax_utils.checkpoint as cp
+
+    ckpt = save_training_state(tmp_path / "c", {"x": np.arange(4.0)},
+                               input_state={"step": 1})
+    real_write = cp._write_checkpoint
+
+    def crashing_write(directory, arrays, input_state):
+        real_write(directory, arrays, None)  # arrays land...
+        raise RuntimeError("preempted")  # ...but the save never completes
+
+    monkeypatch.setattr(cp, "_write_checkpoint", crashing_write)
+    with pytest.raises(RuntimeError, match="preempted"):
+        save_training_state(tmp_path / "c", {"x": np.arange(4.0) * 2},
+                            input_state={"step": 2})
+    monkeypatch.undo()
+    arrays, state = restore_training_state(ckpt)
+    np.testing.assert_array_equal(np.asarray(arrays["x"]), np.arange(4.0))
+    assert state == {"step": 1}
+
+    # next good save supersedes + prunes every other version dir
+    save_training_state(tmp_path / "c", {"x": np.arange(4.0) * 5},
+                        input_state={"step": 3})
+    arrays, state = restore_training_state(ckpt)
+    np.testing.assert_array_equal(np.asarray(arrays["x"]),
+                                  np.arange(4.0) * 5)
+    assert state == {"step": 3}
+    versions = [n for n in os.listdir(ckpt)
+                if os.path.isdir(os.path.join(ckpt, n))]
+    assert len(versions) == 1  # crashed + superseded versions pruned
