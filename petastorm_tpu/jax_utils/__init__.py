@@ -26,6 +26,12 @@ from petastorm_tpu.jax_utils.checkpoint import (
     save_training_state,
 )
 from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
+from petastorm_tpu.jax_utils.packing import (
+    PACK_POSITION_KEY,
+    PACK_SEGMENT_KEY,
+    pack_ragged,
+    packed_valid_mask,
+)
 from petastorm_tpu.jax_utils.sharding import (
     agree_max_batches,
     batch_sharding,
@@ -51,4 +57,8 @@ __all__ = [
     "local_data_to_global_array",
     "save_training_state",
     "restore_training_state",
+    "pack_ragged",
+    "packed_valid_mask",
+    "PACK_SEGMENT_KEY",
+    "PACK_POSITION_KEY",
 ]

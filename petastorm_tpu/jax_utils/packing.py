@@ -1,0 +1,139 @@
+"""Sequence packing: ragged rows → dense ``[B, T]`` batches + segment ids.
+
+The TPU-first answer to ragged long-context input. The loader's
+``last_batch="pad"`` path pads every example to the static T — at long T
+with skewed length distributions most MXU FLOPs hit padding. Packing lays
+MULTIPLE sequences end-to-end in each batch row instead, and the attention
+kernel keeps them from attending to each other via ``segment_ids``
+(``ops.flash_attention(segment_ids=...)`` masks cross-segment pairs
+in-kernel; ``models.sequence_model.attention_reference`` is the dense
+oracle). Static shapes throughout — XLA sees one ``[B, T]`` program
+regardless of how many sequences each batch carries.
+
+This is a host-side (numpy) stage: run it between the reader and
+``device_put``/``make_jax_dataloader``-style staging, the same place the
+batcher lives. The reference has no packing (its NGram windows are
+fixed-length by construction — SURVEY.md §5 "long-context"); this exists
+for the variable-length sequence corpora the flash kernel targets.
+
+Conventions of the packed layout:
+
+- ``segment_ids[b, t]``: 0-based index of the sequence occupying slot
+  position ``t`` of batch row ``b``; **-1 marks padding**. Valid-token mask
+  = ``segment_ids >= 0`` (padding positions attend only among themselves —
+  mask them out of the loss).
+- ``positions[b, t]``: offset WITHIN the sequence (0 at each sequence
+  start; 0 on padding) — feed rotary/learned position embeddings from this,
+  not from ``t``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+PACK_SEGMENT_KEY = "__segment_ids__"
+PACK_POSITION_KEY = "__positions__"
+
+
+def packed_valid_mask(segment_ids):
+    """Boolean [B, T] mask of real (non-padding) token positions."""
+    return np.asarray(segment_ids) >= 0
+
+
+def pack_ragged(rows, slot_len, slots, keys=None):
+    """Pack an iterable of ragged rows into dense batches (generator).
+
+    :param rows: iterable of dicts; every packed field must be an array
+        whose LEADING axis is the sequence length (lengths may differ per
+        row, trailing dims must agree). Non-array / scalar fields are
+        dropped (packing has no per-sequence row to carry them on — keep
+        them upstream or fold them into a packed field).
+    :param slot_len: tokens per batch row (the static T).
+    :param slots: batch rows per emitted batch (the static B).
+    :param keys: fields to pack (default: every ndarray field of the first
+        row with ndim >= 1).
+    :return: yields dicts of ``{key: [slots, slot_len, ...]}`` plus
+        ``PACK_SEGMENT_KEY`` / ``PACK_POSITION_KEY`` int32 arrays. The final
+        batch is emitted even if partially filled (all -1 rows possible).
+
+    Sequences are placed first-fit into the open batch's rows; a sequence
+    longer than ``slot_len`` raises (truncation would silently corrupt the
+    training distribution — split upstream instead), and zero-length
+    sequences are skipped (they carry no tokens to place).
+    """
+    state = None
+
+    def fresh(proto):
+        nonlocal keys
+        if keys is None:
+            keys = [k for k, val in proto.items() if val.ndim >= 1]
+            if not keys:
+                raise ValueError("no packable (array) fields in row")
+        cols = {}
+        for key in keys:
+            trailing = proto[key].shape[1:]
+            cols[key] = np.zeros((slots, slot_len) + trailing,
+                                 proto[key].dtype)
+        seg = np.full((slots, slot_len), -1, np.int32)
+        pos = np.zeros((slots, slot_len), np.int32)
+        return {"cols": cols, "seg": seg, "pos": pos,
+                "used": np.zeros(slots, np.int64),
+                "count": np.zeros(slots, np.int32)}
+
+    def emit(st):
+        out = {k: v for k, v in st["cols"].items()}
+        out[PACK_SEGMENT_KEY] = st["seg"]
+        out[PACK_POSITION_KEY] = st["pos"]
+        return out
+
+    for row in rows:
+        row = {k: np.asarray(v) for k, v in row.items()}
+        if state is None:
+            state = fresh(row)
+        length = row[keys[0]].shape[0]
+        for key in keys:
+            if row[key].shape[0] != length:
+                raise ValueError(
+                    f"field {key!r} length {row[key].shape[0]} != "
+                    f"{keys[0]!r} length {length} (packed fields must share "
+                    "the sequence axis)")
+        if length > slot_len:
+            raise ValueError(
+                f"sequence of length {length} does not fit slot_len "
+                f"{slot_len}; split long sequences upstream")
+        if length == 0:
+            # An empty sequence carries no tokens: placing it would burn a
+            # segment id with no positions (breaking the exactly-once
+            # round-trip); skip it instead.
+            continue
+        # First-fit: the leftmost row with room.
+        fit = np.nonzero(state["used"] + length <= slot_len)[0]
+        if fit.size == 0:
+            yield emit(state)
+            state = fresh(row)
+            fit = np.array([0])
+        b = int(fit[0])
+        start = int(state["used"][b])
+        for key in keys:
+            state["cols"][key][b, start:start + length] = row[key]
+        state["seg"][b, start:start + length] = state["count"][b]
+        state["pos"][b, start:start + length] = np.arange(length)
+        state["used"][b] += length
+        state["count"][b] += 1
+
+    if state is not None and state["count"].sum() > 0:
+        yield emit(state)
+
+
+def unpack(packed, key):
+    """Recover the list of original sequences of ``packed[key]`` (row-major:
+    batch row 0's segments first) — the inverse of :func:`pack_ragged` for
+    round-trip tests and debugging."""
+    seg = packed[PACK_SEGMENT_KEY]
+    out = []
+    for b in range(seg.shape[0]):
+        for s in range(seg[b].max() + 1):
+            mask = seg[b] == s
+            if mask.any():
+                out.append(np.asarray(packed[key])[b, mask])
+    return out

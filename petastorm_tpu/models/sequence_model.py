@@ -26,13 +26,17 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def attention_reference(q, k, v, causal=False, lengths=None):
+def attention_reference(q, k, v, causal=False, lengths=None,
+                        segment_ids=None):
     """Plain (unsharded) scaled-dot-product attention — numerics oracle for
     the ring version. Shapes: [B, T, H, Dh].
 
     ``causal``: mask keys after each query's position (decoder style).
     ``lengths``: optional per-example valid key counts [B] — keys at or past
     ``lengths[b]`` are masked out (NGram windows shorter than T).
+    ``segment_ids``: optional [B, T] ids for packed batches
+    (``jax_utils.packing``) — positions attend only within their segment;
+    requires T_q == T_kv.
     """
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
@@ -46,6 +50,10 @@ def attention_reference(q, k, v, causal=False, lengths=None):
         valid = (jnp.arange(t_kv)[None, :]
                  < lengths[:, None])[:, None, None, :]         # [B,1,1,Tkv]
         mask = valid if mask is None else mask & valid
+    if segment_ids is not None:
+        same = (segment_ids[:, :, None]
+                == segment_ids[:, None, :])[:, None]           # [B,1,Tq,Tkv]
+        mask = same if mask is None else mask & same
     row_valid = None
     if mask is not None:
         # Rows with no valid key (lengths[b] == 0, or causal cross-length
@@ -78,7 +86,7 @@ def _unstripe(x, sp):
 
 def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
                          causal=False, placement="contiguous",
-                         lengths=None):
+                         lengths=None, segment_ids=None):
     """Per-shard ring attention body (runs inside shard_map).
 
     ``q, k, v``: the local sequence slice, [B, L, H, Dh] with L = T/sp.
@@ -109,9 +117,15 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
     r = jax.lax.axis_index(axis_name)
     row_ids = jnp.arange(l)
 
-    def block_update(k_cur, v_cur, acc, row_max, row_sum, src):
+    def block_update(k_cur, v_cur, kseg_cur, acc, row_max, row_sum, src):
         scores = jnp.einsum("blhd,bmhd->bhlm", qf,
                             k_cur.astype(jnp.float32)) * scale
+        if segment_ids is not None:
+            # Packed batches: the resident K block's ids rotated here with
+            # it, so the same-segment mask needs no position bookkeeping
+            # (and composes with striping — the ids were striped alongside).
+            same = segment_ids[:, :, None] == kseg_cur[:, None, :]  # [B,L,L]
+            scores = jnp.where(same[:, None], scores, -jnp.inf)
         if causal or lengths is not None:
             # ORIGINAL global positions of the resident block's keys (the
             # striped wrapper permuted the sequence; these formulas undo it).
@@ -143,21 +157,24 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
         return acc, new_max, row_sum
 
     def body(i, carry):
-        k_cur, v_cur, acc, row_max, row_sum = carry
+        k_cur, v_cur, kseg_cur, acc, row_max, row_sum = carry
         src = (r - i) % axis_size
         if causal and placement == "contiguous":
             # Fully-future block for this device: skip both matmuls.
             acc, row_max, row_sum = jax.lax.cond(
                 src > r,
-                lambda *args: args[2:],
+                lambda *args: args[3:],
                 lambda *args: block_update(*args, src=src),
-                k_cur, v_cur, acc, row_max, row_sum)
+                k_cur, v_cur, kseg_cur, acc, row_max, row_sum)
         else:
-            acc, row_max, row_sum = block_update(k_cur, v_cur, acc, row_max,
-                                                 row_sum, src=src)
+            acc, row_max, row_sum = block_update(k_cur, v_cur, kseg_cur,
+                                                 acc, row_max, row_sum,
+                                                 src=src)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, acc, row_max, row_sum
+        if segment_ids is not None:
+            kseg_cur = jax.lax.ppermute(kseg_cur, axis_name, perm)
+        return k_nxt, v_nxt, kseg_cur, acc, row_max, row_sum
 
     # The softmax stats start as constants but the loop body mixes them with
     # the (sequence-varying) K/V blocks; mark them varying over the ring axis
@@ -167,17 +184,20 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
     def varying(x):
         return mark_varying(x, varying_axes or (axis_name,))
 
-    init = (k, v,
+    kseg0 = (segment_ids if segment_ids is not None
+             else varying(jnp.zeros((b, l), jnp.int32)))
+    init = (k, v, kseg0,
             varying(jnp.zeros((b, h, l, dh), jnp.float32)),
             varying(jnp.full((b, h, l), -jnp.inf, jnp.float32)),
             varying(jnp.zeros((b, h, l), jnp.float32)))
-    _, _, acc, _, row_sum = jax.lax.fori_loop(0, axis_size, body, init)
+    _, _, _, acc, _, row_sum = jax.lax.fori_loop(0, axis_size, body, init)
     out = acc / jnp.maximum(row_sum, 1e-30)[..., None]
     return jnp.einsum("bhld->blhd", out).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
-                   causal=False, placement="striped", lengths=None):
+                   causal=False, placement="striped", lengths=None,
+                   segment_ids=None):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Inputs are global ``[B, T, H, Dh]`` arrays (sharded or shardable on T);
@@ -193,19 +213,30 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     ``lengths`` ([B] int, optional): keys at or past ``lengths[b]`` are
     masked for example ``b`` — masking is by ORIGINAL position, so it
     composes with the striped permutation.
+    ``segment_ids`` ([B, T] int, optional): packed batches
+    (``jax_utils.packing``) — positions attend only within their segment;
+    the ids ride the K/V ring so masking needs no extra bookkeeping.
+    Mutually exclusive with ``lengths`` (give padding its own id).
     """
     from jax import shard_map
 
     sp = mesh.shape[axis_name]
-    if (causal or lengths is not None) and q.shape[1] != k.shape[1]:
+    if (causal or lengths is not None or segment_ids is not None) \
+            and q.shape[1] != k.shape[1]:
         # Both placements derive key positions from q's local length, and
         # contiguous's full-skip condition assumes the same partitioning.
         raise ValueError(
-            "causal/lengths ring attention requires T_q == T_kv "
+            "causal/lengths/segment ring attention requires T_q == T_kv "
             f"(got {q.shape[1]} vs {k.shape[1]})")
+    if lengths is not None and segment_ids is not None:
+        raise ValueError(
+            "segment_ids and lengths are mutually exclusive: give padded "
+            "slots their own segment id instead")
     striped = causal and placement == "striped"
     if striped:
         q, k, v = _stripe(q, sp), _stripe(k, sp), _stripe(v, sp)
+        if segment_ids is not None:
+            segment_ids = _stripe(segment_ids, sp)
 
     spec = P(batch_axis, axis_name, None, None)
     varying_axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
@@ -217,10 +248,17 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
                               causal=causal,
                               placement="striped" if striped
                               else "contiguous")
-    if lengths is None:
+    if lengths is None and segment_ids is None:
         sharded = shard_map(block, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec)
         out = sharded(q, k, v)
+    elif segment_ids is not None:
+        sharded = shard_map(
+            lambda a, b, c, sg: block(a, b, c, segment_ids=sg),
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P(batch_axis, axis_name)),
+            out_specs=spec)
+        out = sharded(q, k, v, segment_ids)
     else:
         sharded = shard_map(
             lambda a, b, c, le: block(a, b, c, lengths=le),
@@ -236,7 +274,8 @@ ULYSSES_FLASH_THRESHOLD = 1024
 
 
 def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
-                            local_attn="auto", lengths=None):
+                            local_attn="auto", lengths=None,
+                            segment_ids=None):
     """Per-shard Ulysses (all-to-all) attention body (runs inside shard_map).
 
     Input: the local sequence slice ``[B, L, H, Dh]`` with ``L = T/sp``.
@@ -271,17 +310,20 @@ def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     # After to_heads each device holds the FULL sequence for its head group,
-    # so per-example lengths apply directly to the local attention.
+    # so per-example lengths / full [B, T] segment ids apply directly to the
+    # local attention.
     local_attn = _resolve_ulysses_local(l * axis_size, local_attn)
     if local_attn == "flash":
         from petastorm_tpu.ops import flash_attention
 
         block = min(128, l * axis_size)
         out = flash_attention(qh, kh, vh, block_q=block, block_k=block,
-                              causal=causal, kv_lengths=lengths)
+                              causal=causal, kv_lengths=lengths,
+                              segment_ids=segment_ids)
     else:
         out = attention_reference(qh, kh, vh, causal=causal,
-                                  lengths=lengths)
+                                  lengths=lengths,
+                                  segment_ids=segment_ids)
     return to_sequence(out)
 
 
@@ -301,7 +343,8 @@ def _resolve_ulysses_local(t_full, local_attn):
 
 
 def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
-                      causal=False, local_attn="auto", lengths=None):
+                      causal=False, local_attn="auto", lengths=None,
+                      segment_ids=None):
     """All-to-all sequence-parallel attention over ``mesh[axis_name]``.
 
     Same contract as :func:`ring_attention` (global ``[B, T, H, Dh]`` in,
@@ -320,14 +363,26 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     block = functools.partial(ulysses_attention_block, axis_name=axis_name,
                               axis_size=mesh.shape[axis_name], causal=causal,
                               local_attn=local_attn)
+    if lengths is not None and segment_ids is not None:
+        raise ValueError(
+            "segment_ids and lengths are mutually exclusive: give padded "
+            "slots their own segment id instead")
     # pallas_call outputs carry no varying-mesh-axes annotation, which
     # the vma checker rejects — opt out only when the flash kernel
     # actually runs, keeping the check live for the dense path.
     check_vma = local_attn != "flash"
-    if lengths is None:
+    if lengths is None and segment_ids is None:
         sharded = shard_map(block, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=check_vma)
         return sharded(q, k, v)
+    if segment_ids is not None:
+        # The FULL [B, T] ids replicate over the sequence axis: after the
+        # head all-to-all each device attends over the whole sequence.
+        sharded = shard_map(
+            lambda a, b, c, sg: block(a, b, c, segment_ids=sg),
+            mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis, None)),
+            out_specs=spec, check_vma=check_vma)
+        return sharded(q, k, v, segment_ids)
     sharded = shard_map(
         lambda a, b, c, le: block(a, b, c, lengths=le),
         mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),

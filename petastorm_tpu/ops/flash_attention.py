@@ -90,7 +90,7 @@ def _kv_limit(lens_ref, kv_len):
 
 
 def _flash_kernel(*refs, sm_scale, block_q, block_k, kv_len, causal_offset,
-                  emit_lse, has_lens, precision):
+                  emit_lse, has_lens, has_segs, precision):
     from jax.experimental import pallas as pl
 
     if has_lens:
@@ -100,6 +100,11 @@ def _flash_kernel(*refs, sm_scale, block_q, block_k, kv_len, causal_offset,
         q_ref, k_ref, v_ref = refs[:3]
         lens_ref = None
         rest = refs[3:]
+    if has_segs:
+        qseg_ref, kvseg_ref = rest[:2]
+        rest = rest[2:]
+    else:
+        qseg_ref = kvseg_ref = None
     o_ref = rest[0]
     rest = rest[1:]
     if emit_lse:
@@ -129,7 +134,10 @@ def _flash_kernel(*refs, sm_scale, block_q, block_k, kv_len, causal_offset,
         s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
                            block_k=block_k, kv_len=kv_limit,
                            causal_offset=causal_offset,
-                           precision=precision)
+                           precision=precision,
+                           q_seg=None if qseg_ref is None else qseg_ref[0],
+                           kv_seg=(None if kvseg_ref is None
+                                   else kvseg_ref[0, :1]))
 
         m_prev = m_scratch[...][:, :1]            # [block_q, 1]
         l_prev = l_scratch[...][:, :1]
@@ -209,8 +217,64 @@ def _lens_spec(pl, pltpu, n_bh):
                         memory_space=pltpu.SMEM)
 
 
+_SUBLANES = 8  # TPU sublane width: kv-segment-id second-to-last dim
+
+
+def _pad_seg_row(segment_ids, block):
+    """[B, T] int segment ids → [B, T_padded] int32. The pad value is
+    irrelevant to masking (padded KV columns die on the kv_len mask, padded
+    Q rows are sliced off), it only has to exist."""
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    pad = (-seg.shape[1]) % block
+    if pad:
+        seg = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+    return seg
+
+
+def _q_segs_arr(segment_ids, block_q):
+    """[B, T] → lane-broadcast [B, Tq_pad, 128]: a (block_q, 128) tile
+    satisfies the TPU min-tile rule where a (1, block_q) row would not."""
+    seg = _pad_seg_row(segment_ids, block_q)
+    return jax.lax.broadcast_in_dim(
+        seg, (seg.shape[0], seg.shape[1], _LANES), (0, 1))
+
+
+def _kv_segs_arr(segment_ids, block_k):
+    """[B, T] → sublane-broadcast [B, 8, Tkv_pad]: an (8, block_k) tile
+    keeps the ids on the LANE axis, where the kernel compares them against
+    the lane-major score columns without a transpose."""
+    seg = _pad_seg_row(segment_ids, block_k)
+    return jax.lax.broadcast_in_dim(
+        seg, (seg.shape[0], _SUBLANES, seg.shape[1]), (0, 2))
+
+
+def _q_seg_spec(pl, pltpu, h, block_q, q_block_of):
+    """Tile of the lane-broadcast q segment ids; the batch coordinate is
+    bh // h (ids are per batch, the grid is per batch·head) and the token
+    block must ride the same (possibly clamped) fetch as its Q tile."""
+    return pl.BlockSpec(
+        (1, block_q, _LANES),
+        lambda bh, i, j: (bh // h, q_block_of(i, j), 0),
+        memory_space=pltpu.VMEM)
+
+
+def _kv_seg_spec(pl, pltpu, h, block_k, kv_block_of):
+    return pl.BlockSpec(
+        (1, _SUBLANES, block_k),
+        lambda bh, i, j: (bh // h, 0, kv_block_of(i, j)),
+        memory_space=pltpu.VMEM)
+
+
+def _check_seg_blocks(block_k):
+    if block_k > _LANES and block_k % _LANES:
+        raise ValueError(
+            f"segment_ids requires block_k <= {_LANES} or a multiple of "
+            f"{_LANES} (the lane-tiled id compare), got {block_k}")
+
+
 def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
-                   return_residuals=False, kv_lengths=None):
+                   return_residuals=False, kv_lengths=None,
+                   segment_ids=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -235,20 +299,22 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
         causal_offset=causal_offset,
         emit_lse=return_residuals,
         has_lens=kv_lengths is not None,
+        has_segs=segment_ids is not None,
         precision=_dot_precision(orig_dtype),
     )
     if causal_offset is None:
-        kv_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+        kv_block = lambda i, j: j  # noqa: E731
     else:
-        def kv_index(bh, i, j):
+        def kv_block(i, j):
             # Clamp skipped (fully-above-causal-boundary) K/V fetches to the
             # last USEFUL block for this Q block: pl.when skips their
             # compute, and an unchanged block index lets the pipeline skip
             # the HBM->VMEM copy too — the skip saves bandwidth, not just
             # MXU time.
             last = (i * block_q + causal_offset + block_q - 1) // block_k
-            return (bh, jnp.minimum(j, jnp.maximum(last, 0)), 0)
+            return jnp.minimum(j, jnp.maximum(last, 0))
 
+    kv_index = lambda bh, i, j: (bh, kv_block(i, j), 0)  # noqa: E731
     q_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
     out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), orig_dtype)
     out_specs = pl.BlockSpec((1, block_q, d), q_index,
@@ -272,6 +338,13 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
     if kv_lengths is not None:
         in_specs.append(_lens_spec(pl, pltpu, b * h))
         inputs.append(_lens_to_bh(kv_lengths, b, h))
+    if segment_ids is not None:
+        _check_seg_blocks(block_k)
+        in_specs.append(_q_seg_spec(pl, pltpu, h, block_q,
+                                    lambda i, j: i))
+        in_specs.append(_kv_seg_spec(pl, pltpu, h, block_k, kv_block))
+        inputs.extend([_q_segs_arr(segment_ids, block_q),
+                       _kv_segs_arr(segment_ids, block_k)])
 
     out = pl.pallas_call(
         kernel,
@@ -295,10 +368,12 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
 
 
 def _masked_scores(q, k, kb, qb, *, sm_scale, block_q, block_k, kv_len,
-                   causal_offset, precision):
+                   causal_offset, precision, q_seg=None, kv_seg=None):
     """Recompute the masked score tile s = mask(scale·q kᵀ) for one
     (Q-block, K-block) pair — shared by both backward kernels; identical
-    masking semantics to the forward kernel."""
+    masking semantics to the forward kernel. ``q_seg``/``kv_seg``:
+    optional ``(1, block)`` int32 segment-id tiles — positions in different
+    segments (packed sequences) never attend to each other."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32,
                                  precision=precision) * sm_scale
@@ -310,11 +385,39 @@ def _masked_scores(q, k, kb, qb, *, sm_scale, block_q, block_k, kv_len,
                    + jax.lax.broadcasted_iota(jnp.int32, s.shape,
                                               dimension=0))
         s = jnp.where(col_ids <= row_ids, s, -jnp.inf)
+    if q_seg is not None:
+        # q_seg: [block_q, 128] lane-broadcast; kv_seg: [1, block_k]. Slice
+        # or lane-tile q's ids to block_k columns, then a broadcast compare
+        # yields the [block_q, block_k] same-segment mask (upstream TPU
+        # flash-attention idiom — no transpose, MXU-friendly layouts).
+        lanes = q_seg.shape[1]
+        if block_k <= lanes:
+            qs = q_seg[:, :block_k]
+        else:
+            qs = jnp.tile(q_seg, (1, block_k // lanes))
+        s = jnp.where(qs == kv_seg, s, -jnp.inf)
     return s
 
 
+def _split_bwd_refs(refs, has_lens, has_segs):
+    """Unpack a backward kernel's refs: 6 fixed inputs (q, k, v, do, o,
+    lse), then the optional lens / segment-id inputs, then outputs+scratch."""
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref = refs[:6]
+    idx = 6
+    lens_ref = None
+    if has_lens:
+        lens_ref = refs[idx]
+        idx += 1
+    qseg_ref = kvseg_ref = None
+    if has_segs:
+        qseg_ref, kvseg_ref = refs[idx:idx + 2]
+        idx += 2
+    return (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref,
+            qseg_ref, kvseg_ref, refs[idx:])
+
+
 def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
-                         causal_offset, has_lens, precision):
+                         causal_offset, has_lens, has_segs, precision):
     """dQ sweep: grid (B·H, Tq/block_q, Tk/block_k) — K blocks iterate
     innermost, dq accumulates in VMEM scratch. Per tile:
     p = exp(s - lse); ds = p·(do·vᵀ - Δ)·scale; dq += ds·k, with
@@ -322,12 +425,9 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
     than staging a third stats tensor)."""
     from jax.experimental import pallas as pl
 
-    if has_lens:
-        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_acc = refs
-        lens_ref = None
+    (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref, qseg_ref,
+     kvseg_ref, rest) = _split_bwd_refs(refs, has_lens, has_segs)
+    dq_ref, dq_acc = rest
     kv_len = _kv_limit(lens_ref, kv_len)
 
     qb = pl.program_id(1)
@@ -348,7 +448,10 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
         s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
                            block_k=block_k, kv_len=kv_len,
                            causal_offset=causal_offset,
-                           precision=precision)
+                           precision=precision,
+                           q_seg=None if qseg_ref is None else qseg_ref[0],
+                           kv_seg=(None if kvseg_ref is None
+                                   else kvseg_ref[0, :1]))
         # lse is +inf for rows with no valid key, so every term is an exact
         # zero (finite-or-(-inf) minus +inf → -inf → exp 0; never inf-inf).
         p = jnp.exp(s - lse_ref[0][:, :1])
@@ -374,19 +477,15 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
 
 
 def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
-                          causal_offset, has_lens, precision):
+                          causal_offset, has_lens, has_segs, precision):
     """dK/dV sweep: grid (B·H, Tk/block_k, Tq/block_q) — Q blocks iterate
     innermost, dk/dv accumulate in VMEM scratch. Per tile:
     dv += pᵀ·do; dk += dsᵀ·q (same recomputed p/ds as the dQ sweep)."""
     from jax.experimental import pallas as pl
 
-    if has_lens:
-        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        lens_ref = None
+    (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref, qseg_ref,
+     kvseg_ref, rest) = _split_bwd_refs(refs, has_lens, has_segs)
+    dk_ref, dv_ref, dk_acc, dv_acc = rest
     kv_len = _kv_limit(lens_ref, kv_len)
 
     kb = pl.program_id(1)
@@ -408,7 +507,10 @@ def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
         s = _masked_scores(q, k, kb, qb, sm_scale=sm_scale, block_q=block_q,
                            block_k=block_k, kv_len=kv_len,
                            causal_offset=causal_offset,
-                           precision=precision)
+                           precision=precision,
+                           q_seg=None if qseg_ref is None else qseg_ref[0],
+                           kv_seg=(None if kvseg_ref is None
+                                   else kvseg_ref[0, :1]))
         p = jnp.exp(s - lse_ref[0][:, :1])
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -438,7 +540,7 @@ def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
 
 
 def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
-                    causal, kv_lengths=None):
+                    causal, kv_lengths=None, segment_ids=None):
     """Flash-2 backward: two pallas sweeps, O(block²) VMEM, no [T, T]
     buffer. ``o_padded``/``lse`` are [B·H, Tq_padded(, )] residuals from the
     forward; q/k/v are the user-shaped [B, T, H, D] primals."""
@@ -464,11 +566,17 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
     if kv_lengths is not None:
         lens_inputs = [_lens_to_bh(kv_lengths, b, h)]
         lens_specs = [_lens_spec(pl, pltpu, b * h)]
+    seg_inputs = []
+    if segment_ids is not None:
+        _check_seg_blocks(block_k)
+        seg_inputs = [_q_segs_arr(segment_ids, block_q),
+                      _kv_segs_arr(segment_ids, block_k)]
 
     causal_offset = (t_kv - t_q) if causal else None
     common = dict(sm_scale=1.0 / float(d) ** 0.5, block_q=block_q,
                   block_k=block_k, kv_len=t_kv, causal_offset=causal_offset,
                   has_lens=kv_lengths is not None,
+                  has_segs=segment_ids is not None,
                   precision=_dot_precision(q.dtype))
 
     q_spec = lambda ix: pl.BlockSpec((1, block_q, d), ix,  # noqa: E731
@@ -479,13 +587,20 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
     # --- dQ sweep: (bh, qb, kb), K innermost --------------------------------
     dq_q_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
     if causal_offset is None:
-        dq_kv_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+        dq_kv_block = lambda i, j: j  # noqa: E731
     else:
-        def dq_kv_index(bh, i, j):
+        def dq_kv_block(i, j):
             # Clamp fetches of skipped (fully-future) K/V blocks, exactly as
             # in the forward, so the pipeline skips the copy too.
             last = (i * block_q + causal_offset + block_q - 1) // block_k
-            return (bh, jnp.minimum(j, jnp.maximum(last, 0)), 0)
+            return jnp.minimum(j, jnp.maximum(last, 0))
+
+    dq_kv_index = lambda bh, i, j: (bh, dq_kv_block(i, j), 0)  # noqa: E731
+    dq_seg_specs = []
+    if segment_ids is not None:
+        dq_seg_specs = [_q_seg_spec(pl, pltpu, h, block_q,
+                                    lambda i, j: i),
+                        _kv_seg_spec(pl, pltpu, h, block_k, dq_kv_block)]
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -498,24 +613,31 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             q_spec(dq_q_index),                      # o
             pl.BlockSpec((1, block_q, _LANES), dq_q_index,
                          memory_space=pltpu.VMEM),   # lse
-        ] + lens_specs,
+        ] + lens_specs + dq_seg_specs,
         out_specs=q_spec(dq_q_index),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs)
 
     # --- dK/dV sweep: (bh, kb, qb), Q innermost -----------------------------
     dkv_kv_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
     if causal_offset is None:
-        dkv_q_index = lambda bh, i, j: (bh, j, 0)  # noqa: E731
+        dkv_q_block = lambda i, j: j  # noqa: E731
     else:
-        def dkv_q_index(bh, i, j):
+        def dkv_q_block(i, j):
             # First Q block whose causal boundary reaches K block i; clamp
             # skipped earlier-Q fetches to it (ceil with floor-division).
             first = -((causal_offset + block_q - 1 - i * block_k) // block_q)
             first = jnp.clip(first, 0, n_qb - 1)
-            return (bh, jnp.maximum(j, first), 0)
+            return jnp.maximum(j, first)
+
+    dkv_q_index = lambda bh, i, j: (bh, dkv_q_block(i, j), 0)  # noqa: E731
+    dkv_seg_specs = []
+    if segment_ids is not None:
+        dkv_seg_specs = [_q_seg_spec(pl, pltpu, h, block_q, dkv_q_block),
+                         _kv_seg_spec(pl, pltpu, h, block_k,
+                                      lambda i, j: i)]
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common),
@@ -528,14 +650,14 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             q_spec(dkv_q_index),                     # o
             pl.BlockSpec((1, block_q, _LANES), dkv_q_index,
                          memory_space=pltpu.VMEM),   # lse
-        ] + lens_specs,
+        ] + lens_specs + dkv_seg_specs,
         out_specs=(kv_spec(dkv_kv_index), kv_spec(dkv_kv_index)),
         out_shape=(jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs)
 
     dq = _from_bh(dq[:, :t_q], b, h)
     dk = _from_bh(dk[:, :t_kv], b, h)
@@ -549,7 +671,8 @@ def _should_interpret():
 
 
 def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
-                    causal=False, bwd_impl="flash", kv_lengths=None):
+                    causal=False, bwd_impl="flash", kv_lengths=None,
+                    segment_ids=None):
     """Tiled attention over ``[B, T, H, D]`` tensors; matches
     ``attention_reference`` numerics (f32 softmax) without materializing the
     ``[T, T]`` score matrix — in the forward OR the backward.
@@ -568,13 +691,29 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
         keys at or past ``kv_lengths[b]`` are masked out for example ``b``
         (ragged NGram windows padded to a common T). With ``causal``, the
         causal alignment still uses the STATIC T_q/T_kv shapes.
+    :param segment_ids: optional [B, T] int ids for PACKED batches (see
+        ``jax_utils.packing``): positions only attend within their own
+        segment. Requires ``T_q == T_kv`` (self-attention); mutually
+        exclusive with ``kv_lengths`` (give padded slots a unique id
+        instead). Composes with ``causal``.
     """
     _check_bwd_impl(bwd_impl)
+    if segment_ids is not None:
+        if kv_lengths is not None:
+            raise ValueError(
+                "segment_ids and kv_lengths are mutually exclusive: give "
+                "padded slots their own segment id instead")
+        if q.shape[1] != k.shape[1]:
+            raise ValueError(
+                f"segment_ids requires T_q == T_kv (self-attention over a "
+                f"packed batch), got {q.shape[1]} vs {k.shape[1]}")
+        return _flash_aux(q, k, v, segment_ids, block_q, block_k,
+                          interpret, causal, bwd_impl, "segs")
     if kv_lengths is None:
         return _flash_static(q, k, v, block_q, block_k, interpret, causal,
                              bwd_impl)
-    return _flash_lens(q, k, v, kv_lengths, block_q, block_k, interpret,
-                       causal, bwd_impl)
+    return _flash_aux(q, k, v, kv_lengths, block_q, block_k, interpret,
+                      causal, bwd_impl, "lens")
 
 
 def _check_bwd_impl(bwd_impl):
@@ -591,16 +730,17 @@ def _flash_static(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
 
 
 def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl,
-         kv_lengths=None):
+         kv_lengths=None, segment_ids=None):
     if interpret is None:
         interpret = _should_interpret()
     if bwd_impl == "reference":
         out = _flash_forward(q, k, v, block_q, block_k, interpret, causal,
-                             kv_lengths=kv_lengths)
+                             kv_lengths=kv_lengths, segment_ids=segment_ids)
         return out, (q, k, v, None, None)
     out_padded, lse = _flash_forward(q, k, v, block_q, block_k, interpret,
                                      causal, return_residuals=True,
-                                     kv_lengths=kv_lengths)
+                                     kv_lengths=kv_lengths,
+                                     segment_ids=segment_ids)
     b, t_q, h, _ = q.shape
     out = _from_bh(out_padded[:, :t_q], b, h)
     # o is saved PADDED in [B·H, T, D] form: the backward consumes it block
@@ -609,7 +749,7 @@ def _fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl,
 
 
 def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g,
-         kv_lengths=None):
+         kv_lengths=None, segment_ids=None):
     if interpret is None:
         interpret = _should_interpret()
     q, k, v, o_padded, lse = residuals
@@ -620,7 +760,8 @@ def _bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g,
             functools.partial(_attention_reference, causal=causal), q, k, v)
         return vjp(g)
     return _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k,
-                           interpret, causal, kv_lengths=kv_lengths)
+                           interpret, causal, kv_lengths=kv_lengths,
+                           segment_ids=segment_ids)
 
 
 def _static_fwd(q, k, v, block_q, block_k, interpret, causal, bwd_impl):
@@ -634,36 +775,43 @@ def _static_bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
 _flash_static.defvjp(_static_fwd, _static_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_lens(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
-                bwd_impl):
+# One custom_vjp serves both integer-aux variants (per-example kv_lengths
+# and packed-batch segment_ids): the wrappers differ only in which keyword
+# the aux array threads through, so ``aux_kind`` selects it statically.
+_AUX_KW = {"lens": "kv_lengths", "segs": "segment_ids"}
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_aux(q, k, v, aux, block_q, block_k, interpret, causal,
+               bwd_impl, aux_kind):
     if interpret is None:
         interpret = _should_interpret()
     return _flash_forward(q, k, v, block_q, block_k, interpret, causal,
-                          kv_lengths=kv_lengths)
+                          **{_AUX_KW[aux_kind]: aux})
 
 
-def _lens_fwd(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
-              bwd_impl):
+def _aux_fwd(q, k, v, aux, block_q, block_k, interpret, causal, bwd_impl,
+             aux_kind):
     if bwd_impl == "reference":
         raise NotImplementedError(
-            "bwd_impl='reference' does not support kv_lengths; the dense "
-            "oracle for lengths lives in "
+            f"bwd_impl='reference' does not support {_AUX_KW[aux_kind]}; "
+            "the dense oracle lives in "
             "models.sequence_model.attention_reference")
     out, residuals = _fwd(q, k, v, block_q, block_k, interpret, causal,
-                          bwd_impl, kv_lengths=kv_lengths)
-    return out, residuals + (kv_lengths,)
+                          bwd_impl, **{_AUX_KW[aux_kind]: aux})
+    return out, residuals + (aux,)
 
 
-def _lens_bwd(block_q, block_k, interpret, causal, bwd_impl, residuals, g):
-    kv_lengths = residuals[-1]
+def _aux_bwd(block_q, block_k, interpret, causal, bwd_impl, aux_kind,
+             residuals, g):
+    aux = residuals[-1]
     dq, dk, dv = _bwd(block_q, block_k, interpret, causal, bwd_impl,
-                      residuals[:-1], g, kv_lengths=kv_lengths)
-    # Integer lengths carry no gradient: the float0 zero cotangent.
+                      residuals[:-1], g, **{_AUX_KW[aux_kind]: aux})
+    # Integer aux arrays carry no gradient: the float0 zero cotangent.
     import numpy as np
 
-    dlens = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlens
+    daux = np.zeros(aux.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, daux
 
 
-_flash_lens.defvjp(_lens_fwd, _lens_bwd)
+_flash_aux.defvjp(_aux_fwd, _aux_bwd)
