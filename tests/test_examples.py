@@ -67,6 +67,12 @@ def test_sequence_example_trains_on_windows(capsys):
     out = capsys.readouterr().out
     assert "5-frame windows" in out
     assert "ragged causal sequences" in out
+    assert "packed causal LM" in out
+    # packing exists to beat padding's slot utilization
+    import re
+
+    m = re.search(r"utilization (\d+)% packed vs (\d+)% padded", out)
+    assert m and int(m.group(1)) > int(m.group(2))
 
 
 def test_criteo_dlrm_trains_and_resumes(tmp_path, capsys):

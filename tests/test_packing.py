@@ -124,6 +124,7 @@ def test_packed_flash_gradients_isolated_across_segments():
     assert float(jnp.abs(g[0, :6]).max()) > 0
     np.testing.assert_array_equal(np.asarray(g[0, 6:]), 0.0)
 
+
 def test_pack_skips_empty_sequences():
     """Zero-length rows carry no tokens: they must not burn a segment id
     (which would break the exactly-once round-trip)."""
