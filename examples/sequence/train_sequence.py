@@ -144,7 +144,8 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
     import jax.numpy as jnp
 
     from petastorm_tpu import make_columnar_reader
-    from petastorm_tpu.jax_utils import (PACK_SEGMENT_KEY, pack_ragged,
+    from petastorm_tpu.jax_utils import (PACK_SEGMENT_KEY,
+                                         make_packed_jax_dataloader,
                                          packed_valid_mask)
     from petastorm_tpu.models.sequence_model import attention_reference
     from petastorm_tpu.ops import flash_attention
@@ -190,31 +191,29 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
     reader = make_columnar_reader(dataset_url, num_epochs=None,
                                   shuffle_row_groups=True,
                                   schema_fields=["seq", "length"])
-
-    def docs():
-        with reader:
-            for batch in reader:  # columnar reader yields namedtuples
-                seqs = np.asarray(batch.seq)
-                lens = np.asarray(batch.length)
-                for i in range(len(lens)):
-                    yield {"seq": seqs[i, :int(lens[i])]}
-
-    loss, done = float("nan"), 0
+    # The packed DELIVERY path: reader -> pack_ragged -> the loader's
+    # prefetch/staging machinery, one call.
+    loader = make_packed_jax_dataloader(reader, slot_len=slot_len,
+                                        slots=slots,
+                                        sequence_fields=["seq"],
+                                        length_field="length",
+                                        max_batches=steps,
+                                        stage_to_device=False)
+    loss = float("nan")
     valid_tokens, total_slots, padded_lens = 0, 0, []
-    for packed in pack_ragged(docs(), slot_len=slot_len, slots=slots):
-        seg = jnp.asarray(packed[PACK_SEGMENT_KEY])
-        x = jnp.asarray(packed["seq"])
-        params, loss = step(params, x, seg)
-        mask = packed_valid_mask(packed[PACK_SEGMENT_KEY])
-        valid_tokens += int(mask.sum())
-        total_slots += mask.size
-        padded_lens.extend(
-            int((packed[PACK_SEGMENT_KEY][b] == sid).sum())
-            for b in range(slots)
-            for sid in range(int(packed[PACK_SEGMENT_KEY][b].max()) + 1))
-        done += 1
-        if done >= steps:
-            break
+    with loader:
+        for packed in loader:
+            seg_np = np.asarray(packed[PACK_SEGMENT_KEY])
+            seg = jnp.asarray(seg_np)
+            x = jnp.asarray(packed["seq"])
+            params, loss = step(params, x, seg)
+            mask = packed_valid_mask(seg_np)
+            valid_tokens += int(mask.sum())
+            total_slots += mask.size
+            padded_lens.extend(
+                int((seg_np[b] == sid).sum())
+                for b in range(slots)
+                for sid in range(int(seg_np[b].max()) + 1))
     packed_util = valid_tokens / max(total_slots, 1)
     # The padded alternative: one row per document at the static max length.
     max_len = max(padded_lens) if padded_lens else 1

@@ -29,6 +29,8 @@ from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
 from petastorm_tpu.jax_utils.packing import (
     PACK_POSITION_KEY,
     PACK_SEGMENT_KEY,
+    iter_ragged_rows,
+    make_packed_jax_dataloader,
     pack_ragged,
     packed_valid_mask,
 )
@@ -59,6 +61,8 @@ __all__ = [
     "restore_training_state",
     "pack_ragged",
     "packed_valid_mask",
+    "make_packed_jax_dataloader",
+    "iter_ragged_rows",
     "PACK_SEGMENT_KEY",
     "PACK_POSITION_KEY",
 ]

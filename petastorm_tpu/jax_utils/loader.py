@@ -117,7 +117,8 @@ class JaxDataLoader:
                  device=None, sharding=None, host_prefetch=4,
                  device_prefetch=2, non_tensor_policy="host",
                  stage_to_device=True, shuffle_buffer_size=0,
-                 shuffle_seed=None, stage_in_producer=False):
+                 shuffle_seed=None, stage_in_producer=False,
+                 batch_source=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
         if stage_in_producer and sharding is not None:
@@ -129,6 +130,22 @@ class JaxDataLoader:
             raise ValueError("non_tensor_policy must be host|drop|error")
         if device_prefetch < 1:
             raise ValueError("device_prefetch must be >= 1")
+        if batch_source is not None:
+            if shuffle_buffer_size or shuffle_seed is not None \
+                    or last_batch != "drop":
+                raise ValueError(
+                    "shuffle_buffer_size/shuffle_seed/last_batch are row-"
+                    "batching knobs the custom batch_source path does not "
+                    "consume; shuffle and shape batches inside the source "
+                    "(silently ignoring them would change training data "
+                    "order/shape with no error)")
+            if sharding is not None and max_batches is None:
+                raise ValueError(
+                    "a custom batch_source with a global sharding requires "
+                    "an explicit max_batches: source batch counts are data-"
+                    "dependent per host, so without an agreed step count "
+                    "pjit deadlocks the pod (agree via "
+                    "jax_utils.sharding.agree_max_batches)")
         self.reader = reader
         self._batch_size = batch_size
         self._last_batch = last_batch
@@ -142,7 +159,17 @@ class JaxDataLoader:
         self._stage_in_producer = stage_in_producer and stage_to_device
         self._shuffle_buffer_size = shuffle_buffer_size
         self._shuffle_seed = shuffle_seed
-        if sharding is not None and max_batches is None:
+        # Custom host-batch pipeline (e.g. sequence packing): a zero-arg
+        # callable returning an iterator of {field: ndarray} batches. The
+        # staging/prefetch/diagnostics machinery is reused unchanged; the
+        # row-batching knobs (batch_size/last_batch/shuffle buffer) are the
+        # source's concern, not this class's.
+        self._batch_source = batch_source
+        if sharding is not None and max_batches is None \
+                and batch_source is None:
+            # (With a custom batch_source the reader-metadata derivation
+            # below would count ROW batches, not source batches — the source
+            # owns step agreement; see make_packed_jax_dataloader docs.)
             # SPMD lockstep: under a global sharding every host must dispatch
             # the same number of steps or pjit deadlocks the pod. Derive the
             # global-min batch count from the reader's shard metadata (each
@@ -179,12 +206,19 @@ class JaxDataLoader:
 
     def _produce(self):
         try:
-            batches = iter(batch_iterator(
-                self.reader, self._batch_size,
-                last_batch=self._last_batch,
-                max_batches=self._max_batches,
-                shuffle_buffer_size=self._shuffle_buffer_size,
-                shuffle_seed=self._shuffle_seed))
+            if self._batch_source is not None:
+                batches = iter(self._batch_source())
+                if self._max_batches is not None:
+                    import itertools
+
+                    batches = itertools.islice(batches, self._max_batches)
+            else:
+                batches = iter(batch_iterator(
+                    self.reader, self._batch_size,
+                    last_batch=self._last_batch,
+                    max_batches=self._max_batches,
+                    shuffle_buffer_size=self._shuffle_buffer_size,
+                    shuffle_seed=self._shuffle_seed))
             while True:
                 t0 = time.perf_counter()
                 with _trace_span("petastorm_tpu.loader.decode"):
@@ -379,6 +413,12 @@ class JaxDataLoader:
         pass the result as ``resume_state=`` to the reader factory feeding a
         fresh loader.
         """
+        if self._batch_source is not None:
+            raise ValueError(
+                "state_dict is not supported with a custom batch_source "
+                "(e.g. the packed loader): yielded-row accounting cannot "
+                "attribute repacked batches to reader deliveries. Checkpoint "
+                "at an epoch boundary with the reader's state_dict()")
         tracker = getattr(self.reader, "_delivery_tracker", None)
         if tracker is None or not hasattr(self.reader, "state_dict"):
             raise TypeError(

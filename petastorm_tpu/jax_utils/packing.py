@@ -125,6 +125,64 @@ def pack_ragged(rows, slot_len, slots, keys=None):
         yield emit(state)
 
 
+def iter_ragged_rows(reader, sequence_fields, length_field=None):
+    """Adapt a Reader's output stream into ragged-row dicts for
+    :func:`pack_ragged`.
+
+    Handles both row readers (one namedtuple per row) and batch/columnar
+    readers (namedtuples of ``[N, ...]`` column arrays, split back into
+    rows). ``length_field``: optional int column holding each row's true
+    sequence length — the packed fields' leading axis is trimmed to it
+    (the standard ragged-in-Parquet layout: static shapes on disk, true
+    length as data).
+    """
+    # Column-batch readers (make_batch_reader / make_columnar_reader)
+    # advertise batched_output; row readers yield one row per item.
+    batched = bool(getattr(reader, "batched_output", False))
+    for item in reader:
+        cols = {f: np.asarray(getattr(item, f)) for f in sequence_fields}
+        if batched:
+            lens = (np.asarray(getattr(item, length_field))
+                    if length_field else None)
+            for i in range(cols[sequence_fields[0]].shape[0]):
+                cut = int(lens[i]) if lens is not None else None
+                yield {f: cols[f][i][:cut] for f in sequence_fields}
+        else:
+            cut = (int(getattr(item, length_field))
+                   if length_field else None)
+            yield {f: cols[f][:cut] for f in sequence_fields}
+
+
+def make_packed_jax_dataloader(reader, slot_len, slots, sequence_fields,
+                               length_field=None, max_batches=None,
+                               **loader_kwargs):
+    """Packed delivery path: reader → ragged rows → :func:`pack_ragged` →
+    the :class:`~petastorm_tpu.jax_utils.loader.JaxDataLoader` staging
+    machinery (prefetch, async device_put, diagnostics) unchanged.
+
+    Yields ``{field: [slots, slot_len, ...]}`` batches plus
+    ``PACK_SEGMENT_KEY`` / ``PACK_POSITION_KEY`` — feed the segment ids to
+    ``flash_attention`` / ``ring_attention`` / ``ulysses_attention``.
+
+    ``sequence_fields``: the reader fields to pack (leading axis =
+    sequence). ``length_field``: optional true-length column for
+    padded-on-disk layouts. Not resumable (``state_dict`` raises): repacked
+    batches cannot be attributed to reader deliveries. With a global
+    ``sharding``, pass ``max_batches`` explicitly (packed batch counts are
+    data-dependent — agree them across hosts with
+    :func:`~petastorm_tpu.jax_utils.sharding.agree_max_batches`).
+    """
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    def source():
+        return pack_ragged(
+            iter_ragged_rows(reader, sequence_fields, length_field),
+            slot_len=slot_len, slots=slots)
+
+    return JaxDataLoader(reader, slots, max_batches=max_batches,
+                         batch_source=source, **loader_kwargs)
+
+
 def unpack(packed, key):
     """Recover the list of original sequences of ``packed[key]`` (row-major:
     batch row 0's segments first) — the inverse of :func:`pack_ragged` for

@@ -135,3 +135,114 @@ def test_pack_skips_empty_sequences():
                                   [0, 0, 0, 1, 1, -1, -1, -1])
     got = [tuple(s) for s in unpack(batch, "ids")]
     assert got == [(0, 1, 2), (10, 11)]
+
+
+def test_packed_loader_end_to_end(tmp_path):
+    """make_packed_jax_dataloader: reader -> pack -> the loader's staging
+    machinery, covering both reader flavors and the resume guard."""
+    import pytest as _pytest
+
+    from petastorm_tpu import make_columnar_reader, make_reader
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.jax_utils import make_packed_jax_dataloader
+    from petastorm_tpu.schema.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("Ragged", [
+        UnischemaField("seq", np.float32, (12, 3), NdarrayCodec(), False),
+        UnischemaField("length", np.int32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(0)
+    lengths = [int(rng.randint(2, 13)) for _ in range(24)]
+    rows = []
+    for n in lengths:
+        seq = np.zeros((12, 3), np.float32)
+        seq[:n] = rng.randn(n, 3)
+        rows.append({"seq": seq, "length": np.int32(n)})
+    url = f"file://{tmp_path}/ragged"
+    materialize_rows(url, schema, rows, rows_per_row_group=8)
+
+    for factory in (make_reader, make_columnar_reader):
+        reader = factory(url, num_epochs=1, shuffle_row_groups=False)
+        loader = make_packed_jax_dataloader(
+            reader, slot_len=16, slots=2, sequence_fields=["seq"],
+            length_field="length", stage_to_device=False)
+        total_valid = 0
+        with loader:
+            for batch in loader:
+                assert batch["seq"].shape == (2, 16, 3)
+                assert batch[PACK_SEGMENT_KEY].shape == (2, 16)
+                total_valid += int(packed_valid_mask(
+                    batch[PACK_SEGMENT_KEY]).sum())
+        assert total_valid == sum(lengths), factory.__name__
+
+    reader = make_reader(url, num_epochs=1)
+    loader = make_packed_jax_dataloader(
+        reader, slot_len=16, slots=2, sequence_fields=["seq"],
+        length_field="length", stage_to_device=False)
+    with loader:
+        next(iter(loader))
+        with _pytest.raises(ValueError, match="batch_source"):
+            loader.state_dict()
+
+
+def test_packed_loader_stages_to_device(tmp_path):
+    """stage_to_device=True emits committed jax arrays for packed fields
+    AND the segment/position int arrays."""
+    import jax
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.jax_utils import make_packed_jax_dataloader
+    from petastorm_tpu.schema.codecs import NdarrayCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("R2", [
+        UnischemaField("tok", np.float32, (8, 2), NdarrayCodec(), False),
+    ])
+    rows = [{"tok": np.random.RandomState(i).randn(8, 2).astype(np.float32)}
+            for i in range(6)]
+    url = f"file://{tmp_path}/r2"
+    materialize_rows(url, schema, rows, rows_per_row_group=4)
+
+    reader = make_reader(url, num_epochs=1)
+    loader = make_packed_jax_dataloader(reader, slot_len=16, slots=2,
+                                        sequence_fields=["tok"])
+    with loader:
+        batch = next(iter(loader))
+    assert isinstance(batch["tok"], jax.Array)
+    assert isinstance(batch[PACK_SEGMENT_KEY], jax.Array)
+    assert batch[PACK_SEGMENT_KEY].shape == (2, 16)
+
+
+def test_packed_loader_rejects_row_batching_knobs_and_unagreed_sharding(
+        tmp_path):
+    """batch_source composes with staging, not with row-batching knobs, and
+    a global sharding needs an explicitly agreed step count."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.jax_utils import make_packed_jax_dataloader
+    from petastorm_tpu.schema.codecs import NdarrayCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("R3", [
+        UnischemaField("tok", np.float32, (4, 2), NdarrayCodec(), False),
+    ])
+    url = f"file://{tmp_path}/r3"
+    materialize_rows(url, schema,
+                     [{"tok": np.zeros((4, 2), np.float32)}] * 4,
+                     rows_per_row_group=4)
+    reader = make_reader(url, num_epochs=1)
+    with pytest.raises(ValueError, match="row-.?batching knobs"):
+        make_packed_jax_dataloader(reader, slot_len=8, slots=2,
+                                   sequence_fields=["tok"],
+                                   shuffle_buffer_size=100)
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    with pytest.raises(ValueError, match="explicit max_batches"):
+        make_packed_jax_dataloader(
+            reader, slot_len=8, slots=2, sequence_fields=["tok"],
+            sharding=NamedSharding(mesh, P("data")))
+    reader.stop(); reader.join()
