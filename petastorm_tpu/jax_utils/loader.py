@@ -88,16 +88,18 @@ def make_jax_dataloader(reader, batch_size,
         top of row-group shuffling (reference ``shuffling_queue_capacity``
         semantics; row readers only).
     :param shuffle_seed: seed for the shuffle buffer.
-    :param stage_in_producer: run ``device_put`` dispatch on the producer
-        thread instead of the consumer: while the training thread blocks on
-        a device step (a GIL-released window), the producer both decodes and
-        dispatches H2D, shrinking the consumer's per-step input cost to a
-        queue get. Best when steps are long enough to hide decode+dispatch;
-        not supported with ``sharding``. In this mode the queue holds
-        device-resident batches, so its depth is bounded by
-        ``device_prefetch`` (not ``host_prefetch``): total in-flight device
-        batches stay ≤ 2·``device_prefetch`` + 1 — raise ``device_prefetch``
-        for deeper jitter absorption.
+    :param stage_in_producer: run ``device_put`` dispatch off the consumer's
+        critical path, on a dedicated STAGING thread fed by the decode
+        thread: decode and H2D dispatch overlap (both release the GIL), so
+        the pipeline's per-batch cost is max(decode, dispatch) instead of
+        their sum, and the consumer's per-step input cost shrinks to a
+        queue get. Best when steps are long enough to hide the slower of
+        the two; not supported with ``sharding``. In this mode the device
+        queue's depth is bounded by ``device_prefetch`` (not
+        ``host_prefetch``): total in-flight device batches stay ≤
+        2·``device_prefetch`` + 1 — raise ``device_prefetch`` for deeper
+        jitter absorption (decoded host batches additionally buffer up to
+        ``host_prefetch`` between the two threads).
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -184,7 +186,9 @@ class JaxDataLoader:
                 self._max_batches = derived
 
         self._queue = None
+        self._host_queue = None
         self._producer = None
+        self._stager = None
         self._producer_error = None
         self._stop = threading.Event()
         self._total_rows_yielded = 0  # cumulative, pad-aware (resume support)
@@ -219,6 +223,12 @@ class JaxDataLoader:
                     max_batches=self._max_batches,
                     shuffle_buffer_size=self._shuffle_buffer_size,
                     shuffle_seed=self._shuffle_seed))
+            # With producer-side staging, decode feeds a separate staging
+            # thread (see _stage_loop) so decode and H2D dispatch OVERLAP —
+            # both release the GIL (pyarrow/cv2; transport writes), so even
+            # a single-core host pipelines them instead of paying their sum.
+            target = (self._host_queue if self._stage_in_producer
+                      else self._queue)
             while True:
                 t0 = time.perf_counter()
                 with _trace_span("petastorm_tpu.loader.decode"):
@@ -226,21 +236,10 @@ class JaxDataLoader:
                 self.diagnostics["producer_decode_s"] += time.perf_counter() - t0
                 if batch is _SENTINEL:
                     break
-                if self._stage_in_producer:
-                    # device_put dispatch runs HERE, off the consumer's
-                    # critical path: while the consumer waits on the device
-                    # step (a GIL-released window), this thread both decodes
-                    # the next batch and dispatches its H2D — the consumer's
-                    # per-step cost shrinks to queue-get + step dispatch.
-                    t0 = time.perf_counter()
-                    with _trace_span("petastorm_tpu.loader.device_put"):
-                        batch = self._stage(batch)
-                    self.diagnostics["device_dispatch_s"] += \
-                        time.perf_counter() - t0
                 t0 = time.perf_counter()
                 while not self._stop.is_set():
                     try:
-                        self._queue.put(batch, timeout=0.1)
+                        target.put(batch, timeout=0.1)
                         break
                     except queue.Full:
                         continue
@@ -251,39 +250,86 @@ class JaxDataLoader:
         except Exception as exc:  # surfaced on the consumer side
             self._producer_error = exc
         finally:
-            # The sentinel MUST land or the consumer blocks forever; retry in
-            # a stop-checking loop (the consumer may legitimately pause far
-            # longer than any fixed timeout — e.g. first-step XLA compile).
-            while True:
+            target = (self._host_queue if self._stage_in_producer
+                      else self._queue)
+            self._put_sentinel(target)
+
+    def _stage_loop(self):
+        """Staging thread (producer-side staging only): host batches →
+        ``device_put`` dispatch → the device queue. Runs concurrently with
+        the decode thread, so per-batch pipeline cost is
+        max(decode, dispatch), not their sum."""
+        try:
+            while not self._stop.is_set():
                 try:
-                    self._queue.put(_SENTINEL, timeout=0.1)
+                    batch = self._host_queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if batch is _SENTINEL:
                     break
-                except queue.Full:
-                    if self._stop.is_set():
+                t0 = time.perf_counter()
+                with _trace_span("petastorm_tpu.loader.device_put"):
+                    batch = self._stage(batch)
+                self.diagnostics["device_dispatch_s"] += \
+                    time.perf_counter() - t0
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
                         break
+                    except queue.Full:
+                        continue
+        except Exception as exc:  # surfaced on the consumer side
+            self._producer_error = exc
+        finally:
+            self._put_sentinel(self._queue)
+
+    def _put_sentinel(self, q):
+        # The sentinel MUST land or the downstream blocks forever; retry in
+        # a stop-checking loop (the consumer may legitimately pause far
+        # longer than any fixed timeout — e.g. first-step XLA compile).
+        while True:
+            try:
+                q.put(_SENTINEL, timeout=0.1)
+                break
+            except queue.Full:
+                if self._stop.is_set():
+                    break
 
     # -- consumer ---------------------------------------------------------
 
     def __iter__(self):
-        if self._producer is not None and self._producer.is_alive():
-            # A previous iteration is still producing; two producers would
-            # pull from the same (non-thread-safe) reader concurrently. Stop
-            # the old one before re-iterating.
+        # A previous iteration's threads may still be running (producer
+        # pulling the non-thread-safe reader, stager mid-device_put); BOTH
+        # must be stopped and joined before the queues are reassigned — a
+        # surviving old thread would inject stale batches and a premature
+        # sentinel into the new iteration's queues. Each is checked
+        # independently: the producer can exit quickly while the stager is
+        # still inside a long dispatch.
+        stale = [("producer", self._producer), ("stager", self._stager)]
+        if any(t is not None and t.is_alive() for _, t in stale):
             self.stop()
-            self._producer.join(timeout=30)
-            if self._producer.is_alive():
-                raise RuntimeError(
-                    "Previous iteration's producer thread did not stop within "
-                    "30s (reader blocked on I/O?); cannot safely re-iterate")
-        # With producer-side staging the queue holds DEVICE-resident batches,
-        # so its depth must be bounded by the device budget (device_prefetch),
-        # not the host budget — otherwise device-resident batches grow to
-        # host_prefetch + device_prefetch and can OOM a model that fit with
-        # consumer-side staging. Total in-flight device batches stay
-        # <= 2 * device_prefetch (+1 in the producer's hand).
+            for name, t in stale:
+                if t is None:
+                    continue
+                t.join(timeout=30)
+                if t.is_alive():
+                    raise RuntimeError(
+                        f"Previous iteration's {name} thread did not stop "
+                        "within 30s (blocked on reader I/O or a device "
+                        "call?); cannot safely re-iterate")
+        # With producer-side staging the device queue holds DEVICE-resident
+        # batches, so its depth is bounded by the device budget
+        # (device_prefetch), not the host budget — otherwise device-resident
+        # batches grow to host_prefetch + device_prefetch and can OOM a
+        # model that fit with consumer-side staging. Total in-flight device
+        # batches stay <= 2 * device_prefetch (+1 in the stager's hand);
+        # decoded host batches additionally buffer up to host_prefetch
+        # between the decode and staging threads (the overlap window).
         maxsize = (max(1, self._device_prefetch) if self._stage_in_producer
                    else self._host_prefetch)
         self._queue = queue.Queue(maxsize=maxsize)
+        self._host_queue = (queue.Queue(maxsize=self._host_prefetch)
+                            if self._stage_in_producer else None)
         self._stop.clear()
         self._producer_error = None
         # Yielded-row accounting is relative to the reader's delivery
@@ -302,6 +348,11 @@ class JaxDataLoader:
         self._producer = threading.Thread(target=self._produce, daemon=True,
                                           name="jax-loader-producer")
         self._producer.start()
+        if self._stage_in_producer:
+            self._stager = threading.Thread(target=self._stage_loop,
+                                            daemon=True,
+                                            name="jax-loader-stager")
+            self._stager.start()
         return self._iterate()
 
     def _iterate(self):
@@ -438,15 +489,18 @@ class JaxDataLoader:
 
     def stop(self):
         self._stop.set()
-        if self._queue is not None:
-            try:  # unblock a producer waiting on a full queue
-                self._queue.get_nowait()
-            except queue.Empty:
-                pass
+        for q in (self._queue, self._host_queue):
+            if q is not None:
+                try:  # unblock a producer/stager waiting on a full queue
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
 
     def join(self):
         if self._producer is not None:
             self._producer.join(timeout=30)
+        if self._stager is not None:
+            self._stager.join(timeout=30)
 
     def __enter__(self):
         return self

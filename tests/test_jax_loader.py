@@ -404,3 +404,33 @@ def test_stage_in_producer_end_to_end(petastorm_dataset):
             assert isinstance(batch["id"], jax.Array)
             rows += batch["id"].shape[0]
     assert rows > 0
+
+
+def test_reiteration_joins_both_pipeline_threads(petastorm_dataset):
+    """Re-iterating a stage_in_producer loader must stop and join BOTH the
+    decode thread and the staging thread before reassigning queues — a
+    surviving old stager would inject stale batches / a premature sentinel
+    into the new iteration (even when the producer already exited)."""
+    import time
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=None, shuffle_row_groups=False)
+    loader = make_jax_dataloader(reader, 5, stage_in_producer=True,
+                                 non_tensor_policy="drop")
+    real_stage = loader._stage
+    loader._stage = lambda b: (time.sleep(0.3), real_stage(b))[1]
+    it = iter(loader)
+    next(it)
+    old_producer, old_stager = loader._producer, loader._stager
+    assert old_stager is not None
+    it2 = iter(loader)  # must join the old threads, then start fresh ones
+    assert loader._stager is not old_stager
+    assert not old_stager.is_alive()
+    assert not old_producer.is_alive()
+    batch = next(it2)
+    assert batch["id"].shape == (5,)
+    loader.stop(); loader.join()
+    reader.stop(); reader.join()
