@@ -84,6 +84,108 @@ def _unstripe(x, sp):
             .swapaxes(1, 2).reshape(x.shape))
 
 
+def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
+                      causal=False, placement="contiguous", lengths=None):
+    """Per-shard ring attention with the Pallas flash kernel as the local
+    attention — NO [L, L] score block materializes anywhere, even
+    sequence-parallel (the kernel is O(block²); ring steps merge the
+    normalized partials via their log-sum-exp, the exact blockwise-softmax
+    combination).
+
+    Per ring step the resident K/V block attends through
+    ``flash_attention_with_lse``; the (out, lse) partials fold into a
+    running ``(num, m, den)`` online-softmax state at per-ROW granularity
+    (O(L·H) statistics, not O(L²)). Causal masking per block: striped
+    placement uses the kernel's causal diagonal (shift 0 when the key
+    shard is at-or-before the query shard in the interleaved order, strict
+    -1 after); contiguous skips fully-future blocks and runs the diagonal
+    block causally. Per-example lengths become per-block ``kv_lengths``
+    (original-position masks translated into each block's local prefix).
+    Backward rides the kernel's lse-cotangent path — no hand-written ring
+    backward schedule.
+    """
+    from petastorm_tpu.ops.flash_attention import flash_attention_with_lse
+
+    b, l, h, dh = q.shape
+    blk = min(128, l)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    r = jax.lax.axis_index(axis_name)
+
+    def block_lens(src):
+        if lengths is None:
+            return None
+        if placement == "striped":
+            # k_pos = src + sp·j < len  ⟺  j < ceil((len - src) / sp)
+            cnt = (lengths - src + axis_size - 1) // axis_size
+        else:
+            cnt = lengths - src * l
+        return jnp.clip(cnt, 0, l).astype(jnp.int32)
+
+    def partial_attn(k_cur, v_cur, src, causal_, shift):
+        return flash_attention_with_lse(
+            q, k_cur, v_cur, block_q=blk, block_k=blk, causal=causal_,
+            causal_shift=shift, kv_lengths=block_lens(src))
+
+    def merge(carry, o_b, lse_b):
+        num, m, den = carry
+        o_b = o_b.astype(jnp.float32)
+        m_new = jnp.maximum(m, lse_b)
+        safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+        beta = jnp.where(jnp.isneginf(lse_b), 0.0, jnp.exp(lse_b - safe))
+        num = num * alpha[..., None] + o_b * beta[..., None]
+        den = den * alpha + beta
+        return num, m_new, den
+
+    def body(i, carry):
+        k_cur, v_cur, num, m, den = carry
+        src = (r - i) % axis_size
+        if not causal:
+            o_b, lse_b = partial_attn(k_cur, v_cur, src, False, 0)
+            num, m, den = merge((num, m, den), o_b, lse_b)
+        elif placement == "striped":
+            # Key shard at-or-before the query shard in interleaved order →
+            # standard causal diagonal; after → strict causal (shift -1).
+            o_b, lse_b = jax.lax.cond(
+                src <= r,
+                lambda kc, vc, s: partial_attn(kc, vc, s, True, 0),
+                lambda kc, vc, s: partial_attn(kc, vc, s, True, -1),
+                k_cur, v_cur, src)
+            num, m, den = merge((num, m, den), o_b, lse_b)
+        else:  # contiguous: skip fully-future, diagonal block causal
+            def future(kc, vc, s, carry):
+                return carry
+
+            def diag(kc, vc, s, carry):
+                o_b, lse_b = partial_attn(kc, vc, s, True, 0)
+                return merge(carry, o_b, lse_b)
+
+            def past(kc, vc, s, carry):
+                o_b, lse_b = partial_attn(kc, vc, s, False, 0)
+                return merge(carry, o_b, lse_b)
+
+            num, m, den = jax.lax.cond(
+                src > r, future,
+                lambda kc, vc, s, c: jax.lax.cond(s == r, diag, past,
+                                                  kc, vc, s, c),
+                k_cur, v_cur, src, (num, m, den))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, num, m, den
+
+    from petastorm_tpu.models._shard_compat import mark_varying
+
+    def varying(x):
+        return mark_varying(x, varying_axes or (axis_name,))
+
+    init = (k, v,
+            varying(jnp.zeros((b, l, h, dh), jnp.float32)),
+            varying(jnp.full((b, l, h), -jnp.inf, jnp.float32)),
+            varying(jnp.zeros((b, l, h), jnp.float32)))
+    _, _, num, _, den = jax.lax.fori_loop(0, axis_size, body, init)
+    return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
+
+
 def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
                          causal=False, placement="contiguous",
                          lengths=None, segment_ids=None):
@@ -197,7 +299,7 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
 
 def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
                    causal=False, placement="striped", lengths=None,
-                   segment_ids=None):
+                   segment_ids=None, local_attn="dense"):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     Inputs are global ``[B, T, H, Dh]`` arrays (sharded or shardable on T);
@@ -217,10 +319,32 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     (``jax_utils.packing``) — positions attend only within their segment;
     the ids ride the K/V ring so masking needs no extra bookkeeping.
     Mutually exclusive with ``lengths`` (give padding its own id).
+    ``local_attn``: ``"dense"`` (each ring step computes its [L, L] score
+    block with XLA), ``"flash"`` (each step runs the Pallas kernel and
+    merges partials by log-sum-exp — NO [L, L] buffer even per step; the
+    long-T choice), or ``"auto"`` (flash once T reaches
+    ``ULYSSES_FLASH_THRESHOLD``). Flash does not support ``segment_ids``
+    (use the dense ring or the Ulysses-flash path for packed batches).
     """
     from jax import shard_map
 
     sp = mesh.shape[axis_name]
+    if local_attn == "auto":
+        local_attn = ("flash" if q.shape[1] >= ULYSSES_FLASH_THRESHOLD
+                      else "dense")
+    if local_attn not in ("dense", "flash"):
+        raise ValueError(f"local_attn {local_attn!r} is not 'auto', "
+                         "'dense', or 'flash'")
+    if local_attn == "flash":
+        if segment_ids is not None:
+            raise ValueError(
+                "local_attn='flash' does not support segment_ids in the "
+                "ring (per-block q/kv ids differ); use the dense ring or "
+                "ulysses_attention(local_attn='flash') for packed batches")
+        if q.shape[1] // sp < 8:
+            # Below the TPU min sublane tile the kernel cannot tile; dense
+            # per-block attention is cheaper at these sizes anyway.
+            local_attn = "dense"
     if (causal or lengths is not None or segment_ids is not None) \
             and q.shape[1] != k.shape[1]:
         # Both placements derive key positions from q's local length, and
@@ -243,27 +367,32 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     # The block's position formulas must describe the ACTUAL data layout:
     # striping is only applied above (causal), so a lengths-only call with
     # the default placement="striped" still holds contiguous data.
-    block = functools.partial(ring_attention_block, axis_name=axis_name,
+    block_fn = (_ring_flash_block if local_attn == "flash"
+                else ring_attention_block)
+    block = functools.partial(block_fn, axis_name=axis_name,
                               axis_size=sp, varying_axes=varying_axes,
                               causal=causal,
                               placement="striped" if striped
                               else "contiguous")
+    # pallas_call outputs carry no varying-mesh-axes annotation, which the
+    # vma checker rejects — opt out only when the flash kernel runs.
+    check_vma = local_attn != "flash"
     if lengths is None and segment_ids is None:
         sharded = shard_map(block, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec)
+                            out_specs=spec, check_vma=check_vma)
         out = sharded(q, k, v)
     elif segment_ids is not None:
         sharded = shard_map(
             lambda a, b, c, sg: block(a, b, c, segment_ids=sg),
             mesh=mesh,
             in_specs=(spec, spec, spec, P(batch_axis, axis_name)),
-            out_specs=spec)
+            out_specs=spec, check_vma=check_vma)
         out = sharded(q, k, v, segment_ids)
     else:
         sharded = shard_map(
             lambda a, b, c, le: block(a, b, c, lengths=le),
             mesh=mesh, in_specs=(spec, spec, spec, P(batch_axis)),
-            out_specs=spec)
+            out_specs=spec, check_vma=check_vma)
         out = sharded(q, k, v, lengths)
     return _unstripe(out, sp) if striped else out
 

@@ -274,7 +274,7 @@ def _check_seg_blocks(block_k):
 
 def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
                    return_residuals=False, kv_lengths=None,
-                   segment_ids=None):
+                   segment_ids=None, causal_shift=0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -288,7 +288,10 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
     tq_p, tk_p = qf.shape[1], kf.shape[1]
 
     grid = (b * h, tq_p // block_q, tk_p // block_k)
-    causal_offset = (t_kv - t_q) if causal else None
+    # causal_shift slides the diagonal: -1 = strict causal (k strictly
+    # before q) — the striped-ring blocks where the key shard sits "after"
+    # the query shard in the interleaved global order.
+    causal_offset = (t_kv - t_q + causal_shift) if causal else None
     kernel = functools.partial(
         _flash_kernel,
         sm_scale=1.0 / float(d) ** 0.5,
@@ -417,16 +420,24 @@ def _split_bwd_refs(refs, has_lens, has_segs):
 
 
 def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
-                         causal_offset, has_lens, has_segs, precision):
+                         causal_offset, has_lens, has_segs, has_dlse,
+                         precision):
     """dQ sweep: grid (B·H, Tq/block_q, Tk/block_k) — K blocks iterate
     innermost, dq accumulates in VMEM scratch. Per tile:
-    p = exp(s - lse); ds = p·(do·vᵀ - Δ)·scale; dq += ds·k, with
+    p = exp(s - lse); ds = p·(do·vᵀ - Δ [+ dlse])·scale; dq += ds·k, with
     Δ = rowsum(do ∘ o) recomputed from the residuals (O(block·d), cheaper
-    than staging a third stats tensor)."""
+    than staging a third stats tensor). ``dlse`` is the cotangent of the
+    emitted log-sum-exp when the caller consumed it (ring merging):
+    ∂lse_i/∂s_ij = p_ij, so it adds inside the parenthesis."""
     from jax.experimental import pallas as pl
 
     (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref, qseg_ref,
      kvseg_ref, rest) = _split_bwd_refs(refs, has_lens, has_segs)
+    if has_dlse:
+        dlse_ref = rest[0]
+        rest = rest[1:]
+    else:
+        dlse_ref = None
     dq_ref, dq_acc = rest
     kv_len = _kv_limit(lens_ref, kv_len)
 
@@ -459,7 +470,10 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32,
                                  precision=precision)
-        ds = p * (dp - delta) * sm_scale
+        inner = dp - delta
+        if dlse_ref is not None:
+            inner = inner + dlse_ref[0][:, :1]
+        ds = p * inner * sm_scale
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -477,14 +491,21 @@ def _flash_bwd_dq_kernel(*refs, sm_scale, block_q, block_k, kv_len,
 
 
 def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
-                          causal_offset, has_lens, has_segs, precision):
+                          causal_offset, has_lens, has_segs, has_dlse,
+                          precision):
     """dK/dV sweep: grid (B·H, Tk/block_k, Tq/block_q) — Q blocks iterate
     innermost, dk/dv accumulate in VMEM scratch. Per tile:
-    dv += pᵀ·do; dk += dsᵀ·q (same recomputed p/ds as the dQ sweep)."""
+    dv += pᵀ·do; dk += dsᵀ·q (same recomputed p/ds as the dQ sweep,
+    including the optional dlse term)."""
     from jax.experimental import pallas as pl
 
     (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, lens_ref, qseg_ref,
      kvseg_ref, rest) = _split_bwd_refs(refs, has_lens, has_segs)
+    if has_dlse:
+        dlse_ref = rest[0]
+        rest = rest[1:]
+    else:
+        dlse_ref = None
     dk_ref, dv_ref, dk_acc, dv_acc = rest
     kv_len = _kv_limit(lens_ref, kv_len)
 
@@ -520,7 +541,10 @@ def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32,
                                  precision=precision)
-        ds = p * (dp - delta) * sm_scale
+        inner = dp - delta
+        if dlse_ref is not None:
+            inner = inner + dlse_ref[0][:, :1]
+        ds = p * inner * sm_scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -540,7 +564,8 @@ def _flash_bwd_dkv_kernel(*refs, sm_scale, block_q, block_k, kv_len,
 
 
 def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
-                    causal, kv_lengths=None, segment_ids=None):
+                    causal, kv_lengths=None, segment_ids=None,
+                    causal_shift=0, dlse=None):
     """Flash-2 backward: two pallas sweeps, O(block²) VMEM, no [T, T]
     buffer. ``o_padded``/``lse`` are [B·H, Tq_padded(, )] residuals from the
     forward; q/k/v are the user-shaped [B, T, H, D] primals."""
@@ -571,12 +596,19 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
         _check_seg_blocks(block_k)
         seg_inputs = [_q_segs_arr(segment_ids, block_q),
                       _kv_segs_arr(segment_ids, block_k)]
+    dlse_inputs = []
+    if dlse is not None:
+        # The lse cotangent, lane-broadcast like the lse residual itself
+        # ([B·H, Tq_pad] from the vjp wrapper).
+        dlse_inputs = [jnp.broadcast_to(dlse[:, :, None],
+                                        (b * h, tq_p, _LANES))]
 
-    causal_offset = (t_kv - t_q) if causal else None
+    causal_offset = (t_kv - t_q + causal_shift) if causal else None
     common = dict(sm_scale=1.0 / float(d) ** 0.5, block_q=block_q,
                   block_k=block_k, kv_len=t_kv, causal_offset=causal_offset,
                   has_lens=kv_lengths is not None,
                   has_segs=segment_ids is not None,
+                  has_dlse=dlse is not None,
                   precision=_dot_precision(q.dtype))
 
     q_spec = lambda ix: pl.BlockSpec((1, block_q, d), ix,  # noqa: E731
@@ -596,6 +628,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             return jnp.minimum(j, jnp.maximum(last, 0))
 
     dq_kv_index = lambda bh, i, j: (bh, dq_kv_block(i, j), 0)  # noqa: E731
+    dq_stats_spec = pl.BlockSpec((1, block_q, _LANES), dq_q_index,
+                                 memory_space=pltpu.VMEM)
     dq_seg_specs = []
     if segment_ids is not None:
         dq_seg_specs = [_q_seg_spec(pl, pltpu, h, block_q,
@@ -611,14 +645,16 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             kv_spec(dq_kv_index),
             q_spec(dq_q_index),                      # do
             q_spec(dq_q_index),                      # o
-            pl.BlockSpec((1, block_q, _LANES), dq_q_index,
-                         memory_space=pltpu.VMEM),   # lse
-        ] + lens_specs + dq_seg_specs,
+            dq_stats_spec,                           # lse
+        ] + lens_specs + dq_seg_specs + (
+            # dlse must ride the EXACT same fetch as lse (same Q block).
+            [dq_stats_spec] if dlse is not None else []),
         out_specs=q_spec(dq_q_index),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs,
+      *dlse_inputs)
 
     # --- dK/dV sweep: (bh, kb, qb), Q innermost -----------------------------
     dkv_kv_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
@@ -633,6 +669,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             return jnp.maximum(j, first)
 
     dkv_q_index = lambda bh, i, j: (bh, dkv_q_block(i, j), 0)  # noqa: E731
+    dkv_stats_spec = pl.BlockSpec((1, block_q, _LANES), dkv_q_index,
+                                  memory_space=pltpu.VMEM)
     dkv_seg_specs = []
     if segment_ids is not None:
         dkv_seg_specs = [_q_seg_spec(pl, pltpu, h, block_q, dkv_q_block),
@@ -648,16 +686,18 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             kv_spec(dkv_kv_index),
             q_spec(dkv_q_index),                     # do
             q_spec(dkv_q_index),                     # o
-            pl.BlockSpec((1, block_q, _LANES), dkv_q_index,
-                         memory_space=pltpu.VMEM),   # lse
-        ] + lens_specs + dkv_seg_specs,
+            dkv_stats_spec,                          # lse
+        ] + lens_specs + dkv_seg_specs + (
+            # dlse must ride the EXACT same fetch as lse (same Q block).
+            [dkv_stats_spec] if dlse is not None else []),
         out_specs=(kv_spec(dkv_kv_index), kv_spec(dkv_kv_index)),
         out_shape=(jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs)
+    )(qf, kf, vf, dof, o_padded, lse_b, *lens_inputs, *seg_inputs,
+      *dlse_inputs)
 
     dq = _from_bh(dq[:, :t_q], b, h)
     dk = _from_bh(dk[:, :t_kv], b, h)
@@ -815,3 +855,94 @@ def _aux_bwd(block_q, block_k, interpret, causal, bwd_impl, aux_kind,
 
 
 _flash_aux.defvjp(_aux_fwd, _aux_bwd)
+
+
+def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
+                             interpret=None, causal=False, causal_shift=0,
+                             kv_lengths=None):
+    """Flash attention that ALSO returns the per-row log-sum-exp — the
+    merge statistic for combining partial attention over K/V shards
+    (ring/blockwise attention: two normalized partials with lse's combine
+    exactly into attention over their union).
+
+    Returns ``(out [B, Tq, H, D], lse [B, Tq, H] f32)`` with
+    ``lse = -inf`` for rows with no valid key (the true logsumexp of an
+    empty set — an empty partial contributes zero weight to a merge).
+    Differentiable in BOTH outputs: the backward kernels fold the lse
+    cotangent into ds (∂lse/∂s = p). ``causal_shift=-1`` gives STRICT
+    causal (key strictly before query) — the striped-ring blocks whose key
+    shard sits after the query shard in the interleaved global order.
+    """
+    return _flash_with_lse(q, k, v, kv_lengths, block_q, block_k,
+                           interpret, causal, causal_shift)
+
+
+def _lse_to_public(lse_raw, b, h, t_q):
+    """[B·H, Tq_pad] residual → [B, Tq, H] public lse; the kernel's +inf
+    no-valid-key convention flips to -inf (empty-set logsumexp)."""
+    lse = lse_raw[:, :t_q]
+    lse = jnp.where(jnp.isposinf(lse), -jnp.inf, lse)
+    return lse.reshape(b, h, t_q).transpose(0, 2, 1)
+
+
+def _dlse_to_bh(dlse, tq_p):
+    """[B, Tq, H] cotangent → [B·H, Tq_pad] kernel layout (zero-padded)."""
+    b, t_q, h = dlse.shape
+    flat = dlse.astype(jnp.float32).transpose(0, 2, 1).reshape(b * h, t_q)
+    pad = tq_p - t_q
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_with_lse(q, k, v, kv_lengths, block_q, block_k, interpret,
+                    causal, causal_shift):
+    out, _, lse_pub = _with_lse_primal(q, k, v, kv_lengths, block_q,
+                                       block_k, interpret, causal,
+                                       causal_shift)
+    return out, lse_pub
+
+
+def _with_lse_primal(q, k, v, kv_lengths, block_q, block_k, interpret,
+                     causal, causal_shift):
+    if interpret is None:
+        interpret = _should_interpret()
+    out_padded, lse_raw = _flash_forward(
+        q, k, v, block_q, block_k, interpret, causal,
+        return_residuals=True, kv_lengths=kv_lengths,
+        causal_shift=causal_shift)
+    b, t_q, h, _ = q.shape
+    out = _from_bh(out_padded[:, :t_q], b, h)
+    return out, (out_padded, lse_raw), _lse_to_public(lse_raw, b, h, t_q)
+
+
+def _with_lse_fwd(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
+                  causal_shift):
+    out, (out_padded, lse_raw), lse_pub = _with_lse_primal(
+        q, k, v, kv_lengths, block_q, block_k, interpret, causal,
+        causal_shift)
+    return (out, lse_pub), (q, k, v, out_padded, lse_raw, kv_lengths)
+
+
+def _with_lse_bwd(block_q, block_k, interpret, causal, causal_shift,
+                  residuals, cotangents):
+    if interpret is None:
+        interpret = _should_interpret()
+    q, k, v, o_padded, lse_raw, kv_lengths = residuals
+    do, dlse = cotangents
+    dlse_bh = _dlse_to_bh(dlse, lse_raw.shape[1])
+    dq, dk, dv = _flash_backward(q, k, v, o_padded, lse_raw, do, block_q,
+                                 block_k, interpret, causal,
+                                 kv_lengths=kv_lengths,
+                                 causal_shift=causal_shift, dlse=dlse_bh)
+    if kv_lengths is None:
+        dlens = None
+    else:
+        import numpy as np
+
+        dlens = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlens
+
+
+_flash_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)

@@ -310,3 +310,56 @@ def test_kv_lengths_under_jit():
     np.testing.assert_allclose(np.asarray(f(q, k, v, lengths)),
                                np.asarray(_lens_oracle(q, k, v, lengths)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_with_lse_matches_dense_and_grads_flow_through_lse():
+    """flash_attention_with_lse: the lse output equals the dense
+    log-sum-exp (with -inf empty-set convention), and gradients flow
+    through BOTH outputs (the backward's dlse term)."""
+    from petastorm_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.RandomState(5)
+    b, t, h, d = 2, 40, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+               for _ in range(3))
+
+    def dense(q, k, v, shift=0):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        iq = jnp.arange(t)[:, None] + shift
+        s = jnp.where((jnp.arange(t)[None, :] <= iq)[None, None], s,
+                      -jnp.inf)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        p = jnp.where(jnp.isneginf(lse)[..., None], 0.0,
+                      jnp.exp(s - safe[..., None]))
+        return (jnp.einsum("bhqk,bkhd->bqhd", p, v),
+                lse.transpose(0, 2, 1))
+
+    for shift in (0, -1):
+        got_o, got_l = flash_attention_with_lse(
+            q, k, v, block_q=16, block_k=16, causal=True,
+            causal_shift=shift)
+        want_o, want_l = dense(q, k, v, shift)
+        np.testing.assert_allclose(np.asarray(got_o), np.asarray(want_o),
+                                   rtol=1e-5, atol=1e-5)
+        finite = np.isfinite(np.asarray(want_l))
+        np.testing.assert_allclose(np.asarray(got_l)[finite],
+                                   np.asarray(want_l)[finite],
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.isneginf(np.asarray(got_l)),
+                                      ~finite)
+
+    def loss_flash(q, k, v):
+        o, l = flash_attention_with_lse(q, k, v, block_q=16, block_k=16,
+                                        causal=True)
+        return (o ** 2).sum() + (jnp.tanh(l) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        o, l = dense(q, k, v)
+        return (o ** 2).sum() + (jnp.tanh(l) ** 2).sum()
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
