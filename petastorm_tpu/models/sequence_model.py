@@ -85,7 +85,8 @@ def _unstripe(x, sp):
 
 
 def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
-                      causal=False, placement="contiguous", lengths=None):
+                      causal=False, placement="contiguous", lengths=None,
+                      segment_ids=None):
     """Per-shard ring attention with the Pallas flash kernel as the local
     attention — NO [L, L] score block materializes anywhere, even
     sequence-parallel (the kernel is O(block²); ring steps merge the
@@ -102,7 +103,9 @@ def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
     block causally. Per-example lengths become per-block ``kv_lengths``
     (original-position masks translated into each block's local prefix).
     Backward rides the kernel's lse-cotangent path — no hand-written ring
-    backward schedule.
+    backward schedule. ``segment_ids`` (packed batches): the local q ids
+    stay put while the resident K/V block's ids ride the ring — the kernel
+    takes the ``(q_ids, kv_ids)`` pair per step.
     """
     from petastorm_tpu.ops.flash_attention import flash_attention_with_lse
 
@@ -121,10 +124,13 @@ def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
             cnt = lengths - src * l
         return jnp.clip(cnt, 0, l).astype(jnp.int32)
 
-    def partial_attn(k_cur, v_cur, src, causal_, shift):
+    def partial_attn(k_cur, v_cur, kseg_cur, src, causal_, shift):
+        segs = (None if segment_ids is None
+                else (segment_ids, kseg_cur))
         return flash_attention_with_lse(
             q, k_cur, v_cur, block_q=blk, block_k=blk, causal=causal_,
-            causal_shift=shift, kv_lengths=block_lens(src))
+            causal_shift=shift, kv_lengths=block_lens(src),
+            segment_ids=segs)
 
     def merge(carry, o_b, lse_b):
         num, m, den = carry
@@ -138,51 +144,55 @@ def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
         return num, m_new, den
 
     def body(i, carry):
-        k_cur, v_cur, num, m, den = carry
+        k_cur, v_cur, kseg_cur, num, m, den = carry
         src = (r - i) % axis_size
         if not causal:
-            o_b, lse_b = partial_attn(k_cur, v_cur, src, False, 0)
+            o_b, lse_b = partial_attn(k_cur, v_cur, kseg_cur, src, False, 0)
             num, m, den = merge((num, m, den), o_b, lse_b)
         elif placement == "striped":
             # Key shard at-or-before the query shard in interleaved order →
             # standard causal diagonal; after → strict causal (shift -1).
             o_b, lse_b = jax.lax.cond(
                 src <= r,
-                lambda kc, vc, s: partial_attn(kc, vc, s, True, 0),
-                lambda kc, vc, s: partial_attn(kc, vc, s, True, -1),
-                k_cur, v_cur, src)
+                lambda kc, vc, kg, s: partial_attn(kc, vc, kg, s, True, 0),
+                lambda kc, vc, kg, s: partial_attn(kc, vc, kg, s, True, -1),
+                k_cur, v_cur, kseg_cur, src)
             num, m, den = merge((num, m, den), o_b, lse_b)
         else:  # contiguous: skip fully-future, diagonal block causal
-            def future(kc, vc, s, carry):
+            def future(kc, vc, kg, s, carry):
                 return carry
 
-            def diag(kc, vc, s, carry):
-                o_b, lse_b = partial_attn(kc, vc, s, True, 0)
+            def diag(kc, vc, kg, s, carry):
+                o_b, lse_b = partial_attn(kc, vc, kg, s, True, 0)
                 return merge(carry, o_b, lse_b)
 
-            def past(kc, vc, s, carry):
-                o_b, lse_b = partial_attn(kc, vc, s, False, 0)
+            def past(kc, vc, kg, s, carry):
+                o_b, lse_b = partial_attn(kc, vc, kg, s, False, 0)
                 return merge(carry, o_b, lse_b)
 
             num, m, den = jax.lax.cond(
                 src > r, future,
-                lambda kc, vc, s, c: jax.lax.cond(s == r, diag, past,
-                                                  kc, vc, s, c),
-                k_cur, v_cur, src, (num, m, den))
+                lambda kc, vc, kg, s, c: jax.lax.cond(s == r, diag, past,
+                                                      kc, vc, kg, s, c),
+                k_cur, v_cur, kseg_cur, src, (num, m, den))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, num, m, den
+        if segment_ids is not None:
+            kseg_cur = jax.lax.ppermute(kseg_cur, axis_name, perm)
+        return k_nxt, v_nxt, kseg_cur, num, m, den
 
     from petastorm_tpu.models._shard_compat import mark_varying
 
     def varying(x):
         return mark_varying(x, varying_axes or (axis_name,))
 
-    init = (k, v,
+    kseg0 = (segment_ids if segment_ids is not None
+             else varying(jnp.zeros((b, l), jnp.int32)))
+    init = (k, v, kseg0,
             varying(jnp.zeros((b, l, h, dh), jnp.float32)),
             varying(jnp.full((b, l, h), -jnp.inf, jnp.float32)),
             varying(jnp.zeros((b, l, h), jnp.float32)))
-    _, _, num, _, den = jax.lax.fori_loop(0, axis_size, body, init)
+    _, _, _, num, _, den = jax.lax.fori_loop(0, axis_size, body, init)
     return (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -323,8 +333,9 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     block with XLA), ``"flash"`` (each step runs the Pallas kernel and
     merges partials by log-sum-exp — NO [L, L] buffer even per step; the
     long-T choice), or ``"auto"`` (flash once T reaches
-    ``ULYSSES_FLASH_THRESHOLD``). Flash does not support ``segment_ids``
-    (use the dense ring or the Ulysses-flash path for packed batches).
+    ``ULYSSES_FLASH_THRESHOLD``). All masks compose with flash, including
+    packed ``segment_ids`` (the kernel takes the local q ids + the
+    ring-carried kv ids as a pair).
     """
     from jax import shard_map
 
@@ -335,16 +346,10 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     if local_attn not in ("dense", "flash"):
         raise ValueError(f"local_attn {local_attn!r} is not 'auto', "
                          "'dense', or 'flash'")
-    if local_attn == "flash":
-        if segment_ids is not None:
-            raise ValueError(
-                "local_attn='flash' does not support segment_ids in the "
-                "ring (per-block q/kv ids differ); use the dense ring or "
-                "ulysses_attention(local_attn='flash') for packed batches")
-        if q.shape[1] // sp < 8:
-            # Below the TPU min sublane tile the kernel cannot tile; dense
-            # per-block attention is cheaper at these sizes anyway.
-            local_attn = "dense"
+    if local_attn == "flash" and q.shape[1] // sp < 8:
+        # Below the TPU min sublane tile the kernel cannot tile; dense
+        # per-block attention is cheaper at these sizes anyway.
+        local_attn = "dense"
     if (causal or lengths is not None or segment_ids is not None) \
             and q.shape[1] != k.shape[1]:
         # Both placements derive key positions from q's local length, and

@@ -231,6 +231,16 @@ def _pad_seg_row(segment_ids, block):
     return seg
 
 
+def _split_segment_ids(segment_ids):
+    """``segment_ids`` is one [B, T] array (self-attention over a packed
+    batch) or a ``(q_ids [B, Tq], kv_ids [B, Tkv])`` pair (the flash ring:
+    resident K/V blocks carry their own ids)."""
+    if isinstance(segment_ids, (tuple, list)):
+        q_ids, kv_ids = segment_ids
+        return q_ids, kv_ids
+    return segment_ids, segment_ids
+
+
 def _q_segs_arr(segment_ids, block_q):
     """[B, T] → lane-broadcast [B, Tq_pad, 128]: a (block_q, 128) tile
     satisfies the TPU min-tile rule where a (1, block_q) row would not."""
@@ -343,11 +353,12 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
         inputs.append(_lens_to_bh(kv_lengths, b, h))
     if segment_ids is not None:
         _check_seg_blocks(block_k)
+        q_ids, kv_ids = _split_segment_ids(segment_ids)
         in_specs.append(_q_seg_spec(pl, pltpu, h, block_q,
                                     lambda i, j: i))
         in_specs.append(_kv_seg_spec(pl, pltpu, h, block_k, kv_block))
-        inputs.extend([_q_segs_arr(segment_ids, block_q),
-                       _kv_segs_arr(segment_ids, block_k)])
+        inputs.extend([_q_segs_arr(q_ids, block_q),
+                       _kv_segs_arr(kv_ids, block_k)])
 
     out = pl.pallas_call(
         kernel,
@@ -594,8 +605,9 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
     seg_inputs = []
     if segment_ids is not None:
         _check_seg_blocks(block_k)
-        seg_inputs = [_q_segs_arr(segment_ids, block_q),
-                      _kv_segs_arr(segment_ids, block_k)]
+        q_ids, kv_ids = _split_segment_ids(segment_ids)
+        seg_inputs = [_q_segs_arr(q_ids, block_q),
+                      _kv_segs_arr(kv_ids, block_k)]
     dlse_inputs = []
     if dlse is not None:
         # The lse cotangent, lane-broadcast like the lse residual itself
@@ -743,7 +755,10 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
             raise ValueError(
                 "segment_ids and kv_lengths are mutually exclusive: give "
                 "padded slots their own segment id instead")
-        if q.shape[1] != k.shape[1]:
+        if (not isinstance(segment_ids, (tuple, list))
+                and q.shape[1] != k.shape[1]):
+            # A single id array implies self-attention; the (q_ids, kv_ids)
+            # pair form carries its own per-side lengths.
             raise ValueError(
                 f"segment_ids requires T_q == T_kv (self-attention over a "
                 f"packed batch), got {q.shape[1]} vs {k.shape[1]}")
@@ -847,11 +862,9 @@ def _aux_bwd(block_q, block_k, interpret, causal, bwd_impl, aux_kind,
     aux = residuals[-1]
     dq, dk, dv = _bwd(block_q, block_k, interpret, causal, bwd_impl,
                       residuals[:-1], g, **{_AUX_KW[aux_kind]: aux})
-    # Integer aux arrays carry no gradient: the float0 zero cotangent.
-    import numpy as np
-
-    daux = np.zeros(aux.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, daux
+    # Integer aux carries no gradient: float0 zeros (handles the
+    # (q_ids, kv_ids) pair form of segment_ids too).
+    return dq, dk, dv, _int_aux_zeros(aux)
 
 
 _flash_aux.defvjp(_aux_fwd, _aux_bwd)
@@ -859,7 +872,7 @@ _flash_aux.defvjp(_aux_fwd, _aux_bwd)
 
 def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
                              interpret=None, causal=False, causal_shift=0,
-                             kv_lengths=None):
+                             kv_lengths=None, segment_ids=None):
     """Flash attention that ALSO returns the per-row log-sum-exp — the
     merge statistic for combining partial attention over K/V shards
     (ring/blockwise attention: two normalized partials with lse's combine
@@ -872,9 +885,16 @@ def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
     cotangent into ds (∂lse/∂s = p). ``causal_shift=-1`` gives STRICT
     causal (key strictly before query) — the striped-ring blocks whose key
     shard sits after the query shard in the interleaved global order.
+    ``segment_ids`` may be one [B, T] array (self-attention over a packed
+    batch) or a ``(q_ids, kv_ids)`` pair (the ring: the resident K/V block
+    carries its own ids); mutually exclusive with ``kv_lengths``.
     """
-    return _flash_with_lse(q, k, v, kv_lengths, block_q, block_k,
-                           interpret, causal, causal_shift)
+    if segment_ids is not None and kv_lengths is not None:
+        raise ValueError(
+            "segment_ids and kv_lengths are mutually exclusive: give "
+            "padded slots their own segment id instead")
+    return _flash_with_lse(q, k, v, kv_lengths, segment_ids, block_q,
+                           block_k, interpret, causal, causal_shift)
 
 
 def _lse_to_public(lse_raw, b, h, t_q):
@@ -895,54 +915,59 @@ def _dlse_to_bh(dlse, tq_p):
     return flat
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_with_lse(q, k, v, kv_lengths, block_q, block_k, interpret,
-                    causal, causal_shift):
-    out, _, lse_pub = _with_lse_primal(q, k, v, kv_lengths, block_q,
-                                       block_k, interpret, causal,
+def _int_aux_zeros(aux):
+    """float0 zero cotangent matching an integer aux pytree (or None)."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda a: np.zeros(jnp.shape(a), dtype=jax.dtypes.float0), aux)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_with_lse(q, k, v, kv_lengths, segment_ids, block_q, block_k,
+                    interpret, causal, causal_shift):
+    out, _, lse_pub = _with_lse_primal(q, k, v, kv_lengths, segment_ids,
+                                       block_q, block_k, interpret, causal,
                                        causal_shift)
     return out, lse_pub
 
 
-def _with_lse_primal(q, k, v, kv_lengths, block_q, block_k, interpret,
-                     causal, causal_shift):
+def _with_lse_primal(q, k, v, kv_lengths, segment_ids, block_q, block_k,
+                     interpret, causal, causal_shift):
     if interpret is None:
         interpret = _should_interpret()
     out_padded, lse_raw = _flash_forward(
         q, k, v, block_q, block_k, interpret, causal,
         return_residuals=True, kv_lengths=kv_lengths,
-        causal_shift=causal_shift)
+        segment_ids=segment_ids, causal_shift=causal_shift)
     b, t_q, h, _ = q.shape
     out = _from_bh(out_padded[:, :t_q], b, h)
     return out, (out_padded, lse_raw), _lse_to_public(lse_raw, b, h, t_q)
 
 
-def _with_lse_fwd(q, k, v, kv_lengths, block_q, block_k, interpret, causal,
-                  causal_shift):
+def _with_lse_fwd(q, k, v, kv_lengths, segment_ids, block_q, block_k,
+                  interpret, causal, causal_shift):
     out, (out_padded, lse_raw), lse_pub = _with_lse_primal(
-        q, k, v, kv_lengths, block_q, block_k, interpret, causal,
-        causal_shift)
-    return (out, lse_pub), (q, k, v, out_padded, lse_raw, kv_lengths)
+        q, k, v, kv_lengths, segment_ids, block_q, block_k, interpret,
+        causal, causal_shift)
+    return (out, lse_pub), (q, k, v, out_padded, lse_raw, kv_lengths,
+                            segment_ids)
 
 
 def _with_lse_bwd(block_q, block_k, interpret, causal, causal_shift,
                   residuals, cotangents):
     if interpret is None:
         interpret = _should_interpret()
-    q, k, v, o_padded, lse_raw, kv_lengths = residuals
+    q, k, v, o_padded, lse_raw, kv_lengths, segment_ids = residuals
     do, dlse = cotangents
     dlse_bh = _dlse_to_bh(dlse, lse_raw.shape[1])
     dq, dk, dv = _flash_backward(q, k, v, o_padded, lse_raw, do, block_q,
                                  block_k, interpret, causal,
                                  kv_lengths=kv_lengths,
+                                 segment_ids=segment_ids,
                                  causal_shift=causal_shift, dlse=dlse_bh)
-    if kv_lengths is None:
-        dlens = None
-    else:
-        import numpy as np
-
-        dlens = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlens
+    return (dq, dk, dv, _int_aux_zeros(kv_lengths),
+            _int_aux_zeros(segment_ids))
 
 
 _flash_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)

@@ -363,3 +363,24 @@ def test_with_lse_matches_dense_and_grads_flow_through_lse():
     for a, b_, name in zip(gf, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_segment_id_pair_form_grads_through_public_api():
+    """flash_attention accepts the (q_ids, kv_ids) pair form and its
+    backward handles the tuple cotangent (float0 per element)."""
+    rng = np.random.RandomState(7)
+    b, t, h, d = 1, 24, 2, 8
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+               for _ in range(3))
+    ids = jnp.asarray(np.array([[0] * 10 + [1] * 14]), jnp.int32)
+
+    out_pair = flash_attention(q, k, v, block_q=8, block_k=24,
+                               segment_ids=(ids, ids))
+    out_single = flash_attention(q, k, v, block_q=8, block_k=24,
+                                 segment_ids=ids)
+    np.testing.assert_allclose(np.asarray(out_pair),
+                               np.asarray(out_single), rtol=1e-6)
+    g = jax.grad(lambda q: (flash_attention(
+        q, k, v, block_q=8, block_k=24,
+        segment_ids=(ids, ids)) ** 2).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()

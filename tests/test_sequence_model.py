@@ -599,11 +599,6 @@ def test_flash_ring_jitted_dp_sp_and_guards():
         local_attn="flash"))
     np.testing.assert_allclose(np.asarray(fn(q, k, v)), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
-    # flash ring rejects segment_ids (per-block q/kv ids differ)
-    seg = jnp.zeros((2, 32), jnp.int32)
-    with pytest.raises(ValueError, match="does not support segment_ids"):
-        ring_attention(q, k, v, mesh, "sp", segment_ids=seg,
-                       local_attn="flash")
     # below the min tile (L < 8) it silently falls back to dense
     small_mesh = _mesh((8,), ("sp",))
     qs, ks, vs, _ = _flash_ring_case(t=32, seed=14)  # L = 4
@@ -611,3 +606,53 @@ def test_flash_ring_jitted_dp_sp_and_guards():
     np.testing.assert_allclose(np.asarray(got),
                                np.asarray(attention_reference(qs, ks, vs)),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal,placement", [
+    (False, "striped"), (True, "striped"), (True, "contiguous")])
+def test_flash_ring_packed_segments_match_reference(causal, placement):
+    """Packed batches through the flash ring: the local q ids pair with the
+    ring-carried kv ids per step — must match the dense packed oracle."""
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(15)
+    b, t, h, d = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+               for _ in range(3))
+    seg = jnp.asarray(np.stack([
+        np.array([0] * 20 + [1] * 30 + [2] * 14),
+        np.array([0] * 40 + [1] * 16 + [-1] * 8),
+    ]), jnp.int32)
+    want = attention_reference(q, k, v, causal=causal, segment_ids=seg)
+    got = ring_attention(q, k, v, mesh, "sp", causal=causal,
+                         placement=placement, segment_ids=seg,
+                         local_attn="flash")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4,
+                               err_msg=f"{placement} causal={causal}")
+
+
+def test_flash_ring_packed_gradients_match_reference():
+    mesh = _mesh((8,), ("sp",))
+    rng = np.random.RandomState(16)
+    b, t, h, d = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+               for _ in range(3))
+    seg = jnp.asarray(np.stack([
+        np.array([0] * 30 + [1] * 34),
+        np.array([0] * 50 + [-1] * 14),
+    ]), jnp.int32)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mesh, "sp", causal=True,
+                               segment_ids=seg,
+                               local_attn="flash") ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (attention_reference(q, k, v, causal=True,
+                                    segment_ids=seg) ** 2).sum()
+
+    gf = jax.grad(loss_ring, (0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
