@@ -556,7 +556,7 @@ def seq_param_partition_specs():
 
 def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
                     compute_dtype=jnp.bfloat16, attn_impl="dense",
-                    causal=False, lengths=None):
+                    causal=False, lengths=None, local_attn="auto"):
     """``windows``: [B, T, F] float (NGram windows collated to a time axis).
 
     With ``mesh``: sequence-parallel attention over ``mesh[attn_axis]`` (T
@@ -574,7 +574,9 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
     [B] int — positions at/after ``lengths[b]`` neither attend nor are
     attended to nor pooled, so a ragged window padded to T produces exactly
     the logits of its unpadded self (all impls, single-shard AND
-    sequence-parallel).
+    sequence-parallel). ``local_attn``: the sequence-parallel impls' local
+    attention ("auto" = Pallas flash at long T, dense below — see
+    :func:`ring_attention` / :func:`ulysses_attention`).
     """
     h = num_heads
     x = windows.astype(compute_dtype) @ params["embed"].astype(compute_dtype)
@@ -598,7 +600,7 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
                          else ring_attention)
         attn = parallel_attn(q, k, v, mesh, attn_axis,
                              batch_axis=batch_axis, causal=causal,
-                             lengths=lengths)
+                             lengths=lengths, local_attn=local_attn)
     elif attn_impl == "ring":
         # Symmetric remap: "ring" is the mesh-side default (the train-step
         # factory passes it unconditionally); without a mesh it means plain
@@ -637,7 +639,8 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
 
 
 def make_seq_train_step(learning_rate=0.05, num_heads=4, mesh=None,
-                        attn_axis="sp", attn_impl="ring", causal=False):
+                        attn_axis="sp", attn_impl="ring", causal=False,
+                        local_attn="auto"):
     """``step(params, windows, labels, mask[, lengths]) -> (params, loss)``
     — masked cross-entropy + SGD, sequence-parallel attention (ring or
     ulysses) when a mesh is given, decoder-style masking with ``causal``.
@@ -648,7 +651,7 @@ def make_seq_train_step(learning_rate=0.05, num_heads=4, mesh=None,
         logits = apply_seq_model(params, windows, num_heads=num_heads,
                                  mesh=mesh, attn_axis=attn_axis,
                                  attn_impl=attn_impl, causal=causal,
-                                 lengths=lengths)
+                                 lengths=lengths, local_attn=local_attn)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         nll = jnp.where(mask, nll, 0.0)

@@ -241,6 +241,24 @@ def _split_segment_ids(segment_ids):
     return segment_ids, segment_ids
 
 
+def _check_segment_ids(segment_ids, t_q, t_kv):
+    """Per-side length validation for both segment-id forms — a mismatched
+    array would silently mis-mask (ids sliced/padded against the wrong
+    positions), so it must raise instead."""
+    if isinstance(segment_ids, (tuple, list)):
+        q_ids, kv_ids = segment_ids
+        if jnp.shape(q_ids)[1] != t_q or jnp.shape(kv_ids)[1] != t_kv:
+            raise ValueError(
+                f"segment_ids pair shapes {jnp.shape(q_ids)} / "
+                f"{jnp.shape(kv_ids)} do not match T_q={t_q} / "
+                f"T_kv={t_kv} (is the (q_ids, kv_ids) order swapped?)")
+    elif t_q != t_kv:
+        raise ValueError(
+            f"a single segment_ids array requires T_q == T_kv "
+            f"(self-attention over a packed batch), got {t_q} vs {t_kv}; "
+            "pass a (q_ids, kv_ids) pair for cross-length attention")
+
+
 def _q_segs_arr(segment_ids, block_q):
     """[B, T] → lane-broadcast [B, Tq_pad, 128]: a (block_q, 128) tile
     satisfies the TPU min-tile rule where a (1, block_q) row would not."""
@@ -743,9 +761,11 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
         keys at or past ``kv_lengths[b]`` are masked out for example ``b``
         (ragged NGram windows padded to a common T). With ``causal``, the
         causal alignment still uses the STATIC T_q/T_kv shapes.
-    :param segment_ids: optional [B, T] int ids for PACKED batches (see
+    :param segment_ids: optional int ids for PACKED batches (see
         ``jax_utils.packing``): positions only attend within their own
-        segment. Requires ``T_q == T_kv`` (self-attention); mutually
+        segment. Either one [B, T] array (self-attention — requires
+        ``T_q == T_kv``) or a ``(q_ids [B, Tq], kv_ids [B, Tkv])`` pair
+        (cross-length, e.g. the flash ring's per-block ids). Mutually
         exclusive with ``kv_lengths`` (give padded slots a unique id
         instead). Composes with ``causal``.
     """
@@ -755,13 +775,7 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
             raise ValueError(
                 "segment_ids and kv_lengths are mutually exclusive: give "
                 "padded slots their own segment id instead")
-        if (not isinstance(segment_ids, (tuple, list))
-                and q.shape[1] != k.shape[1]):
-            # A single id array implies self-attention; the (q_ids, kv_ids)
-            # pair form carries its own per-side lengths.
-            raise ValueError(
-                f"segment_ids requires T_q == T_kv (self-attention over a "
-                f"packed batch), got {q.shape[1]} vs {k.shape[1]}")
+        _check_segment_ids(segment_ids, q.shape[1], k.shape[1])
         return _flash_aux(q, k, v, segment_ids, block_q, block_k,
                           interpret, causal, bwd_impl, "segs")
     if kv_lengths is None:
@@ -889,10 +903,12 @@ def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
     batch) or a ``(q_ids, kv_ids)`` pair (the ring: the resident K/V block
     carries its own ids); mutually exclusive with ``kv_lengths``.
     """
-    if segment_ids is not None and kv_lengths is not None:
-        raise ValueError(
-            "segment_ids and kv_lengths are mutually exclusive: give "
-            "padded slots their own segment id instead")
+    if segment_ids is not None:
+        if kv_lengths is not None:
+            raise ValueError(
+                "segment_ids and kv_lengths are mutually exclusive: give "
+                "padded slots their own segment id instead")
+        _check_segment_ids(segment_ids, q.shape[1], k.shape[1])
     return _flash_with_lse(q, k, v, kv_lengths, segment_ids, block_q,
                            block_k, interpret, causal, causal_shift)
 

@@ -384,3 +384,25 @@ def test_segment_id_pair_form_grads_through_public_api():
         q, k, v, block_q=8, block_k=24,
         segment_ids=(ids, ids)) ** 2).sum())(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_segment_id_shape_validation_both_entry_points():
+    from petastorm_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(1, 16, 1, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 24, 1, 8).astype(np.float32))
+    ids16 = jnp.zeros((1, 16), jnp.int32)
+    ids24 = jnp.zeros((1, 24), jnp.int32)
+    # single array + cross-length → both entry points raise
+    with pytest.raises(ValueError, match="T_q == T_kv"):
+        flash_attention(q, k, k, segment_ids=ids16)
+    with pytest.raises(ValueError, match="T_q == T_kv"):
+        flash_attention_with_lse(q, k, k, segment_ids=ids16)
+    # swapped pair → raises rather than silently mis-masking
+    with pytest.raises(ValueError, match="swapped"):
+        flash_attention_with_lse(q, k, k, segment_ids=(ids24, ids16))
+    # correct pair → runs
+    out, lse = flash_attention_with_lse(q, k, k, block_q=16, block_k=24,
+                                        segment_ids=(ids16, ids24))
+    assert out.shape == (1, 16, 1, 8)
