@@ -17,6 +17,9 @@ The reference's headline workload shapes, runnable on synthetic data via
 - ``converter_mixing`` — config #5 end-to-end: ``make_spark_converter``
   materialization -> per-corpus batch readers -> weighted mix ->
   ``make_jax_dataloader`` (the whole pipeline, not just the sampler).
+- ``packed`` — ragged-sequence delivery: ``make_packed_jax_dataloader``
+  tokens/sec plus packed-vs-padded slot utilization (the attention-FLOP
+  waste packing removes).
 
 Each scenario materializes its own synthetic dataset (unless given a url),
 runs the measurement, and returns a flat dict of numbers (the CLI prints it
@@ -418,10 +421,96 @@ def converter_mixing_scenario(dataset_url=None, rows=8_192,
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Scenario: packed sequence delivery (ragged docs -> pack_ragged -> loader)
+# ---------------------------------------------------------------------------
+
+def packed_delivery_scenario(dataset_url=None, docs=2_048, max_len=48,
+                             slot_len=96, slots=8, feature_dim=8,
+                             workers=3):
+    """Packed vs padded delivery of a ragged-sequence corpus: tokens/sec
+    through ``make_packed_jax_dataloader`` and the slot utilization of each
+    layout — the FLOP-waste number packing exists to fix (every padding
+    slot burns MXU cycles at train time).
+
+    ``dataset_url``: optional location for the generated corpus (default:
+    a fresh tmpdir, removed afterwards).
+    """
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.etl.metadata import materialize_rows
+    from petastorm_tpu.jax_utils import (PACK_SEGMENT_KEY,
+                                         make_packed_jax_dataloader,
+                                         packed_valid_mask)
+    from petastorm_tpu.schema.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.schema.unischema import Unischema, UnischemaField
+
+    schema = Unischema("PackedBench", [
+        UnischemaField("seq", np.float32, (max_len, feature_dim),
+                       NdarrayCodec(), False),
+        UnischemaField("length", np.int32, (), ScalarCodec(), False),
+    ])
+    rng = np.random.RandomState(41)
+
+    def rows():
+        for _ in range(docs):
+            n = int(rng.randint(4, max_len + 1))
+            seq = np.zeros((max_len, feature_dim), np.float32)
+            seq[:n] = rng.rand(n, feature_dim)
+            yield {"seq": seq, "length": np.int32(n)}
+
+    tmpdir = None
+    if dataset_url is None:
+        # Synthesize only when no dataset was supplied — --dataset-url
+        # reuses an existing ragged corpus (seq + length columns), like
+        # every other scenario.
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_packed_")
+        dataset_url = f"file://{tmpdir}/ds"
+        materialize_rows(dataset_url, schema, rows(),
+                         rows_per_row_group=256)
+    try:
+        reader = make_columnar_reader(dataset_url, num_epochs=1,
+                                      shuffle_row_groups=False,
+                                      workers_count=workers)
+        loader = make_packed_jax_dataloader(
+            reader, slot_len=slot_len, slots=slots,
+            sequence_fields=["seq"], length_field="length",
+            stage_to_device=False)
+        valid = total = batches = doc_count = observed_max = 0
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                seg = batch[PACK_SEGMENT_KEY]
+                valid += int(packed_valid_mask(seg).sum())
+                total += seg.size
+                batches += 1
+                for b in range(seg.shape[0]):
+                    for sid in range(int(seg[b].max()) + 1):
+                        n = int((seg[b] == sid).sum())
+                        if n:
+                            doc_count += 1
+                            observed_max = max(observed_max, n)
+        wall = time.perf_counter() - t0
+        return {
+            "scenario": "packed_delivery",
+            "docs": doc_count,
+            "batches": batches,
+            "tokens_per_sec": round(valid / wall, 1),
+            "packed_utilization": round(valid / max(total, 1), 3),
+            # the padded alternative: one row per OBSERVED doc at the
+            # longest observed length
+            "padded_utilization": round(
+                valid / max(doc_count * observed_max, 1), 3),
+        }
+    finally:
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "tabular": tabular_predicate_scenario,
     "ngram": ngram_window_scenario,
     "image": image_pipeline_scenario,
     "weighted": weighted_mixing_scenario,
     "converter_mixing": converter_mixing_scenario,
+    "packed": packed_delivery_scenario,
 }

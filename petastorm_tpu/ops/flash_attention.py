@@ -252,11 +252,17 @@ def _check_segment_ids(segment_ids, t_q, t_kv):
                 f"segment_ids pair shapes {jnp.shape(q_ids)} / "
                 f"{jnp.shape(kv_ids)} do not match T_q={t_q} / "
                 f"T_kv={t_kv} (is the (q_ids, kv_ids) order swapped?)")
-    elif t_q != t_kv:
-        raise ValueError(
-            f"a single segment_ids array requires T_q == T_kv "
-            f"(self-attention over a packed batch), got {t_q} vs {t_kv}; "
-            "pass a (q_ids, kv_ids) pair for cross-length attention")
+    else:
+        if t_q != t_kv:
+            raise ValueError(
+                f"a single segment_ids array requires T_q == T_kv "
+                f"(self-attention over a packed batch), got {t_q} vs "
+                f"{t_kv}; pass a (q_ids, kv_ids) pair for cross-length "
+                "attention")
+        if jnp.shape(segment_ids)[1] != t_q:
+            raise ValueError(
+                f"segment_ids shape {jnp.shape(segment_ids)} does not "
+                f"match the sequence length T={t_q}")
 
 
 def _q_segs_arr(segment_ids, block_q):

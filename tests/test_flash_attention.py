@@ -406,3 +406,11 @@ def test_segment_id_shape_validation_both_entry_points():
     out, lse = flash_attention_with_lse(q, k, k, block_q=16, block_k=24,
                                         segment_ids=(ids16, ids24))
     assert out.shape == (1, 16, 1, 8)
+
+
+def test_single_segment_ids_length_mismatch_raises():
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(1, 16, 1, 8).astype(np.float32))
+    bad_ids = jnp.zeros((1, 24), jnp.int32)
+    with pytest.raises(ValueError, match="does not match the sequence"):
+        flash_attention(q, q, q, segment_ids=bad_ids)

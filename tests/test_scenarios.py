@@ -70,3 +70,12 @@ def test_converter_mixing_scenario_end_to_end():
     # coarse granularity (row-group-sized draws): wide tolerance
     assert abs(empirical[0] - 0.7) < 0.15
     assert abs(empirical[1] - 0.3) < 0.15
+
+
+def test_packed_delivery_scenario_beats_padded_utilization():
+    from petastorm_tpu.benchmark.scenarios import packed_delivery_scenario
+
+    result = packed_delivery_scenario(docs=256, max_len=24, slot_len=48,
+                                      slots=4)
+    assert result["batches"] > 0 and result["tokens_per_sec"] > 0
+    assert result["packed_utilization"] > result["padded_utilization"]
