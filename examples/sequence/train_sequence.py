@@ -144,7 +144,8 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
     import jax.numpy as jnp
 
     from petastorm_tpu import make_columnar_reader
-    from petastorm_tpu.jax_utils import (PACK_SEGMENT_KEY,
+    from petastorm_tpu.jax_utils import (PACK_POSITION_KEY,
+                                         PACK_SEGMENT_KEY,
                                          make_packed_jax_dataloader,
                                          packed_valid_mask)
     from petastorm_tpu.models.sequence_model import attention_reference
@@ -152,18 +153,22 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
 
     feature_dim, d_model, heads = 6, 32, 4
     rng = jax.random.PRNGKey(2)
-    keys = jax.random.split(rng, 5)
+    keys = jax.random.split(rng, 6)
     s = lambda fan: 1.0 / np.sqrt(fan)  # noqa: E731
     params = {
         "emb": jax.random.normal(keys[0], (feature_dim, d_model)) * s(feature_dim),
+        # Learned position table indexed by the packer's WITHIN-SEGMENT
+        # positions: each packed document starts at position 0 (indexing by
+        # the raw slot index t would leak the packing layout into the model).
+        "pos": jax.random.normal(keys[5], (slot_len, d_model)) * 0.02,
         "wq": jax.random.normal(keys[1], (d_model, d_model)) * s(d_model),
         "wk": jax.random.normal(keys[2], (d_model, d_model)) * s(d_model),
         "wv": jax.random.normal(keys[3], (d_model, d_model)) * s(d_model),
         "out": jax.random.normal(keys[4], (d_model, feature_dim)) * s(d_model),
     }
 
-    def loss_fn(params, x, seg):
-        h = x @ params["emb"]
+    def loss_fn(params, x, seg, pos):
+        h = x @ params["emb"] + params["pos"][pos]
         b, t, _ = h.shape
         split = lambda w: (h @ w).reshape(b, t, heads, d_model // heads)  # noqa: E731
         q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
@@ -183,8 +188,8 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
         return (err * cont).sum() / jnp.maximum(cont.sum(), 1.0)
 
     @jax.jit
-    def step(params, x, seg):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, seg)
+    def step(params, x, seg, pos):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, seg, pos)
         return jax.tree_util.tree_map(
             lambda p, g: p - 0.05 * g, params, grads), loss
 
@@ -205,8 +210,9 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
         for packed in loader:
             seg_np = np.asarray(packed[PACK_SEGMENT_KEY])
             seg = jnp.asarray(seg_np)
+            pos = jnp.asarray(packed[PACK_POSITION_KEY])
             x = jnp.asarray(packed["seq"])
-            params, loss = step(params, x, seg)
+            params, loss = step(params, x, seg, pos)
             mask = packed_valid_mask(seg_np)
             valid_tokens += int(mask.sum())
             total_slots += mask.size

@@ -15,10 +15,12 @@ def main(argv=None):
         argv = sys.argv[1:]
     if argv and argv[0] == "scenario":
         return _scenario_main(argv[1:])
+    from petastorm_tpu.benchmark.scenarios import SCENARIOS
+
     parser = argparse.ArgumentParser(
         description="Measure Reader throughput (rows/sec) on a dataset; or "
                     "run a named workload: "
-                    "`scenario {tabular,ngram,image,weighted}`")
+                    f"`scenario {{{','.join(sorted(SCENARIOS))}}}`")
     parser.add_argument("dataset_url")
     parser.add_argument("--field-regex", nargs="*", default=None,
                         help="read only fields matching these regexes")
