@@ -80,6 +80,23 @@ def _barrier(name):
         multihost_utils.sync_global_devices(name)
 
 
+def _agree_version(next_num):
+    """Process 0's version number, broadcast to every host. Each host reads
+    CURRENT from the shared filesystem independently; with stale attribute
+    caching (NFS) they can disagree — and since the barrier names embed the
+    version string, a disagreement would make ``sync_global_devices`` hang
+    on mismatched barrier names instead of failing cleanly. Agreeing the
+    number via a device collective first makes the barrier names provably
+    identical on every host."""
+    if _process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        next_num = int(
+            multihost_utils.broadcast_one_to_all(np.int64(next_num)))
+    return next_num
+
+
 def _is_version_name(name):
     """Strictly ``v<int>`` — the only names this module creates; anything
     else in the directory belongs to the user and must never be pruned."""
@@ -128,7 +145,7 @@ def save_training_state(directory, arrays, loader=None, input_state=None,
         next_num = int(current[1:]) + 1 if current else 1
     except ValueError:  # pragma: no cover - hand-edited CURRENT
         next_num = 1
-    version = _VERSION_TMPL.format(next_num)
+    version = _VERSION_TMPL.format(_agree_version(next_num))
     vdir = os.path.join(directory, version)
     # Barrier: no host may clear/write the shared version dir while another
     # is still deciding the version (or finishing a previous save call).

@@ -31,8 +31,12 @@ training memory is O(block_q × block_k) + O(T·D) residuals — not O(T²).
 The pre-round-4 recompute-through-the-reference backward is kept as a
 correctness oracle behind ``bwd_impl="reference"``.
 
-Off-TPU (tests, CPU dev) the kernel runs in interpret mode, so numerics are
-validated everywhere while the Mosaic lowering is exercised on real TPU.
+Off-TPU (tests, CPU dev) the kernel runs in interpret mode; the Mosaic
+lowering is exercised on real TPU by the driver benchmark's flash legs
+(``bench.py`` ``flash_numerics``: forward + backward for causal /
+kv_lengths / segment_ids / with_lse vs a float64 dense oracle, and
+``flash_memsweep``: the O(block²)-vs-O(T²) training-memory claim as
+measured OOM ceilings — ``BENCH_r05.json`` ``flash_kernel``).
 """
 
 from __future__ import annotations
@@ -247,12 +251,22 @@ def _check_segment_ids(segment_ids, t_q, t_kv):
     positions), so it must raise instead."""
     if isinstance(segment_ids, (tuple, list)):
         q_ids, kv_ids = segment_ids
+        for name, ids in (("q_ids", q_ids), ("kv_ids", kv_ids)):
+            if len(jnp.shape(ids)) != 2:
+                raise ValueError(
+                    f"segment_ids {name} must be [B, T] (batch axis "
+                    f"included), got shape {jnp.shape(ids)}")
         if jnp.shape(q_ids)[1] != t_q or jnp.shape(kv_ids)[1] != t_kv:
             raise ValueError(
                 f"segment_ids pair shapes {jnp.shape(q_ids)} / "
                 f"{jnp.shape(kv_ids)} do not match T_q={t_q} / "
                 f"T_kv={t_kv} (is the (q_ids, kv_ids) order swapped?)")
     else:
+        if len(jnp.shape(segment_ids)) != 2:
+            raise ValueError(
+                f"segment_ids must be [B, T] (batch axis included — "
+                f"per-token ids alone are ambiguous across the batch), "
+                f"got shape {jnp.shape(segment_ids)}")
         if t_q != t_kv:
             raise ValueError(
                 f"a single segment_ids array requires T_q == T_kv "
