@@ -1,0 +1,60 @@
+"""The bench's f64 dense oracle (``bench._flash_oracle_f64``) anchors the
+round's on-chip flash numerics evidence — validate it against the
+production dense oracle (``models.sequence_model.attention_reference``)
+for every case configuration the bench compares, plus the lse output
+against an independently-computed dense log-sum-exp."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402
+from petastorm_tpu.models.sequence_model import attention_reference  # noqa: E402
+
+
+def _case_kwargs(case):
+    q, k, v, lengths, segs = bench._flash_case_inputs(case, t=64)
+    causal = case != "plain"
+    return q, k, v, causal, lengths, segs
+
+
+def test_f64_oracle_matches_production_oracle_every_case():
+    # enable_x64: test the TRUE f64 path the bench's oracle subprocess runs
+    # (without it, the f64 casts silently downcast to f32 under the test
+    # conftest and a f64-only defect would pass).
+    with jax.enable_x64(True):
+        for case in bench.FLASH_CASES:
+            q, k, v, causal, lengths, segs = _case_kwargs(case)
+            out64, _ = bench._flash_oracle_f64(
+                q, k, v, causal=causal,
+                lengths=None if lengths is None else jnp.asarray(lengths),
+                segment_ids=None if segs is None else jnp.asarray(segs))
+            assert np.asarray(out64).dtype == np.float64
+            want = attention_reference(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=causal,
+                lengths=None if lengths is None else jnp.asarray(lengths),
+                segment_ids=None if segs is None else jnp.asarray(segs))
+            np.testing.assert_allclose(np.asarray(out64, np.float32),
+                                       np.asarray(want), rtol=2e-5,
+                                       atol=2e-5, err_msg=case)
+
+
+def test_f64_oracle_lse_matches_dense_logsumexp():
+    with jax.enable_x64(True):
+        q, k, v, causal, _, _ = _case_kwargs("causal")
+        _, lse = bench._flash_oracle_f64(q, k, v, causal=True)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", jnp.asarray(q, jnp.float64),
+            jnp.asarray(k, jnp.float64)) / np.sqrt(q.shape[-1])
+        t = q.shape[1]
+        mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        want = jax.scipy.special.logsumexp(scores, axis=-1)  # [B, H, T]
+        np.testing.assert_allclose(np.asarray(lse),
+                                   np.asarray(want.transpose(0, 2, 1)),
+                                   rtol=1e-12, atol=1e-12)
