@@ -29,6 +29,7 @@ from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
 from petastorm_tpu.jax_utils.packing import (
     PACK_POSITION_KEY,
     PACK_SEGMENT_KEY,
+    count_packed_batches,
     iter_ragged_rows,
     make_packed_jax_dataloader,
     pack_ragged,
@@ -61,6 +62,7 @@ __all__ = [
     "restore_training_state",
     "pack_ragged",
     "packed_valid_mask",
+    "count_packed_batches",
     "make_packed_jax_dataloader",
     "iter_ragged_rows",
     "PACK_SEGMENT_KEY",

@@ -153,6 +153,39 @@ def iter_ragged_rows(reader, sequence_fields, length_field=None):
             yield {f: cols[f][:cut] for f in sequence_fields}
 
 
+def count_packed_batches(reader, slot_len, slots, sequence_fields,
+                         length_field=None):
+    """Count the batches :func:`pack_ragged` will emit for ``reader`` by
+    DRAINING it once — the observation half of
+    :func:`~petastorm_tpu.jax_utils.sharding.agree_max_batches` for the
+    PACKED delivery path (the packed analogue of
+    :func:`~petastorm_tpu.jax_utils.sharding.count_deliverable_batches`,
+    which counts ROW batches and therefore cannot predict packed emission).
+
+    Packed batch counts are doubly data-dependent — they depend on the
+    ragged LENGTH DISTRIBUTION through first-fit placement, not just on row
+    counts — so under a global sharding every host must observe its own
+    count on a separately-constructed counting reader (same arguments),
+    agree the minimum across hosts, and pass it as ``max_batches`` to
+    :func:`make_packed_jax_dataloader`. Drains :func:`pack_ragged` itself
+    rather than re-implementing first-fit arithmetic: the count is exactly
+    the emission count, including the final partial batch and zero-length
+    skips, by construction.
+    """
+    if getattr(reader, "num_epochs", 1) is None:
+        raise ValueError(
+            "count_packed_batches would never terminate on an infinite "
+            "reader (num_epochs=None): construct the counting reader with "
+            "num_epochs=1 and scale the agreed count by your epoch budget")
+    n = 0
+    with reader:
+        for _ in pack_ragged(
+                iter_ragged_rows(reader, sequence_fields, length_field),
+                slot_len=slot_len, slots=slots):
+            n += 1
+    return n
+
+
 def make_packed_jax_dataloader(reader, slot_len, slots, sequence_fields,
                                length_field=None, max_batches=None,
                                **loader_kwargs):

@@ -167,6 +167,97 @@ def test_predicate_ragged_pod_locksteps_via_agreement(ragged_pod_dataset):
     assert max(seen.values()) == 1
 
 
+@pytest.fixture(scope="module")
+def ragged_seq_pod_dataset(tmp_path_factory):
+    """Ragged-sequence corpus whose 2 shards carry SKEWED length
+    distributions: even row groups hold long docs, odd groups short ones,
+    so round-robin sharding gives host 0 mostly-long and host 1
+    mostly-short corpora — packed batch counts differ even where row
+    counts would not. Same corpus the real two-process pod dryrun uses
+    (one writer, no drift between the test and the dryrun)."""
+    import __graft_entry__
+
+    path = tmp_path_factory.mktemp("ragged_pod") / "ds"
+    url = f"file://{path}"
+    __graft_entry__._write_pod_ragged_dataset(url)
+    return url
+
+
+def test_packed_pod_locksteps_via_agreement(ragged_seq_pod_dataset):
+    """Packed equal-step counting (VERDICT r4 next #5): the packed path's
+    batch count is data-dependent through first-fit placement, so each
+    virtual host observes its own count via ``count_packed_batches``, the
+    pod agrees the min, and every host then iterates exactly that many
+    packed batches under a global sharding — no hand-derived constant."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import (PACK_SEGMENT_KEY,
+                                         agree_max_batches,
+                                         count_packed_batches,
+                                         make_packed_jax_dataloader)
+
+    url = ragged_seq_pod_dataset
+    slot_len, slots = 24, 4
+
+    def host_reader(host):
+        return make_columnar_reader(url, num_epochs=1,
+                                    shuffle_row_groups=False,
+                                    cur_shard=host, shard_count=HOSTS)
+
+    local_counts = [
+        count_packed_batches(host_reader(h), slot_len, slots,
+                             sequence_fields=["seq"],
+                             length_field="length")
+        for h in range(HOSTS)]
+    assert all(c > 0 for c in local_counts)
+    assert len(set(local_counts)) > 1, \
+        f"fixture must produce skewed packed counts, got {local_counts}"
+    agreed = min(agree_max_batches(c) for c in local_counts)
+    assert agreed == min(local_counts)
+
+    # The counting helper must agree EXACTLY with what the packed loader
+    # emits uncapped (same pack_ragged drain by construction).
+    for h in range(HOSTS):
+        loader = make_packed_jax_dataloader(
+            host_reader(h), slot_len, slots, sequence_fields=["seq"],
+            length_field="length", stage_to_device=False)
+        with loader:
+            full = sum(1 for _ in loader)
+        assert full == local_counts[h], (h, full, local_counts)
+
+    # Lockstep under a sharding: every host delivers exactly `agreed`
+    # packed batches as sharded jax.Arrays.
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    for h in range(HOSTS):
+        loader = make_packed_jax_dataloader(
+            host_reader(h), slot_len, slots, sequence_fields=["seq"],
+            length_field="length", sharding=sharding, max_batches=agreed)
+        steps = 0
+        with loader:
+            for batch in loader:
+                assert batch["seq"].shape == (slots, slot_len, 2)
+                assert PACK_SEGMENT_KEY in batch
+                steps += 1
+        assert steps == agreed, (h, steps, agreed)
+
+
+def test_count_packed_batches_rejects_infinite_reader(ragged_seq_pod_dataset):
+    from petastorm_tpu import make_columnar_reader
+    from petastorm_tpu.jax_utils import count_packed_batches
+
+    reader = make_columnar_reader(ragged_seq_pod_dataset, num_epochs=None)
+    try:
+        with pytest.raises(ValueError, match="num_epochs=None"):
+            count_packed_batches(reader, 24, 4, sequence_fields=["seq"],
+                                 length_field="length")
+    finally:
+        reader.stop()
+        reader.join()
+
+
 def test_agree_max_batches_multihost_semantics(monkeypatch):
     """min / host0 reduction over the (mocked) pod collective."""
     import types
