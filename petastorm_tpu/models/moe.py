@@ -12,12 +12,16 @@ The construction is the canonical TPU MoE (GShard/Switch recipe):
 - the E experts' FFN weights live STACKED ``[E, ...]`` and shard over the
   mesh's ``"ep"`` axis; tokens shard over the same axis (each device is both
   a data shard and an expert host, as in GShard);
-- routing is **top-1 with a fixed capacity** ``C`` per (expert, data shard):
-  static shapes throughout — tokens beyond capacity are *dropped* (their MoE
-  output is exactly zero, so the surrounding residual connection passes them
-  through unchanged). Dispatch/combine are one-hot einsum contractions, so
-  the scatter/gather the routing implies runs as batched matmuls on the MXU
-  instead of dynamic scatters XLA can't tile;
+- routing is **top-k with a fixed capacity** ``C = k·n·f/E`` per (expert,
+  data shard) — ``top_k=1`` is Switch (raw gate probability), ``top_k=2``
+  is GShard top-2 (chosen gates renormalized; first choices enqueue before
+  any second choice, and a full queue degrades gracefully: the surviving
+  choice still contributes). Static shapes throughout — assignments beyond
+  capacity are *dropped* (a token losing every assignment outputs exactly
+  zero, so the surrounding residual passes it through unchanged).
+  Dispatch/combine are one-hot einsum contractions, so the scatter/gather
+  the routing implies runs as batched matmuls on the MXU instead of
+  dynamic scatters XLA can't tile;
 - inside ``shard_map``, two ``lax.all_to_all`` collectives over ``"ep"``
   move ``[E, C, d]`` token slots to their expert owners and back — the ICI
   realization of the NCCL all-to-all GPU MoE stacks hand-write. Backward is
@@ -70,40 +74,63 @@ def moe_param_partition_specs():
             "head": P()}
 
 
-def _route_top1(gates, capacity):
-    """Top-1 routing with a fixed per-expert capacity.
+def _route_topk(gates, capacity, top_k=1):
+    """Top-k routing with a fixed per-expert capacity.
 
     ``gates``: ``[n, E]`` router softmax.  Returns ``(dispatch, combine,
     aux)`` where ``dispatch`` is the ``[n, E, C]`` one-hot token→slot
-    assignment, ``combine = dispatch * gate`` carries the router weight back
-    to the token, and ``aux`` is the Switch load-balance loss. Tokens whose
-    expert queue is already full get all-zero rows (dropped).
+    assignment (a token can hold up to ``top_k`` slots, in distinct
+    experts), ``combine`` carries the router weight back to the token, and
+    ``aux`` is the Switch load-balance loss. Tokens whose expert queue is
+    already full lose that assignment (top-1: dropped entirely; top-2: the
+    surviving choice still contributes — GShard's graceful degradation).
+
+    Choice priority follows GShard: ALL first choices enqueue before any
+    second choice (per-expert queue offsets accumulate across choice
+    rounds), so a token's 2nd pick cannot evict another token's 1st pick.
+    Gate weights: top-1 uses the raw chosen probability (Switch); top-k>1
+    renormalizes the chosen gates to sum to 1 (GShard).
     """
     n, num_experts = gates.shape
-    expert_idx = jnp.argmax(gates, axis=1)  # [n]
+    _, top_idx = jax.lax.top_k(gates, top_k)  # [n, k]
     # Routing bookkeeping stays int32/f32 regardless of the gate dtype: a
     # bf16 cumsum is exact only to 256, which would collide queue positions
     # (two tokens in one slot) once capacity grows past it.
-    onehot_i = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)
-    # Queue position of each token within its chosen expert (0-based):
-    # cumsum over the token axis counts earlier claims on the same expert.
-    pos = (jnp.cumsum(onehot_i, axis=0) - 1) * onehot_i  # [n, E]
-    keep = (pos < capacity) & (onehot_i > 0)  # [n, E] bool
-    slot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [n, E, C]
-    dispatch = slot * keep.astype(gates.dtype)[..., None]
-    onehot = onehot_i.astype(gates.dtype)
-    gate_val = (gates * onehot).sum(axis=1)  # [n] chosen gate prob
-    combine = dispatch * gate_val[:, None, None]
-    # Switch aux loss: E * Σ_e (fraction of tokens routed to e) * (mean gate
-    # prob of e). 1.0 at perfect balance; grows as routing collapses.
-    # Accumulated in f32 — a bf16 mean over many tokens loses the signal.
-    fraction = onehot_i.astype(jnp.float32).mean(axis=0)
+    onehots = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.int32)  # [n,k,E]
+    gate_chosen = jnp.take_along_axis(gates, top_idx, axis=1)  # [n, k]
+    if top_k > 1:
+        gate_weight = gate_chosen / jnp.maximum(
+            gate_chosen.sum(axis=1, keepdims=True), 1e-9)
+    else:
+        gate_weight = gate_chosen  # Switch: raw probability
+    dispatch = jnp.zeros((n, num_experts, capacity), gates.dtype)
+    combine = jnp.zeros_like(dispatch)
+    counts = jnp.zeros((num_experts,), jnp.int32)  # earlier-choice claims
+    for j in range(top_k):
+        oh = onehots[:, j]  # [n, E] int
+        # Queue position of each token within its chosen expert (0-based):
+        # cumsum over the token axis counts earlier claims on the same
+        # expert within this choice round, offset by all prior rounds'.
+        pos = (jnp.cumsum(oh, axis=0) - 1) * oh + counts[None, :] * oh
+        keep = (pos < capacity) & (oh > 0)  # [n, E] bool
+        slot = jax.nn.one_hot(jnp.minimum(pos, capacity - 1), capacity,
+                              dtype=gates.dtype)  # [n, E, C]
+        d_j = slot * keep.astype(gates.dtype)[..., None]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate_weight[:, j][:, None, None]
+        counts = counts + oh.sum(axis=0)
+    # Switch aux loss over FIRST choices: E * Σ_e (fraction of tokens whose
+    # top choice is e) * (mean gate prob of e). 1.0 at perfect balance;
+    # grows as routing collapses. Accumulated in f32 — a bf16 mean over
+    # many tokens loses the signal.
+    fraction = onehots[:, 0].astype(jnp.float32).mean(axis=0)
     importance = gates.astype(jnp.float32).mean(axis=0)
     aux = num_experts * jnp.sum(fraction * importance)
     return dispatch, combine, aux
 
 
-def _moe_body(w1, w2, router, x, axis_name, capacity, batch_axis=None):
+def _moe_body(w1, w2, router, x, axis_name, capacity, batch_axis=None,
+              top_k=1):
     """Per-device MoE layer (runs inside shard_map over ``"ep"``).
 
     ``w1``/``w2``: this device's expert slice, ``[E_local, d, h]`` /
@@ -111,7 +138,7 @@ def _moe_body(w1, w2, router, x, axis_name, capacity, batch_axis=None):
     local tokens' MoE output (zero rows for dropped tokens) + aux loss.
     """
     gates = jax.nn.softmax(x @ router)  # [n_local, E]
-    dispatch, combine, aux = _route_top1(gates, capacity)
+    dispatch, combine, aux = _route_topk(gates, capacity, top_k=top_k)
     # Local contribution to every expert's queue, then all_to_all so each
     # device receives its experts' slots from all data shards: [E, C, d] →
     # [E_local, ep*C, d]. The transpose (backward) is the reverse exchange.
@@ -129,18 +156,21 @@ def _moe_body(w1, w2, router, x, axis_name, capacity, batch_axis=None):
     return y, aux
 
 
-def _capacity(tokens_per_shard, num_experts, capacity_factor):
-    """Static per-(expert, data-shard) queue length."""
-    return max(1, int(tokens_per_shard * capacity_factor / num_experts))
+def _capacity(tokens_per_shard, num_experts, capacity_factor, top_k=1):
+    """Static per-(expert, data-shard) queue length (scales with ``top_k``:
+    k assignments per token compete for slots — GShard's C = k·n·f/E)."""
+    return max(1, int(tokens_per_shard * top_k * capacity_factor
+                      / num_experts))
 
 
 def moe_ffn(params, x, mesh, axis_name="ep", capacity_factor=2.0,
-            batch_axis=None):
+            batch_axis=None, top_k=1):
     """Routed expert FFN over tokens ``x`` ``[N, d_model]`` → ``(y, aux)``.
 
     ``N`` must divide by the mesh's token-sharding extent (ep × optional
     ``batch_axis`` for dp × ep — routing and the capacity budget are then
     per (dp, ep) shard, with expert weights replicated over dp).
+    ``top_k``: experts per token (1 = Switch, 2 = GShard top-2).
     """
     from jax import shard_map
 
@@ -157,9 +187,10 @@ def moe_ffn(params, x, mesh, axis_name="ep", capacity_factor=2.0,
         raise ValueError(f"{x.shape[0]} tokens do not shard over {shards} "
                          f"devices ({token_axes})")
     capacity = _capacity(x.shape[0] // shards, params["w1"].shape[0],
-                         capacity_factor)
+                         capacity_factor, top_k=top_k)
     body = functools.partial(_moe_body, axis_name=axis_name,
-                             capacity=capacity, batch_axis=batch_axis)
+                             capacity=capacity, batch_axis=batch_axis,
+                             top_k=top_k)
     x_spec = P(token_axes)
     return shard_map(
         body, mesh=mesh,
@@ -170,30 +201,32 @@ def moe_ffn(params, x, mesh, axis_name="ep", capacity_factor=2.0,
 
 
 def apply_moe_model(params, features, mesh, axis_name="ep",
-                    capacity_factor=2.0, batch_axis=None):
+                    capacity_factor=2.0, batch_axis=None, top_k=1):
     """``features`` ``[B, F]`` → ``(logits [B, C] f32, aux)`` through
     embed → residual MoE FFN → head."""
     x = features @ params["embed"]
     y, aux = moe_ffn(params, x, mesh, axis_name=axis_name,
-                     capacity_factor=capacity_factor, batch_axis=batch_axis)
+                     capacity_factor=capacity_factor, batch_axis=batch_axis,
+                     top_k=top_k)
     x = x + y  # dropped tokens pass through the residual unchanged
     return (x @ params["head"]).astype(jnp.float32), aux
 
 
-def reference_forward(params, features, num_shards=1, capacity_factor=2.0):
+def reference_forward(params, features, num_shards=1, capacity_factor=2.0,
+                      top_k=1):
     """Dense single-device oracle running the IDENTICAL routing math —
     including per-shard capacity drops when ``num_shards`` matches the
     sharded run's token-shard count — that the ep-sharded path must match."""
     x = features @ params["embed"]
     n, d = x.shape
     capacity = _capacity(n // num_shards, params["w1"].shape[0],
-                         capacity_factor)
+                         capacity_factor, top_k=top_k)
     outs = []
     auxes = []
     for shard in range(num_shards):
         xs = x[shard * (n // num_shards):(shard + 1) * (n // num_shards)]
         gates = jax.nn.softmax(xs @ params["router"])
-        dispatch, combine, aux = _route_top1(gates, capacity)
+        dispatch, combine, aux = _route_topk(gates, capacity, top_k=top_k)
         expert_in = jnp.einsum("nec,nd->ecd", dispatch, xs)
         h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
         out = jnp.einsum("ech,ehd->ecd", h, params["w2"])
@@ -206,7 +239,7 @@ def reference_forward(params, features, num_shards=1, capacity_factor=2.0):
 
 def make_moe_train_step(learning_rate=0.05, aux_weight=0.01, mesh=None,
                         axis_name="ep", capacity_factor=2.0,
-                        batch_axis=None):
+                        batch_axis=None, top_k=1):
     """``step(params, features, labels, mask) -> (params, loss)`` — masked
     cross-entropy + Switch aux loss, SGD through both all_to_alls."""
 
@@ -214,7 +247,7 @@ def make_moe_train_step(learning_rate=0.05, aux_weight=0.01, mesh=None,
         logits, aux = apply_moe_model(params, features, mesh,
                                       axis_name=axis_name,
                                       capacity_factor=capacity_factor,
-                                      batch_axis=batch_axis)
+                                      batch_axis=batch_axis, top_k=top_k)
         logp = jax.nn.log_softmax(logits)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         nll = jnp.where(mask, nll, 0.0)
