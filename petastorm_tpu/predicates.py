@@ -1,8 +1,8 @@
 """Row-level predicates, evaluated worker-side before full decode.
 
 Reference parity: ``petastorm/predicates.py`` (``PredicateBase``, ``in_set``,
-``in_lambda``, ``in_negate``, ``in_reduce``, ``in_pseudorandom_split``) —
-SURVEY.md §2.1. Predicates declare the minimal column subset they need
+``in_intersection``, ``in_lambda``, ``in_negate``, ``in_reduce``,
+``in_pseudorandom_split``) — SURVEY.md §2.1. Predicates declare the minimal column subset they need
 (:meth:`PredicateBase.get_fields`); the reader worker does a two-phase read
 (predicate columns → boolean mask → remaining columns for surviving rows), so
 a selective predicate skips most of the expensive decode work.
@@ -178,6 +178,36 @@ class in_set(PredicateBase):
 
     def __repr__(self):
         return (f"in_set({sorted(map(repr, self._inclusion_values))}, "
+                f"{self._predicate_field!r})")
+
+
+class in_intersection(PredicateBase):
+    """Keep rows whose ITERABLE ``predicate_field`` value shares at least
+    one element with ``inclusion_values`` — the collection-valued
+    counterpart of :class:`in_set` (a tag/category array column: keep the
+    row if ANY tag is in the inclusion set). Upstream
+    ``petastorm/predicates.py`` lists an ``in_intersection`` combinator;
+    SURVEY.md §2.1 marks its exact semantics uncertain, so this implements
+    the natural reading: non-empty set intersection. A scalar field value
+    degrades to :class:`in_set` membership."""
+
+    def __init__(self, inclusion_values, predicate_field):
+        self._inclusion_values = set(inclusion_values)
+        self._predicate_field = predicate_field
+
+    def get_fields(self):
+        return {self._predicate_field}
+
+    def do_include(self, values):
+        import numpy as np
+
+        value = values[self._predicate_field]
+        items = np.asarray(value).ravel().tolist()
+        return not self._inclusion_values.isdisjoint(items)
+
+    def __repr__(self):
+        return (f"in_intersection("
+                f"{sorted(map(repr, self._inclusion_values))}, "
                 f"{self._predicate_field!r})")
 
 

@@ -12,6 +12,7 @@ from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index, get_row_gr
 from petastorm_tpu.local_disk_arrow_table_cache import LocalDiskArrowTableCache
 from petastorm_tpu.local_disk_cache import LocalDiskCache
 from petastorm_tpu.predicates import (
+    in_intersection,
     in_lambda,
     in_negate,
     in_pseudorandom_split,
@@ -48,6 +49,21 @@ def test_predicate_combinators():
     assert either.do_include({"x": 12}) and either.do_include({"x": 9})
     assert not either.do_include({"x": 11})
     assert neg.do_include({"x": 3}) and not neg.do_include({"x": 4})
+
+
+def test_in_intersection_collection_valued_field():
+    pred = in_intersection({"cat", "dog"}, "tags")
+    assert pred.get_fields() == {"tags"}
+    # list-valued, ndarray-valued, scalar, and disjoint cases
+    assert pred.do_include({"tags": ["bird", "dog"]})
+    assert pred.do_include({"tags": np.asarray(["cat"])})
+    assert pred.do_include({"tags": "dog"})  # scalar degrades to in_set
+    assert not pred.do_include({"tags": ["bird", "fish"]})
+    assert not pred.do_include({"tags": []})
+    # deterministic repr (part of the disk-cache key)
+    assert repr(pred) == repr(in_intersection({"dog", "cat"}, "tags"))
+    # composes with the other combinators
+    assert in_negate(pred).do_include({"tags": ["fish"]})
 
 
 def test_vectorized_predicate_masks_match_row_path():
