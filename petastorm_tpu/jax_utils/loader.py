@@ -498,6 +498,11 @@ class JaxDataLoader:
     # -- lifecycle --------------------------------------------------------
 
     def stop(self):
+        """Teardown-only: signals the threads and DISCARDS one queued batch
+        per queue to unblock a producer/stager waiting on a full queue.
+        Never call it to pause a stream you intend to keep consuming — the
+        discarded batches are gone (resume accounting stays correct: the
+        at-least-once contract re-reads buffered-but-unyielded rows)."""
         self._stop.set()
         for q in (self._queue, self._host_queue):
             if q is not None:

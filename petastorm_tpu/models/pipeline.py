@@ -304,10 +304,12 @@ def _1f1b_body(w1, w2, head, x_mb, labels_mb, mask_mb, *, axis_name,
     carry, _ = jax.lax.scan(
         tick, init, jnp.arange(m_count + 2 * s_count - 1))
     (_, _, _, _, dx, dw1, dw2, dhead, lsum, cnt) = carry
-    # dhead/dx/loss/count live on one stage only — psum replicates them
-    # across the pipeline axis (zeros elsewhere).
+    # dhead/loss/count live on one stage only — psum replicates the small
+    # ones across the pipeline axis (zeros elsewhere). dx is [M, mb, d]
+    # (only stage 0's copy is nonzero): return it STACKED over the pp axis
+    # and let the wrapper select stage 0's slice — an allreduce of the
+    # full-batch cotangent would move S copies of it to propagate one.
     dhead = jax.lax.psum(dhead, axis_name)
-    dx = jax.lax.psum(dx, axis_name)
     lsum = jax.lax.psum(lsum, axis_name)
     cnt = jax.lax.psum(cnt, axis_name)
     if batch_axis:
@@ -316,7 +318,7 @@ def _1f1b_body(w1, w2, head, x_mb, labels_mb, mask_mb, *, axis_name,
         dhead = jax.lax.psum(dhead, batch_axis)
         lsum = jax.lax.psum(lsum, batch_axis)
         cnt = jax.lax.psum(cnt, batch_axis)
-    return dw1[None], dw2[None], dhead, dx, lsum, cnt
+    return dw1[None], dw2[None], dhead, dx[None], lsum, cnt
 
 
 def pipeline_1f1b_loss_and_grads(params, features, labels, mask, mesh,
@@ -358,13 +360,15 @@ def pipeline_1f1b_loss_and_grads(params, features, labels, mask, mesh,
         num_classes=params["head"].shape[-1], batch_axis=batch_axis)
     x_spec = P(None, batch_axis, None)
     row_spec = P(None, batch_axis)
-    dw1, dw2, dhead, dx, lsum, cnt = shard_map(
+    dw1, dw2, dhead, dx_stacked, lsum, cnt = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(), x_spec, row_spec,
                   row_spec),
-        out_specs=(P(axis_name), P(axis_name), P(), x_spec, P(), P()))(
+        out_specs=(P(axis_name), P(axis_name), P(),
+                   P(axis_name, None, batch_axis, None), P(), P()))(
         params["w1"], params["w2"], params["head"], x_mb, labels_mb,
         mask_mb)
+    dx = dx_stacked[0]  # stage 0's copy holds the input cotangents
     denom = jnp.maximum(cnt, 1.0)
     loss = lsum / denom
     dx_flat = dx.reshape(b, d_model) / denom
