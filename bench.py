@@ -627,6 +627,15 @@ def _flash_case_inputs(case, t=None):
     # and the oracle + kernel subprocesses must regenerate IDENTICAL inputs.
     rng = np.random.RandomState(zlib.crc32(case.encode()) % (2**31))
     q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+    if case.endswith("_bf16"):
+        # Production dtype: round the inputs THROUGH bf16 in both
+        # subprocesses, so the f64 oracle sees exactly the values the
+        # kernel receives (the comparison then measures only the kernel's
+        # bf16 compute error, not input quantization).
+        import ml_dtypes
+
+        q, k, v = (x.astype(ml_dtypes.bfloat16).astype(np.float32)
+                   for x in (q, k, v))
     lengths = segs = None
     if case == "kv_lengths":
         lengths = np.asarray([t - t // 3, t], np.int32)
@@ -637,7 +646,14 @@ def _flash_case_inputs(case, t=None):
     return q, k, v, lengths, segs
 
 
-FLASH_CASES = ("plain", "causal", "kv_lengths", "segment_ids", "with_lse")
+FLASH_CASES = ("plain", "causal", "kv_lengths", "segment_ids", "with_lse",
+               "causal_bf16")
+# Per-case (fwd abs, grad/lse rel) tolerances: f32 inputs ride the MXU at
+# HIGHEST precision (~1e-6 observed); the bf16 case measures the
+# production-dtype path (single-pass bf16 MXU + f32 online softmax —
+# ~bf16-epsilon-level error is the CORRECT result there, not a defect).
+_FLASH_TOLS = {"causal_bf16": (5e-2, 5e-2)}
+_FLASH_DEFAULT_TOLS = (1e-4, 1e-3)
 
 
 def _flash_oracle_f64(q, k, v, causal=False, lengths=None, segment_ids=None):
@@ -748,13 +764,16 @@ def leg_flash_numerics(_url):
     finally:
         shutil.rmtree(npz_dir, ignore_errors=True)
 
-    fwd_tol, grad_rel_tol = 1e-4, 1e-3
     cases = {}
     all_pass = True
     for case in FLASH_CASES:
         q, k, v, lengths, segs = _flash_case_inputs(case)
         causal = case != "plain"
-        qj, kj, vj = map(jnp.asarray, (q, k, v))
+        fwd_tol, grad_rel_tol = _FLASH_TOLS.get(case, _FLASH_DEFAULT_TOLS)
+        if case.endswith("_bf16"):
+            qj, kj, vj = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+        else:
+            qj, kj, vj = map(jnp.asarray, (q, k, v))
         kw = {}
         if lengths is not None:
             kw["kv_lengths"] = jnp.asarray(lengths)
@@ -799,6 +818,8 @@ def leg_flash_numerics(_url):
             err = float(np.abs(np.asarray(g, np.float64) - ref).max())
             entry[f"{name}_max_rel_err"] = err / scale
             worst_rel = max(worst_rel, err / scale)
+        entry["fwd_abs_tol"] = fwd_tol
+        entry["grad_rel_tol"] = grad_rel_tol
         entry["pass"] = (entry["fwd_max_abs_err"] <= fwd_tol
                          and entry.get("lse_max_rel_err", 0.0)
                          <= grad_rel_tol
@@ -808,8 +829,8 @@ def leg_flash_numerics(_url):
                        for k2, v2 in entry.items()}
     return {"images_per_sec": 0.0, "t": FLASH_T,
             "lowering": "mosaic (interpret=False)",
-            "oracle": "dense f64 (CPU x64 subprocess), autodiff grads",
-            "fwd_abs_tol": fwd_tol, "grad_rel_tol": grad_rel_tol,
+            "oracle": "dense f64 (CPU x64 subprocess), autodiff grads; "
+                      "bf16 case inputs rounded through bf16 on both sides",
             "cases": cases, "all_pass": all_pass}
 
 

@@ -209,9 +209,11 @@ def _1f1b_body(w1, w2, head, x_mb, labels_mb, mask_mb, *, axis_name,
     stash: the live span at stage ``s`` is ``m1 - m2 = 2(S - s) - 1 ≤
     2S - 1 < 2S`` slots, so first-writer-wins never collides.
 
-    Returns per-device ``(dw1[1], dw2[1], dhead, dx, loss_sum, count)``
-    with dhead/dx/loss/count psum-replicated over the pipeline axis (and
-    weight grads psum-reduced over ``batch_axis`` when given).
+    Returns per-device ``(dw1[1], dw2[1], dhead, dx[1], loss_sum, count)``
+    with dhead/loss/count psum-replicated over the pipeline axis and
+    ``dx`` returned UN-reduced (stacked by the wrapper's out_specs; only
+    stage 0's slice is nonzero — select it, do not psum), plus weight
+    grads psum-reduced over ``batch_axis`` when given.
     """
     stage = jax.lax.axis_index(axis_name)
     s_count, m_count = num_stages, num_microbatches
