@@ -613,7 +613,7 @@ def leg_realstep(url):
 
 FLASH_T = int(os.environ.get("BENCH_FLASH_T", "1024"))
 FLASH_MEM_START_T = int(os.environ.get("BENCH_FLASH_MEM_START_T", "4096"))
-FLASH_MEM_CAP_T = int(os.environ.get("BENCH_FLASH_MEM_CAP_T", "131072"))
+FLASH_MEM_CAP_T = int(os.environ.get("BENCH_FLASH_MEM_CAP_T", "262144"))
 
 
 def _flash_case_inputs(case, t=None):
