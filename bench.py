@@ -626,7 +626,9 @@ def _flash_case_inputs(case, t=None):
     # crc32, NOT hash(): str hash is salted per process (PYTHONHASHSEED),
     # and the oracle + kernel subprocesses must regenerate IDENTICAL inputs.
     rng = np.random.RandomState(zlib.crc32(case.encode()) % (2**31))
-    q, k, v = (rng.randn(b, t, h, d).astype(np.float32) for _ in range(3))
+    q = rng.randn(b, t, h, d).astype(np.float32)
+    h_kv = 2 if case.endswith("_gqa") else h  # grouped-query K/V heads
+    k, v = (rng.randn(b, t, h_kv, d).astype(np.float32) for _ in range(2))
     if case.endswith("_bf16"):
         # Production dtype: round the inputs THROUGH bf16 in both
         # subprocesses, so the f64 oracle sees exactly the values the
@@ -647,7 +649,7 @@ def _flash_case_inputs(case, t=None):
 
 
 FLASH_CASES = ("plain", "causal", "kv_lengths", "segment_ids", "with_lse",
-               "causal_bf16")
+               "causal_bf16", "causal_gqa")
 # Per-case (fwd abs, grad/lse rel) tolerances: f32 inputs ride the MXU at
 # HIGHEST precision (~1e-6 observed); the bf16 case measures the
 # production-dtype path (single-pass bf16 MXU + f32 online softmax —
@@ -699,6 +701,18 @@ def _flash_case_loss(case, out, lse=None):
     return loss
 
 
+def _oracle_repeat_kv(case, q, k, v):
+    """GQA's defining equivalence for the oracle: repeat the K/V heads to
+    the query head count (autodiff through the repeat then yields the
+    group-summed dK/dV the kernel must match)."""
+    if case.endswith("_gqa"):
+        import jax.numpy as jnp
+
+        g = q.shape[2] // k.shape[2]
+        return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+    return k, v
+
+
 def leg_flash_oracle(_url):
     """CPU x64 subprocess: write oracle outputs + grads per case to the npz
     at $BENCH_FLASH_NPZ."""
@@ -716,17 +730,18 @@ def leg_flash_oracle(_url):
         q, k, v, lengths, segs = _flash_case_inputs(case)
         causal = case != "plain"
 
-        def loss_fn(q, k, v):
-            out, lse = _flash_oracle_f64(
-                q, k, v, causal=causal,
+        def oracle(q, k, v):
+            kr, vr = _oracle_repeat_kv(case, q, k, v)
+            return _flash_oracle_f64(
+                q, kr, vr, causal=causal,
                 lengths=None if lengths is None else jnp.asarray(lengths),
                 segment_ids=None if segs is None else jnp.asarray(segs))
+
+        def loss_fn(q, k, v):
+            out, lse = oracle(q, k, v)
             return _flash_case_loss(case, out, lse)
 
-        out, lse = _flash_oracle_f64(
-            q, k, v, causal=causal,
-            lengths=None if lengths is None else jnp.asarray(lengths),
-            segment_ids=None if segs is None else jnp.asarray(segs))
+        out, lse = oracle(q, k, v)
         dq, dk, dv = jax.grad(loss_fn, (0, 1, 2))(
             jnp.asarray(q, jnp.float64), jnp.asarray(k, jnp.float64),
             jnp.asarray(v, jnp.float64))

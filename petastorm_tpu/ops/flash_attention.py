@@ -313,6 +313,55 @@ def _kv_seg_spec(pl, pltpu, h, block_k, kv_block_of):
         memory_space=pltpu.VMEM)
 
 
+def _kv_bh_map(h, h_kv):
+    """Grid-coordinate map for grouped-query attention: the grid iterates
+    q-heads (``bh = b·h + hq``), and each group of ``h // h_kv`` q-heads
+    reads the SAME K/V head — the map lands their fetches on its flattened
+    coordinate ``b·h_kv + hq // group``. Identity when ``h == h_kv``
+    (the arithmetic reduces to ``bh``), so one code path serves both."""
+    group = h // h_kv
+
+    def kv_bh(bh):
+        return (bh // h) * h_kv + (bh % h) // group
+
+    return kv_bh
+
+
+def _check_gqa_heads(q, k, v, bwd_impl=None):
+    """Validate the grouped-query head contract: K and V share a head
+    count that divides Q's. Returns ``(h, h_kv)``."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if v.shape[2] != h_kv:
+        raise ValueError(
+            f"k has {h_kv} heads but v has {v.shape[2]}; K and V must "
+            "share their (possibly grouped) head count")
+    if h % h_kv:
+        raise ValueError(
+            f"{h} query heads do not group over {h_kv} K/V heads "
+            "(grouped-query attention requires h % h_kv == 0)")
+    if bwd_impl == "reference" and h_kv != h:
+        raise NotImplementedError(
+            "bwd_impl='reference' does not support grouped-query K/V "
+            "(the dense oracle is single-ratio); repeat K/V to the query "
+            "head count for the oracle, or use bwd_impl='flash'")
+    return h, h_kv
+
+
+def _group_sum_kv_grad(grad_bh, b, h, h_kv, t_kv):
+    """Per-q-head dK/dV partials ``[B·H, Tk_pad, D]`` → ``[B, Tk, h_kv,
+    D]``: each K/V head's gradient is the sum over its q-head group
+    (f32 accumulation — a bf16 group-sum would round between partials).
+    The ungrouped path short-circuits to the plain reshape/transpose so
+    standard MHA backward keeps its exact pre-GQA form (no f32 transient
+    at the memory-sweep ceiling)."""
+    if h == h_kv:
+        return _from_bh(grad_bh[:, :t_kv], b, h)
+    d = grad_bh.shape[-1]
+    g = grad_bh[:, :t_kv].reshape(b, h_kv, h // h_kv, t_kv, d)
+    g = g.astype(jnp.float32).sum(axis=2)
+    return g.transpose(0, 2, 1, 3).astype(grad_bh.dtype)
+
+
 def _check_seg_blocks(block_k):
     if block_k > _LANES and block_k % _LANES:
         raise ValueError(
@@ -329,6 +378,8 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
     orig_dtype = q.dtype
     b, t_q, h, d = q.shape
     t_kv = k.shape[1]
+    h_kv = k.shape[2]
+    kv_bh = _kv_bh_map(h, h_kv)
 
     qf = _pad_t(_to_bh(q), block_q)
     kf = _pad_t(_to_bh(k), block_k)
@@ -365,7 +416,7 @@ def _flash_forward(q, k, v, block_q, block_k, interpret, causal=False,
             last = (i * block_q + causal_offset + block_q - 1) // block_k
             return jnp.minimum(j, jnp.maximum(last, 0))
 
-    kv_index = lambda bh, i, j: (bh, kv_block(i, j), 0)  # noqa: E731
+    kv_index = lambda bh, i, j: (kv_bh(bh), kv_block(i, j), 0)  # noqa: E731
     q_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
     out_shape = jax.ShapeDtypeStruct((b * h, tq_p, d), orig_dtype)
     out_specs = pl.BlockSpec((1, block_q, d), q_index,
@@ -623,6 +674,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
 
     b, t_q, h, d = q.shape
     t_kv = k.shape[1]
+    h_kv = k.shape[2]
+    kv_bh = _kv_bh_map(h, h_kv)
 
     qf = _pad_t(_to_bh(q), block_q)
     kf = _pad_t(_to_bh(k), block_k)
@@ -677,7 +730,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
             last = (i * block_q + causal_offset + block_q - 1) // block_k
             return jnp.minimum(j, jnp.maximum(last, 0))
 
-    dq_kv_index = lambda bh, i, j: (bh, dq_kv_block(i, j), 0)  # noqa: E731
+    dq_kv_index = \
+        lambda bh, i, j: (kv_bh(bh), dq_kv_block(i, j), 0)  # noqa: E731
     dq_stats_spec = pl.BlockSpec((1, block_q, _LANES), dq_q_index,
                                  memory_space=pltpu.VMEM)
     dq_seg_specs = []
@@ -707,6 +761,12 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
       *dlse_inputs)
 
     # --- dK/dV sweep: (bh, kb, qb), Q innermost -----------------------------
+    # The grid stays per Q-HEAD: each grid row reads its group's shared K/V
+    # block (kv_bh-mapped INPUT fetch) but writes its OWN per-q-head dk/dv
+    # partial (un-mapped OUTPUT index) — grouped heads writing one output
+    # block from different grid rows would race; the wrapper group-sums the
+    # partials instead.
+    dkv_kv_in_index = lambda bh, i, j: (kv_bh(bh), i, 0)  # noqa: E731
     dkv_kv_index = lambda bh, i, j: (bh, i, 0)  # noqa: E731
     if causal_offset is None:
         dkv_q_block = lambda i, j: j  # noqa: E731
@@ -732,8 +792,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
         grid=(b * h, n_kb, n_qb),
         in_specs=[
             q_spec(dkv_q_index),
-            kv_spec(dkv_kv_index),
-            kv_spec(dkv_kv_index),
+            kv_spec(dkv_kv_in_index),
+            kv_spec(dkv_kv_in_index),
             q_spec(dkv_q_index),                     # do
             q_spec(dkv_q_index),                     # o
             dkv_stats_spec,                          # lse
@@ -750,8 +810,8 @@ def _flash_backward(q, k, v, o_padded, lse, g, block_q, block_k, interpret,
       *dlse_inputs)
 
     dq = _from_bh(dq[:, :t_q], b, h)
-    dk = _from_bh(dk[:, :t_kv], b, h)
-    dv = _from_bh(dv[:, :t_kv], b, h)
+    dk = _group_sum_kv_grad(dk, b, h, h_kv, t_kv)
+    dv = _group_sum_kv_grad(dv, b, h, h_kv, t_kv)
     return dq, dk, dv
 
 
@@ -788,8 +848,18 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
         (cross-length, e.g. the flash ring's per-block ids). Mutually
         exclusive with ``kv_lengths`` (give padded slots a unique id
         instead). Composes with ``causal``.
+
+    Grouped-query attention (GQA/MQA): ``k``/``v`` may carry FEWER heads
+    than ``q`` (``h % h_kv == 0``; ``h_kv == 1`` is multi-query) — each
+    group of ``h // h_kv`` query heads attends to one shared K/V head,
+    equivalent to repeating K/V heads but without materializing the
+    repeat: the kernels' K/V fetches are group-mapped in the BlockSpec
+    index maps, so HBM traffic and residual memory scale with ``h_kv``,
+    and dK/dV come back group-summed at the K/V head count (f32
+    accumulation). Not supported with ``bwd_impl="reference"``.
     """
     _check_bwd_impl(bwd_impl)
+    _check_gqa_heads(q, k, v, bwd_impl)
     if segment_ids is not None:
         if kv_lengths is not None:
             raise ValueError(
@@ -923,6 +993,7 @@ def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
     batch) or a ``(q_ids, kv_ids)`` pair (the ring: the resident K/V block
     carries its own ids); mutually exclusive with ``kv_lengths``.
     """
+    _check_gqa_heads(q, k, v)
     if segment_ids is not None:
         if kv_lengths is not None:
             raise ValueError(

@@ -29,13 +29,18 @@ def test_f64_oracle_matches_production_oracle_every_case():
     with jax.enable_x64(True):
         for case in bench.FLASH_CASES:
             q, k, v, causal, lengths, segs = _case_kwargs(case)
+            # The GQA case's oracle sees the K/V heads repeated to the
+            # query head count — same transform the bench oracle applies.
+            kr, vr = bench._oracle_repeat_kv(case, jnp.asarray(q),
+                                             jnp.asarray(k),
+                                             jnp.asarray(v))
             out64, _ = bench._flash_oracle_f64(
-                q, k, v, causal=causal,
+                q, kr, vr, causal=causal,
                 lengths=None if lengths is None else jnp.asarray(lengths),
                 segment_ids=None if segs is None else jnp.asarray(segs))
             assert np.asarray(out64).dtype == np.float64
             want = attention_reference(
-                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(q), kr, vr,
                 causal=causal,
                 lengths=None if lengths is None else jnp.asarray(lengths),
                 segment_ids=None if segs is None else jnp.asarray(segs))

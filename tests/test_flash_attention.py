@@ -414,3 +414,109 @@ def test_single_segment_ids_length_mismatch_raises():
     bad_ids = jnp.zeros((1, 24), jnp.int32)
     with pytest.raises(ValueError, match="does not match the sequence"):
         flash_attention(q, q, q, segment_ids=bad_ids)
+
+
+# ---------------------------------------------------------------------------
+# Grouped-query attention (GQA / MQA): k/v carry fewer heads than q
+# ---------------------------------------------------------------------------
+
+def _gqa_qkv(h=4, h_kv=2, b=2, t=48, d=16, seed=9):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    return q, k, v
+
+
+def _repeat_kv_oracle(h, h_kv, **okw):
+    """GQA's defining equivalence: attention with the K/V heads repeated
+    to the query head count."""
+    g = h // h_kv
+
+    def fn(q, k, v):
+        return attention_reference(q, jnp.repeat(k, g, axis=2),
+                                   jnp.repeat(v, g, axis=2), **okw)
+
+    return fn
+
+
+@pytest.mark.parametrize("h_kv", [2, 1])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_matches_repeated_kv_reference(h_kv, causal):
+    q, k, v = _gqa_qkv(h_kv=h_kv)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, causal=causal)
+    want = _repeat_kv_oracle(4, h_kv, causal=causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_gradients_group_sum_matches_repeated_kv_autodiff():
+    """dK/dV must come back at the K/V head count as the SUM over each
+    group's q-heads — exactly what autodiff through the repeated-KV
+    oracle produces for the un-repeated tensors."""
+    q, k, v = _gqa_qkv(h_kv=2, seed=10)
+    oracle = _repeat_kv_oracle(4, 2, causal=True)
+
+    def loss_fl(q, k, v):
+        return (flash_attention(q, k, v, block_q=16, block_k=16,
+                                causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (oracle(q, k, v) ** 2).sum()
+
+    got = jax.grad(loss_fl, (0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), got, want):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
+
+
+def test_gqa_composes_with_lengths_and_segments():
+    q, k, v = _gqa_qkv(h_kv=2, seed=11)
+    t = q.shape[1]
+    lens = jnp.asarray([t - 10, t], jnp.int32)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, causal=True,
+                          kv_lengths=lens)
+    want = _repeat_kv_oracle(4, 2, causal=True, lengths=lens)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    segs = jnp.asarray(np.repeat(np.arange(4), t // 4)[None]
+                       .repeat(2, 0), jnp.int32)
+    out = flash_attention(q, k, v, block_q=16, block_k=16, causal=True,
+                          segment_ids=segs)
+    want = _repeat_kv_oracle(4, 2, causal=True, segment_ids=segs)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_with_lse_and_cotangent():
+    from petastorm_tpu.ops.flash_attention import flash_attention_with_lse
+
+    q, k, v = _gqa_qkv(h_kv=2, seed=12)
+    out, lse = flash_attention_with_lse(q, k, v, block_q=16, block_k=16,
+                                        causal=True)
+    want = _repeat_kv_oracle(4, 2, causal=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    assert lse.shape == (2, q.shape[1], 4)  # lse is per QUERY head
+
+    def loss(q, k, v):
+        o, l = flash_attention_with_lse(q, k, v, block_q=16, block_k=16,
+                                        causal=True)
+        return (o ** 2).sum() + (l * 0.01).sum()
+
+    grads = jax.grad(loss, (0, 1, 2))(q, k, v)
+    assert grads[1].shape == k.shape and grads[2].shape == v.shape
+    assert all(bool(jnp.isfinite(g).all()) for g in grads)
+
+
+def test_gqa_rejects_bad_head_ratios_and_reference_bwd():
+    q, k, v = _gqa_qkv(h_kv=2)
+    with pytest.raises(ValueError, match="group"):
+        flash_attention(q, k[:, :, :1].repeat(3, axis=2),
+                        v[:, :, :1].repeat(3, axis=2))  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="share"):
+        flash_attention(q, k, v[:, :, :1])  # k/v head mismatch
+    with pytest.raises(NotImplementedError, match="reference"):
+        flash_attention(q, k, v, bwd_impl="reference")
