@@ -229,7 +229,17 @@ def ring_attention_block(q, k, v, axis_name, axis_size, varying_axes=None,
     r = jax.lax.axis_index(axis_name)
     row_ids = jnp.arange(l)
 
+    group = h // k.shape[2]  # grouped-query: q-heads per shared K/V head
+
     def block_update(k_cur, v_cur, kseg_cur, acc, row_max, row_sum, src):
+        if group > 1:
+            # GQA: repeat the K/V heads AT LOCAL COMPUTE only — the ring
+            # still permutes the grouped (small) blocks, so ICI traffic
+            # scales with h_kv; only this shard's [B, L, H, Dh] repeat
+            # materializes, and only on the dense path (the flash path
+            # group-maps fetches in-kernel instead).
+            k_cur = jnp.repeat(k_cur, group, axis=2)
+            v_cur = jnp.repeat(v_cur, group, axis=2)
         scores = jnp.einsum("blhd,bmhd->bhlm", qf,
                             k_cur.astype(jnp.float32)) * scale
         if segment_ids is not None:
@@ -340,6 +350,14 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     from jax import shard_map
 
     sp = mesh.shape[axis_name]
+    if v.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"k has {k.shape[2]} heads but v has {v.shape[2]}; K and V "
+            "must share their (possibly grouped) head count")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"ring_attention grouped-query heads must divide: q has "
+            f"{q.shape[2]} heads, k/v have {k.shape[2]}")
     if local_attn == "auto":
         local_attn = ("flash" if q.shape[1] >= ULYSSES_FLASH_THRESHOLD
                       else "dense")
@@ -492,6 +510,14 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis=None,
     """
     from jax import shard_map
 
+    if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
+        raise NotImplementedError(
+            f"ulysses_attention reshards HEADS over the sequence axis, so "
+            f"grouped-query K/V (q {q.shape[2]} heads vs k/v "
+            f"{k.shape[2]}/{v.shape[2]}) is not supported — use "
+            "ring_attention (its K/V ring permutes the grouped heads "
+            "directly, shrinking ICI traffic by the group factor) or "
+            "repeat K/V to the query head count first")
     local_attn = _resolve_ulysses_local(q.shape[1], local_attn)
     spec = P(batch_axis, axis_name, None, None)
     block = functools.partial(ulysses_attention_block, axis_name=axis_name,
