@@ -611,7 +611,7 @@ def leg_realstep(url):
 #   execution over the tunnel; a D2H value fetch cannot complete early).
 # --------------------------------------------------------------------------
 
-FLASH_T = int(os.environ.get("BENCH_FLASH_T", "1024"))
+FLASH_T = int(os.environ.get("BENCH_FLASH_T", "4096"))
 FLASH_MEM_START_T = int(os.environ.get("BENCH_FLASH_MEM_START_T", "4096"))
 FLASH_MEM_CAP_T = int(os.environ.get("BENCH_FLASH_MEM_CAP_T", "262144"))
 

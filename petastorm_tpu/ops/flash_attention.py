@@ -820,15 +820,36 @@ def _should_interpret():
     return jax.default_backend() != "tpu"
 
 
-def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
+# Length-aware block_k default, measured on v5e (alternating A/B, fwd+bwd
+# at bf16): 512 beats 128 by ~1.05x at T=8192 and ~1.35x at T=16384 — 4x
+# fewer K-grid steps amortize the per-block revisit overhead — while 128
+# stays right below the threshold (min-tile padding waste, and short-T
+# shapes often don't divide 512).
+_LONG_T_BLOCK_K = 512
+_LONG_T_THRESHOLD = 4096
+
+
+def _default_blocks(t_kv, block_q, block_k):
+    """Resolve ``None`` block sizes (the public wrappers call this BEFORE
+    the custom_vjp captures them, so forward and backward always agree)."""
+    if block_q is None:
+        block_q = 128
+    if block_k is None:
+        block_k = (_LONG_T_BLOCK_K if t_kv >= _LONG_T_THRESHOLD
+                   else 128)
+    return block_q, block_k
+
+
+def flash_attention(q, k, v, block_q=None, block_k=None, interpret=None,
                     causal=False, bwd_impl="flash", kv_lengths=None,
                     segment_ids=None):
     """Tiled attention over ``[B, T, H, D]`` tensors; matches
     ``attention_reference`` numerics (f32 softmax) without materializing the
     ``[T, T]`` score matrix — in the forward OR the backward.
 
-    :param block_q / block_k: VMEM tile sizes; keep at 128 (MXU-shaped)
-        unless T is small.
+    :param block_q / block_k: VMEM tile sizes (``None`` = auto: 128, with
+        ``block_k`` rising to 512 once ``T_kv`` reaches 4096 — measured
+        faster on v5e at long T; see ``_default_blocks``).
     :param interpret: force the pallas interpreter (None = auto: interpret
         off-TPU, Mosaic on TPU).
     :param causal: mask key positions after each query's (last-aligned)
@@ -860,6 +881,7 @@ def flash_attention(q, k, v, block_q=128, block_k=128, interpret=None,
     """
     _check_bwd_impl(bwd_impl)
     _check_gqa_heads(q, k, v, bwd_impl)
+    block_q, block_k = _default_blocks(k.shape[1], block_q, block_k)
     if segment_ids is not None:
         if kv_lengths is not None:
             raise ValueError(
@@ -974,7 +996,7 @@ def _aux_bwd(block_q, block_k, interpret, causal, bwd_impl, aux_kind,
 _flash_aux.defvjp(_aux_fwd, _aux_bwd)
 
 
-def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
+def flash_attention_with_lse(q, k, v, block_q=None, block_k=None,
                              interpret=None, causal=False, causal_shift=0,
                              kv_lengths=None, segment_ids=None):
     """Flash attention that ALSO returns the per-row log-sum-exp — the
@@ -994,6 +1016,7 @@ def flash_attention_with_lse(q, k, v, block_q=128, block_k=128,
     carries its own ids); mutually exclusive with ``kv_lengths``.
     """
     _check_gqa_heads(q, k, v)
+    block_q, block_k = _default_blocks(k.shape[1], block_q, block_k)
     if segment_ids is not None:
         if kv_lengths is not None:
             raise ValueError(

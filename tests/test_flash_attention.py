@@ -520,3 +520,39 @@ def test_gqa_rejects_bad_head_ratios_and_reference_bwd():
         flash_attention(q, k, v[:, :, :1])  # k/v head mismatch
     with pytest.raises(NotImplementedError, match="reference"):
         flash_attention(q, k, v, bwd_impl="reference")
+
+
+# ---------------------------------------------------------------------------
+# Length-aware block_k default (512 at T >= 4096, measured faster on v5e)
+# ---------------------------------------------------------------------------
+
+def test_default_blocks_resolution():
+    from petastorm_tpu.ops.flash_attention import _default_blocks
+
+    assert _default_blocks(1024, None, None) == (128, 128)
+    assert _default_blocks(4095, None, None) == (128, 128)
+    assert _default_blocks(4096, None, None) == (128, 512)
+    assert _default_blocks(8192, 64, None) == (64, 512)
+    # explicit sizes always win
+    assert _default_blocks(8192, None, 128) == (128, 128)
+    assert _default_blocks(8192, 256, 256) == (256, 256)
+
+
+def test_long_t_auto_block_matches_reference():
+    """T=4096 crosses the auto threshold — the shipping default
+    (block_k=512) must stay oracle-exact, forward and backward."""
+    rng = np.random.RandomState(40)
+    q, k, v = (jnp.asarray(rng.randn(1, 4096, 1, 8).astype(np.float32))
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    got = jax.grad(lambda a, b, c: (flash_attention(a, b, c, causal=True)
+                                    ** 2).sum(), (0, 1, 2))(q, k, v)
+    ref = jax.grad(lambda a, b, c: (attention_reference(a, b, c,
+                                                        causal=True)
+                                    ** 2).sum(), (0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=name)
