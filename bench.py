@@ -613,7 +613,7 @@ def leg_realstep(url):
 
 FLASH_T = int(os.environ.get("BENCH_FLASH_T", "4096"))
 FLASH_MEM_START_T = int(os.environ.get("BENCH_FLASH_MEM_START_T", "4096"))
-FLASH_MEM_CAP_T = int(os.environ.get("BENCH_FLASH_MEM_CAP_T", "262144"))
+FLASH_MEM_CAP_T = int(os.environ.get("BENCH_FLASH_MEM_CAP_T", "524288"))
 
 
 def _flash_case_inputs(case, t=None):
@@ -884,7 +884,11 @@ def _flash_mem_trial_main():
     loss, _grads = step(params, x)
     loss_val = float(loss)  # D2H fetch: forces real execution
     compile_and_first_s = time.perf_counter() - t0
-    reps = 2
+    # One timed rep at the largest Ts: their steps run minutes under HBM
+    # pressure (and swing ~2x with it) — a second rep would spend the
+    # trial-timeout margin on a number that is ceiling evidence, not a
+    # throughput claim.
+    reps = 1 if t >= 262144 else 2
     t0 = time.perf_counter()
     for _ in range(reps):
         loss, _grads = step(params, x)
@@ -904,9 +908,13 @@ def leg_flash_memsweep(_url):
         env.update(BENCH_FLASH_MEM_TRIAL="1", BENCH_FLASH_IMPL=impl,
                    BENCH_FLASH_TRIAL_T=str(t))
         try:
+            # 1800 s: the T=524288 flash trial measured ~90 s compile +
+            # ~110-210 s/step (HBM-pressure swings) — one warm + one timed
+            # step needs ~300-500 s, and the deadline must survive a 2x
+            # weather window without falsely demoting the ceiling.
             result = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True, timeout=900)
+                capture_output=True, text=True, timeout=1800)
         except subprocess.TimeoutExpired:
             return {"ok": False, "reason": "timeout"}
         if result.returncode != 0:

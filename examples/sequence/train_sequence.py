@@ -173,8 +173,11 @@ def train_packed_causal(dataset_url, slot_len=48, slots=4, steps=6,
         split = lambda w: (h @ w).reshape(b, t, heads, d_model // heads)  # noqa: E731
         q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
         if attn_impl == "flash":
+            # block_k=None defers to the kernel's length-aware
+            # default (512 at long T — measured faster on v5e).
             attn = flash_attention(q, k, v, block_q=min(128, t),
-                                   block_k=min(128, t), causal=True,
+                                   block_k=None if t >= 4096
+                                   else min(128, t), causal=True,
                                    segment_ids=seg)
         else:
             attn = attention_reference(q, k, v, causal=True,

@@ -25,6 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+# Single source of truth for the kernel's length-aware block_k default:
+# at/above this T, pass block_k=None and let the kernel pick its tuned
+# long-T tile (512 today) — retuning the kernel retunes every call site.
+from petastorm_tpu.ops.flash_attention import (
+    _LONG_T_THRESHOLD as _FLASH_LONG_T,
+)
+
 
 def attention_reference(q, k, v, causal=False, lengths=None,
                         segment_ids=None):
@@ -114,7 +121,7 @@ def _ring_flash_block(q, k, v, axis_name, axis_size, varying_axes=None,
     # block_k=None defers to the kernel's length-aware default (512 once
     # the resident block reaches 4096 — measured faster on v5e); below
     # that, match block_q so short shards keep their exact tiles.
-    blk_k = None if l >= 4096 else blk
+    blk_k = None if l >= _FLASH_LONG_T else blk
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     r = jax.lax.axis_index(axis_name)
 
@@ -477,7 +484,7 @@ def ulysses_attention_block(q, k, v, axis_name, axis_size, causal=False,
         # block_k=None: the kernel's length-aware default (512 at the
         # full-sequence lengths Ulysses attends over) — measured faster.
         out = flash_attention(qh, kh, vh, block_q=block,
-                              block_k=None if t_full >= 4096 else block,
+                              block_k=None if t_full >= _FLASH_LONG_T else block,
                               causal=causal, kv_lengths=lengths,
                               segment_ids=segment_ids)
     else:
@@ -651,7 +658,7 @@ def apply_seq_model(params, windows, num_heads=4, mesh=None, attn_axis="sp",
         else:
             block = min(128, t)
             attn = flash_attention(q, k, v, block_q=block,
-                                   block_k=None if t >= 4096 else block,
+                                   block_k=None if t >= _FLASH_LONG_T else block,
                                    causal=causal, kv_lengths=lengths)
     elif attn_impl == "dense":
         attn = attention_reference(q, k, v, causal=causal, lengths=lengths)
