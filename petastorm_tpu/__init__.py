@@ -31,6 +31,11 @@ _LAZY_EXPORTS = {
     "UnischemaField": ("petastorm_tpu.schema.unischema", "UnischemaField"),
     "TransformSpec": ("petastorm_tpu.schema.transform", "TransformSpec"),
     "make_jax_dataloader": ("petastorm_tpu.jax_utils.loader", "make_jax_dataloader"),
+    # Disaggregated data service (docs/guides/service.md).
+    "Dispatcher": ("petastorm_tpu.service.dispatcher", "Dispatcher"),
+    "BatchWorker": ("petastorm_tpu.service.worker", "BatchWorker"),
+    "ServiceBatchSource": ("petastorm_tpu.service.client",
+                           "ServiceBatchSource"),
 }
 
 __all__ = list(_LAZY_EXPORTS) + ["__version__"]

@@ -54,6 +54,7 @@ def main(argv=None):
 
 
 def _scenario_main(argv):
+    import inspect
     import json
 
     from petastorm_tpu.benchmark.scenarios import SCENARIOS
@@ -61,18 +62,37 @@ def _scenario_main(argv):
     parser = argparse.ArgumentParser(
         prog="petastorm-tpu-throughput scenario",
         description="Run a named benchmark scenario on synthetic data "
-                    "(BASELINE.md configs #2-#5)")
+                    "(BASELINE.md configs #2-#5, plus the `service` "
+                    "loopback data-service tier)")
     parser.add_argument("name", choices=sorted(SCENARIOS))
     parser.add_argument("--dataset-url", default=None,
                         help="reuse an existing dataset instead of "
                              "synthesizing one (weighted: a base url "
                              "holding corpus_<i> datasets with a 'corpus' "
                              "column)")
-    parser.add_argument("--workers", type=int, default=3)
+    parser.add_argument("--workers", type=int, default=3,
+                        help="reader pool threads (service: batch-worker "
+                             "fleet size)")
+    parser.add_argument("--batch-size", type=int, default=None,
+                        help="rows per batch (scenarios that batch)")
+    parser.add_argument("--mode", default=None,
+                        choices=["static", "fcfs"],
+                        help="service scenario sharding mode")
     args = parser.parse_args(argv)
 
-    result = SCENARIOS[args.name](dataset_url=args.dataset_url,
-                                  workers=args.workers)
+    scenario = SCENARIOS[args.name]
+    kwargs = {"dataset_url": args.dataset_url, "workers": args.workers}
+    # Optional knobs forward only to scenarios whose signature takes them
+    # (argparse exposes one surface; each scenario keeps its own defaults).
+    accepted = set(inspect.signature(scenario).parameters)
+    for name, value in (("batch_size", args.batch_size),
+                        ("mode", args.mode)):
+        if value is not None:
+            if name not in accepted:
+                parser.error(f"--{name.replace('_', '-')} is not a knob of "
+                             f"the {args.name!r} scenario")
+            kwargs[name] = value
+    result = scenario(**kwargs)
     print(json.dumps(result))
     return 0
 

@@ -521,6 +521,85 @@ def packed_delivery_scenario(dataset_url=None, docs=2_048, max_len=48,
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Scenario: disaggregated data service, loopback (dispatcher + workers +
+# client all on 127.0.0.1 — the serving tier's overhead vs a local reader)
+# ---------------------------------------------------------------------------
+
+def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
+                              days=DEFAULT_TABULAR_DAYS, workers=2,
+                              batch_size=512, mode="static"):
+    """Rows/sec through the full disaggregated path: dispatcher + ``workers``
+    batch workers + one client, all over loopback TCP, streamed into
+    ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
+    read by a local ``make_batch_reader`` pipeline, so the number reported
+    is the serving tier's overhead (serialize → TCP → deserialize) at
+    one-machine scale. ``workers`` is the number of batch workers; each runs
+    a 2-thread reader pool.
+    """
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_batch_reader
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+
+    tmpdir = None
+    if dataset_url is None:
+        tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_service_")
+        dataset_url = f"file://{tmpdir}/ds"
+        rows = make_tabular_dataset(dataset_url, rows=rows, days=days)
+
+    dispatcher = Dispatcher(port=0, mode=mode, num_epochs=1).start()
+    fleet = []
+    try:
+        fleet = [
+            BatchWorker(dataset_url, dispatcher_address=dispatcher.address,
+                        batch_size=batch_size, reader_factory="batch",
+                        worker_id=f"bench-worker-{i}",
+                        reader_kwargs={"workers_count": 2}).start()
+            for i in range(workers)]
+        source = ServiceBatchSource(dispatcher.address)
+        loader = JaxDataLoader(None, batch_size, batch_source=source,
+                               stage_to_device=False)
+        served_rows = batches = 0
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                batches += 1
+                served_rows += len(next(iter(batch.values())))
+        service_wall = time.perf_counter() - t0
+        stall_pct = loader.diagnostics["input_stall_pct"]
+
+        # Local baseline: the same dataset through the same collation,
+        # no network tier.
+        local_rows = 0
+        t0 = time.perf_counter()
+        with make_batch_reader(dataset_url, reader_pool_type="thread",
+                               workers_count=2, num_epochs=1,
+                               shuffle_row_groups=False) as reader:
+            for b in batch_iterator(reader, batch_size, last_batch="keep"):
+                local_rows += len(next(iter(b.values())))
+        local_wall = time.perf_counter() - t0
+        return {
+            "scenario": "service_loopback",
+            "mode": mode,
+            "workers": workers,
+            "rows": served_rows,
+            "batches": batches,
+            "service_rows_per_sec": round(served_rows / service_wall, 1),
+            "local_rows_per_sec": round(local_rows / local_wall, 1),
+            "service_vs_local": round(
+                (served_rows / service_wall) / (local_rows / local_wall), 2),
+            "loader_input_stall_pct": stall_pct,
+        }
+    finally:
+        for worker in fleet:
+            worker.stop()
+        dispatcher.stop()
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 SCENARIOS = {
     "tabular": tabular_predicate_scenario,
     "ngram": ngram_window_scenario,
@@ -528,4 +607,5 @@ SCENARIOS = {
     "weighted": weighted_mixing_scenario,
     "converter_mixing": converter_mixing_scenario,
     "packed": packed_delivery_scenario,
+    "service": service_loopback_scenario,
 }

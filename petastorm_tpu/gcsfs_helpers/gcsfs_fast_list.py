@@ -39,17 +39,28 @@ def _strip_scheme(url):
     return url
 
 
-def fast_list(gcs_url, storage_options=None, detail=False, filesystem=None):
+def fast_list(gcs_url, storage_options=None, detail=False, filesystem=None,
+              retries=3, retry_base_delay=0.5):
     """Recursively list ``gs://bucket/prefix`` with one ``find()`` sweep.
 
     ``find`` maps to a single paginated ``objects.list`` API sequence —
     gcsfs follows ``nextPageToken`` internally, so a million-object prefix is
     still one logical call, not one per directory.
 
+    The sweep retries with bounded exponential backoff + jitter
+    (:func:`petastorm_tpu.utils.retry_with_backoff`): it runs exactly once
+    per reader construction, so one transient listing failure would
+    otherwise abort startup for a whole pod. ``FileNotFoundError`` is never
+    retried — a missing dataset doesn't become present by waiting.
+
     :param filesystem: any fsspec-compatible filesystem (tests pass a fake;
         defaults to a ``gcsfs.GCSFileSystem`` built from ``storage_options``).
     :param detail: ``True`` → ``{path: info}``; ``False`` → sorted path list.
+    :param retries: additional sweep attempts after the first (0 disables).
+    :param retry_base_delay: backoff base in seconds (doubles per attempt).
     """
+    from petastorm_tpu.utils import retry_with_backoff
+
     if filesystem is None:
         try:
             import gcsfs
@@ -61,7 +72,11 @@ def fast_list(gcs_url, storage_options=None, detail=False, filesystem=None):
 
         filesystem = gcsfs.GCSFileSystem(**(storage_options or {}))
     path = _strip_scheme(gcs_url)
-    listing = filesystem.find(path, detail=True)
+    listing = retry_with_backoff(
+        lambda: filesystem.find(path, detail=True),
+        retries=retries, base_delay=retry_base_delay,
+        no_retry_on=(FileNotFoundError, PermissionError),
+        description=f"GCS listing sweep of {path!r}")
     if detail:
         return listing
     return sorted(listing)

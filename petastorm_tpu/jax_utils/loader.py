@@ -475,11 +475,35 @@ class JaxDataLoader:
         fresh loader.
         """
         if self._batch_source is not None:
+            # A source that knows how to checkpoint itself (e.g. the data
+            # service's ServiceBatchSource tracks completed splits) owns the
+            # snapshot: delegate. Sources accepting ``yielded_batches`` get
+            # this loader's yielded-batch count so batches still buffered in
+            # the prefetch queues stay un-checkpointed and are re-delivered
+            # on resume (at-least-once, the same contract as the reader
+            # path's buffered-row re-read).
+            source_state = getattr(self._batch_source, "state_dict", None)
+            if callable(source_state):
+                import inspect
+
+                try:
+                    params = inspect.signature(source_state).parameters
+                except (TypeError, ValueError):  # builtins, C callables
+                    params = {}
+                accepts_yielded = "yielded_batches" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+                if accepts_yielded:
+                    return source_state(
+                        yielded_batches=self.diagnostics["batches"])
+                return source_state()
             raise ValueError(
                 "state_dict is not supported with a custom batch_source "
-                "(e.g. the packed loader): yielded-row accounting cannot "
-                "attribute repacked batches to reader deliveries. Checkpoint "
-                "at an epoch boundary with the reader's state_dict()")
+                "that has no state_dict() of its own (e.g. the packed "
+                "loader): yielded-row accounting cannot attribute repacked "
+                "batches to reader deliveries. Checkpoint at an epoch "
+                "boundary with the reader's state_dict(), or give the "
+                "source a state_dict()")
         tracker = getattr(self.reader, "_delivery_tracker", None)
         if tracker is None or not hasattr(self.reader, "state_dict"):
             raise TypeError(
@@ -523,5 +547,8 @@ class JaxDataLoader:
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.stop()
         self.join()
-        self.reader.stop()
-        self.reader.join()
+        # reader is None when a custom batch_source owns the pipeline (e.g.
+        # the data service's ServiceBatchSource — no local reader exists).
+        if self.reader is not None:
+            self.reader.stop()
+            self.reader.join()

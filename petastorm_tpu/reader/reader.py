@@ -68,7 +68,8 @@ def make_reader(dataset_url,
                 filesystem=None,
                 reader_engine=None,
                 resume_state=None,
-                fast_gcs_listing=True):
+                fast_gcs_listing=True,
+                piece_indices=None):
     """Reader for **petastorm-format** datasets (Unischema + codecs attached).
 
     Reference parity: ``petastorm/reader.py::make_reader`` — same knob surface.
@@ -126,7 +127,8 @@ def make_reader(dataset_url,
                   cache=cache,
                   transform_spec=transform_spec,
                   filters=filters,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  piece_indices=piece_indices)
 
 
 def make_columnar_reader(dataset_url,
@@ -149,7 +151,8 @@ def make_columnar_reader(dataset_url,
                          zmq_copy_buffers=True,
                          filesystem=None,
                          resume_state=None,
-                         fast_gcs_listing=True):
+                         fast_gcs_listing=True,
+                         piece_indices=None):
     """Columnar reader for **petastorm-format** datasets — the TPU-native
     fast path feeding :func:`petastorm_tpu.jax_utils.make_jax_dataloader`.
 
@@ -212,7 +215,8 @@ def make_columnar_reader(dataset_url,
                   cache=cache,
                   transform_spec=transform_spec,
                   filters=filters,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  piece_indices=piece_indices)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -234,7 +238,8 @@ def make_batch_reader(dataset_url_or_urls,
                       zmq_copy_buffers=True,
                       filesystem=None,
                       resume_state=None,
-                      fast_gcs_listing=True):
+                      fast_gcs_listing=True,
+                      piece_indices=None):
     """Batch reader for **plain Parquet** stores (no petastorm metadata needed).
 
     Reference parity: ``petastorm/reader.py::make_batch_reader``. Yields
@@ -286,7 +291,8 @@ def make_batch_reader(dataset_url_or_urls,
                   cache=cache,
                   transform_spec=transform_spec,
                   filters=filters,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  piece_indices=piece_indices)
 
 
 def _default_shard_options(cur_shard, shard_count):
@@ -343,7 +349,7 @@ class Reader:
                  predicate=None, rowgroup_selector=None, num_epochs=1,
                  cur_shard=None, shard_count=None, shard_seed=None,
                  cache=None, transform_spec=None, filters=None,
-                 resume_state=None):
+                 resume_state=None, piece_indices=None):
         if predicate is not None and not isinstance(predicate, PredicateBase):
             raise ValueError("predicate must be an instance of PredicateBase")
         if (cur_shard is None) != (shard_count is None):
@@ -395,6 +401,21 @@ class Reader:
             canonical = (pieces if filters is None
                          and not isinstance(dataset_path, list) else None)
             pieces = self._apply_selector(pieces, rowgroup_selector, canonical)
+        if piece_indices is not None:
+            # Explicit split plan (the data service's dispatcher hands these
+            # out): indices into the canonical enumeration order AFTER
+            # filters/selector for the same planning config — assigner and
+            # reader must plan with identical filters/selector arguments.
+            piece_indices = sorted(set(int(i) for i in piece_indices))
+            out_of_range = [i for i in piece_indices
+                            if not 0 <= i < len(pieces)]
+            if out_of_range:
+                raise ValueError(
+                    f"piece_indices {out_of_range} out of range for the "
+                    f"{len(pieces)} row-group pieces this planning config "
+                    f"enumerates")
+            pieces = [pieces[i] for i in piece_indices]
+        self._piece_indices = piece_indices
         pre_shard_count = len(pieces)
         pieces = self._shard_pieces(pieces, cur_shard, shard_count, shard_seed)
         if not pieces and pre_shard_count > 0:
@@ -432,9 +453,13 @@ class Reader:
 
         self._shard_seed = shard_seed
         self._shuffle_row_drop_partitions = shuffle_row_drop_partitions
-        # filters/selector change which pieces the positional item keys
-        # denote — they must be part of the resume fingerprint.
-        self._planning_repr = repr((filters, rowgroup_selector))
+        # filters/selector (and an explicit piece_indices plan) change which
+        # pieces the positional item keys denote — they must be part of the
+        # resume fingerprint. The two-element repr is kept when no explicit
+        # plan is given so pre-existing checkpoints stay resumable.
+        self._planning_repr = repr(
+            (filters, rowgroup_selector) if piece_indices is None
+            else (filters, rowgroup_selector, tuple(piece_indices)))
         self._resume_state = resume_state
         self._num_items = len(items)  # full item universe (pre-resume trim)
         iterations = num_epochs

@@ -1,0 +1,46 @@
+"""Disaggregated data service: dispatcher + batch workers + trainer clients.
+
+The tf.data-service-shaped tier above the reader library (arxiv 2210.14826's
+disaggregation argument, cedar's arxiv 2401.08895 pipeline split): input CPU
+work moves off the trainer host onto a fleet of **batch workers**, each
+wrapping an ordinary ``make_reader``-family pipeline and serving ready numpy
+batches over length-prefixed TCP
+(:mod:`petastorm_tpu.reader_impl.framed_socket`). A single **dispatcher**
+owns the split plan — which row-group pieces each client's workers read —
+and the **client** (:class:`ServiceBatchSource`) plugs into
+:class:`~petastorm_tpu.jax_utils.loader.JaxDataLoader` through its
+``batch_source=`` seam, so the trainer-side staging/prefetch/diagnostics
+machinery is reused unchanged.
+
+Sharding modes (dispatcher ``mode=``):
+
+- ``static`` — each client declares ``(client_index, num_clients)``; the
+  dispatcher shards row groups per client (``pieces[client_index::
+  num_clients]``) and partitions each client's shard across live workers.
+  Deterministic per-client data; resumable (``ServiceBatchSource.
+  state_dict()``).
+- ``fcfs`` — one shared split queue; any client takes the next row group
+  first-come-first-served (dispatcher-owned epoch refills). Maximum
+  utilization, no per-client determinism.
+
+Failure semantics are at-least-once at row-group-set granularity: a worker
+dying mid-stream triggers client reconnect with bounded exponential backoff
+(:func:`petastorm_tpu.utils.retry_with_backoff`), then dispatcher
+re-assignment of the dead worker's pieces to survivors — re-delivered from
+the start of the piece set, so no sample is lost (duplicates possible,
+exactly the reader layer's buffered-row resume contract).
+
+CLI: ``python -m petastorm_tpu.service dispatcher|worker``; architecture
+walkthrough in ``docs/guides/service.md``.
+"""
+
+from petastorm_tpu.service.client import ServiceBatchSource, ServiceError
+from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.worker import BatchWorker
+
+__all__ = [
+    "Dispatcher",
+    "BatchWorker",
+    "ServiceBatchSource",
+    "ServiceError",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from petastorm_tpu.service.cli import main
+
+sys.exit(main())

@@ -1,0 +1,116 @@
+"""``python -m petastorm_tpu.service`` — run a dispatcher or a batch worker.
+
+A two-worker loopback service on one machine::
+
+    python -m petastorm_tpu.service dispatcher --port 7077 --mode static
+    python -m petastorm_tpu.service worker --dispatcher 127.0.0.1:7077 \\
+        --dataset-url file:///data/ds --reader batch --batch-size 512 &
+    python -m petastorm_tpu.service worker --dispatcher 127.0.0.1:7077 \\
+        --dataset-url file:///data/ds --reader batch --batch-size 512 &
+
+then, trainer-side::
+
+    source = ServiceBatchSource(("127.0.0.1", 7077))
+    loader = JaxDataLoader(None, 512, batch_source=source)
+
+Each process prints one JSON line with its bound address (port 0 picks a
+free port) and serves until SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def parse_address(value):
+    """``"host:port"`` (or bare ``"port"``) → ``(host, port)``."""
+    host, _, port = str(value).rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m petastorm_tpu.service",
+        description="Disaggregated data service: dispatcher owns split "
+                    "assignment; workers serve collated numpy batches over "
+                    "TCP (docs/guides/service.md)")
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    disp = sub.add_parser("dispatcher", help="run the split dispatcher")
+    disp.add_argument("--host", default="127.0.0.1")
+    disp.add_argument("--port", type=int, default=7077,
+                      help="0 picks a free port (printed on stdout)")
+    disp.add_argument("--mode", choices=["static", "fcfs"], default="static")
+    disp.add_argument("--num-epochs", type=int, default=1,
+                      help="epochs to serve; 0 means serve forever")
+
+    work = sub.add_parser("worker", help="run a batch worker")
+    work.add_argument("--dispatcher", default=None,
+                      help="dispatcher address host:port (omit to run an "
+                           "unregistered worker addressed directly)")
+    work.add_argument("--host", default="127.0.0.1")
+    work.add_argument("--port", type=int, default=0)
+    work.add_argument("--dataset-url", required=True)
+    work.add_argument("--batch-size", type=int, default=256)
+    work.add_argument("--reader", choices=["row", "batch", "columnar"],
+                      default="row",
+                      help="row=make_reader, batch=make_batch_reader, "
+                           "columnar=make_columnar_reader")
+    work.add_argument("--workers-count", type=int, default=4,
+                      help="reader pool size inside this worker")
+    work.add_argument("--reader-pool-type", default="thread",
+                      choices=["thread", "process", "dummy"])
+    work.add_argument("--worker-id", default=None)
+    return parser
+
+
+def build_service_node(args):
+    """argparse namespace → an unstarted Dispatcher or BatchWorker."""
+    if args.role == "dispatcher":
+        from petastorm_tpu.service.dispatcher import Dispatcher
+
+        return Dispatcher(host=args.host, port=args.port, mode=args.mode,
+                          num_epochs=args.num_epochs or None)
+    from petastorm_tpu.service.worker import BatchWorker
+
+    return BatchWorker(
+        args.dataset_url,
+        dispatcher_address=(parse_address(args.dispatcher)
+                            if args.dispatcher else None),
+        host=args.host, port=args.port, batch_size=args.batch_size,
+        reader_factory=args.reader, worker_id=args.worker_id,
+        reader_kwargs={"workers_count": args.workers_count,
+                       "reader_pool_type": args.reader_pool_type})
+
+
+def main(argv=None, run_seconds=None):
+    """Entry point. ``run_seconds`` bounds the serve loop (tests); the
+    default serves until SIGINT/SIGTERM."""
+    args = _build_parser().parse_args(argv)
+    node = build_service_node(args)
+    node.start()
+    host, port = node.address
+    print(json.dumps({"role": args.role, "host": host, "port": port,
+                      **({"worker_id": node.worker_id}
+                         if args.role == "worker" else {})}),
+          flush=True)
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread (tests)
+    try:
+        stop.wait(timeout=run_seconds)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,509 @@
+"""Trainer-side client of the disaggregated data service.
+
+:class:`ServiceBatchSource` is a zero-arg callable returning an iterator of
+``{field: ndarray}`` batches — exactly the ``batch_source=`` contract of
+:class:`~petastorm_tpu.jax_utils.loader.JaxDataLoader`, so a trainer swaps
+its local reader pipeline for remote workers by changing one constructor
+argument and keeps the loader's staging/prefetch/stall accounting unchanged.
+
+Failure handling (static mode): a broken worker connection first retries
+against the same worker with bounded exponential backoff + jitter
+(:func:`petastorm_tpu.utils.retry_with_backoff` — the same policy the GCS
+listing sweep uses); if the worker stays dead, the client reports it to the
+dispatcher, which re-partitions the dead worker's piece set across the
+survivors. Re-delivery restarts those pieces from the beginning:
+at-least-once, no sample loss, duplicates possible — the service-tier
+analogue of the reader layer's buffered-row resume contract.
+
+Checkpointing: :meth:`ServiceBatchSource.state_dict` snapshots the epoch and
+the piece sets whose streams fully completed;
+``JaxDataLoader.state_dict()`` delegates here when this source is plugged
+in. Pass the snapshot back as ``resume_state=`` to skip completed pieces on
+restart (static mode only — fcfs has no per-client resumable position).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import uuid
+
+from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
+    FramedConnection,
+)
+from petastorm_tpu.utils import retry_with_backoff
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceError(RuntimeError):
+    """A non-transient service-protocol failure (dispatcher/worker replied
+    ``error``, or the service cannot make progress)."""
+
+
+class _WorkerStream:
+    """One ``stream`` request against one worker; connects lazily so every
+    connection failure funnels through ``next_batch`` (one recovery path)."""
+
+    def __init__(self, worker_id, address, pieces, epoch, connect_timeout):
+        self.worker_id = worker_id
+        self.address = tuple(address)
+        self.pieces = list(pieces)
+        self.epoch = epoch
+        self._connect_timeout = connect_timeout
+        self._conn = None
+
+    def next_batch(self):
+        """Next batch dict, or ``None`` when the stream ended cleanly."""
+        if self._conn is None:
+            # connect_timeout bounds the dial only: an inter-batch gap has
+            # no upper bound (reader construction, cold storage reads), so
+            # the stream socket must not inherit the dial timeout — a slow
+            # healthy worker must not be misread as a dead one. Keepalive
+            # covers the opposite failure: a worker HOST dying without
+            # FIN/RST surfaces as an OSError within ~2 minutes instead of
+            # blocking this timeout-less recv forever.
+            self._conn = FramedConnection.connect(
+                self.address, timeout=self._connect_timeout,
+                stream_timeout=None, keepalive=True)
+            self._conn.send({"type": "stream", "pieces": self.pieces,
+                             "epoch": self.epoch})
+        header, payload = self._conn.recv()
+        kind = header.get("type")
+        if kind == "batch":
+            return payload
+        if kind == "end":
+            self.close()
+            return None
+        if kind == "error":
+            raise ServiceError(
+                f"worker {self.worker_id} failed streaming pieces "
+                f"{self.pieces}: {header.get('error')}")
+        raise ServiceError(f"unexpected stream message {kind!r}")
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+class ServiceBatchSource:
+    """Stream remote batches from a dispatcher's worker fleet.
+
+    :param dispatcher_address: ``(host, port)`` of the dispatcher.
+    :param client_index/num_clients: this trainer's static shard (static
+        mode; ignored by fcfs).
+    :param max_retries: reconnect attempts per failed worker before the
+        failure is reported to the dispatcher for re-assignment.
+    :param backoff_base/backoff_max: exponential-backoff bounds (seconds).
+    :param resume_state: a prior :meth:`state_dict` snapshot — completed
+        pieces are skipped on the resumed epoch (static mode only).
+    """
+
+    def __init__(self, dispatcher_address, client_index=0, num_clients=1,
+                 client_id=None, connect_timeout=10.0, max_retries=3,
+                 backoff_base=0.05, backoff_max=2.0, resume_state=None):
+        self._dispatcher_address = tuple(dispatcher_address)
+        self.client_index = client_index
+        self.num_clients = num_clients
+        self.client_id = client_id or (
+            f"client-{client_index}-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+        self._connect_timeout = connect_timeout
+        self._max_retries = max_retries
+        self._backoff_base = backoff_base
+        self._backoff_max = backoff_max
+        self._lock = threading.Lock()
+        self._mode = None
+        self._epoch = 0
+        self._completed = set()
+        if resume_state is not None:
+            self._validate_resume_state(resume_state)
+            self._epoch = int(resume_state["epoch"])
+            self._completed = set(int(p)
+                                  for p in resume_state["completed_pieces"])
+        self._resumed = resume_state is not None
+        # Production-order bookkeeping for state_dict(): the n-th produced
+        # batch is the n-th batch the consumer yields (FIFO through the
+        # loader), so "piece set completed after batch c" events let a
+        # snapshot be computed relative to what the TRAINER has seen, not
+        # what this source has produced into the loader's prefetch queue.
+        self._production_count = 0
+        self._events = []        # (production_count, epoch, [pieces])
+        self._epoch_starts = [(0, self._epoch, set(self._completed))]
+
+    # -- dispatcher control channel ---------------------------------------
+
+    def _dispatcher_request(self, header):
+        """One request/reply against the dispatcher; transient socket
+        failures retry with backoff, protocol errors raise immediately."""
+
+        def once():
+            with FramedConnection.connect(
+                    self._dispatcher_address,
+                    timeout=self._connect_timeout) as conn:
+                reply, _ = conn.request(header)
+            if reply.get("type") == "error":
+                raise ServiceError(reply.get("error", "dispatcher error"))
+            return reply
+
+        return retry_with_backoff(
+            once, retries=self._max_retries, base_delay=self._backoff_base,
+            max_delay=self._backoff_max, retry_on=(OSError,),
+            no_retry_on=(ServiceError,),
+            description=f"dispatcher request {header.get('type')!r}")
+
+    # -- the batch_source contract ----------------------------------------
+
+    def __call__(self):
+        info = self._dispatcher_request({"type": "list_workers"})
+        with self._lock:
+            self._mode = info["mode"]
+            # Fresh iteration: the consumer's batch counter restarts, so
+            # production bookkeeping restarts with it.
+            self._production_count = 0
+            self._events = []
+            self._epoch_starts = [(0, self._epoch, set(self._completed))]
+        if info["mode"] == "static":
+            return self._iter_static(info)
+        return self._iter_fcfs(info)
+
+    # -- static mode -------------------------------------------------------
+
+    def _iter_static(self, info):
+        num_epochs = info["num_epochs"]
+        epoch = self._epoch
+        while num_epochs is None or epoch < num_epochs:
+            reply = self._dispatcher_request({
+                "type": "get_assignment", "client_id": self.client_id,
+                "client_index": self.client_index,
+                "num_clients": self.num_clients, "epoch": epoch})
+            if not reply["assignments"] and num_epochs is None:
+                # This client's static shard has no pieces at all (more
+                # clients than row groups). With infinite epochs the loop
+                # would otherwise spin get_assignment requests forever with
+                # nothing to yield — end the stream instead; the shard can
+                # never become non-empty (num_pieces is fixed).
+                logger.warning(
+                    "client %s (index %d of %d) received an empty static "
+                    "shard and num_epochs is None — ending the stream "
+                    "(prefer num_clients <= row-group count)",
+                    self.client_id, self.client_index, self.num_clients)
+                return
+            with self._lock:
+                skip = set(self._completed)
+            streams = {}
+            for wid, pieces in reply["assignments"].items():
+                pending = [p for p in pieces if p not in skip]
+                if pending:
+                    streams[len(streams)] = _WorkerStream(
+                        wid, reply["workers"][wid], pending, epoch,
+                        self._connect_timeout)
+            yield from self._drain_streams(streams, epoch)
+            epoch += 1
+            with self._lock:
+                self._completed = set()
+                self._epoch = epoch
+                self._epoch_starts.append(
+                    (self._production_count, epoch, set()))
+
+    def _drain_streams(self, streams, epoch):
+        """Round-robin ready batches across worker streams until all end;
+        a broken stream is retried, then reported and re-assigned."""
+        order = itertools.cycle(list(streams))
+        try:
+            while streams:
+                sid = next(order)
+                if sid not in streams:
+                    order = itertools.cycle(list(streams))
+                    continue
+                stream = streams[sid]
+                try:
+                    batch = stream.next_batch()
+                except (ConnectionClosedError, ConnectionError, OSError):
+                    replacement = self._retry_stream(stream)
+                    if replacement is not None:
+                        streams[sid] = replacement
+                        continue
+                    del streams[sid]
+                    takeover = self._reassign(stream)
+                    for new_stream in takeover:
+                        streams[max(streams, default=sid) + 1] = new_stream
+                    order = itertools.cycle(list(streams))
+                    continue
+                if batch is None:
+                    with self._lock:
+                        self._completed.update(stream.pieces)
+                        # The stream's batches are all among the first
+                        # _production_count produced: once the consumer has
+                        # yielded that many, these pieces are truly done.
+                        self._events.append((self._production_count, epoch,
+                                             sorted(stream.pieces)))
+                    del streams[sid]
+                    order = itertools.cycle(list(streams))
+                    continue
+                with self._lock:
+                    self._production_count += 1
+                yield batch
+        finally:
+            for stream in streams.values():
+                stream.close()
+
+    def _retry_stream(self, stream):
+        """Reconnect to the same worker and restart its piece set (the whole
+        set — at-least-once). ``None`` when the worker stays unreachable."""
+        stream.close()
+
+        def attempt():
+            fresh = _WorkerStream(stream.worker_id, stream.address,
+                                  stream.pieces, stream.epoch,
+                                  self._connect_timeout)
+            batch = fresh.next_batch()  # forces connect + first reply
+            return fresh, batch
+
+        try:
+            fresh, batch = retry_with_backoff(
+                attempt, retries=self._max_retries,
+                base_delay=self._backoff_base, max_delay=self._backoff_max,
+                retry_on=(OSError,), no_retry_on=(ServiceError,),
+                description=f"reconnect to worker {stream.worker_id}")
+        except OSError:
+            return None
+        # The first batch was consumed by the probe; hand it back by
+        # buffering it on the stream object.
+        if batch is None:
+            # The restarted stream ended immediately; _drain_streams's
+            # end-of-stream branch records the completion bookkeeping.
+            return _EndedStream(fresh)
+        return _BufferedStream(fresh, batch)
+
+    def _reassign(self, stream):
+        """Report ``stream``'s worker dead; return fresh streams for its
+        pieces on the surviving workers the dispatcher names."""
+        logger.warning(
+            "worker %s unreachable after %d retries; requesting "
+            "re-assignment of %d pieces", stream.worker_id,
+            self._max_retries + 1, len(stream.pieces))
+        reply = self._dispatcher_request({
+            "type": "report_failure", "client_id": self.client_id,
+            "worker_id": stream.worker_id, "pieces": stream.pieces})
+        return [
+            _WorkerStream(wid, reply["workers"][wid], pieces, stream.epoch,
+                          self._connect_timeout)
+            for wid, pieces in reply["assignments"].items()
+        ]
+
+    # -- fcfs mode ---------------------------------------------------------
+
+    def _list_workers(self):
+        reply = self._dispatcher_request({"type": "list_workers"})
+        return {wid: tuple(addr) for wid, addr in reply["workers"].items()}
+
+    def _iter_fcfs(self, info):
+        workers = {wid: tuple(addr) for wid, addr in info["workers"].items()}
+        rr_counter = 0
+        while True:
+            reply = self._dispatcher_request(
+                {"type": "next_split", "client_id": self.client_id})
+            if reply["type"] == "end_of_stream":
+                return
+            piece, epoch = reply["piece"], reply["epoch"]
+            refreshed = False
+            while True:  # serve attempts for this split
+                if not workers:
+                    # The local fleet snapshot drained: replacements may
+                    # have registered since (elastic fleets) — ask the
+                    # dispatcher before giving up. Reported-dead workers
+                    # are not re-listed, so this terminates.
+                    workers = self._list_workers()
+                    refreshed = True
+                    if not workers:
+                        raise ServiceError(
+                            f"no worker could serve split {piece} — no "
+                            f"live workers registered")
+                # Round-robin start offset spreads pieces over the fleet.
+                candidates = sorted(workers)
+                start = rr_counter % len(candidates)
+                rr_counter += 1
+                served = False
+                for wid in candidates[start:] + candidates[:start]:
+                    served = yield from self._serve_split_with_retries(
+                        wid, workers[wid], piece, epoch)
+                    if served:
+                        break
+                    # Worker stayed unreachable through the backoff
+                    # budget: flag it dead and try the piece elsewhere
+                    # (restarting the piece from its beginning:
+                    # at-least-once).
+                    workers.pop(wid, None)
+                    try:
+                        self._dispatcher_request({
+                            "type": "report_failure",
+                            "client_id": self.client_id,
+                            "worker_id": wid, "pieces": []})
+                    except ServiceError:
+                        pass  # surfaces via the refresh path above
+                if served:
+                    break
+                if refreshed and not workers:
+                    raise ServiceError(
+                        f"no worker could serve split {piece} — all "
+                        f"workers unreachable")
+
+    def _serve_split_with_retries(self, wid, address, piece, epoch):
+        """Yield one split's batches from one worker, retrying transient
+        connection failures on :func:`~petastorm_tpu.utils.backoff_delays`
+        — the same schedule ``retry_with_backoff`` sleeps on, used directly
+        because a generator must keep yielding between attempts. Returns
+        ``True`` when the split was fully served, ``False`` when the worker
+        stayed unreachable through the retry budget. A retry restarts the
+        piece from its beginning (at-least-once — batches already yielded
+        from the broken attempt arrive again)."""
+        import time
+
+        from petastorm_tpu.utils import backoff_delays
+
+        delays = backoff_delays(self._max_retries, self._backoff_base,
+                                self._backoff_max)
+        for attempt in range(self._max_retries + 1):
+            stream = _WorkerStream(wid, address, [piece], epoch,
+                                   self._connect_timeout)
+            try:
+                yield from self._drain_one(stream)
+                return True
+            except (ConnectionClosedError, ConnectionError, OSError) as exc:
+                if attempt == self._max_retries:
+                    return False
+                sleep_s = next(delays)
+                logger.warning(
+                    "split %s from worker %s failed (%s); retry %d/%d in "
+                    "%.2fs", piece, wid, exc, attempt + 1,
+                    self._max_retries, sleep_s)
+                time.sleep(sleep_s)
+        return False
+
+    def _drain_one(self, stream):
+        try:
+            while True:
+                batch = stream.next_batch()
+                if batch is None:
+                    return
+                yield batch
+        finally:
+            stream.close()
+
+    # -- checkpoint / diagnostics -----------------------------------------
+
+    def state_dict(self, yielded_batches=None):
+        """Resumable position: the epoch in progress and the piece sets
+        whose streams fully completed (pieces mid-stream are re-read on
+        resume — at-least-once). Static mode only.
+
+        ``yielded_batches``: for a consumer that prefetches past this
+        source — the number of batches it has actually surfaced.
+        Completion is then computed as of that batch (batches still sitting
+        in a prefetch queue keep their pieces un-completed, so they are
+        re-read on resume: at-least-once, never sample loss).
+        ``JaxDataLoader.state_dict()`` passes this for you; a consumer
+        iterating the source directly has no prefetch gap and the default
+        (everything produced) is exact.
+        """
+        with self._lock:
+            if self._mode == "fcfs":
+                raise ValueError(
+                    "state_dict is not supported in fcfs mode: splits are "
+                    "handed out first-come-first-served, so a client has no "
+                    "deterministic resumable position — use static sharding "
+                    "for resumable training")
+            count = (self._production_count if yielded_batches is None
+                     else min(int(yielded_batches), self._production_count))
+            epoch, base = self._epoch_starts[0][1], self._epoch_starts[0][2]
+            for start_count, start_epoch, start_base in self._epoch_starts:
+                if start_count <= count:
+                    epoch, base = start_epoch, start_base
+            completed = set(base)
+            completed.update(
+                piece
+                for event_count, event_epoch, pieces in self._events
+                if event_epoch == epoch and event_count <= count
+                for piece in pieces)
+            return {
+                "version": 1,
+                "mode": "static",
+                "client_index": self.client_index,
+                "num_clients": self.num_clients,
+                "epoch": epoch,
+                "completed_pieces": sorted(completed),
+            }
+
+    def _validate_resume_state(self, state):
+        if state.get("version") != 1:
+            raise ValueError(
+                f"Unsupported resume_state version {state.get('version')!r}")
+        if state.get("mode") != "static":
+            raise ValueError("resume_state requires static sharding mode")
+        for key in ("client_index", "num_clients"):
+            if state.get(key) != getattr(self, key):
+                raise ValueError(
+                    f"resume_state mismatch on {key!r}: checkpoint has "
+                    f"{state.get(key)!r}, this client has "
+                    f"{getattr(self, key)!r}")
+
+    def remote_diagnostics(self):
+        """Per-worker ``Reader.diagnostics`` snapshots — remote input stalls
+        become visible trainer-side (see docs/guides/diagnostics.md)."""
+        info = self._dispatcher_request({"type": "list_workers"})
+        out = {}
+        for wid, addr in info["workers"].items():
+            try:
+                with FramedConnection.connect(
+                        tuple(addr), timeout=self._connect_timeout) as conn:
+                    _, payload = conn.request({"type": "diagnostics"})
+                out[wid] = payload
+            except (ConnectionClosedError, OSError) as exc:
+                out[wid] = {"error": f"unreachable: {exc}"}
+        return out
+
+    def dispatcher_status(self):
+        """The dispatcher's control-plane snapshot (workers, clients,
+        split-queue depth)."""
+        return self._dispatcher_request({"type": "status"})
+
+
+class _BufferedStream:
+    """A stream whose first batch was already pulled by the reconnect probe."""
+
+    def __init__(self, stream, first_batch):
+        self._stream = stream
+        self._first = first_batch
+        self.worker_id = stream.worker_id
+        self.address = stream.address
+        self.pieces = stream.pieces
+        self.epoch = stream.epoch
+
+    def next_batch(self):
+        if self._first is not None:
+            batch, self._first = self._first, None
+            return batch
+        return self._stream.next_batch()
+
+    def close(self):
+        self._stream.close()
+
+
+class _EndedStream:
+    """A stream that already ended cleanly during the reconnect probe."""
+
+    def __init__(self, stream):
+        self.worker_id = stream.worker_id
+        self.address = stream.address
+        self.pieces = stream.pieces
+        self.epoch = stream.epoch
+
+    def next_batch(self):
+        return None
+
+    def close(self):
+        pass

@@ -1,0 +1,246 @@
+"""The dispatcher: control plane of the disaggregated data service.
+
+Owns the split plan and nothing else — no sample bytes ever flow through it
+(tf.data service's design split, arxiv 2210.14826 §3): workers register
+their address and the dataset's row-group count; clients ask it which pieces
+to stream from which workers. State is a few dicts under one lock; every
+request is a single framed message with a single framed reply, so the
+dispatcher stays trivially cheap even with many clients polling.
+
+Request vocabulary (header ``type``):
+
+- ``register_worker`` ``{worker_id, host, port, num_pieces}`` → ``ok``
+- ``list_workers`` → ``workers`` (alive worker addresses + service config)
+- ``get_assignment`` ``{client_id, client_index, num_clients, epoch}``
+  (static mode) → ``assignment``: this client's row-group shard partitioned
+  across live workers
+- ``report_failure`` ``{client_id, worker_id, pieces}`` → ``assignment``:
+  the dead worker's pieces re-partitioned across survivors
+- ``next_split`` ``{client_id}`` (fcfs mode) → ``split`` or
+  ``end_of_stream`` (dispatcher-owned epoch tracking: the shared queue
+  refills until ``num_epochs`` is exhausted)
+- ``status`` → full control-plane snapshot (workers, clients, queue depth)
+- ``ping`` → ``pong``
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+
+from petastorm_tpu.reader_impl.framed_socket import (
+    FramedServer,
+    recv_framed,
+    send_framed,
+)
+
+logger = logging.getLogger(__name__)
+
+MODES = ("static", "fcfs")
+
+
+class Dispatcher:
+    """Split-assignment server; start with :meth:`start`, stop with
+    :meth:`stop` (context manager supported)."""
+
+    def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if num_epochs is not None and num_epochs <= 0:
+            raise ValueError("num_epochs must be a positive integer or None")
+        self.mode = mode
+        self.num_epochs = num_epochs
+        self._lock = threading.Lock()
+        self._workers = {}   # worker_id -> {address, num_pieces, alive}
+        self._clients = {}   # client_id -> {epoch, client_index, num_clients}
+        self._num_pieces = None
+        # fcfs shared queue: lazily built once the piece count is known.
+        self._fcfs_queue = None
+        self._fcfs_epoch = 0
+        self._server = FramedServer(self._serve_connection, host=host,
+                                    port=port, name="service-dispatcher")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._server.start()
+        return self
+
+    @property
+    def address(self):
+        """``(host, port)`` clients and workers connect to."""
+        return self._server.address
+
+    def stop(self):
+        self._server.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+    # -- serving -----------------------------------------------------------
+
+    def _serve_connection(self, sock):
+        while not self._server.stopped.is_set():
+            header, _ = recv_framed(sock)
+            try:
+                reply = self._handle(header)
+            except Exception as exc:  # reply instead of killing the conn
+                logger.exception("dispatcher request %r failed", header)
+                reply = {"type": "error", "error": str(exc)}
+            send_framed(sock, reply)
+
+    def _handle(self, header):
+        kind = header.get("type")
+        handler = getattr(self, f"_handle_{kind}", None)
+        if handler is None:
+            return {"type": "error", "error": f"unknown request {kind!r}"}
+        return handler(header)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _handle_ping(self, header):
+        return {"type": "pong"}
+
+    def _handle_register_worker(self, header):
+        worker_id = header["worker_id"]
+        num_pieces = int(header["num_pieces"])
+        with self._lock:
+            if self._num_pieces is not None \
+                    and self._num_pieces != num_pieces:
+                return {"type": "error", "error": (
+                    f"worker {worker_id!r} enumerated {num_pieces} row-group "
+                    f"pieces but the service plan has {self._num_pieces} — "
+                    f"all workers must read the same dataset with the same "
+                    f"planning config")}
+            self._num_pieces = num_pieces
+            self._workers[worker_id] = {
+                "address": [header["host"], int(header["port"])],
+                "num_pieces": num_pieces,
+                "alive": True,
+            }
+        logger.info("worker %s registered at %s:%s (%d pieces)",
+                    worker_id, header["host"], header["port"], num_pieces)
+        return {"type": "ok"}
+
+    def _alive_workers(self):
+        return {wid: w for wid, w in self._workers.items() if w["alive"]}
+
+    def _handle_list_workers(self, header):
+        with self._lock:
+            return {
+                "type": "workers",
+                "workers": {wid: w["address"]
+                            for wid, w in self._alive_workers().items()},
+                "mode": self.mode,
+                "num_epochs": self.num_epochs,
+                "num_pieces": self._num_pieces,
+            }
+
+    @staticmethod
+    def _partition(pieces, worker_ids):
+        """Round-robin a piece list across workers; empty shares dropped."""
+        assignments = {wid: list(pieces[i::len(worker_ids)])
+                       for i, wid in enumerate(worker_ids)}
+        return {wid: ps for wid, ps in assignments.items() if ps}
+
+    def _handle_get_assignment(self, header):
+        if self.mode != "static":
+            return {"type": "error", "error":
+                    "get_assignment is a static-mode request; fcfs clients "
+                    "use next_split"}
+        client_index = int(header["client_index"])
+        num_clients = int(header["num_clients"])
+        if not 0 <= client_index < num_clients:
+            return {"type": "error", "error":
+                    f"client_index {client_index} out of range "
+                    f"[0, {num_clients})"}
+        with self._lock:
+            if self._num_pieces is None:
+                return {"type": "error",
+                        "error": "no workers have registered yet"}
+            alive = self._alive_workers()
+            if not alive:
+                return {"type": "error", "error": "no live workers"}
+            client_pieces = list(
+                range(self._num_pieces))[client_index::num_clients]
+            worker_ids = sorted(alive)
+            assignments = self._partition(client_pieces, worker_ids)
+            self._clients[header["client_id"]] = {
+                "epoch": int(header.get("epoch", 0)),
+                "client_index": client_index,
+                "num_clients": num_clients,
+            }
+            return {
+                "type": "assignment",
+                "epoch": int(header.get("epoch", 0)),
+                "assignments": assignments,
+                "workers": {wid: alive[wid]["address"]
+                            for wid in assignments},
+            }
+
+    def _handle_report_failure(self, header):
+        worker_id = header["worker_id"]
+        pieces = [int(p) for p in header.get("pieces", [])]
+        with self._lock:
+            if worker_id in self._workers:
+                self._workers[worker_id]["alive"] = False
+            alive = self._alive_workers()
+            if not alive:
+                return {"type": "error", "error": (
+                    f"worker {worker_id!r} reported dead and no live workers "
+                    f"remain — the service cannot make progress")}
+            worker_ids = sorted(alive)
+            assignments = self._partition(pieces, worker_ids)
+            logger.warning(
+                "worker %s reported failed by %s; reassigning %d pieces "
+                "across %d survivors", worker_id, header.get("client_id"),
+                len(pieces), len(worker_ids))
+            return {
+                "type": "assignment",
+                "assignments": assignments,
+                "workers": {wid: alive[wid]["address"]
+                            for wid in assignments},
+            }
+
+    def _handle_next_split(self, header):
+        if self.mode != "fcfs":
+            return {"type": "error", "error":
+                    "next_split is an fcfs-mode request; static clients use "
+                    "get_assignment"}
+        with self._lock:
+            if self._num_pieces is None:
+                return {"type": "error",
+                        "error": "no workers have registered yet"}
+            if self._fcfs_queue is None:
+                self._fcfs_queue = deque(range(self._num_pieces))
+            if not self._fcfs_queue:
+                # Epoch boundary: refill while epochs remain (None = forever).
+                if self.num_epochs is not None \
+                        and self._fcfs_epoch + 1 >= self.num_epochs:
+                    return {"type": "end_of_stream",
+                            "epochs_completed": self._fcfs_epoch + 1}
+                self._fcfs_epoch += 1
+                self._fcfs_queue.extend(range(self._num_pieces))
+            return {"type": "split",
+                    "piece": self._fcfs_queue.popleft(),
+                    "epoch": self._fcfs_epoch}
+
+    def _handle_status(self, header):
+        with self._lock:
+            return {
+                "type": "status",
+                "mode": self.mode,
+                "num_epochs": self.num_epochs,
+                "num_pieces": self._num_pieces,
+                "workers": {wid: {"address": w["address"],
+                                  "alive": w["alive"]}
+                            for wid, w in self._workers.items()},
+                "clients": {cid: dict(c) for cid, c in self._clients.items()},
+                "fcfs_epoch": self._fcfs_epoch,
+                "fcfs_remaining": (len(self._fcfs_queue)
+                                   if self._fcfs_queue is not None else None),
+            }

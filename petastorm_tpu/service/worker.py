@@ -1,0 +1,282 @@
+"""Batch workers: the data plane of the disaggregated data service.
+
+A worker wraps the ordinary single-process input pipeline — a
+``make_reader``-family Reader plus ``batch_iterator`` collation — and serves
+the resulting ready-to-stage numpy batch dicts over framed TCP. Each
+``stream`` request names an explicit set of row-group piece indices (the
+dispatcher's split plan), which the worker turns into a Reader via the
+reader layer's ``piece_indices=`` planning hook; the stream then carries one
+``batch`` message per collated batch and a final ``end`` message with the
+row total, all payload-encoded by the pool serializers
+(:mod:`petastorm_tpu.reader_impl.framed_socket`).
+
+Remote observability: a ``diagnostics`` request snapshots every active
+stream's ``Reader.diagnostics`` (and the final snapshot of recently finished
+streams), so a trainer-side client can root-cause a remote input stall the
+same way it would a local one (``docs/guides/diagnostics.md``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+
+from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
+    FramedServer,
+    recv_framed,
+    send_framed,
+)
+
+logger = logging.getLogger(__name__)
+
+_FACTORIES = ("row", "batch", "columnar")
+
+#: Final diagnostics snapshots kept for the ``diagnostics`` request.
+_COMPLETED_SNAPSHOTS_KEPT = 16
+
+
+def _resolve_factory(reader_factory):
+    if callable(reader_factory):
+        return reader_factory
+    from petastorm_tpu.reader.reader import (
+        make_batch_reader,
+        make_columnar_reader,
+        make_reader,
+    )
+
+    factories = {"row": make_reader, "batch": make_batch_reader,
+                 "columnar": make_columnar_reader}
+    if reader_factory not in factories:
+        raise ValueError(
+            f"reader_factory must be a callable or one of {_FACTORIES}, "
+            f"got {reader_factory!r}")
+    return factories[reader_factory]
+
+
+class BatchWorker:
+    """Serve collated batches of ``dataset_url`` over TCP.
+
+    :param dataset_url: the dataset every stream reads (workers in one
+        service must all point at the same dataset).
+    :param dispatcher_address: ``(host, port)`` to register with (optional —
+        a worker can be addressed directly in tests).
+    :param batch_size: rows per collated batch. The last batch of a stream
+        is ragged (``last_batch="keep"``): the service must not drop rows —
+        equal-step SPMD shaping stays the trainer-side loader's concern.
+    :param reader_factory: ``"row"`` (make_reader), ``"batch"``
+        (make_batch_reader), ``"columnar"`` (make_columnar_reader), or any
+        callable with the same signature.
+    :param reader_kwargs: extra kwargs for the factory (``workers_count``,
+        ``reader_pool_type``, ``filters``, ...). ``piece_indices``,
+        ``num_epochs`` and ``shuffle_row_groups`` are owned by the stream
+        protocol.
+    """
+
+    def __init__(self, dataset_url, dispatcher_address=None,
+                 host="127.0.0.1", port=0, batch_size=64,
+                 reader_factory="row", reader_kwargs=None, worker_id=None,
+                 register_retries=5, register_backoff=0.2):
+        self.dataset_url = dataset_url
+        self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self._dispatcher_address = (tuple(dispatcher_address)
+                                    if dispatcher_address else None)
+        self._batch_size = batch_size
+        self._factory = _resolve_factory(reader_factory)
+        self._reader_kwargs = dict(reader_kwargs or {})
+        # piece_indices/num_epochs/shuffle_row_groups belong to the stream
+        # protocol; rowgroup_selector and cur_shard/shard_count/shard_seed
+        # would change (selector) or silently re-shard (sharding) the piece
+        # universe the dispatcher's plan is denominated in — sample loss or
+        # out-of-range splits. Split planning is the dispatcher's job.
+        for owned in ("piece_indices", "num_epochs", "shuffle_row_groups",
+                      "rowgroup_selector", "cur_shard", "shard_count",
+                      "shard_seed"):
+            if owned in self._reader_kwargs:
+                raise ValueError(
+                    f"reader_kwargs[{owned!r}] is owned by the service's "
+                    f"split protocol (the dispatcher plans row-group "
+                    f"assignment), not worker construction")
+        self._register_retries = register_retries
+        self._register_backoff = register_backoff
+        self.num_pieces = None
+        self._lock = threading.Lock()
+        self._active = {}            # stream key -> Reader
+        self._completed = {}         # stream key -> final diagnostics dict
+        self._server = FramedServer(self._serve_connection, host=host,
+                                    port=port,
+                                    name=f"service-worker-{self.worker_id}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self.num_pieces = self._count_pieces()
+        self._server.start()
+        if self._dispatcher_address is not None:
+            self._register()
+        return self
+
+    @property
+    def address(self):
+        return self._server.address
+
+    def stop(self):
+        """Graceful teardown: stop accepting, stop active readers, and
+        close open connections so handler threads blocked in ``recv`` exit
+        (they would otherwise pin a thread + fd per idle client forever)."""
+        self._server.stopped.set()
+        with self._lock:
+            readers = list(self._active.values())
+        for reader in readers:
+            try:
+                reader.stop()
+            except Exception:
+                pass
+        self._server.stop()
+
+    def kill(self):
+        """Abrupt failure injection (tests): drop every open connection
+        without sending ``end``, then tear down — clients see a mid-stream
+        :class:`ConnectionClosedError`, exactly like a worker host dying."""
+        self._server.stopped.set()
+        self._server.close_connections()
+        self.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+    # -- registration / planning ------------------------------------------
+
+    def _count_pieces(self):
+        """Enumerate the dataset's row-group pieces with the same planning
+        config every stream reader will use — the count the dispatcher's
+        split plan is denominated in."""
+        from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
+        from petastorm_tpu.reader.reader import enumerate_row_group_pieces
+
+        fs, path = get_filesystem_and_path_or_paths(
+            self.dataset_url,
+            storage_options=self._reader_kwargs.get("storage_options"),
+            filesystem=self._reader_kwargs.get("filesystem"))
+        return len(enumerate_row_group_pieces(
+            fs, path, self._reader_kwargs.get("filters")))
+
+    def _register(self):
+        from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+        from petastorm_tpu.utils import retry_with_backoff
+
+        host, port = self.address
+
+        def attempt():
+            with FramedConnection.connect(self._dispatcher_address,
+                                          timeout=10.0) as conn:
+                reply, _ = conn.request({
+                    "type": "register_worker",
+                    "worker_id": self.worker_id,
+                    "host": host,
+                    "port": port,
+                    "num_pieces": self.num_pieces,
+                })
+            if reply.get("type") != "ok":
+                raise RuntimeError(
+                    f"dispatcher rejected registration: "
+                    f"{reply.get('error', reply)}")
+            return reply
+
+        retry_with_backoff(
+            attempt, retries=self._register_retries,
+            base_delay=self._register_backoff,
+            retry_on=(OSError,),
+            description=f"worker {self.worker_id} registration")
+
+    # -- serving -----------------------------------------------------------
+
+    def _serve_connection(self, sock):
+        while not self._server.stopped.is_set():
+            header, _ = recv_framed(sock)
+            kind = header.get("type")
+            if kind == "stream":
+                self._stream(sock, header)
+            elif kind == "diagnostics":
+                send_framed(sock, {"type": "diagnostics",
+                                   "worker_id": self.worker_id},
+                            self.diagnostics_snapshot())
+            elif kind == "ping":
+                send_framed(sock, {"type": "pong",
+                                   "worker_id": self.worker_id})
+            else:
+                send_framed(sock, {"type": "error",
+                                   "error": f"unknown request {kind!r}"})
+
+    def _stream(self, sock, header):
+        """Serve one ``stream`` request: batches of the named pieces, then
+        ``end``. A reader/collation error becomes an ``error`` message (the
+        client re-raises it — a bad plan is not a transient failure)."""
+        from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+        pieces = [int(p) for p in header["pieces"]]
+        stream_key = f"{uuid.uuid4().hex[:8]}"
+        reader = None
+        rows_sent = 0
+        try:
+            # cur_shard=0/shard_count=1 pins sharding OFF: the factory
+            # defaults would silently fill jax.process_index()/count() on a
+            # host with multi-process JAX initialized, dropping (N-1)/N of
+            # the assigned pieces AFTER piece_indices selection — the
+            # dispatcher's plan is the only sharding a worker applies.
+            reader = self._factory(self.dataset_url, piece_indices=pieces,
+                                   num_epochs=1, shuffle_row_groups=False,
+                                   cur_shard=0, shard_count=1,
+                                   **self._reader_kwargs)
+            with self._lock:
+                self._active[stream_key] = reader
+            for batch in batch_iterator(reader, self._batch_size,
+                                        last_batch="keep"):
+                if self._server.stopped.is_set():
+                    return
+                n = self._batch_rows(batch)
+                send_framed(sock, {"type": "batch", "rows": n}, batch)
+                rows_sent += n
+            send_framed(sock, {"type": "end", "rows": rows_sent,
+                               "pieces": pieces})
+        except (ConnectionClosedError, OSError):
+            raise  # client hung up — nothing to tell it
+        except Exception as exc:
+            logger.exception("stream %s over pieces %s failed",
+                             stream_key, pieces)
+            send_framed(sock, {"type": "error", "error": str(exc)})
+        finally:
+            with self._lock:
+                self._active.pop(stream_key, None)
+                if reader is not None:
+                    self._completed[stream_key] = dict(reader.diagnostics)
+                    while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
+                        self._completed.pop(next(iter(self._completed)))
+            if reader is not None:
+                reader.stop()
+                reader.join()
+
+    @staticmethod
+    def _batch_rows(batch):
+        for value in batch.values():
+            return int(len(value))
+        return 0
+
+    def diagnostics_snapshot(self):
+        """``Reader.diagnostics`` of every active stream plus the final
+        snapshot of recently finished ones — what a remote client sees."""
+        with self._lock:
+            active = {key: dict(reader.diagnostics)
+                      for key, reader in self._active.items()}
+            completed = {key: dict(diag)
+                         for key, diag in self._completed.items()}
+        return {
+            "worker_id": self.worker_id,
+            "num_pieces": self.num_pieces,
+            "active_streams": active,
+            "completed_streams": completed,
+        }

@@ -107,6 +107,68 @@ def decode_row(row, schema):
     return decoded_row
 
 
+def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
+                       jitter=0.5, retry_on=(Exception,), no_retry_on=(),
+                       description=None, sleep=None, rng=None):
+    """Call ``fn()`` with bounded retries, exponential backoff and jitter.
+
+    The shared transient-failure policy for network-facing control paths:
+    the GCS listing sweep (one flaky ``objects.list`` page must not abort
+    reader construction for a whole pod) and the data-service client's
+    dispatcher/worker reconnects both route through here so the backoff
+    shape is tuned in one place.
+
+    :param retries: additional attempts after the first (``retries=3`` ⇒ up
+        to 4 calls). The final failure re-raises the original exception.
+    :param base_delay: delay before the first retry; doubles per attempt.
+    :param max_delay: cap on the exponential delay (pre-jitter).
+    :param jitter: each delay is scaled by ``1 + uniform(0, jitter)`` so a
+        pod's worth of hosts retrying the same outage don't re-stampede in
+        lockstep.
+    :param retry_on: exception types worth retrying (transient).
+    :param no_retry_on: exception types that fail immediately even when they
+        match ``retry_on`` (e.g. ``FileNotFoundError`` — a missing dataset
+        never becomes present by waiting).
+    :param description: label for the retry warning log line.
+    :param sleep: injection point for tests (default ``time.sleep``).
+    :param rng: injection point for tests (default module-level ``random``).
+    """
+    import logging
+    import time
+
+    sleep = sleep if sleep is not None else time.sleep
+    delays = backoff_delays(retries, base_delay, max_delay, jitter=jitter,
+                            rng=rng)
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except no_retry_on:
+            raise
+        except retry_on as exc:
+            if attempt == retries:
+                raise
+            delay = next(delays)
+            logging.getLogger(__name__).warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                description or getattr(fn, "__name__", "call"),
+                attempt + 1, retries + 1, exc, delay)
+            sleep(delay)
+
+
+def backoff_delays(retries, base_delay, max_delay, jitter=0.5, rng=None):
+    """The delay schedule :func:`retry_with_backoff` sleeps on, as a
+    generator — for call sites that cannot wrap the retried body in a
+    closure (e.g. a generator that must keep yielding between attempts,
+    like the service client's fcfs split streaming). One policy, two entry
+    points."""
+    import random
+
+    rng = rng if rng is not None else random
+    for attempt in range(retries):
+        delay = min(max_delay, base_delay * (2 ** attempt))
+        yield delay * (1.0 + jitter * rng.random())
+
+
 def run_in_subprocess(func, *args, **kwargs):
     """Run ``func(*args, **kwargs)`` in a fresh child process and return its
     result.

@@ -58,4 +58,4 @@ def test_documented_apis_exist():
     from petastorm_tpu.benchmark.scenarios import SCENARIOS
 
     assert set(SCENARIOS) == {"tabular", "ngram", "image", "weighted",
-                              "converter_mixing", "packed"}
+                              "converter_mixing", "packed", "service"}

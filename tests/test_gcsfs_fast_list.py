@@ -334,3 +334,75 @@ def test_multi_url_gs_list_skips_fast_listing(gs_registered):
     except Exception:
         pass  # default gs resolution may be unavailable here
     assert all(f.find_calls == 0 for f in LocalBackedGCSFake.instances)
+
+
+# ---------------------------------------------------------------------------
+# transient-failure retry (satellite of the data-service PR: one flaky
+# listing page must not abort reader construction for a whole pod)
+# ---------------------------------------------------------------------------
+
+class FlakyGCSFileSystem(FakeGCSFileSystem):
+    """Fails the first ``fail_times`` find() sweeps with ``error``."""
+
+    def __init__(self, keys, fail_times=1, error=None):
+        super().__init__(keys)
+        self._fail_times = fail_times
+        self._error = error or OSError("503 backend unavailable")
+
+    def find(self, path, detail=False):
+        if self.find_calls < self._fail_times:
+            self.find_calls += 1
+            raise self._error
+        return super().find(path, detail=detail)
+
+
+def test_fast_list_retries_transient_failures(monkeypatch):
+    import time as _time
+
+    slept = []
+    monkeypatch.setattr(_time, "sleep", slept.append)
+    fs = FlakyGCSFileSystem(DATASET_KEYS, fail_times=2)
+    paths = fast_list("gs://bucket/ds", filesystem=fs, retries=3,
+                      retry_base_delay=0.25)
+    assert paths == sorted(DATASET_KEYS)
+    assert fs.find_calls == 3          # 2 failures + 1 success
+    assert len(slept) == 2
+    # Exponential backoff with jitter: base, then doubled, each within
+    # [delay, delay * 1.5).
+    assert 0.25 <= slept[0] < 0.375
+    assert 0.5 <= slept[1] < 0.75
+
+
+def test_fast_list_retry_budget_is_bounded(monkeypatch):
+    import time as _time
+
+    monkeypatch.setattr(_time, "sleep", lambda _s: None)
+    fs = FlakyGCSFileSystem(DATASET_KEYS, fail_times=99)
+    with pytest.raises(OSError, match="503"):
+        fast_list("gs://bucket/ds", filesystem=fs, retries=2)
+    assert fs.find_calls == 3          # initial call + 2 retries, no more
+
+
+def test_fast_list_does_not_retry_missing_dataset():
+    fs = FlakyGCSFileSystem(DATASET_KEYS, fail_times=99,
+                            error=FileNotFoundError("bucket/nope"))
+    with pytest.raises(FileNotFoundError):
+        fast_list("gs://bucket/nope", filesystem=fs, retries=5)
+    assert fs.find_calls == 1          # permanent error: no retry
+
+
+def test_retry_with_backoff_is_shared_with_the_service_client():
+    """The factored helper is the exact policy the service client reuses."""
+    from petastorm_tpu.utils import retry_with_backoff
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("worker not up yet")
+        return "ok"
+
+    assert retry_with_backoff(flaky, retries=4, base_delay=0,
+                              sleep=lambda _s: None) == "ok"
+    assert len(calls) == 3

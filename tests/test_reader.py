@@ -332,3 +332,54 @@ def test_process_pool_batch_reader_arrow_ipc(scalar_dataset):
                            workers_count=2) as reader:
         ids = _collect_batch_ids(reader)
     assert sorted(ids) == list(range(30))
+
+
+# ---------------------------------------------------------------------------
+# explicit split plans (piece_indices — the data service's planning hook)
+# ---------------------------------------------------------------------------
+
+def test_piece_indices_selects_row_groups(petastorm_dataset):
+    # 30 rows in 3 row groups of 10: piece k holds ids [10k, 10k+10).
+    with make_reader(petastorm_dataset.url, num_epochs=1,
+                     shuffle_row_groups=False, piece_indices=[0, 2]) as reader:
+        ids = sorted(_collect_ids(reader))
+    assert ids == list(range(10)) + list(range(20, 30))
+
+
+def test_piece_indices_validates_range(petastorm_dataset):
+    with pytest.raises(ValueError, match="out of range"):
+        make_reader(petastorm_dataset.url, piece_indices=[0, 7])
+
+
+def test_piece_indices_partition_is_disjoint_and_complete(petastorm_dataset):
+    """Readers over a partition of piece indices jointly see every row
+    exactly once — the invariant the service's dispatcher relies on."""
+    ids = []
+    for plan in ([0], [1], [2]):
+        with make_reader(petastorm_dataset.url, num_epochs=1,
+                         shuffle_row_groups=False,
+                         piece_indices=plan) as reader:
+            ids.extend(_collect_ids(reader))
+    assert sorted(ids) == list(range(30))
+
+
+def test_piece_indices_batch_reader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           shuffle_row_groups=False,
+                           piece_indices=[1]) as reader:
+        ids = sorted(_collect_batch_ids(reader))
+    assert ids == list(range(10, 20))
+
+
+def test_piece_indices_are_part_of_resume_fingerprint(petastorm_dataset):
+    with make_reader(petastorm_dataset.url, num_epochs=1,
+                     shuffle_row_groups=False, piece_indices=[0]) as reader:
+        list(reader)
+        state = reader.state_dict()
+    # Same plan resumes; a different plan must be rejected.
+    make_reader(petastorm_dataset.url, num_epochs=1, shuffle_row_groups=False,
+                piece_indices=[0], resume_state=state).stop()
+    with pytest.raises(ValueError, match="resume_state mismatch"):
+        make_reader(petastorm_dataset.url, num_epochs=1,
+                    shuffle_row_groups=False, piece_indices=[0, 1],
+                    resume_state=state)

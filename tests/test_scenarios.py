@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from petastorm_tpu.benchmark.cli import main
 from petastorm_tpu.benchmark.scenarios import (
     image_pipeline_scenario,
@@ -79,3 +81,22 @@ def test_packed_delivery_scenario_beats_padded_utilization():
                                       slots=4)
     assert result["batches"] > 0 and result["tokens_per_sec"] > 0
     assert result["packed_utilization"] > result["padded_utilization"]
+
+
+def test_service_scenario_streams_through_loopback_fleet():
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=2000, days=4, workers=2,
+                                       batch_size=128)
+    assert result["scenario"] == "service_loopback"
+    assert result["rows"] == 2000
+    assert result["workers"] == 2
+    assert result["service_rows_per_sec"] > 0
+    assert result["local_rows_per_sec"] > 0
+    assert 0 <= result["loader_input_stall_pct"] <= 100
+
+
+def test_scenario_cli_rejects_knobs_the_scenario_lacks(capsys):
+    with pytest.raises(SystemExit):
+        main(["scenario", "ngram", "--batch-size", "64"])
+    assert "not a knob" in capsys.readouterr().err

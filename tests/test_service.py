@@ -1,0 +1,610 @@
+"""Disaggregated data service tests — all over 127.0.0.1.
+
+Layers under test (docs/guides/service.md):
+
+- the framed-socket codec (``reader_impl/framed_socket.py``) — pure wire
+  format, exercised over a socketpair;
+- the dispatcher's split planning (static per-client sharding, fcfs queue,
+  epoch tracking, failure re-assignment) — driven through the real protocol;
+- the loopback end-to-end path (ISSUE acceptance): dispatcher + 2 workers +
+  1 client streaming through ``JaxDataLoader`` yields the same multiset of
+  samples as a local ``make_reader`` of the same dataset;
+- worker-failure handling: a fast in-process kill smoke test (tier-1) and a
+  real-subprocess kill mid-epoch (``slow``) — both assert no sample loss
+  under static sharding.
+"""
+
+import multiprocessing
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
+    FramedConnection,
+    recv_framed,
+    send_framed,
+)
+from petastorm_tpu.service import (
+    BatchWorker,
+    Dispatcher,
+    ServiceBatchSource,
+    ServiceError,
+)
+
+pytestmark = pytest.mark.service
+
+
+# ---------------------------------------------------------------------------
+# framed-socket codec
+# ---------------------------------------------------------------------------
+
+def _socketpair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def test_framed_roundtrip_pickle_payload():
+    a, b = _socketpair()
+    batch = {"id": np.arange(5), "x": np.random.rand(5, 3).astype(np.float32),
+             "s": np.array(["a", "bb", "ccc", "d", "e"], dtype=object)}
+    send_framed(a, {"type": "batch", "rows": 5}, batch)
+    header, payload = recv_framed(b)
+    assert header == {"type": "batch", "rows": 5}
+    np.testing.assert_array_equal(payload["id"], batch["id"])
+    np.testing.assert_array_equal(payload["x"], batch["x"])
+    assert list(payload["s"]) == ["a", "bb", "ccc", "d", "e"]
+    a.close(), b.close()
+
+
+def test_framed_roundtrip_arrow_table_payload():
+    import pyarrow as pa
+
+    a, b = _socketpair()
+    table = pa.table({"c": [1, 2, 3], "d": ["x", "y", "z"]})
+    send_framed(a, {"type": "batch"}, table)
+    _, payload = recv_framed(b)
+    assert isinstance(payload, pa.Table)
+    assert payload.equals(table)
+    a.close(), b.close()
+
+
+def test_framed_none_payload_and_multiple_messages():
+    a, b = _socketpair()
+    send_framed(a, {"type": "ping"})
+    send_framed(a, {"type": "ping", "n": 2})
+    assert recv_framed(b) == ({"type": "ping"}, None)
+    assert recv_framed(b) == ({"type": "ping", "n": 2}, None)
+    a.close(), b.close()
+
+
+def test_framed_peer_close_raises_connection_closed():
+    a, b = _socketpair()
+    a.close()
+    with pytest.raises(ConnectionClosedError):
+        recv_framed(b)
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher control plane (driven through the real protocol)
+# ---------------------------------------------------------------------------
+
+def _register(dispatcher, worker_id, num_pieces, port=1):
+    with FramedConnection.connect(dispatcher.address) as conn:
+        reply, _ = conn.request({
+            "type": "register_worker", "worker_id": worker_id,
+            "host": "127.0.0.1", "port": port, "num_pieces": num_pieces})
+    return reply
+
+
+def _request(dispatcher, header):
+    with FramedConnection.connect(dispatcher.address) as conn:
+        reply, _ = conn.request(header)
+    return reply
+
+
+def test_dispatcher_static_assignment_is_disjoint_and_complete():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        assert _register(disp, "w0", 10)["type"] == "ok"
+        assert _register(disp, "w1", 10)["type"] == "ok"
+        reply = _request(disp, {"type": "get_assignment", "client_id": "c",
+                                "client_index": 0, "num_clients": 1,
+                                "epoch": 0})
+        assert reply["type"] == "assignment"
+        pieces = sorted(p for ps in reply["assignments"].values() for p in ps)
+        assert pieces == list(range(10))  # complete, no overlap
+        assert len(reply["assignments"]) == 2  # both workers used
+
+
+def test_dispatcher_static_shards_per_client():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 9)
+        shards = []
+        for index in range(3):
+            reply = _request(disp, {
+                "type": "get_assignment", "client_id": f"c{index}",
+                "client_index": index, "num_clients": 3, "epoch": 0})
+            shards.append(sorted(
+                p for ps in reply["assignments"].values() for p in ps))
+        assert shards == [[0, 3, 6], [1, 4, 7], [2, 5, 8]]
+
+
+def test_dispatcher_reassigns_dead_workers_pieces_to_survivors():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 6)
+        _register(disp, "w1", 6)
+        reply = _request(disp, {"type": "report_failure", "client_id": "c",
+                                "worker_id": "w1", "pieces": [1, 3, 5]})
+        assert reply["type"] == "assignment"
+        assert reply["assignments"] == {"w0": [1, 3, 5]}
+        # A dead worker stops being listed and assigned.
+        listed = _request(disp, {"type": "list_workers"})
+        assert sorted(listed["workers"]) == ["w0"]
+        # Killing the last worker leaves the service unable to progress.
+        reply = _request(disp, {"type": "report_failure", "client_id": "c",
+                                "worker_id": "w0", "pieces": [0]})
+        assert reply["type"] == "error"
+
+
+def test_dispatcher_rejects_mismatched_piece_counts():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 6)
+        reply = _register(disp, "w1", 7)
+        assert reply["type"] == "error"
+        assert "6" in reply["error"] and "7" in reply["error"]
+
+
+def test_dispatcher_fcfs_queue_and_epoch_refill():
+    with Dispatcher(port=0, mode="fcfs", num_epochs=2).start() as disp:
+        _register(disp, "w0", 3)
+        seen = []
+        while True:
+            reply = _request(disp, {"type": "next_split", "client_id": "c"})
+            if reply["type"] == "end_of_stream":
+                assert reply["epochs_completed"] == 2
+                break
+            seen.append((reply["epoch"], reply["piece"]))
+        # Two full epochs, each covering every piece exactly once.
+        assert [p for e, p in seen if e == 0] == [0, 1, 2]
+        assert [p for e, p in seen if e == 1] == [0, 1, 2]
+
+
+def test_dispatcher_mode_mismatch_and_unknown_requests_error():
+    with Dispatcher(port=0, mode="fcfs", num_epochs=1).start() as disp:
+        _register(disp, "w0", 3)
+        assert _request(disp, {"type": "get_assignment", "client_id": "c",
+                               "client_index": 0, "num_clients": 1,
+                               "epoch": 0})["type"] == "error"
+        assert _request(disp, {"type": "bogus"})["type"] == "error"
+        status = _request(disp, {"type": "status"})
+        assert status["mode"] == "fcfs"
+        assert status["num_pieces"] == 3
+
+
+# ---------------------------------------------------------------------------
+# loopback end-to-end (the ISSUE acceptance path)
+# ---------------------------------------------------------------------------
+
+def _local_ids(url, **kwargs):
+    from petastorm_tpu import make_reader
+
+    with make_reader(url, num_epochs=1, shuffle_row_groups=False,
+                     workers_count=2, **kwargs) as reader:
+        return sorted(int(row.id) for row in reader)
+
+
+def _service_fleet(url, mode="static", num_epochs=1, n_workers=2,
+                   batch_size=7, reader_factory="row"):
+    dispatcher = Dispatcher(port=0, mode=mode, num_epochs=num_epochs).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=batch_size, reader_factory=reader_factory,
+                    worker_id=f"w{i}",
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(n_workers)]
+    return dispatcher, workers
+
+
+def test_loopback_static_matches_local_reader(petastorm_dataset):
+    """Dispatcher + 2 workers + 1 client over 127.0.0.1 yields the same
+    multiset of samples as a local make_reader (order-independent)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        got = []
+        with loader:
+            for batch in loader:
+                got.extend(int(i) for i in batch["id"])
+        assert sorted(got) == _local_ids(petastorm_dataset.url)
+        assert loader.diagnostics["rows"] == len(got)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_loopback_fcfs_matches_local_reader(petastorm_dataset):
+    dispatcher, workers = _service_fleet(petastorm_dataset.url, mode="fcfs")
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == _local_ids(petastorm_dataset.url)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_loopback_two_static_clients_split_the_dataset(petastorm_dataset):
+    """Two clients with disjoint static shards cover the dataset exactly."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        ids = []
+        for index in range(2):
+            source = ServiceBatchSource(dispatcher.address,
+                                        client_index=index, num_clients=2)
+            ids.append(sorted(
+                int(i) for batch in source() for i in batch["id"]))
+        assert not set(ids[0]) & set(ids[1])
+        assert sorted(ids[0] + ids[1]) == _local_ids(petastorm_dataset.url)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_loopback_multi_epoch_static(petastorm_dataset):
+    dispatcher, workers = _service_fleet(petastorm_dataset.url, num_epochs=2)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == sorted(_local_ids(petastorm_dataset.url) * 2)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_remote_diagnostics_surface_reader_snapshots(petastorm_dataset):
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        for _ in source():
+            pass
+        diag = source.remote_diagnostics()
+        assert sorted(diag) == ["w0", "w1"]
+        for snapshot in diag.values():
+            assert snapshot["num_pieces"] == 3
+            # Streams finished: their final Reader.diagnostics are retained.
+            assert snapshot["completed_streams"]
+            finished = next(iter(snapshot["completed_streams"].values()))
+            assert "rowgroups_total" in finished
+        status = source.dispatcher_status()
+        assert status["type"] == "status"
+        assert sorted(status["workers"]) == ["w0", "w1"]
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_loader_state_dict_delegates_to_service_source(petastorm_dataset):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        with loader:
+            for _ in loader:
+                pass
+        state = loader.state_dict()
+        assert state["mode"] == "static"
+        assert state["epoch"] == 1  # the epoch now in progress
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_loader_state_dict_still_raises_without_source_support():
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    loader = JaxDataLoader(None, 4, batch_source=lambda: iter(()),
+                           stage_to_device=False)
+    with pytest.raises(ValueError, match="batch_source"):
+        loader.state_dict()
+
+
+def test_resume_skips_completed_pieces(petastorm_dataset):
+    """A snapshot naming completed pieces resumes without re-reading them."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        # Dataset has 3 row groups of 10 rows; claim piece 0 completed.
+        state = {"version": 1, "mode": "static", "client_index": 0,
+                 "num_clients": 1, "epoch": 0, "completed_pieces": [0]}
+        source = ServiceBatchSource(dispatcher.address, resume_state=state)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        expected = [i for i in _local_ids(petastorm_dataset.url) if i >= 10]
+        assert sorted(got) == expected
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_resume_state_validation():
+    state = {"version": 1, "mode": "static", "client_index": 1,
+             "num_clients": 2, "epoch": 0, "completed_pieces": []}
+    with pytest.raises(ValueError, match="client_index"):
+        ServiceBatchSource(("127.0.0.1", 1), client_index=0, num_clients=2,
+                           resume_state=state)
+    with pytest.raises(ValueError, match="version"):
+        ServiceBatchSource(("127.0.0.1", 1),
+                           resume_state={"version": 9, "mode": "static"})
+
+
+def test_fcfs_state_dict_raises(petastorm_dataset):
+    dispatcher, workers = _service_fleet(petastorm_dataset.url, mode="fcfs")
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        for _ in source():
+            break
+        with pytest.raises(ValueError, match="fcfs"):
+            source.state_dict()
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# worker failure (fast in-process smoke — tier-1)
+# ---------------------------------------------------------------------------
+
+def test_worker_kill_mid_epoch_loses_no_samples(tmp_path):
+    """Kill one of two workers after the first batches flow; the client
+    reconnects, reports the failure, and the dispatcher's re-assignment
+    finishes the epoch with every sample delivered (duplicates allowed —
+    at-least-once)."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=60,
+                                      rows_per_row_group=5)  # 12 row groups
+    dispatcher, workers = _service_fleet(url, batch_size=4,
+                                         reader_factory="batch")
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=2,
+                                    backoff_base=0.02, backoff_max=0.1)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 8:
+                workers[1].kill()
+                killed = True
+        assert killed, "dataset too small to kill mid-epoch"
+        assert set(int(r["id"]) for r in rows) <= set(got)  # no sample loss
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_error_streams_surface_as_service_error(petastorm_dataset):
+    """A deterministic worker-side failure (bad piece plan) is an error
+    reply, not a reconnect loop."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        with FramedConnection.connect(workers[0].address) as conn:
+            conn.send({"type": "stream", "pieces": [99], "epoch": 0})
+            header, _ = conn.recv()
+        assert header["type"] == "error"
+        assert "99" in header["error"]
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# worker failure (real subprocess kill — slow)
+# ---------------------------------------------------------------------------
+
+def _run_worker_process(dataset_url, dispatcher_address, worker_id):
+    """Child-process entry: serve until killed."""
+    worker = BatchWorker(dataset_url, dispatcher_address=dispatcher_address,
+                         batch_size=4, reader_factory="batch",
+                         worker_id=worker_id,
+                         reader_kwargs={"workers_count": 2})
+    worker.start()
+    threading.Event().wait()  # until SIGKILL
+
+
+@pytest.mark.slow
+def test_subprocess_worker_sigkill_mid_epoch_loses_no_samples(tmp_path):
+    """Fault injection with a real process death (SIGKILL, no FIN handshake
+    from the worker's streams beyond what the kernel sends): the epoch still
+    completes with no sample loss under static sharding."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)  # 24 row groups
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    local = BatchWorker(url, dispatcher_address=dispatcher.address,
+                        batch_size=4, reader_factory="batch",
+                        worker_id="local",
+                        reader_kwargs={"workers_count": 2}).start()
+    ctx = multiprocessing.get_context("spawn")
+    child = ctx.Process(target=_run_worker_process,
+                        args=(url, dispatcher.address, "child"), daemon=True)
+    child.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with FramedConnection.connect(dispatcher.address) as conn:
+                reply, _ = conn.request({"type": "list_workers"})
+            if sorted(reply["workers"]) == ["child", "local"]:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("child worker never registered")
+
+        source = ServiceBatchSource(dispatcher.address, max_retries=2,
+                                    backoff_base=0.02, backoff_max=0.2)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 12:
+                child.kill()
+                killed = True
+        assert killed
+        assert set(int(r["id"]) for r in rows) <= set(got)
+    finally:
+        child.kill()
+        child.join(timeout=10)
+        local.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_service_cli_parse_address():
+    from petastorm_tpu.service.cli import parse_address
+
+    assert parse_address("10.0.0.1:7077") == ("10.0.0.1", 7077)
+    assert parse_address("7077") == ("127.0.0.1", 7077)
+
+
+def test_service_cli_runs_dispatcher_and_worker(petastorm_dataset, capsys):
+    import json
+
+    from petastorm_tpu.service.cli import main
+
+    ready = {}
+
+    def run_dispatcher():
+        main(["dispatcher", "--port", "0", "--mode", "static"],
+             run_seconds=8)
+
+    disp_thread = threading.Thread(target=run_dispatcher, daemon=True)
+    disp_thread.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "port" not in ready:
+        out = capsys.readouterr().out
+        for line in out.splitlines():
+            if line.startswith("{"):
+                ready.update(json.loads(line))
+        time.sleep(0.05)
+    assert ready.get("role") == "dispatcher"
+
+    worker_thread = threading.Thread(
+        target=lambda: main(
+            ["worker", "--dispatcher", f"127.0.0.1:{ready['port']}",
+             "--dataset-url", petastorm_dataset.url, "--batch-size", "7",
+             "--workers-count", "2"],
+            run_seconds=8),
+        daemon=True)
+    worker_thread.start()
+
+    source = ServiceBatchSource(("127.0.0.1", ready["port"]), max_retries=8,
+                                backoff_base=0.1, backoff_max=0.5)
+
+    # The worker registers asynchronously; retry until the fleet serves.
+    deadline = time.monotonic() + 8
+    got = []
+    while time.monotonic() < deadline:
+        try:
+            got = [int(i) for batch in source() for i in batch["id"]]
+            if got:
+                break
+        except ServiceError:
+            time.sleep(0.2)
+    assert sorted(got) == _local_ids(petastorm_dataset.url)
+
+
+def test_state_dict_respects_consumer_yield_position(petastorm_dataset):
+    """Completion is computed relative to what the consumer actually
+    yielded: batches still in a prefetch queue keep their pieces
+    un-completed, so a resume re-reads them (at-least-once, never loss)."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        total_batches = sum(1 for _ in source())
+        all_pieces = {0, 1, 2}
+        # Nothing yielded yet → nothing completed, epoch still 0.
+        s0 = source.state_dict(yielded_batches=0)
+        assert (s0["epoch"], s0["completed_pieces"]) == (0, [])
+        # One batch short of everything → at most a strict subset completed.
+        s_mid = source.state_dict(yielded_batches=total_batches - 1)
+        assert s_mid["epoch"] == 0
+        assert set(s_mid["completed_pieces"]) < all_pieces
+        # Everything yielded → the epoch is done; next epoch, clean slate.
+        s_end = source.state_dict(yielded_batches=total_batches)
+        assert (s_end["epoch"], s_end["completed_pieces"]) == (1, [])
+        # Default (no consumer info) equals the fully-yielded snapshot —
+        # exact for direct iteration, where produced == consumed.
+        assert source.state_dict() == s_end
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_worker_rejects_split_planning_reader_kwargs(petastorm_dataset):
+    """Sharding/selector kwargs would silently disagree with the
+    dispatcher's piece universe — rejected at construction."""
+    for bad in ({"cur_shard": 0, "shard_count": 2},
+                {"rowgroup_selector": object()},
+                {"piece_indices": [0]}):
+        with pytest.raises(ValueError, match="split protocol"):
+            BatchWorker(petastorm_dataset.url, reader_kwargs=bad)
+
+
+def test_fcfs_worker_kill_loses_no_samples(tmp_path):
+    """fcfs failure path: retry the worker with backoff, then flag it and
+    serve the split from a surviving worker — no sample loss."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=60,
+                                      rows_per_row_group=5)
+    dispatcher, workers = _service_fleet(url, mode="fcfs", batch_size=4,
+                                         reader_factory="batch")
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=1,
+                                    backoff_base=0.02, backoff_max=0.05)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 8:
+                workers[0].kill()
+                killed = True
+        assert killed
+        assert set(int(r["id"]) for r in rows) <= set(got)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
