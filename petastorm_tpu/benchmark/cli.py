@@ -78,6 +78,16 @@ def _scenario_main(argv):
     parser.add_argument("--mode", default=None,
                         choices=["static", "fcfs"],
                         help="service scenario sharding mode")
+    parser.add_argument("--skew-ms", type=float, default=None,
+                        help="service scenario fault injection: delay one "
+                             "worker this many ms per batch (head-of-line "
+                             "demonstration)")
+    parser.add_argument("--credits", type=int, default=None,
+                        help="service scenario per-worker flow-control "
+                             "window (un-acked batches in flight)")
+    parser.add_argument("--json-out", default=None,
+                        help="also append the result as one JSON line to "
+                             "this file (BENCH-style perf trajectory)")
     args = parser.parse_args(argv)
 
     scenario = SCENARIOS[args.name]
@@ -86,7 +96,10 @@ def _scenario_main(argv):
     # (argparse exposes one surface; each scenario keeps its own defaults).
     accepted = set(inspect.signature(scenario).parameters)
     for name, value in (("batch_size", args.batch_size),
-                        ("mode", args.mode)):
+                        ("mode", args.mode),
+                        ("skew_ms", args.skew_ms),
+                        ("credits", args.credits),
+                        ("json_out", args.json_out)):
         if value is not None:
             if name not in accepted:
                 parser.error(f"--{name.replace('_', '-')} is not a knob of "

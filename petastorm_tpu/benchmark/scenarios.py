@@ -528,7 +528,8 @@ def packed_delivery_scenario(dataset_url=None, docs=2_048, max_len=48,
 
 def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               days=DEFAULT_TABULAR_DAYS, workers=2,
-                              batch_size=512, mode="static"):
+                              batch_size=512, mode="static", skew_ms=0.0,
+                              credits=8, json_out=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -536,6 +537,19 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     is the serving tier's overhead (serialize → TCP → deserialize) at
     one-machine scale. ``workers`` is the number of batch workers; each runs
     a 2-thread reader pool.
+
+    ``skew_ms`` is fault injection for the head-of-line question: the FIRST
+    worker sleeps that long before every batch send. Under the multiplexed
+    drain the client's throughput stays bounded by the fast workers'
+    buffered output (the slow worker's stall shows up in
+    ``per_worker_stall_s``, not in delivery); a blocking round-robin drain
+    would serialize every fast batch behind the slow one. ``credits`` is
+    the per-worker flow-control window handed to the client.
+
+    The result is BENCH-style (``metric``/``value``/``unit``/
+    ``vs_baseline`` + detail keys, one JSON object); ``json_out`` appends
+    it as one JSON line to that path so skew/loopback numbers land in the
+    perf trajectory instead of stdout only.
     """
     from petastorm_tpu.jax_utils.batcher import batch_iterator
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
@@ -552,23 +566,36 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     dispatcher = Dispatcher(port=0, mode=mode, num_epochs=1).start()
     fleet = []
     try:
-        fleet = [
-            BatchWorker(dataset_url, dispatcher_address=dispatcher.address,
-                        batch_size=batch_size, reader_factory="batch",
-                        worker_id=f"bench-worker-{i}",
-                        reader_kwargs={"workers_count": 2}).start()
-            for i in range(workers)]
-        source = ServiceBatchSource(dispatcher.address)
+        for i in range(workers):
+            # Appended one by one so a failing start() mid-fleet still
+            # leaves the already-started workers in `fleet` for teardown.
+            fleet.append(BatchWorker(
+                dataset_url, dispatcher_address=dispatcher.address,
+                batch_size=batch_size, reader_factory="batch",
+                worker_id=f"bench-worker-{i}",
+                batch_delay_s=(skew_ms / 1000.0 if i == 0 else 0.0),
+                reader_kwargs={"workers_count": 2}).start())
+        source = ServiceBatchSource(dispatcher.address, credits=credits)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False)
         served_rows = batches = 0
+        arrivals = []  # (elapsed_s, cumulative rows) per batch
         t0 = time.perf_counter()
         with loader:
             for batch in loader:
                 batches += 1
                 served_rows += len(next(iter(batch.values())))
+                arrivals.append((time.perf_counter() - t0, served_rows))
         service_wall = time.perf_counter() - t0
+        # Delivery timeline: when half the rows had reached the trainer.
+        # Under skew this is the head-of-line number — a blocking drain
+        # paces EVERY delivery at the slow worker's rate (half at ~half the
+        # wall), the multiplexed drain front-loads the fast workers'
+        # batches (half at roughly the fast workers' production time).
+        time_to_half = next((t for t, n in arrivals
+                             if n >= served_rows / 2), service_wall)
         stall_pct = loader.diagnostics["input_stall_pct"]
+        source_diag = source.diagnostics
 
         # Local baseline: the same dataset through the same collation,
         # no network tier.
@@ -580,18 +607,41 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             for b in batch_iterator(reader, batch_size, last_batch="keep"):
                 local_rows += len(next(iter(b.values())))
         local_wall = time.perf_counter() - t0
-        return {
+        service_rps = round(served_rows / service_wall, 1)
+        result = {
             "scenario": "service_loopback",
+            # BENCH-style envelope: the headline number, named.
+            "metric": "service_rows_per_sec",
+            "value": service_rps,
+            "unit": "rows/sec",
+            "vs_baseline": round(
+                (served_rows / service_wall) / (local_rows / local_wall), 2),
             "mode": mode,
             "workers": workers,
+            "skew_ms": skew_ms,
+            "credits": credits,
             "rows": served_rows,
             "batches": batches,
-            "service_rows_per_sec": round(served_rows / service_wall, 1),
+            "service_rows_per_sec": service_rps,
+            "service_wall_s": round(service_wall, 3),
+            "time_to_half_rows_s": round(time_to_half, 3),
             "local_rows_per_sec": round(local_rows / local_wall, 1),
             "service_vs_local": round(
                 (served_rows / service_wall) / (local_rows / local_wall), 2),
             "loader_input_stall_pct": stall_pct,
+            "per_worker_batches": {
+                wid: counters["batches"]
+                for wid, counters in source_diag["per_worker"].items()},
+            "per_worker_stall_s": {
+                wid: counters["stall_s"]
+                for wid, counters in source_diag["per_worker"].items()},
         }
+        if json_out:
+            import json
+
+            with open(json_out, "a", encoding="utf-8") as f:
+                f.write(json.dumps(result) + "\n")
+        return result
     finally:
         for worker in fleet:
             worker.stop()

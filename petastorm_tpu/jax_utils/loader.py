@@ -190,6 +190,8 @@ class JaxDataLoader:
         self._producer = None
         self._stager = None
         self._producer_error = None
+        self._source_iter = None   # batch_source() iterator for _produce
+        self._direct_iter = None   # prefetched source consumed sans producer
         self._stop = threading.Event()
         self._total_rows_yielded = 0  # cumulative, pad-aware (resume support)
         self._yield_count_tracker = None  # tracker the count is relative to
@@ -217,8 +219,8 @@ class JaxDataLoader:
 
     def _produce(self):
         try:
-            if self._batch_source is not None:
-                batches = iter(self._batch_source())
+            if self._source_iter is not None:
+                batches = iter(self._source_iter)
                 if self._max_batches is not None:
                     import itertools
 
@@ -324,6 +326,15 @@ class JaxDataLoader:
                         f"Previous iteration's {name} thread did not stop "
                         "within 30s (blocked on reader I/O or a device "
                         "call?); cannot safely re-iterate")
+        # A previous DIRECT iteration has no loader threads, but its source
+        # iterator may still own live reader threads (the service drain) —
+        # close it before a new iteration resets the source's bookkeeping
+        # under them. Also keeps an abandoned first iteration's later
+        # finalization from touching the new iteration's source.
+        if self._source_iter is not None:
+            close = getattr(self._source_iter, "close", None)
+            if callable(close):
+                close()
         # With producer-side staging the device queue holds DEVICE-resident
         # batches, so its depth is bounded by the device budget
         # (device_prefetch), not the host budget — otherwise device-resident
@@ -332,11 +343,35 @@ class JaxDataLoader:
         # batches stay <= 2 * device_prefetch (+1 in the stager's hand);
         # decoded host batches additionally buffer up to host_prefetch
         # between the decode and staging threads (the overlap window).
-        maxsize = (max(1, self._device_prefetch) if self._stage_in_producer
-                   else self._host_prefetch)
-        self._queue = queue.Queue(maxsize=maxsize)
-        self._host_queue = (queue.Queue(maxsize=self._host_prefetch)
-                            if self._stage_in_producer else None)
+        # A batch_source whose iterator declares itself ``prefetched`` (the
+        # data service's multiplexed drain: its own reader threads feeding a
+        # bounded ready-queue) is consumed DIRECTLY on the iterating thread:
+        # the producer thread would be pure plumbing between two bounded
+        # queues — one extra thread wakeup per batch on the hot path, with
+        # no extra buffering to show for it. Prefetch depth and
+        # backpressure stay the source's (ready-queue + credit window).
+        self._source_iter = None
+        self._direct_iter = None
+        direct = False
+        if self._batch_source is not None:
+            self._source_iter = self._batch_source()
+            direct = (not self._stage_in_producer
+                      and getattr(self._source_iter, "prefetched", False))
+        if direct:
+            batches = iter(self._source_iter)
+            if self._max_batches is not None:
+                import itertools
+
+                batches = itertools.islice(batches, self._max_batches)
+            self._direct_iter = batches
+            self._queue = None
+            self._host_queue = None
+        else:
+            maxsize = (max(1, self._device_prefetch)
+                       if self._stage_in_producer else self._host_prefetch)
+            self._queue = queue.Queue(maxsize=maxsize)
+            self._host_queue = (queue.Queue(maxsize=self._host_prefetch)
+                                if self._stage_in_producer else None)
         self._stop.clear()
         self._producer_error = None
         # Yielded-row accounting is relative to the reader's delivery
@@ -352,19 +387,28 @@ class JaxDataLoader:
                                 input_stall_pct=0.0, producer_decode_s=0.0,
                                 producer_queue_wait_s=0.0,
                                 device_dispatch_s=0.0, consumer_s=0.0)
-        self._producer = threading.Thread(target=self._produce, daemon=True,
-                                          name="jax-loader-producer")
-        self._producer.start()
-        if self._stage_in_producer:
-            self._stager = threading.Thread(target=self._stage_loop,
-                                            daemon=True,
-                                            name="jax-loader-stager")
-            self._stager.start()
+        if self._direct_iter is None:
+            self._producer = threading.Thread(target=self._produce,
+                                              daemon=True,
+                                              name="jax-loader-producer")
+            self._producer.start()
+            if self._stage_in_producer:
+                self._stager = threading.Thread(target=self._stage_loop,
+                                                daemon=True,
+                                                name="jax-loader-stager")
+                self._stager.start()
+        else:
+            self._producer = None
+            self._stager = None
         return self._iterate()
 
     def _iterate(self):
         inflight = []  # device batches dispatched ahead (double buffer)
         done = False
+        direct = self._direct_iter
+        # Captured so the finally tears down THIS iteration's source even
+        # if a newer iteration has since replaced the attribute.
+        source_iter = self._source_iter
         start = time.perf_counter()
         try:
             while True:
@@ -372,7 +416,12 @@ class JaxDataLoader:
                 while not done and len(inflight) < self._device_prefetch:
                     t0 = time.perf_counter()
                     with _trace_span("petastorm_tpu.loader.wait"):
-                        host_batch = self._queue.get()
+                        # Direct path: pull the prefetched source here
+                        # (its reader threads are the producers); an error
+                        # raises inline — no sentinel relay needed.
+                        host_batch = (next(direct, _SENTINEL)
+                                      if direct is not None
+                                      else self._queue.get())
                     self.diagnostics["stall_s"] += time.perf_counter() - t0
                     if host_batch is _SENTINEL:
                         done = True
@@ -410,8 +459,23 @@ class JaxDataLoader:
                 self.diagnostics["input_stall_pct"] = round(
                     100.0 * self.diagnostics["stall_s"]
                     / self.diagnostics["wall_s"], 2)
+            # A batch_source with its own delivery counters (e.g. the data
+            # service's per-worker stall / ready-queue / credit numbers)
+            # lands in the stage breakdown, so one diagnostics dict
+            # root-causes a stall across the whole delivery path.
+            source_diag = (getattr(self._batch_source, "diagnostics", None)
+                           if self._batch_source is not None else None)
+            if isinstance(source_diag, dict):
+                self.diagnostics["source"] = dict(source_diag)
             # Generator abandoned (break) or exhausted: stop the producer so
-            # it doesn't keep decoding the rest of the dataset forever.
+            # it doesn't keep decoding the rest of the dataset forever. On
+            # the direct path, closing the source iterator is what tears
+            # down its reader threads and sockets (a no-op if a newer
+            # iteration's __iter__ already closed it).
+            if direct is not None and source_iter is not None:
+                close = getattr(source_iter, "close", None)
+                if callable(close):
+                    close()
             self.stop()
 
     @staticmethod

@@ -38,6 +38,8 @@ class PickleSerializer:
         """Inverse of :meth:`serialize_to_frames`; ``frames`` may be bytes,
         memoryviews, or zmq frame buffers."""
         head, buffers = frames[0], frames[1:]
-        if not isinstance(head, (bytes, bytearray)):
-            head = bytes(head)
+        if not isinstance(head, (bytes, bytearray, memoryview)):
+            # pickle.loads accepts any buffer-like; memoryview() wraps zmq
+            # frames and friends without the bytes() copy.
+            head = memoryview(head)
         return pickle.loads(head, buffers=buffers)  # noqa: S301

@@ -6,6 +6,16 @@
 its local reader pipeline for remote workers by changing one constructor
 argument and keeps the loader's staging/prefetch/stall accounting unchanged.
 
+Delivery (static mode) is multiplexed: one reader thread per worker stream,
+all feeding a single bounded ready-queue the consumer yields from —
+whichever worker is ready is consumed, so a slow worker never head-of-line
+blocks batches already buffered on its peers. Credit-based flow control
+(``credits=``) bounds each worker's un-acknowledged batches in flight: the
+``stream`` request carries the window and the client replenishes one credit
+per consumed batch, so backpressure composes end to end (worker blocks out
+of credits → ready-queue bounds client-side buffering → the loader's
+prefetch queue bounds staging).
+
 Failure handling (static mode): a broken worker connection first retries
 against the same worker with bounded exponential backoff + jitter
 (:func:`petastorm_tpu.utils.retry_with_backoff` — the same policy the GCS
@@ -27,7 +37,9 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import queue
 import threading
+import time
 import uuid
 
 from petastorm_tpu.reader_impl.framed_socket import (
@@ -46,18 +58,36 @@ class ServiceError(RuntimeError):
 
 class _WorkerStream:
     """One ``stream`` request against one worker; connects lazily so every
-    connection failure funnels through ``next_batch`` (one recovery path)."""
+    connection failure funnels through ``next_batch`` (one recovery path).
 
-    def __init__(self, worker_id, address, pieces, epoch, connect_timeout):
+    ``credits`` arms flow control: the ``stream`` request carries the
+    window, the worker keeps at most that many un-acknowledged batches in
+    flight, and :meth:`add_credit` replenishes as batches are consumed.
+    ``auto_replenish=True`` acks each batch as soon as it is received —
+    the sequential consumption paths (fcfs splits, reconnect probes) where
+    receive and consume are the same event; the multiplexed drain uses
+    ``False`` and acks from the consumer side of its ready-queue, so the
+    window bounds worker-sent-but-unconsumed batches end to end."""
+
+    def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
+                 credits=None, auto_replenish=False):
         self.worker_id = worker_id
         self.address = tuple(address)
         self.pieces = list(pieces)
         self.epoch = epoch
+        self.credits = credits
+        self._auto_replenish = auto_replenish
         self._connect_timeout = connect_timeout
         self._conn = None
+        self._closed = False
 
     def next_batch(self):
         """Next batch dict, or ``None`` when the stream ended cleanly."""
+        if self._closed:
+            # Terminal: a teardown close() must not be mistaken for the
+            # lazy not-yet-connected state — reconnecting here would send
+            # the worker a spurious full stream request nobody consumes.
+            raise ConnectionClosedError("stream closed")
         if self._conn is None:
             # connect_timeout bounds the dial only: an inter-batch gap has
             # no upper bound (reader construction, cold storage reads), so
@@ -69,11 +99,22 @@ class _WorkerStream:
             self._conn = FramedConnection.connect(
                 self.address, timeout=self._connect_timeout,
                 stream_timeout=None, keepalive=True)
-            self._conn.send({"type": "stream", "pieces": self.pieces,
-                             "epoch": self.epoch})
+            if self._closed:
+                # close() raced the dial: tear the fresh socket down
+                # instead of streaming into an abandoned stream object.
+                self._conn.close()
+                self._conn = None
+                raise ConnectionClosedError("stream closed")
+            request = {"type": "stream", "pieces": self.pieces,
+                       "epoch": self.epoch}
+            if self.credits is not None:
+                request["credits"] = self.credits
+            self._conn.send(request)
         header, payload = self._conn.recv()
         kind = header.get("type")
         if kind == "batch":
+            if self._auto_replenish:
+                self.add_credit(1)
             return payload
         if kind == "end":
             self.close()
@@ -84,10 +125,104 @@ class _WorkerStream:
                 f"{self.pieces}: {header.get('error')}")
         raise ServiceError(f"unexpected stream message {kind!r}")
 
+    def add_credit(self, n=1):
+        """Replenish ``n`` credits of the worker's flow-control window.
+
+        Send-only (safe against the reader thread's concurrent ``recv`` —
+        opposite directions of the same socket); a no-op without credits
+        or after close, and a broken socket is swallowed — the receive
+        path owns failure detection and recovery."""
+        conn = self._conn
+        if conn is None or self.credits is None:
+            return
+        try:
+            conn.send({"type": "credit", "n": n})
+        except OSError:
+            pass
+
     def close(self):
+        self._closed = True
         if self._conn is not None:
             self._conn.close()
             self._conn = None
+
+
+class _SourceIterator:
+    """Iterator wrapper carrying delivery metadata the loader reads.
+
+    ``prefetched=True`` declares that the underlying iteration already runs
+    its own producer threads and bounded buffering (the multiplexed drain's
+    reader threads + ready-queue), so a consumer like ``JaxDataLoader`` can
+    skip its own producer-thread prefetch hop and pull batches directly —
+    one fewer thread wakeup per batch on the hot path, with the same
+    end-to-end buffering bound."""
+
+    def __init__(self, gen, prefetched):
+        self._gen = gen
+        self.prefetched = prefetched
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        self._gen.close()
+
+
+class _StreamReader(threading.Thread):
+    """One worker stream's receive loop: pulls batches and feeds the shared
+    ready-queue as ``(kind, sid, item)`` events — ``batch`` per payload,
+    then one terminal ``end`` (clean), ``broken`` (connection-type failure
+    → consumer retry/takeover), or ``error`` (``ServiceError`` → consumer
+    re-raises). Bookkeeping stays on the consumer side of the queue; this
+    thread only reports its receive-stall seconds via ``note_recv``."""
+
+    def __init__(self, sid, stream, ready, stop, note_recv):
+        super().__init__(daemon=True,
+                         name=f"service-stream-{stream.worker_id}")
+        self._sid = sid
+        self._stream = stream
+        self._ready = ready
+        # NB: Thread owns a private `_stop` method — don't shadow it.
+        self._stopped = stop
+        self._note_recv = note_recv
+
+    def run(self):
+        try:
+            while not self._stopped.is_set():
+                t0 = time.perf_counter()
+                try:
+                    batch = self._stream.next_batch()
+                except (ConnectionClosedError, ConnectionError,
+                        OSError) as exc:
+                    # A close() from the consumer's teardown also lands here
+                    # — the stop flag distinguishes it from a real failure.
+                    if not self._stopped.is_set():
+                        self._put(("broken", self._sid, exc))
+                    return
+                self._note_recv(self._stream.worker_id,
+                                time.perf_counter() - t0, batch is not None)
+                if batch is None:
+                    self._put(("end", self._sid, None))
+                    return
+                self._put(("batch", self._sid, batch))
+        except BaseException as exc:
+            # ServiceError and anything unexpected: forward as a terminal
+            # event for the consumer to re-raise — a reader dying silently
+            # would hang the consumer's queue.get forever.
+            self._put(("error", self._sid, exc))
+
+    def _put(self, event):
+        # Bounded queue: block with a stop check so teardown never hangs a
+        # reader behind a full queue the consumer abandoned.
+        while not self._stopped.is_set():
+            try:
+                self._ready.put(event, timeout=0.1)
+                return
+            except queue.Full:
+                continue
 
 
 class ServiceBatchSource:
@@ -101,11 +236,27 @@ class ServiceBatchSource:
     :param backoff_base/backoff_max: exponential-backoff bounds (seconds).
     :param resume_state: a prior :meth:`state_dict` snapshot — completed
         pieces are skipped on the resumed epoch (static mode only).
+    :param credits: per-worker flow-control window — a worker keeps at most
+        this many un-acknowledged batches in flight; the client replenishes
+        as it consumes. ``None`` disables flow control (unbounded push,
+        the pre-credit protocol). Default 8: deep enough to hide a
+        consume-ack round trip, shallow enough that a pause stops pulling
+        within ~`credits` batches per worker.
+    :param ready_queue_depth: bound of the shared ready-queue the
+        multiplexed drain yields from (static mode). ``None`` sizes it to
+        ``max(4, 2 * active streams)`` — enough that every stream can have
+        a batch ready plus one in the consumer's hand.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
                  client_id=None, connect_timeout=10.0, max_retries=3,
-                 backoff_base=0.05, backoff_max=2.0, resume_state=None):
+                 backoff_base=0.05, backoff_max=2.0, resume_state=None,
+                 credits=8, ready_queue_depth=None):
+        if credits is not None and credits < 1:
+            raise ValueError("credits must be a positive integer or None")
+        if ready_queue_depth is not None and ready_queue_depth < 1:
+            raise ValueError(
+                "ready_queue_depth must be a positive integer or None")
         self._dispatcher_address = tuple(dispatcher_address)
         self.client_index = client_index
         self.num_clients = num_clients
@@ -115,6 +266,10 @@ class ServiceBatchSource:
         self._max_retries = max_retries
         self._backoff_base = backoff_base
         self._backoff_max = backoff_max
+        self._credits = credits
+        self._ready_queue_depth = ready_queue_depth
+        self._ready_queue = None      # live queue while a drain is active
+        self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
         self._mode = None
         self._epoch = 0
@@ -162,13 +317,26 @@ class ServiceBatchSource:
         with self._lock:
             self._mode = info["mode"]
             # Fresh iteration: the consumer's batch counter restarts, so
-            # production bookkeeping restarts with it.
+            # production bookkeeping (and delivery diagnostics) restart
+            # with it.
             self._production_count = 0
             self._events = []
             self._epoch_starts = [(0, self._epoch, set(self._completed))]
+            self._per_worker = {}
         if info["mode"] == "static":
-            return self._iter_static(info)
-        return self._iter_fcfs(info)
+            # The multiplexed drain prefetches into its ready-queue behind
+            # reader threads — consumers may pull it directly.
+            return _SourceIterator(self._iter_static(info), prefetched=True)
+        if self._resumed:
+            raise ValueError(
+                "resume_state was supplied but the dispatcher is in fcfs "
+                "mode: fcfs has no per-client resumable position, so the "
+                "snapshot's completed pieces cannot be skipped — silently "
+                "re-streaming everything would duplicate trained data. "
+                "Run the dispatcher in static mode to resume")
+        # fcfs consumes streams sequentially (no reader threads): a
+        # prefetching consumer should keep its own producer thread.
+        return _SourceIterator(self._iter_fcfs(info), prefetched=False)
 
     # -- static mode -------------------------------------------------------
 
@@ -200,7 +368,7 @@ class ServiceBatchSource:
                 if pending:
                     streams[len(streams)] = _WorkerStream(
                         wid, reply["workers"][wid], pending, epoch,
-                        self._connect_timeout)
+                        self._connect_timeout, credits=self._credits)
             yield from self._drain_streams(streams, epoch)
             epoch += 1
             with self._lock:
@@ -210,30 +378,91 @@ class ServiceBatchSource:
                     (self._production_count, epoch, set()))
 
     def _drain_streams(self, streams, epoch):
-        """Round-robin ready batches across worker streams until all end;
-        a broken stream is retried, then reported and re-assigned."""
-        order = itertools.cycle(list(streams))
-        try:
-            while streams:
-                sid = next(order)
-                if sid not in streams:
-                    order = itertools.cycle(list(streams))
-                    continue
-                stream = streams[sid]
+        """Multiplexed drain: one reader thread per worker stream, all
+        feeding a single bounded ready-queue this generator yields from —
+        whichever worker is ready is consumed, so a stalled worker never
+        head-of-line blocks batches already buffered on its peers (the
+        round-robin ``next_batch`` loop this replaces blocked on one slow
+        stream while the others' batches sat in socket buffers).
+
+        Semantics preserved from the blocking drain:
+
+        - a broken stream is retried against the same worker, then reported
+          and re-assigned (at-least-once takeover) — recovery runs on a
+          helper thread, so a dead worker's connect timeouts and backoff
+          never block this consumer from yielding the survivors' batches
+          (recovery completing posts a ``recovered`` event and the new
+          streams' readers are launched here);
+        - production-count accounting happens HERE, on the consumer side of
+          the queue: events flow per-stream FIFO, so a stream's ``end`` is
+          dequeued only after all its batches were yielded and completion
+          events carry the same production counts as before;
+        - credits replenish on dequeue, so the per-worker window bounds
+          worker-sent-but-unconsumed batches end to end (socket buffer +
+          ready-queue share).
+        """
+        if not streams:
+            return
+        depth = (self._ready_queue_depth
+                 if self._ready_queue_depth is not None
+                 else max(4, 2 * len(streams)))
+        ready = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        readers = []
+        sid_counter = itertools.count(max(streams) + 1)
+        with self._lock:
+            self._ready_queue = ready
+
+        def launch(sid, stream):
+            streams[sid] = stream
+            reader = _StreamReader(sid, stream, ready, stop,
+                                   self._note_stream_recv)
+            readers.append(reader)
+            reader.start()
+
+        def post(event):
+            while not stop.is_set():
                 try:
-                    batch = stream.next_batch()
-                except (ConnectionClosedError, ConnectionError, OSError):
-                    replacement = self._retry_stream(stream)
-                    if replacement is not None:
-                        streams[sid] = replacement
-                        continue
-                    del streams[sid]
-                    takeover = self._reassign(stream)
-                    for new_stream in takeover:
-                        streams[max(streams, default=sid) + 1] = new_stream
-                    order = itertools.cycle(list(streams))
+                    ready.put(event, timeout=0.1)
+                    return True
+                except queue.Full:
                     continue
-                if batch is None:
+            return False
+
+        def recover(broken):
+            # Retry-then-takeover off the consumer thread: a dead worker's
+            # connect timeouts and backoff (tens of seconds) must not stop
+            # the consumer from yielding survivors' buffered batches — the
+            # head-of-line failure mode this drain exists to remove.
+            try:
+                replacement = self._retry_stream(broken)
+                fresh = ([replacement] if replacement is not None
+                         else self._reassign(broken))
+            except BaseException as exc:
+                post(("error", None, exc))
+                return
+            if not post(("recovered", None, fresh)):
+                for stream in fresh:  # drain torn down mid-recovery
+                    stream.close()
+
+        try:
+            for sid, stream in list(streams.items()):
+                launch(sid, stream)
+            active = set(streams)
+            recovering = 0
+            while active or recovering:
+                kind, sid, item = ready.get()
+                if kind == "batch":
+                    stream = streams[sid]
+                    # Ack BEFORE yielding: the worker refills its window
+                    # while the trainer computes on this batch.
+                    stream.add_credit(1)
+                    with self._lock:
+                        self._production_count += 1
+                        self._note_consumed_locked(stream.worker_id)
+                    yield item
+                elif kind == "end":
+                    stream = streams.pop(sid)
                     with self._lock:
                         self._completed.update(stream.pieces)
                         # The stream's batches are all among the first
@@ -241,15 +470,52 @@ class ServiceBatchSource:
                         # yielded that many, these pieces are truly done.
                         self._events.append((self._production_count, epoch,
                                              sorted(stream.pieces)))
-                    del streams[sid]
-                    order = itertools.cycle(list(streams))
-                    continue
-                with self._lock:
-                    self._production_count += 1
-                yield batch
+                    active.discard(sid)
+                elif kind == "error":
+                    raise item
+                elif kind == "recovered":
+                    recovering -= 1
+                    for new_stream in item:
+                        new_sid = next(sid_counter)
+                        active.add(new_sid)
+                        launch(new_sid, new_stream)
+                else:  # "broken" — recover concurrently, keep draining
+                    stream = streams.pop(sid)
+                    active.discard(sid)
+                    recovering += 1
+                    threading.Thread(
+                        target=recover, args=(stream,), daemon=True,
+                        name=f"service-recover-{stream.worker_id}").start()
         finally:
+            stop.set()
+            # Closing the sockets unblocks readers parked in recv; the stop
+            # flag unblocks readers (and recovery threads) parked on a full
+            # queue. A recovery thread still mid-dial is a daemon bounded
+            # by its retry budget; streams it creates after this point are
+            # closed by its stop-guarded post.
             for stream in streams.values():
                 stream.close()
+            with self._lock:
+                self._ready_queue = None
+            for reader in readers:
+                reader.join(timeout=5)
+
+    def _note_stream_recv(self, worker_id, stall_s, got_batch):
+        """Reader-thread callback: receive-stall seconds (time blocked
+        waiting on the worker) and one more batch held client-side."""
+        with self._lock:
+            counters = self._per_worker.setdefault(
+                worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
+            counters["stall_s"] += stall_s
+            if got_batch:
+                counters["inflight"] += 1
+
+    def _note_consumed_locked(self, worker_id):
+        """One batch consumed (and its credit acked) — callers hold _lock."""
+        counters = self._per_worker.setdefault(
+            worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
+        counters["batches"] += 1
+        counters["inflight"] = max(0, counters["inflight"] - 1)
 
     def _retry_stream(self, stream):
         """Reconnect to the same worker and restart its piece set (the whole
@@ -259,7 +525,8 @@ class ServiceBatchSource:
         def attempt():
             fresh = _WorkerStream(stream.worker_id, stream.address,
                                   stream.pieces, stream.epoch,
-                                  self._connect_timeout)
+                                  self._connect_timeout,
+                                  credits=self._credits)
             batch = fresh.next_batch()  # forces connect + first reply
             return fresh, batch
 
@@ -291,7 +558,7 @@ class ServiceBatchSource:
             "worker_id": stream.worker_id, "pieces": stream.pieces})
         return [
             _WorkerStream(wid, reply["workers"][wid], pieces, stream.epoch,
-                          self._connect_timeout)
+                          self._connect_timeout, credits=self._credits)
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -361,15 +628,18 @@ class ServiceBatchSource:
         stayed unreachable through the retry budget. A retry restarts the
         piece from its beginning (at-least-once — batches already yielded
         from the broken attempt arrive again)."""
-        import time
-
         from petastorm_tpu.utils import backoff_delays
 
         delays = backoff_delays(self._max_retries, self._backoff_base,
                                 self._backoff_max)
         for attempt in range(self._max_retries + 1):
+            # Sequential consumption: receive == consume, so each batch is
+            # acked on arrival (auto_replenish) and the credit window still
+            # bounds the worker's read-ahead past this client.
             stream = _WorkerStream(wid, address, [piece], epoch,
-                                   self._connect_timeout)
+                                   self._connect_timeout,
+                                   credits=self._credits,
+                                   auto_replenish=True)
             try:
                 yield from self._drain_one(stream)
                 return True
@@ -387,9 +657,15 @@ class ServiceBatchSource:
     def _drain_one(self, stream):
         try:
             while True:
+                t0 = time.perf_counter()
                 batch = stream.next_batch()
+                self._note_stream_recv(stream.worker_id,
+                                       time.perf_counter() - t0,
+                                       batch is not None)
                 if batch is None:
                     return
+                with self._lock:
+                    self._note_consumed_locked(stream.worker_id)
                 yield batch
         finally:
             stream.close()
@@ -451,6 +727,37 @@ class ServiceBatchSource:
                     f"{state.get(key)!r}, this client has "
                     f"{getattr(self, key)!r}")
 
+    @property
+    def diagnostics(self):
+        """Client-side delivery counters for the multiplexed drain:
+
+        - ``ready_queue_depth`` / ``ready_queue_capacity``: batches waiting
+          in the shared ready-queue right now (0/0 outside a drain);
+        - ``credits_window``: the per-worker flow-control window in force;
+        - ``per_worker``: per-worker ``batches`` consumed, ``stall_s``
+          (seconds its reader thread spent blocked waiting on the worker —
+          a skewed worker shows up here, not in delivery latency), and
+          ``credits_outstanding`` (batches received but not yet
+          consumed-and-acked).
+
+        ``JaxDataLoader`` snapshots this into its own ``diagnostics`` under
+        ``"source"`` when the source is plugged in.
+        """
+        with self._lock:
+            ready = self._ready_queue
+            return {
+                "ready_queue_depth": ready.qsize() if ready is not None
+                else 0,
+                "ready_queue_capacity": ready.maxsize if ready is not None
+                else 0,
+                "credits_window": self._credits,
+                "per_worker": {
+                    wid: {"batches": counters["batches"],
+                          "stall_s": round(counters["stall_s"], 3),
+                          "credits_outstanding": counters["inflight"]}
+                    for wid, counters in self._per_worker.items()},
+            }
+
     def remote_diagnostics(self):
         """Per-worker ``Reader.diagnostics`` snapshots — remote input stalls
         become visible trainer-side (see docs/guides/diagnostics.md)."""
@@ -482,12 +789,16 @@ class _BufferedStream:
         self.address = stream.address
         self.pieces = stream.pieces
         self.epoch = stream.epoch
+        self.credits = stream.credits
 
     def next_batch(self):
         if self._first is not None:
             batch, self._first = self._first, None
             return batch
         return self._stream.next_batch()
+
+    def add_credit(self, n=1):
+        self._stream.add_credit(n)
 
     def close(self):
         self._stream.close()
@@ -501,9 +812,13 @@ class _EndedStream:
         self.address = stream.address
         self.pieces = stream.pieces
         self.epoch = stream.epoch
+        self.credits = stream.credits
 
     def next_batch(self):
         return None
+
+    def add_credit(self, n=1):
+        pass
 
     def close(self):
         pass

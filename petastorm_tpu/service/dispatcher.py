@@ -20,6 +20,10 @@ Request vocabulary (header ``type``):
   ``end_of_stream`` (dispatcher-owned epoch tracking: the shared queue
   refills until ``num_epochs`` is exhausted)
 - ``status`` → full control-plane snapshot (workers, clients, queue depth)
+- ``worker_diagnostics`` → one fan-out to every live worker's
+  ``diagnostics`` endpoint, aggregated — a trainer (or an operator's
+  one-liner) reads the whole fleet's reader/flow-control state through the
+  single address it already knows
 - ``ping`` → ``pong``
 """
 
@@ -30,8 +34,8 @@ import threading
 from collections import deque
 
 from petastorm_tpu.reader_impl.framed_socket import (
+    FramedReader,
     FramedServer,
-    recv_framed,
     send_framed,
 )
 
@@ -84,14 +88,21 @@ class Dispatcher:
     # -- serving -----------------------------------------------------------
 
     def _serve_connection(self, sock):
+        reader = FramedReader(sock)
         while not self._server.stopped.is_set():
-            header, _ = recv_framed(sock)
+            header, _ = reader.recv()
             try:
                 reply = self._handle(header)
             except Exception as exc:  # reply instead of killing the conn
                 logger.exception("dispatcher request %r failed", header)
                 reply = {"type": "error", "error": str(exc)}
-            send_framed(sock, reply)
+            # A handler may return (header, payload) when the reply carries
+            # non-JSON data (worker_diagnostics aggregates arbitrary
+            # Reader.diagnostics values).
+            if isinstance(reply, tuple):
+                send_framed(sock, reply[0], reply[1])
+            else:
+                send_framed(sock, reply)
 
     def _handle(self, header):
         kind = header.get("type")
@@ -228,6 +239,40 @@ class Dispatcher:
             return {"type": "split",
                     "piece": self._fcfs_queue.popleft(),
                     "epoch": self._fcfs_epoch}
+
+    def _handle_worker_diagnostics(self, header):
+        """Diagnostics passthrough: fan the ``diagnostics`` request out to
+        every live worker CONCURRENTLY and aggregate — no sample bytes, a
+        few small framed messages, and the aggregate's latency is one
+        worker round trip (max, not sum — a fleet with dead workers must
+        not cost ``timeout`` each, serially). An unreachable worker is
+        reported in place rather than failing the aggregate."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+
+        timeout = float(header.get("timeout", 5.0))
+        with self._lock:
+            workers = {wid: tuple(w["address"])
+                       for wid, w in self._alive_workers().items()}
+
+        def probe(address):
+            try:
+                with FramedConnection.connect(address,
+                                              timeout=timeout) as conn:
+                    _, payload = conn.request({"type": "diagnostics"})
+                return payload
+            except (ConnectionError, OSError) as exc:
+                return {"error": f"unreachable: {exc}"}
+
+        out = {}
+        if workers:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(workers))) as pool:
+                for wid, payload in zip(
+                        workers, pool.map(probe, workers.values())):
+                    out[wid] = payload
+        return {"type": "diagnostics", "workers": sorted(workers)}, out
 
     def _handle_status(self, header):
         with self._lock:

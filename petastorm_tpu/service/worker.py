@@ -20,12 +20,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import uuid
 
 from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
+    FramedReader,
     FramedServer,
-    recv_framed,
     send_framed,
 )
 
@@ -72,12 +73,16 @@ class BatchWorker:
         ``reader_pool_type``, ``filters``, ...). ``piece_indices``,
         ``num_epochs`` and ``shuffle_row_groups`` are owned by the stream
         protocol.
+    :param batch_delay_s: fault injection for benchmarks/tests — sleep this
+        long before each ``batch`` send, simulating a slow worker (the
+        ``--skew-ms`` knob of the ``service`` benchmark scenario).
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
                  host="127.0.0.1", port=0, batch_size=64,
                  reader_factory="row", reader_kwargs=None, worker_id=None,
-                 register_retries=5, register_backoff=0.2):
+                 register_retries=5, register_backoff=0.2,
+                 batch_delay_s=0.0):
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
@@ -100,9 +105,10 @@ class BatchWorker:
                     f"assignment), not worker construction")
         self._register_retries = register_retries
         self._register_backoff = register_backoff
+        self._batch_delay_s = float(batch_delay_s)
         self.num_pieces = None
         self._lock = threading.Lock()
-        self._active = {}            # stream key -> Reader
+        self._active = {}            # stream key -> {"reader", "flow"}
         self._completed = {}         # stream key -> final diagnostics dict
         self._server = FramedServer(self._serve_connection, host=host,
                                     port=port,
@@ -127,7 +133,7 @@ class BatchWorker:
         (they would otherwise pin a thread + fd per idle client forever)."""
         self._server.stopped.set()
         with self._lock:
-            readers = list(self._active.values())
+            readers = [entry["reader"] for entry in self._active.values()]
         for reader in readers:
             try:
                 reader.stop()
@@ -196,11 +202,17 @@ class BatchWorker:
     # -- serving -----------------------------------------------------------
 
     def _serve_connection(self, sock):
+        reader = FramedReader(sock)  # buffered, per-connection
         while not self._server.stopped.is_set():
-            header, _ = recv_framed(sock)
+            header, _ = reader.recv()
             kind = header.get("type")
             if kind == "stream":
-                self._stream(sock, header)
+                self._stream(sock, header, conn_reader=reader)
+            elif kind == "credit":
+                # A replenishment raced the stream's `end` (the client sends
+                # credits as it consumes, and the tail of those can land
+                # after the stream finished) — stale, not an error.
+                pass
             elif kind == "diagnostics":
                 send_framed(sock, {"type": "diagnostics",
                                    "worker_id": self.worker_id},
@@ -212,13 +224,26 @@ class BatchWorker:
                 send_framed(sock, {"type": "error",
                                    "error": f"unknown request {kind!r}"})
 
-    def _stream(self, sock, header):
+    def _stream(self, sock, header, conn_reader):
         """Serve one ``stream`` request: batches of the named pieces, then
         ``end``. A reader/collation error becomes an ``error`` message (the
-        client re-raises it — a bad plan is not a transient failure)."""
+        client re-raises it — a bad plan is not a transient failure).
+
+        Flow control: a ``credits`` field in the request bounds the window
+        of un-acknowledged batches. Each ``batch`` send spends one credit;
+        the client replenishes with ``credit`` messages as it consumes. Out
+        of credits, the worker blocks reading the replenishment stream —
+        per-worker in-flight batches stay <= the window instead of growing
+        with the socket buffer (unbounded push) or collapsing to
+        request/response lockstep. Without the field the stream is
+        unbounded (pre-credit clients)."""
         from petastorm_tpu.jax_utils.batcher import batch_iterator
 
         pieces = [int(p) for p in header["pieces"]]
+        credits = header.get("credits")
+        credits = int(credits) if credits is not None else None
+        flow = {"credits_window": credits, "credits_left": credits,
+                "batches_sent": 0, "credit_wait_s": 0.0}
         stream_key = f"{uuid.uuid4().hex[:8]}"
         reader = None
         rows_sent = 0
@@ -233,14 +258,40 @@ class BatchWorker:
                                    cur_shard=0, shard_count=1,
                                    **self._reader_kwargs)
             with self._lock:
-                self._active[stream_key] = reader
+                self._active[stream_key] = {"reader": reader, "flow": flow}
             for batch in batch_iterator(reader, self._batch_size,
                                         last_batch="keep"):
                 if self._server.stopped.is_set():
                     return
+                if credits is not None:
+                    # Drain replenishments OPPORTUNISTICALLY every batch,
+                    # not only when starved: un-read credit messages would
+                    # otherwise pile up in the TCP buffers all stream long
+                    # until the client's blocking ack send wedges against
+                    # this worker's blocking batch send (a four-way
+                    # distributed deadlock on long streams).
+                    while conn_reader.data_pending():
+                        reply, _ = conn_reader.recv()
+                        if reply.get("type") == "credit":
+                            flow["credits_left"] += int(reply.get("n", 1))
+                        # anything else mid-stream is out of protocol; skip
+                if credits is not None and flow["credits_left"] <= 0:
+                    t0 = time.perf_counter()
+                    while flow["credits_left"] <= 0:
+                        if self._server.stopped.is_set():
+                            return
+                        reply, _ = conn_reader.recv()
+                        if reply.get("type") == "credit":
+                            flow["credits_left"] += int(reply.get("n", 1))
+                    flow["credit_wait_s"] += time.perf_counter() - t0
+                if self._batch_delay_s:
+                    time.sleep(self._batch_delay_s)
                 n = self._batch_rows(batch)
                 send_framed(sock, {"type": "batch", "rows": n}, batch)
                 rows_sent += n
+                flow["batches_sent"] += 1
+                if credits is not None:
+                    flow["credits_left"] -= 1
             send_framed(sock, {"type": "end", "rows": rows_sent,
                                "pieces": pieces})
         except (ConnectionClosedError, OSError):
@@ -253,7 +304,8 @@ class BatchWorker:
             with self._lock:
                 self._active.pop(stream_key, None)
                 if reader is not None:
-                    self._completed[stream_key] = dict(reader.diagnostics)
+                    self._completed[stream_key] = dict(reader.diagnostics,
+                                                       **flow)
                     while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
                         self._completed.pop(next(iter(self._completed)))
             if reader is not None:
@@ -267,11 +319,14 @@ class BatchWorker:
         return 0
 
     def diagnostics_snapshot(self):
-        """``Reader.diagnostics`` of every active stream plus the final
-        snapshot of recently finished ones — what a remote client sees."""
+        """``Reader.diagnostics`` of every active stream (merged with its
+        flow-control state — credits window/left, batches sent, seconds
+        blocked waiting for replenishment) plus the final snapshot of
+        recently finished ones — what a remote client sees."""
         with self._lock:
-            active = {key: dict(reader.diagnostics)
-                      for key, reader in self._active.items()}
+            active = {key: dict(entry["reader"].diagnostics,
+                                **entry["flow"])
+                      for key, entry in self._active.items()}
             completed = {key: dict(diag)
                          for key, diag in self._completed.items()}
         return {

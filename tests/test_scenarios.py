@@ -83,20 +83,51 @@ def test_packed_delivery_scenario_beats_padded_utilization():
     assert result["packed_utilization"] > result["padded_utilization"]
 
 
-def test_service_scenario_streams_through_loopback_fleet():
+def test_service_scenario_streams_through_loopback_fleet(tmp_path):
     from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
 
+    json_out = tmp_path / "service_bench.json"
     result = service_loopback_scenario(rows=2000, days=4, workers=2,
-                                       batch_size=128)
+                                       batch_size=128,
+                                       json_out=str(json_out))
     assert result["scenario"] == "service_loopback"
     assert result["rows"] == 2000
     assert result["workers"] == 2
     assert result["service_rows_per_sec"] > 0
     assert result["local_rows_per_sec"] > 0
     assert 0 <= result["loader_input_stall_pct"] <= 100
+    # BENCH-style envelope: named headline metric + baseline ratio.
+    assert result["metric"] == "service_rows_per_sec"
+    assert result["value"] == result["service_rows_per_sec"]
+    assert result["unit"] == "rows/sec"
+    assert result["vs_baseline"] == result["service_vs_local"]
+    # Per-worker delivery accounting covers every served batch.
+    assert sorted(result["per_worker_batches"]) == ["bench-worker-0",
+                                                   "bench-worker-1"]
+    assert sum(result["per_worker_batches"].values()) == result["batches"]
+    assert all(s >= 0 for s in result["per_worker_stall_s"].values())
+    # --json-out appended the result as one JSON line (perf trajectory).
+    assert json.loads(json_out.read_text().strip()) == result
 
 
 def test_scenario_cli_rejects_knobs_the_scenario_lacks(capsys):
     with pytest.raises(SystemExit):
         main(["scenario", "ngram", "--batch-size", "64"])
     assert "not a knob" in capsys.readouterr().err
+
+
+def test_scenario_cli_forwards_service_knobs(capsys, monkeypatch):
+    import petastorm_tpu.benchmark.scenarios as scenarios
+
+    seen = {}
+
+    def fake(dataset_url=None, workers=3, skew_ms=0.0, credits=8,
+             json_out=None):
+        seen.update(skew_ms=skew_ms, credits=credits)
+        return {"ok": True}
+
+    monkeypatch.setitem(scenarios.SCENARIOS, "service", fake)
+    assert main(["scenario", "service", "--skew-ms", "250",
+                 "--credits", "4"]) == 0
+    assert seen == {"skew_ms": 250.0, "credits": 4}
+    assert json.loads(capsys.readouterr().out.strip()) == {"ok": True}

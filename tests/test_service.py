@@ -89,6 +89,110 @@ def test_framed_peer_close_raises_connection_closed():
     b.close()
 
 
+def test_buffer_pool_recycles_transient_buffers():
+    from petastorm_tpu.reader_impl.framed_socket import BufferPool
+
+    pool = BufferPool()
+    buf = pool.acquire(100)
+    assert len(buf) == 128  # size-classed to the next power of two
+    pool.release(buf)
+    assert pool.acquire(100) is buf  # recycled, not reallocated
+    assert (pool.hits, pool.misses) == (1, 1)
+    # Odd-sized buffers (exact-size allocations above the pooled cap) and
+    # releases beyond max_buffers are dropped, not hoarded.
+    small = BufferPool(max_buffers=1)
+    first, second = small.acquire(64), small.acquire(64)
+    small.release(first)
+    small.release(second)
+    assert small.acquire(64) is first
+    assert small.acquire(64) is not second
+
+
+def test_framed_reader_reuses_buffers_and_stays_correct():
+    """Buffered receive: transient buffers recycle across messages while
+    the decoded arrays (built zero-copy from out-of-band frames) stay
+    intact — data frames must never land in the pool or the shared
+    transit buffer's recycled region."""
+    from petastorm_tpu.reader_impl.framed_socket import (BufferPool,
+                                                         FramedReader)
+
+    pool = BufferPool()
+    a, b = _socketpair()
+    reader = FramedReader(b, pool=pool)
+    rng = np.random.RandomState(5)
+    batches = [{"id": np.arange(i, i + 8),
+                "x": rng.rand(8, 4).astype(np.float32)} for i in range(3)]
+    received = []
+    for batch in batches:
+        send_framed(a, {"type": "batch"}, batch)
+        header, payload = reader.recv()
+        assert header == {"type": "batch"}
+        received.append(payload)
+    # Later messages recycled the earlier pickle heads...
+    assert pool.hits > 0
+    # ...and did not corrupt earlier payloads (the zero-copy invariant).
+    for batch, payload in zip(batches, received):
+        np.testing.assert_array_equal(payload["id"], batch["id"])
+        np.testing.assert_array_equal(payload["x"], batch["x"])
+    # Out-of-band reconstruction is zero-copy: the arrays view the received
+    # frame buffers instead of owning a fresh copy.
+    assert received[0]["x"].base is not None
+    a.close(), b.close()
+
+
+def test_framed_reader_interleaves_with_large_frames():
+    """Messages mixing tiny and large frames (bulk frames bypass the
+    transit buffer) decode correctly across several messages, including
+    one large enough to exceed the reader's refill chunk. The sender runs
+    on a thread: a 2x-CHUNK message overflows the socketpair buffer, so a
+    same-thread send would deadlock against the recv."""
+    from petastorm_tpu.reader_impl.framed_socket import FramedReader
+
+    a, b = _socketpair()
+    reader = FramedReader(b)
+    rng = np.random.RandomState(9)
+    big = rng.rand(FramedReader.CHUNK // 4).astype(np.float64)  # 2x CHUNK
+    batches = [{"small": np.arange(3) + rep, "big": big} for rep in range(2)]
+
+    def _send_all():
+        for rep, batch in enumerate(batches):
+            send_framed(a, {"rep": rep}, batch)
+
+    sender = threading.Thread(target=_send_all, daemon=True)
+    sender.start()
+    for rep, batch in enumerate(batches):
+        header, payload = reader.recv()
+        assert header == {"rep": rep}
+        np.testing.assert_array_equal(payload["small"], batch["small"])
+        np.testing.assert_array_equal(payload["big"], big)
+    sender.join(timeout=10)
+    assert not sender.is_alive()
+    a.close(), b.close()
+
+
+def test_send_framed_handles_more_frames_than_iov_max():
+    """A very wide schema serializes to more sendmsg iovec entries than
+    IOV_MAX (1024) — the send path must slice, not fail with EMSGSIZE."""
+    from petastorm_tpu.reader_impl.framed_socket import FramedReader
+
+    a, b = _socketpair()
+    wide = {f"c{i}": np.arange(4) + i for i in range(700)}  # >1400 parts
+    result = {}
+
+    def _recv():
+        result["msg"] = FramedReader(b).recv()
+
+    t = threading.Thread(target=_recv, daemon=True)
+    t.start()
+    send_framed(a, {"type": "batch"}, wide)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    _, payload = result["msg"]
+    assert len(payload) == 700
+    np.testing.assert_array_equal(payload["c699"], np.arange(4) + 699)
+    a.close(), b.close()
+
+
 # ---------------------------------------------------------------------------
 # dispatcher control plane (driven through the real protocol)
 # ---------------------------------------------------------------------------
@@ -371,6 +475,22 @@ def test_fcfs_state_dict_raises(petastorm_dataset):
         dispatcher.stop()
 
 
+def test_fcfs_rejects_resume_state(petastorm_dataset):
+    """A static-mode snapshot fed to an fcfs dispatcher must error, not
+    silently re-stream the whole dataset (duplicating trained data)."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url, mode="fcfs")
+    try:
+        state = {"version": 1, "mode": "static", "client_index": 0,
+                 "num_clients": 1, "epoch": 1, "completed_pieces": [0]}
+        source = ServiceBatchSource(dispatcher.address, resume_state=state)
+        with pytest.raises(ValueError, match="fcfs"):
+            source()
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
 # ---------------------------------------------------------------------------
 # worker failure (fast in-process smoke — tier-1)
 # ---------------------------------------------------------------------------
@@ -400,6 +520,295 @@ def test_worker_kill_mid_epoch_loses_no_samples(tmp_path):
                 killed = True
         assert killed, "dataset too small to kill mid-epoch"
         assert set(int(r["id"]) for r in rows) <= set(got)  # no sample loss
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_credit_window_respected(petastorm_dataset):
+    """Flow-control smoke (tier-1): a worker never has more than ``credits``
+    un-acked batches in flight — it blocks out of credits and resumes per
+    replenishment message."""
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"workers_count": 2}).start()
+    sock = None
+    try:
+        sock = socket.create_connection(worker.address, timeout=5)
+        send_framed(sock, {"type": "stream", "pieces": [0, 1, 2],
+                           "epoch": 0, "credits": 2})
+        for _ in range(2):
+            header, _ = recv_framed(sock)
+            assert header["type"] == "batch"
+        # Window exhausted: the worker must NOT send a third batch.
+        sock.settimeout(0.5)
+        with pytest.raises(socket.timeout):
+            recv_framed(sock)
+        # One credit buys exactly one more batch.
+        send_framed(sock, {"type": "credit", "n": 1})
+        sock.settimeout(5)
+        header, _ = recv_framed(sock)
+        assert header["type"] == "batch"
+        sock.settimeout(0.5)
+        with pytest.raises(socket.timeout):
+            recv_framed(sock)
+    finally:
+        if sock is not None:
+            sock.close()
+        worker.stop()
+
+
+def test_stream_without_credits_is_unbounded(petastorm_dataset):
+    """A pre-credit client (no ``credits`` in the stream request) still gets
+    the full unbounded push — protocol backward compatibility."""
+    worker = BatchWorker(petastorm_dataset.url, batch_size=10,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        with FramedConnection.connect(worker.address, timeout=5) as conn:
+            conn.send({"type": "stream", "pieces": [0, 1, 2], "epoch": 0})
+            kinds = []
+            while True:
+                header, _ = conn.recv()
+                kinds.append(header["type"])
+                if header["type"] == "end":
+                    break
+        assert kinds == ["batch"] * 3 + ["end"]  # all batches, no blocking
+    finally:
+        worker.stop()
+
+
+def test_stream_end_mid_epoch_never_skips_or_double_counts(petastorm_dataset):
+    """Regression for the old drain's cycle-rebuild on stream removal: as
+    streams end at different times mid-epoch, completion bookkeeping must
+    record every piece exactly once per epoch, at non-decreasing production
+    counts — nothing skipped, nothing double-counted."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url,
+                                         num_epochs=2)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == sorted(_local_ids(petastorm_dataset.url) * 2)
+        events = source._events
+        for epoch in (0, 1):
+            pieces = sorted(p for _, event_epoch, ps in events
+                            for p in ps if event_epoch == epoch)
+            assert pieces == [0, 1, 2]  # exactly once each
+        counts = [count for count, _, _ in events]
+        assert counts == sorted(counts)  # production counts never regress
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_source_and_loader_surface_flow_diagnostics(petastorm_dataset):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        with loader:
+            for _ in loader:
+                pass
+        diag = source.diagnostics
+        assert diag["credits_window"] == 8
+        assert diag["ready_queue_depth"] == 0  # drained and torn down
+        per_worker = diag["per_worker"]
+        assert sorted(per_worker) == ["w0", "w1"]
+        for counters in per_worker.values():
+            assert counters["batches"] > 0
+            assert counters["stall_s"] >= 0
+            assert counters["credits_outstanding"] == 0  # all consumed
+        assert (sum(c["batches"] for c in per_worker.values())
+                == loader.diagnostics["batches"])
+        # The loader snapshots the source's counters into its own stage
+        # breakdown — one dict root-causes the whole delivery path.
+        assert loader.diagnostics["source"]["per_worker"] == per_worker
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_loader_reiteration_closes_stale_direct_source(petastorm_dataset):
+    """Re-iterating the loader mid-epoch on the direct (prefetched-source)
+    path must tear down the first drain's reader threads before the fresh
+    iteration resets the source's bookkeeping — and the abandoned first
+    iterator must not break the live one."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        it1 = iter(loader)
+        next(it1)  # first drain live, readers running
+        got = [int(i) for batch in loader for i in batch["id"]]
+        assert sorted(got) == _local_ids(petastorm_dataset.url)
+        # The superseded iterator winds down cleanly (its source generator
+        # was closed by the re-iteration): it may flush batches it had
+        # already prefetched, then ends without raising.
+        list(it1)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_dispatcher_worker_diagnostics_passthrough(petastorm_dataset):
+    """One ``worker_diagnostics`` request against the dispatcher aggregates
+    every live worker's diagnostics (reader counters + flow-control state)."""
+    dispatcher, workers = _service_fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        for _ in source():
+            pass
+        with FramedConnection.connect(dispatcher.address) as conn:
+            reply, payload = conn.request({"type": "worker_diagnostics"})
+        assert reply["type"] == "diagnostics"
+        assert sorted(payload) == ["w0", "w1"]
+        for snapshot in payload.values():
+            assert snapshot["completed_streams"]
+            finished = next(iter(snapshot["completed_streams"].values()))
+            assert finished["credits_window"] == 8
+            assert finished["batches_sent"] > 0
+            assert "rowgroups_total" in finished  # reader counters merged
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.slow
+def test_skewed_worker_does_not_head_of_line_block(tmp_path):
+    """One of two workers delayed per batch: the client must keep yielding
+    the fast worker's batches instead of serializing them behind the slow
+    stream (the failure mode of the old blocking round-robin drain)."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    create_test_scalar_dataset(url, rows_count=60,
+                               rows_per_row_group=5)  # 12 row groups
+    delay_s = 0.3
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=5, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=(delay_s if i == 0 else 0.0),
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        # Piece plan: sorted worker ids, round-robin → w0 (slow) serves the
+        # even pieces, w1 (fast) the odd ones; row ids of piece p are
+        # [5p, 5p+5), so a batch's origin is identifiable from its ids.
+        fast_rows = {i for p in range(1, 12, 2) for i in range(5 * p, 5 * p + 5)}
+        t0 = time.perf_counter()
+        yielded = []  # (elapsed_s, is_fast)
+        for batch in source():
+            ids = [int(i) for i in batch["id"]]
+            yielded.append((time.perf_counter() - t0,
+                            all(i in fast_rows for i in ids)))
+        fast_done_at = max(t for t, is_fast in yielded if is_fast)
+        # The fast worker's 6 batches arrive while the slow worker is still
+        # sleeping off its first deliveries — well before the ~1.8s the
+        # slow stream needs. The old drain interleaved them 1:1, pushing
+        # the last fast batch past ~5 slow periods (~1.5s).
+        assert fast_done_at < 3 * delay_s, (
+            f"fast worker's batches head-of-line blocked: last arrived at "
+            f"{fast_done_at:.2f}s (yields: {yielded})")
+        # Interleaving, not starvation: most of the first half of the
+        # delivery order is fast-worker batches.
+        first_half = [is_fast for _, is_fast in yielded[:6]]
+        assert sum(first_half) >= 4
+        # The slow worker's stall is visible per worker, attributed to w0.
+        per_worker = source.diagnostics["per_worker"]
+        assert per_worker["w0"]["stall_s"] > per_worker["w1"]["stall_s"]
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.slow
+def test_recovery_does_not_block_survivor_delivery(tmp_path):
+    """Retry/takeover of a dead worker runs off the consumer thread: while
+    the client sits out the reconnect backoff (>= 0.9s with these knobs —
+    jitter only lengthens it), the survivor's batches must keep flowing."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)  # 24 pieces
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=5, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=0.05,  # both paced: batches keep coming
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=2,
+                                    backoff_base=0.4, backoff_max=0.5)
+        got, killed_at, post_kill = [], None, []
+        for batch in source():
+            now = time.perf_counter()
+            got.extend(int(i) for i in batch["id"])
+            if killed_at is None and len(got) >= 10:
+                workers[0].kill()
+                killed_at = time.perf_counter()
+            elif killed_at is not None:
+                post_kill.append(now - killed_at)
+        assert killed_at is not None
+        # Recovery's backoff alone sleeps >= 0.9s; a blocking drain would
+        # yield nothing in that window. The survivor delivers throughout.
+        early = [t for t in post_kill if t < 0.7]
+        assert len(early) >= 2, (
+            f"no survivor delivery during recovery: {post_kill[:6]}")
+        assert set(int(r["id"]) for r in rows) <= set(got)  # no loss
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.slow
+def test_worker_kill_under_skew_loses_no_samples(tmp_path):
+    """Takeover still at-least-once under the multiplexed drain with skew in
+    the fleet: kill the slow worker mid-epoch; the survivors re-serve its
+    pieces and no sample is lost."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=60,
+                                      rows_per_row_group=5)
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=(0.1 if i == 0 else 0.0),
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=1,
+                                    backoff_base=0.02, backoff_max=0.1)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 8:
+                workers[0].kill()  # the slow one
+                killed = True
+        assert killed
+        assert set(int(r["id"]) for r in rows) <= set(got)
     finally:
         for w in workers:
             w.stop()
