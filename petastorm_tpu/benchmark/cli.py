@@ -88,21 +88,45 @@ def _scenario_main(argv):
     parser.add_argument("--json-out", default=None,
                         help="also append the result as one JSON line to "
                              "this file (BENCH-style perf trajectory)")
+    parser.add_argument("--chaos", default=None,
+                        help="service scenario fault harness: "
+                             "dispatcher-restart, worker-kill, conn-drop "
+                             "(comma-separable). Checks delivery "
+                             "invariants and raises on violation")
+    parser.add_argument("--chaos-interval", type=float, default=None,
+                        dest="chaos_interval_s",
+                        help="seconds between injected chaos events")
+    parser.add_argument("--chaos-max-events", type=int, default=None,
+                        dest="chaos_max_events",
+                        help="stop injecting after this many events "
+                             "(default 4; 0 = unbounded)")
+    parser.add_argument("--journal-dir", default=None,
+                        help="service scenario dispatcher journal "
+                             "directory (default under chaos: a tmpdir)")
     args = parser.parse_args(argv)
 
     scenario = SCENARIOS[args.name]
     kwargs = {"dataset_url": args.dataset_url, "workers": args.workers}
     # Optional knobs forward only to scenarios whose signature takes them
     # (argparse exposes one surface; each scenario keeps its own defaults).
+    # Each entry carries the real flag spelling — kwarg names and flags
+    # diverge (--chaos-interval ↔ chaos_interval_s), and a rejection
+    # message must name a flag that exists.
     accepted = set(inspect.signature(scenario).parameters)
-    for name, value in (("batch_size", args.batch_size),
-                        ("mode", args.mode),
-                        ("skew_ms", args.skew_ms),
-                        ("credits", args.credits),
-                        ("json_out", args.json_out)):
+    for name, flag, value in (
+            ("batch_size", "--batch-size", args.batch_size),
+            ("mode", "--mode", args.mode),
+            ("skew_ms", "--skew-ms", args.skew_ms),
+            ("credits", "--credits", args.credits),
+            ("json_out", "--json-out", args.json_out),
+            ("chaos", "--chaos", args.chaos),
+            ("chaos_interval_s", "--chaos-interval", args.chaos_interval_s),
+            ("chaos_max_events", "--chaos-max-events",
+             args.chaos_max_events),
+            ("journal_dir", "--journal-dir", args.journal_dir)):
         if value is not None:
             if name not in accepted:
-                parser.error(f"--{name.replace('_', '-')} is not a knob of "
+                parser.error(f"{flag} is not a knob of "
                              f"the {args.name!r} scenario")
             kwargs[name] = value
     result = scenario(**kwargs)

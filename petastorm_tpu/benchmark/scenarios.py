@@ -66,6 +66,10 @@ def make_tabular_dataset(dataset_url, rows=DEFAULT_TABULAR_ROWS,
     day = np.repeat(np.arange(days, dtype=np.int32), rows // days)
     rows = len(day)  # trim to an exact multiple
     columns = {"day": day,
+               # Unique per-row key: lets the service/chaos scenarios check
+               # delivery invariants (no lost rows, no duplicates) instead
+               # of trusting row counts.
+               "sample_index": np.arange(rows, dtype=np.int64),
                "label": rng.randint(0, 2, rows).astype(np.int32)}
     for i in range(dense_cols):
         columns[f"dense_{i}"] = rng.rand(rows).astype(np.float32)
@@ -529,7 +533,9 @@ def packed_delivery_scenario(dataset_url=None, docs=2_048, max_len=48,
 def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               days=DEFAULT_TABULAR_DAYS, workers=2,
                               batch_size=512, mode="static", skew_ms=0.0,
-                              credits=8, json_out=None):
+                              credits=8, json_out=None, chaos=None,
+                              chaos_interval_s=1.5, chaos_max_events=4,
+                              journal_dir=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -546,6 +552,22 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     would serialize every fast batch behind the slow one. ``credits`` is
     the per-worker flow-control window handed to the client.
 
+    ``chaos`` arms the fault-injection harness
+    (:mod:`petastorm_tpu.service.chaos`): ``"dispatcher-restart"`` (crash +
+    journal-replay restart on the same port), ``"worker-kill"``,
+    ``"conn-drop"``, or a comma-separated mix, injected every
+    ``chaos_interval_s`` while the epoch streams, at most
+    ``chaos_max_events`` times (``None`` = unbounded — note that repeated
+    ``conn-drop`` restarts every in-flight piece set, so an unbounded
+    drop rate faster than a piece set streams never converges). The scenario then checks
+    delivery invariants on the dataset's unique ``sample_index`` — zero
+    lost rows always; zero duplicates too when only the control plane was
+    perturbed (dispatcher restarts) — and RAISES if they are violated, so
+    a chaos run doubles as an acceptance check. All workers are paced
+    ~30 ms/batch under chaos so the epoch outlasts the injections.
+    Recovery counters land in the result (``dispatcher_recovery``,
+    ``client_recovery``, ``chaos_events``).
+
     The result is BENCH-style (``metric``/``value``/``unit``/
     ``vs_baseline`` + detail keys, one JSON object); ``json_out`` appends
     it as one JSON line to that path so skew/loopback numbers land in the
@@ -556,37 +578,98 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     from petastorm_tpu.reader.reader import make_batch_reader
     from petastorm_tpu.service import (BatchWorker, Dispatcher,
                                        ServiceBatchSource)
+    from petastorm_tpu.service.chaos import (CHAOS_KINDS, ChaosInjector,
+                                             connection_drop_action,
+                                             delivery_invariants,
+                                             dispatcher_restart_action,
+                                             worker_kill_action)
+
+    chaos_kinds = ([k.strip() for k in chaos.split(",") if k.strip()]
+                   if isinstance(chaos, str) else list(chaos or []))
+    for kind in chaos_kinds:
+        if kind not in CHAOS_KINDS:
+            raise ValueError(
+                f"unknown chaos kind {kind!r}; choose from {CHAOS_KINDS}")
+    if chaos_kinds and mode != "static":
+        raise ValueError("chaos invariants need static sharding (fcfs has "
+                         "no per-client delivery contract to check)")
+    if chaos_kinds and dataset_url is not None:
+        raise ValueError(
+            "chaos delivery invariants are checked against the scenario's "
+            "own synthesized dataset (unique sample_index per row, known "
+            "row count) — omit --dataset-url when --chaos is armed")
 
     tmpdir = None
     if dataset_url is None:
         tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_service_")
         dataset_url = f"file://{tmpdir}/ds"
         rows = make_tabular_dataset(dataset_url, rows=rows, days=days)
+    journal_tmp = None
+    if chaos_kinds and journal_dir is None:
+        journal_tmp = tempfile.mkdtemp(prefix="petastorm_tpu_journal_")
+        journal_dir = journal_tmp
 
-    dispatcher = Dispatcher(port=0, mode=mode, num_epochs=1).start()
+    # Chaos pacing: loopback drains a synthetic epoch in well under a
+    # second, which no failure could land inside — pace every worker so the
+    # epoch spans several injection intervals.
+    chaos_pace_s = 0.03 if chaos_kinds else 0.0
+    lease_timeout_s = 2.0 if chaos_kinds else 30.0
+
+    def make_dispatcher(host="127.0.0.1", port=0):
+        return Dispatcher(host=host, port=port, mode=mode, num_epochs=1,
+                          journal_dir=journal_dir,
+                          lease_timeout_s=lease_timeout_s)
+
+    dispatcher_holder = [make_dispatcher().start()]
     fleet = []
+    injector = None
     try:
         for i in range(workers):
             # Appended one by one so a failing start() mid-fleet still
             # leaves the already-started workers in `fleet` for teardown.
             fleet.append(BatchWorker(
-                dataset_url, dispatcher_address=dispatcher.address,
+                dataset_url,
+                dispatcher_address=dispatcher_holder[0].address,
                 batch_size=batch_size, reader_factory="batch",
                 worker_id=f"bench-worker-{i}",
-                batch_delay_s=(skew_ms / 1000.0 if i == 0 else 0.0),
+                batch_delay_s=max(skew_ms / 1000.0 if i == 0 else 0.0,
+                                  chaos_pace_s),
+                heartbeat_interval_s=0.5 if chaos_kinds else 5.0,
                 reader_kwargs={"workers_count": 2}).start())
-        source = ServiceBatchSource(dispatcher.address, credits=credits)
+        source = ServiceBatchSource(
+            dispatcher_holder[0].address, credits=credits,
+            heartbeat_interval_s=0.3 if chaos_kinds else 2.0)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False)
+        if chaos_kinds:
+            actions = []
+            for kind in chaos_kinds:
+                if kind == "dispatcher-restart":
+                    actions.append((kind, dispatcher_restart_action(
+                        dispatcher_holder, make_dispatcher)))
+                elif kind == "worker-kill":
+                    actions.append((kind, worker_kill_action(fleet)))
+                else:
+                    actions.append((kind, connection_drop_action(
+                        lambda: [dispatcher_holder[0]] + fleet)))
+            injector = ChaosInjector(actions,
+                                     interval_s=chaos_interval_s,
+                                     max_events=(chaos_max_events
+                                                 or None)).start()
         served_rows = batches = 0
+        got_ids = []
         arrivals = []  # (elapsed_s, cumulative rows) per batch
         t0 = time.perf_counter()
         with loader:
             for batch in loader:
                 batches += 1
                 served_rows += len(next(iter(batch.values())))
+                if chaos_kinds and "sample_index" in batch:
+                    got_ids.extend(int(i) for i in batch["sample_index"])
                 arrivals.append((time.perf_counter() - t0, served_rows))
         service_wall = time.perf_counter() - t0
+        if injector is not None:
+            injector.stop()
         # Delivery timeline: when half the rows had reached the trainer.
         # Under skew this is the head-of-line number — a blocking drain
         # paces EVERY delivery at the slow worker's rate (half at ~half the
@@ -636,6 +719,40 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 wid: counters["stall_s"]
                 for wid, counters in source_diag["per_worker"].items()},
         }
+        if chaos_kinds:
+            # Control-plane-only faults must not repeat a single row; any
+            # fault that kills or drops the data plane re-delivers pieces
+            # (at-least-once — duplicates are the contract, loss never is).
+            allow_duplicates = any(k != "dispatcher-restart"
+                                   for k in chaos_kinds)
+            invariants = delivery_invariants(range(rows), got_ids,
+                                             allow_duplicates)
+            status = source.dispatcher_status()
+            recovery = status.get("recovery", {})
+            result.update({
+                "chaos": ",".join(chaos_kinds),
+                "chaos_events": injector.events,
+                "chaos_errors": injector.errors,
+                "chaos_pace_s": chaos_pace_s,
+                "lost_rows": invariants["lost_rows"],
+                "duplicate_rows": invariants["duplicate_rows"],
+                "fencing_epoch": status.get("fencing_epoch"),
+                "dispatcher_recovery": recovery,
+                "client_recovery": source.diagnostics.get("recovery", {}),
+            })
+            if not invariants["ok"]:
+                raise RuntimeError(
+                    f"chaos run violated delivery invariants: "
+                    f"{invariants['lost_rows']} lost rows, "
+                    f"{invariants['duplicate_rows']} duplicates "
+                    f"(allow_duplicates={allow_duplicates}); events: "
+                    f"{injector.events}")
+            if "dispatcher-restart" in chaos_kinds and (
+                    recovery.get("journal_replays", 0) < 1
+                    or recovery.get("fencing_bumps", 0) < 1):
+                raise RuntimeError(
+                    f"dispatcher-restart chaos recorded no recovery: "
+                    f"{recovery} (events: {injector.events})")
         if json_out:
             import json
 
@@ -643,11 +760,15 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 f.write(json.dumps(result) + "\n")
         return result
     finally:
+        if injector is not None:
+            injector.stop()
         for worker in fleet:
             worker.stop()
-        dispatcher.stop()
+        dispatcher_holder[0].stop()
         if tmpdir:
             shutil.rmtree(tmpdir, ignore_errors=True)
+        if journal_tmp:
+            shutil.rmtree(journal_tmp, ignore_errors=True)
 
 
 SCENARIOS = {

@@ -409,6 +409,11 @@ class JaxDataLoader:
         # Captured so the finally tears down THIS iteration's source even
         # if a newer iteration has since replaced the attribute.
         source_iter = self._source_iter
+        # Seed the source's delivery/recovery counters at iteration START
+        # (the finally refreshes them at the end): a consumer polling
+        # diagnostics mid-epoch — a stall dashboard, the chaos harness —
+        # must see the "source" stage without waiting for the pass to end.
+        self._snapshot_source_diagnostics()
         start = time.perf_counter()
         try:
             while True:
@@ -463,10 +468,7 @@ class JaxDataLoader:
             # service's per-worker stall / ready-queue / credit numbers)
             # lands in the stage breakdown, so one diagnostics dict
             # root-causes a stall across the whole delivery path.
-            source_diag = (getattr(self._batch_source, "diagnostics", None)
-                           if self._batch_source is not None else None)
-            if isinstance(source_diag, dict):
-                self.diagnostics["source"] = dict(source_diag)
+            self._snapshot_source_diagnostics()
             # Generator abandoned (break) or exhausted: stop the producer so
             # it doesn't keep decoding the rest of the dataset forever. On
             # the direct path, closing the source iterator is what tears
@@ -477,6 +479,14 @@ class JaxDataLoader:
                 if callable(close):
                     close()
             self.stop()
+
+    def _snapshot_source_diagnostics(self):
+        """Copy the batch_source's diagnostics dict (if it has one) into
+        this loader's ``diagnostics["source"]`` stage slot."""
+        source_diag = (getattr(self._batch_source, "diagnostics", None)
+                       if self._batch_source is not None else None)
+        if isinstance(source_diag, dict):
+            self.diagnostics["source"] = dict(source_diag)
 
     @staticmethod
     def _batch_rows(batch):

@@ -40,6 +40,7 @@ import json
 import socket
 import struct
 import threading
+import time
 
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
 
@@ -51,7 +52,10 @@ PAYLOAD_NONE = 0
 PAYLOAD_PICKLE = 1
 PAYLOAD_ARROW = 2
 
-#: Refuse to allocate for absurd frame sizes (corrupt stream / wrong peer).
+#: Default frame-size cap: refuse to allocate for absurd frame sizes
+#: (corrupt stream / wrong peer / hostile length prefix). Receivers accept a
+#: per-connection ``max_frame_bytes`` override — a control-plane server has
+#: no business accepting multi-GB frames even when the data plane does.
 MAX_FRAME_BYTES = 1 << 34
 #: Headers are small JSON dicts (well under 1 KB in practice); a "header
 #: length" beyond this means a desynced or non-protocol byte stream, and
@@ -61,6 +65,30 @@ MAX_HEADER_BYTES = 1 << 20
 
 class ConnectionClosedError(ConnectionError):
     """The peer closed the connection (mid-message or between messages)."""
+
+
+class ProtocolError(ValueError):
+    """The byte stream is not a sane framed message (oversized header or
+    frame length prefix — a desynced, corrupt, or hostile peer). Raised
+    BEFORE any allocation sized by the untrusted prefix; the connection is
+    unrecoverable (framing is lost) and should be closed."""
+
+
+def _check_frame_len(frame_len, max_frame_bytes):
+    limit = MAX_FRAME_BYTES if max_frame_bytes is None else max_frame_bytes
+    if frame_len > limit:
+        raise ProtocolError(
+            f"Framed payload frame of {frame_len} bytes exceeds the "
+            f"{limit}-byte max_frame_bytes limit (desynced, corrupt, or "
+            f"hostile peer?) — refusing the allocation")
+
+
+def _check_header_len(header_len):
+    if header_len > MAX_HEADER_BYTES:
+        raise ProtocolError(
+            f"Framed header length {header_len} exceeds the "
+            f"{MAX_HEADER_BYTES}-byte header limit (desynced or "
+            f"non-protocol peer?)")
 
 
 class BufferPool:
@@ -213,11 +241,13 @@ def send_framed(sock, header, payload=None):
             sock.sendall(part)
 
 
-def recv_framed(sock):
+def recv_framed(sock, max_frame_bytes=None):
     """Receive one message → ``(header dict, payload)``.
 
     Raises :class:`ConnectionClosedError` when the peer hung up (cleanly
-    between messages or mid-message — both mean the stream is over).
+    between messages or mid-message — both mean the stream is over), and
+    :class:`ProtocolError` for a length prefix beyond ``max_frame_bytes``
+    (default :data:`MAX_FRAME_BYTES`) — BEFORE allocating for it.
 
     Stateless field-by-field fallback (one ``recv_into`` per field, never
     over-reads): right for one-shot peers and tests. Connection-oriented
@@ -225,19 +255,14 @@ def recv_framed(sock):
     recycles transient buffers across messages.
     """
     header_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
-    if header_len > MAX_HEADER_BYTES:
-        raise ValueError(
-            f"Framed header length {header_len} exceeds the "
-            f"{MAX_HEADER_BYTES}-byte header limit (desynced or "
-            f"non-protocol peer?)")
+    _check_header_len(header_len)
     header = json.loads(_recv_exact(sock, header_len).decode("utf-8"))
     fmt = _FMT.unpack(_recv_exact(sock, _FMT.size))[0]
     n_frames = _NFRAMES.unpack(_recv_exact(sock, _NFRAMES.size))[0]
     frames = []
     for _ in range(n_frames):
         frame_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
-        if frame_len > MAX_FRAME_BYTES:
-            raise ValueError(f"Frame length {frame_len} exceeds limit")
+        _check_frame_len(frame_len, max_frame_bytes)
         frames.append(_recv_exact(sock, frame_len))
     return header, _decode_payload(fmt, frames)
 
@@ -269,9 +294,10 @@ class FramedReader:
     #: connection proves to be a data stream (see ``_refill``).
     FIRST_CHUNK = 1 << 13
 
-    def __init__(self, sock, pool=None):
+    def __init__(self, sock, pool=None, max_frame_bytes=None):
         self._sock = sock
         self._pool = pool if pool is not None else BufferPool()
+        self._max_frame_bytes = max_frame_bytes
         self._buf = None   # allocated lazily on first receive
         self._view = None
         self._start = 0   # unread region is [_start, _end)
@@ -355,11 +381,7 @@ class FramedReader:
     def recv(self):
         """Receive one framed message → ``(header dict, payload)``."""
         header_len = _LEN.unpack_from(self._take(_LEN.size))[0]
-        if header_len > MAX_HEADER_BYTES:
-            raise ValueError(
-                f"Framed header length {header_len} exceeds the "
-                f"{MAX_HEADER_BYTES}-byte header limit (desynced or "
-                f"non-protocol peer?)")
+        _check_header_len(header_len)
         header = json.loads(str(self._take(header_len), "utf-8"))
         meta = self._take(_FMT.size + _NFRAMES.size)
         fmt = _FMT.unpack_from(meta, 0)[0]
@@ -368,8 +390,7 @@ class FramedReader:
         head_buf = None
         for i in range(n_frames):
             frame_len = _LEN.unpack_from(self._take(_LEN.size))[0]
-            if frame_len > MAX_FRAME_BYTES:
-                raise ValueError(f"Frame length {frame_len} exceeds limit")
+            _check_frame_len(frame_len, self._max_frame_bytes)
             if fmt == PAYLOAD_PICKLE and i == 0:
                 # Pickle head: consumed synchronously by pickle.loads and
                 # never referenced after — pooled, recycled post-decode.
@@ -396,9 +417,9 @@ class FramedConnection:
     calls per message instead of one syscall per field, direct zero-copy
     receive for bulk frames, and pooled transient buffers."""
 
-    def __init__(self, sock):
+    def __init__(self, sock, max_frame_bytes=None):
         self._sock = sock
-        self._reader = FramedReader(sock)
+        self._reader = FramedReader(sock, max_frame_bytes=max_frame_bytes)
 
     #: Keepalive tuning for long-lived batch streams: first probe after 30s
     #: of idle, then every 10s, declared dead after 6 missed probes (~90s).
@@ -408,7 +429,7 @@ class FramedConnection:
 
     @classmethod
     def connect(cls, address, timeout=None, stream_timeout="same",
-                keepalive=False):
+                keepalive=False, max_frame_bytes=None):
         """Open a TCP connection to ``(host, port)``.
 
         ``timeout`` bounds the *dial*; ``stream_timeout`` is what the socket
@@ -425,6 +446,16 @@ class FramedConnection:
         timeout-less recv forever. Streams rely on this for worker-failure
         detection."""
         sock = socket.create_connection(tuple(address), timeout=timeout)
+        if sock.getsockname() == sock.getpeername():
+            # TCP self-connect: dialing a free port in the ephemeral range
+            # (a dispatcher that just died) can have the kernel pick the
+            # SAME port as the source — the socket connects to itself,
+            # squats the port (blocking the restart's rebind), and would
+            # feed the protocol its own bytes. Treat as refused; the
+            # shared retry policy handles the rest.
+            close_socket(sock)
+            raise ConnectionRefusedError(
+                f"self-connected to {tuple(address)} (peer not listening)")
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if keepalive:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
@@ -436,7 +467,7 @@ class FramedConnection:
                                     getattr(socket, opt), value)
         if stream_timeout != "same":
             sock.settimeout(stream_timeout)
-        return cls(sock)
+        return cls(sock, max_frame_bytes=max_frame_bytes)
 
     def send(self, header, payload=None):
         send_framed(self._sock, header, payload)
@@ -498,6 +529,7 @@ class FramedServer:
         self._listener = None
         self._accept_thread = None
         self._conns = set()
+        self._threads = set()  # live handler threads (bounded stop-drain)
         self._conns_lock = threading.Lock()
         self.stopped = threading.Event()
 
@@ -520,10 +552,23 @@ class FramedServer:
     def stop(self):
         self.stopped.set()
         if self._listener is not None:
+            # shutdown() BEFORE close(): close alone does not wake a
+            # thread blocked in accept(), and the in-progress syscall then
+            # pins the kernel socket in LISTEN — an immediate restart on
+            # the same port (dispatcher crash recovery) would fail with
+            # EADDRINUSE until some stray connection happened to arrive.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
                 pass
+        if self._accept_thread is not None \
+                and self._accept_thread is not threading.current_thread():
+            # The port is only certainly free once the accept loop exited.
+            self._accept_thread.join(timeout=5)
         self.close_connections()
 
     def close_connections(self):
@@ -534,6 +579,21 @@ class FramedServer:
         for sock in conns:
             close_socket(sock)
 
+    def join(self, timeout=5.0):
+        """Bounded drain of live handler threads (call after :meth:`stop`:
+        closed sockets unblock their ``recv``/``send``, so they exit fast).
+        Returns the threads still alive at the deadline — a caller that
+        must tear down shared resources (e.g. a worker's readers) can do
+        so knowing which handlers failed to wind down in time."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._conns_lock:
+            threads = list(self._threads)
+        for thread in threads:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            thread.join(timeout=remaining)
+        return [t for t in threads if t.is_alive()]
+
     def _accept_loop(self):
         while not self.stopped.is_set():
             try:
@@ -541,17 +601,23 @@ class FramedServer:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            thread = threading.Thread(target=self._serve, args=(conn,),
+                                      daemon=True,
+                                      name=f"{self._name}-conn")
             with self._conns_lock:
                 self._conns.add(conn)
-            threading.Thread(target=self._serve, args=(conn,), daemon=True,
-                             name=f"{self._name}-conn").start()
+                self._threads.add(thread)
+            thread.start()
 
     def _serve(self, sock):
         try:
             self._handle_connection(sock)
         except (ConnectionClosedError, OSError):
             pass
+        except ProtocolError:
+            pass  # desynced peer: framing lost, drop the connection
         finally:
             with self._conns_lock:
                 self._conns.discard(sock)
+                self._threads.discard(threading.current_thread())
             sock.close()

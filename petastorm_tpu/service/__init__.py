@@ -30,12 +30,22 @@ re-assignment of the dead worker's pieces to survivors — re-delivered from
 the start of the piece set, so no sample is lost (duplicates possible,
 exactly the reader layer's buffered-row resume contract).
 
+The control plane itself is fault-tolerant: the dispatcher journals its
+state to a WAL (:mod:`petastorm_tpu.service.journal`) and rebuilds it on
+restart; workers and clients heartbeat (lease expiry evicts hung workers;
+workers re-register automatically); and a monotonically increasing fencing
+epoch makes every party resync after a recovery instead of acting on a
+stale plan. :mod:`petastorm_tpu.service.chaos` injects these failures at
+configurable rates so the invariants stay tested end to end.
+
 CLI: ``python -m petastorm_tpu.service dispatcher|worker``; architecture
 walkthrough in ``docs/guides/service.md``.
 """
 
+from petastorm_tpu.service.chaos import ChaosInjector
 from petastorm_tpu.service.client import ServiceBatchSource, ServiceError
 from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.journal import Journal
 from petastorm_tpu.service.worker import BatchWorker
 
 __all__ = [
@@ -43,4 +53,6 @@ __all__ = [
     "BatchWorker",
     "ServiceBatchSource",
     "ServiceError",
+    "Journal",
+    "ChaosInjector",
 ]

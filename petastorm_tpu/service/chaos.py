@@ -1,0 +1,175 @@
+"""Chaos harness for the disaggregated data service.
+
+Injects the control-plane failures the service claims to survive —
+dispatcher kill/restart, worker SIGKILL-style death, connection drops — at
+a configurable rate while a real topology serves a real epoch, so the
+delivery invariants (no lost rows; no duplicates when only the control
+plane is perturbed) are asserted against actual behavior instead of unit
+mocks. The ``service`` benchmark scenario wires this in via ``--chaos``
+(``docs/guides/service.md#failure-model-and-recovery``); the fault-injection
+tests drive the same actions deterministically.
+
+Each injected event is recorded as ``(elapsed_s, label)`` so a failing
+invariant can be correlated with what the harness did when.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop")
+
+
+class ChaosInjector:
+    """Run ``actions`` round-robin on a background thread.
+
+    :param actions: list of ``(label, callable)`` — each callable injects
+        one fault when invoked (and must tolerate being called while the
+        topology is mid-recovery from the previous one).
+    :param interval_s: pause between injected events.
+    :param initial_delay_s: pause before the first event (lets the epoch's
+        streams start so the fault lands mid-flight, not at setup).
+    :param max_events: stop injecting after this many events (``None`` =
+        until :meth:`stop`).
+    """
+
+    def __init__(self, actions, interval_s=1.5, initial_delay_s=0.4,
+                 max_events=None):
+        if not actions:
+            raise ValueError("chaos needs at least one (label, action)")
+        self._actions = list(actions)
+        self._interval_s = interval_s
+        self._initial_delay_s = initial_delay_s
+        self._max_events = max_events
+        self._stop = threading.Event()
+        self._thread = None
+        self._start_time = None
+        self.events = []   # (elapsed_s, label) per injected fault
+        self.errors = []   # (label, repr(exc)) — injection must not die
+
+    def start(self):
+        self._start_time = time.perf_counter()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="service-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=30):
+        """Signal and join. The join budget covers a worst-case in-flight
+        ``dispatcher_restart_action`` (graceful stop ≈ up to ~10s on a
+        wedged handler + downtime + start): callers tear nodes down AFTER
+        this returns, so an action must not be left installing a fresh
+        node behind the teardown's back."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.error(
+                    "chaos injector thread still alive after %.0fs stop "
+                    "budget — a node it installs now may leak", timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+    def _run(self):
+        if self._stop.wait(self._initial_delay_s):
+            return
+        count = 0
+        while not self._stop.is_set():
+            label, action = self._actions[count % len(self._actions)]
+            elapsed = time.perf_counter() - self._start_time
+            logger.warning("chaos: injecting %s at t=%.2fs", label, elapsed)
+            try:
+                action()
+                self.events.append((round(elapsed, 3), label))
+            except Exception as exc:  # a failed injection must not kill
+                logger.exception("chaos action %s failed", label)
+                self.errors.append((label, repr(exc)))
+            count += 1
+            if self._max_events is not None and count >= self._max_events:
+                return
+            if self._stop.wait(self._interval_s):
+                return
+
+
+def dispatcher_restart_action(holder, dispatcher_factory, downtime_s=0.15):
+    """Crash-and-restart the dispatcher in ``holder[0]``.
+
+    The running dispatcher is stopped abruptly (no final snapshot — a
+    crash), and after ``downtime_s`` a replacement built by
+    ``dispatcher_factory(host, port)`` is started on the SAME address and
+    placed back into ``holder`` (a one-element list, so the surrounding
+    scenario's teardown always stops the current incumbent). Point the
+    factory at the same ``journal_dir`` to exercise WAL replay — that is
+    the configuration whose delivery invariant is zero lost AND zero
+    duplicate rows.
+    """
+    def action():
+        old = holder[0]
+        host, port = old.address
+        old.stop()
+        time.sleep(downtime_s)
+        holder[0] = dispatcher_factory(host, port).start()
+    return action
+
+
+def worker_kill_action(fleet, min_survivors=1):
+    """Kill (SIGKILL-style: connections dropped mid-stream, no ``end``)
+    the next live worker in ``fleet``, never dropping the live count below
+    ``min_survivors`` — the delivery invariant under worker death is
+    at-least-once (no loss; duplicates allowed)."""
+    state = {"killed": set()}
+
+    def action():
+        alive = [w for w in fleet if id(w) not in state["killed"]]
+        if len(alive) <= min_survivors:
+            logger.warning("chaos: only %d worker(s) left — not killing",
+                           len(alive))
+            return
+        victim = alive[0]
+        state["killed"].add(id(victim))
+        victim.kill()
+    return action
+
+
+def connection_drop_action(nodes_fn):
+    """Drop every open connection on every node (dispatcher and/or
+    workers) without stopping their servers — a transport blip; clients
+    must reconnect and re-stream (at-least-once). ``nodes_fn`` is called
+    per event so the action tracks replacements (a dispatcher-restart
+    injection swaps the incumbent out from under a static list)."""
+    def action():
+        for node in nodes_fn():
+            node.drop_connections()
+    return action
+
+
+def delivery_invariants(expected_ids, got_ids, allow_duplicates):
+    """Check the chaos run's row-delivery invariants.
+
+    :param expected_ids: the unique sample keys one clean epoch delivers.
+    :param got_ids: every sample key the trainer actually received.
+    :param allow_duplicates: ``True`` under data-plane faults (worker
+        kill, connection drop — at-least-once re-delivery is the
+        contract); ``False`` when only the control plane was perturbed
+        (dispatcher restart with a journal must not repeat rows).
+    :returns: ``{"lost_rows", "duplicate_rows", "ok"}``.
+    """
+    from collections import Counter
+
+    expected = Counter(expected_ids)
+    got = Counter(got_ids)
+    lost = sum((expected - got).values())
+    duplicates = sum((got - expected).values())
+    return {
+        "lost_rows": lost,
+        "duplicate_rows": duplicates,
+        "ok": lost == 0 and (allow_duplicates or duplicates == 0),
+    }

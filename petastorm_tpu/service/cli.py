@@ -47,6 +47,17 @@ def _build_parser():
     disp.add_argument("--mode", choices=["static", "fcfs"], default="static")
     disp.add_argument("--num-epochs", type=int, default=1,
                       help="epochs to serve; 0 means serve forever")
+    disp.add_argument("--journal-dir", default=None,
+                      help="crash-recovery journal directory (JSONL WAL + "
+                           "compacted snapshots); a restarted dispatcher "
+                           "replays it and resumes with identical "
+                           "assignments. Omit for in-memory-only state")
+    disp.add_argument("--lease-timeout", type=float, default=30.0,
+                      help="seconds without a heartbeat before a worker is "
+                           "evicted; 0 disables lease expiry")
+    disp.add_argument("--journal-fsync", action="store_true",
+                      help="fsync the WAL per record (durable against OS "
+                           "crash; default survives process crashes)")
 
     work = sub.add_parser("worker", help="run a batch worker")
     work.add_argument("--dispatcher", default=None,
@@ -65,6 +76,10 @@ def _build_parser():
     work.add_argument("--reader-pool-type", default="thread",
                       choices=["thread", "process", "dummy"])
     work.add_argument("--worker-id", default=None)
+    work.add_argument("--heartbeat-interval", type=float, default=5.0,
+                      help="seconds between dispatcher lease renewals "
+                           "(also drives automatic re-registration after "
+                           "a dispatcher restart); 0 disables")
     return parser
 
 
@@ -74,7 +89,10 @@ def build_service_node(args):
         from petastorm_tpu.service.dispatcher import Dispatcher
 
         return Dispatcher(host=args.host, port=args.port, mode=args.mode,
-                          num_epochs=args.num_epochs or None)
+                          num_epochs=args.num_epochs or None,
+                          journal_dir=args.journal_dir,
+                          lease_timeout_s=args.lease_timeout or None,
+                          journal_fsync=args.journal_fsync)
     from petastorm_tpu.service.worker import BatchWorker
 
     return BatchWorker(
@@ -83,13 +101,16 @@ def build_service_node(args):
                             if args.dispatcher else None),
         host=args.host, port=args.port, batch_size=args.batch_size,
         reader_factory=args.reader, worker_id=args.worker_id,
+        heartbeat_interval_s=args.heartbeat_interval or None,
         reader_kwargs={"workers_count": args.workers_count,
                        "reader_pool_type": args.reader_pool_type})
 
 
-def main(argv=None, run_seconds=None):
-    """Entry point. ``run_seconds`` bounds the serve loop (tests); the
-    default serves until SIGINT/SIGTERM."""
+def main(argv=None, run_seconds=None, stop_event=None):
+    """Entry point. ``run_seconds`` bounds the serve loop and
+    ``stop_event`` stops it early (both for tests — an embedding test must
+    be able to tear the node down instead of leaking its sockets for the
+    rest of ``run_seconds``); the default serves until SIGINT/SIGTERM."""
     args = _build_parser().parse_args(argv)
     node = build_service_node(args)
     node.start()
@@ -98,7 +119,7 @@ def main(argv=None, run_seconds=None):
                       **({"worker_id": node.worker_id}
                          if args.role == "worker" else {})}),
           flush=True)
-    stop = threading.Event()
+    stop = stop_event if stop_event is not None else threading.Event()
     try:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
     except ValueError:

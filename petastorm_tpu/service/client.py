@@ -246,12 +246,28 @@ class ServiceBatchSource:
         multiplexed drain yields from (static mode). ``None`` sizes it to
         ``max(4, 2 * active streams)`` — enough that every stream can have
         a batch ready plus one in the consumer's hand.
+    :param heartbeat_interval_s: poll the dispatcher's ``client_heartbeat``
+        this often while a static drain is live. The heartbeat carries the
+        dispatcher's fencing epoch: when it moves past the epoch this
+        client last synced its assignment at (dispatcher restart, worker
+        eviction), the drain resyncs — it re-fetches the assignment and
+        retires only the streams whose piece→worker mapping actually
+        changed, so a journal-backed restart that restores identical
+        assignments is a no-op (zero duplicate rows). ``None`` disables
+        the loop (fencing changes are then only seen through broken
+        streams and ``stale_fencing`` replies).
+    :param rpc_deadline_s: total time budget per dispatcher control RPC
+        across all retries (the shared ``retry_with_backoff`` policy) —
+        bounds how long a dispatcher outage can stall a control call.
+    :param max_frame_bytes: receive frame cap for this client's
+        connections (``None`` = the module default).
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
                  client_id=None, connect_timeout=10.0, max_retries=3,
                  backoff_base=0.05, backoff_max=2.0, resume_state=None,
-                 credits=8, ready_queue_depth=None):
+                 credits=8, ready_queue_depth=None, heartbeat_interval_s=2.0,
+                 rpc_deadline_s=30.0, max_frame_bytes=None):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if ready_queue_depth is not None and ready_queue_depth < 1:
@@ -268,12 +284,32 @@ class ServiceBatchSource:
         self._backoff_max = backoff_max
         self._credits = credits
         self._ready_queue_depth = ready_queue_depth
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._rpc_deadline_s = rpc_deadline_s
+        self._max_frame_bytes = max_frame_bytes
         self._ready_queue = None      # live queue while a drain is active
         self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
         self._mode = None
         self._epoch = 0
         self._completed = set()
+        # Fencing: the dispatcher's epoch at which the current assignment
+        # was fetched (or last resynced). The heartbeat loop compares the
+        # dispatcher's live epoch against it; _fence_pending dedupes fence
+        # events posted into the drain's ready-queue.
+        self._synced_fencing_epoch = 0
+        self._fence_pending = False
+        self._recovery = {
+            "resyncs": 0,             # fence-triggered assignment refreshes
+            "resync_failures": 0,     # resyncs deferred (dispatcher not
+            #                           ready) — retried by the heartbeat
+            "streams_retired": 0,     # live streams torn down by a resync
+            "takeovers": 0,           # dead-worker piece re-assignments
+            "stale_fencing_retries": 0,
+            "heartbeat_failures": 0,  # dispatcher unreachable at a tick
+            "fencing_epoch": 0,       # last fencing epoch observed
+            "dispatcher": {},         # dispatcher recovery counters (last
+        }                             # heartbeat reply)
         if resume_state is not None:
             self._validate_resume_state(resume_state)
             self._epoch = int(resume_state["epoch"])
@@ -291,24 +327,35 @@ class ServiceBatchSource:
 
     # -- dispatcher control channel ---------------------------------------
 
-    def _dispatcher_request(self, header):
-        """One request/reply against the dispatcher; transient socket
-        failures retry with backoff, protocol errors raise immediately."""
+    def _dispatcher_request(self, header, retries=None):
+        """One request/reply against the dispatcher under the shared retry
+        policy (bounded attempts, backoff with jitter, total
+        ``rpc_deadline_s`` budget); transient socket failures retry,
+        protocol errors raise immediately. Replies carrying a
+        ``fencing_epoch`` update the observed-epoch counter."""
 
         def once():
             with FramedConnection.connect(
                     self._dispatcher_address,
-                    timeout=self._connect_timeout) as conn:
+                    timeout=self._connect_timeout,
+                    max_frame_bytes=self._max_frame_bytes) as conn:
                 reply, _ = conn.request(header)
             if reply.get("type") == "error":
                 raise ServiceError(reply.get("error", "dispatcher error"))
             return reply
 
-        return retry_with_backoff(
-            once, retries=self._max_retries, base_delay=self._backoff_base,
+        reply = retry_with_backoff(
+            once, retries=self._max_retries if retries is None else retries,
+            base_delay=self._backoff_base,
             max_delay=self._backoff_max, retry_on=(OSError,),
-            no_retry_on=(ServiceError,),
+            no_retry_on=(ServiceError,), deadline_s=self._rpc_deadline_s,
             description=f"dispatcher request {header.get('type')!r}")
+        if "fencing_epoch" in reply:
+            with self._lock:
+                self._recovery["fencing_epoch"] = max(
+                    self._recovery["fencing_epoch"],
+                    int(reply["fencing_epoch"]))
+        return reply
 
     # -- the batch_source contract ----------------------------------------
 
@@ -343,11 +390,47 @@ class ServiceBatchSource:
     def _iter_static(self, info):
         num_epochs = info["num_epochs"]
         epoch = self._epoch
+        heartbeat_stop = threading.Event()
+        heartbeat = None
+        if self._heartbeat_interval_s is not None:
+            heartbeat = threading.Thread(
+                target=self._heartbeat_loop, args=(heartbeat_stop,),
+                daemon=True, name=f"service-heartbeat-{self.client_id}")
+            heartbeat.start()
+        try:
+            yield from self._iter_static_epochs(num_epochs, epoch)
+        finally:
+            heartbeat_stop.set()
+            if heartbeat is not None:
+                heartbeat.join(timeout=5)
+
+    def _request_assignment(self, epoch):
+        """The raw get_assignment request/reply — no fencing side effects
+        (callers that only need a piece→worker mapping for a SUBSET of the
+        shard, like the stale-fencing takeover path, must NOT mark the
+        whole drain synced: other streams moved by the same bump still
+        need the heartbeat-triggered resync to reconcile them)."""
+        return self._dispatcher_request({
+            "type": "get_assignment", "client_id": self.client_id,
+            "client_index": self.client_index,
+            "num_clients": self.num_clients, "epoch": epoch})
+
+    def _fetch_assignment(self, epoch):
+        """Fetch this client's assignment for ``epoch`` and sync the
+        fencing bookkeeping to it: the assignment is the freshest plan
+        there is, so whatever fencing epoch it was computed at is what the
+        drain is synced to (and any pending fence event is satisfied) —
+        valid only for callers that APPLY the full assignment (epoch
+        start, resync)."""
+        reply = self._request_assignment(epoch)
+        with self._lock:
+            self._synced_fencing_epoch = int(reply.get("fencing_epoch", 0))
+            self._fence_pending = False
+        return reply
+
+    def _iter_static_epochs(self, num_epochs, epoch):
         while num_epochs is None or epoch < num_epochs:
-            reply = self._dispatcher_request({
-                "type": "get_assignment", "client_id": self.client_id,
-                "client_index": self.client_index,
-                "num_clients": self.num_clients, "epoch": epoch})
+            reply = self._fetch_assignment(epoch)
             if not reply["assignments"] and num_epochs is None:
                 # This client's static shard has no pieces at all (more
                 # clients than row groups). With infinite epochs the loop
@@ -399,7 +482,13 @@ class ServiceBatchSource:
           events carry the same production counts as before;
         - credits replenish on dequeue, so the per-worker window bounds
           worker-sent-but-unconsumed batches end to end (socket buffer +
-          ready-queue share).
+          ready-queue share);
+        - a ``fence`` event (the heartbeat loop saw the dispatcher's
+          fencing epoch move past this drain's) resyncs the assignment:
+          streams whose piece→worker mapping is unchanged keep flowing
+          untouched (a journal-backed dispatcher restart is a no-op — zero
+          duplicates); only streams whose mapping changed are retired and
+          their pending pieces relaunched per the fresh plan.
         """
         if not streams:
             return
@@ -409,6 +498,7 @@ class ServiceBatchSource:
         ready = queue.Queue(maxsize=depth)
         stop = threading.Event()
         readers = []
+        retired = set()   # sids closed by a resync: terminal events ignored
         sid_counter = itertools.count(max(streams) + 1)
         with self._lock:
             self._ready_queue = ready
@@ -445,13 +535,82 @@ class ServiceBatchSource:
                 for stream in fresh:  # drain torn down mid-recovery
                     stream.close()
 
+        def resync(active):
+            """Re-fetch the assignment under the current fencing epoch and
+            reconcile the live streams against it (consumer thread). A
+            control-plane failure here (dispatcher mid-restart with no
+            workers re-registered yet, dispatcher unreachable) must NOT
+            surface into the training loop: the live streams are still
+            valid until proven otherwise, so leave them flowing and let
+            the next heartbeat re-trigger the resync."""
+            try:
+                reply = self._fetch_assignment(epoch)
+            except (ServiceError, OSError) as exc:
+                logger.warning(
+                    "resync under fencing epoch change failed (%s) — "
+                    "keeping current streams; the next heartbeat retries",
+                    exc)
+                with self._lock:
+                    self._recovery["resync_failures"] += 1
+                    self._fence_pending = False
+                return
+            with self._lock:
+                completed = set(self._completed)
+                self._recovery["resyncs"] += 1
+            desired = {}  # pending piece -> (worker_id, address)
+            for wid, pieces in reply["assignments"].items():
+                for piece in pieces:
+                    if piece not in completed:
+                        desired[piece] = (wid,
+                                          tuple(reply["workers"][wid]))
+            for sid in list(active):
+                stream = streams[sid]
+                if all(desired.get(p, (None,))[0] == stream.worker_id
+                       for p in stream.pieces):
+                    # Mapping unchanged: the stream keeps flowing — its
+                    # pieces are accounted for.
+                    for piece in stream.pieces:
+                        desired.pop(piece, None)
+                else:
+                    # Mapping moved (its worker was evicted/re-planned):
+                    # retire the stream; its pieces relaunch below, from
+                    # their beginning (at-least-once).
+                    streams.pop(sid)
+                    active.discard(sid)
+                    retired.add(sid)
+                    stream.close()
+                    with self._lock:
+                        self._recovery["streams_retired"] += 1
+                    logger.warning(
+                        "resync: retiring stream to %s (pieces %s moved "
+                        "under fencing epoch %s)", stream.worker_id,
+                        stream.pieces, reply.get("fencing_epoch"))
+            regroup = {}
+            for piece, (wid, address) in sorted(desired.items()):
+                regroup.setdefault((wid, address), []).append(piece)
+            for (wid, address), pieces in regroup.items():
+                new_sid = next(sid_counter)
+                active.add(new_sid)
+                launch(new_sid, _WorkerStream(
+                    wid, address, pieces, epoch, self._connect_timeout,
+                    credits=self._credits))
+
         try:
             for sid, stream in list(streams.items()):
                 launch(sid, stream)
             active = set(streams)
             recovering = 0
+            fence_deferred = False
             while active or recovering:
                 kind, sid, item = ready.get()
+                if sid is not None and sid in retired:
+                    # A batch/terminal event from a stream a resync already
+                    # retired: its pieces were relaunched elsewhere, so the
+                    # event is stale. Terminal events also finish the
+                    # bookkeeping for the retired sid.
+                    if kind in ("end", "broken"):
+                        retired.discard(sid)
+                    continue
                 if kind == "batch":
                     stream = streams[sid]
                     # Ack BEFORE yielding: the worker refills its window
@@ -479,6 +638,17 @@ class ServiceBatchSource:
                         new_sid = next(sid_counter)
                         active.add(new_sid)
                         launch(new_sid, new_stream)
+                    if recovering == 0 and fence_deferred:
+                        fence_deferred = False
+                        resync(active)
+                elif kind == "fence":
+                    # Defer while a takeover is in flight: the recovery
+                    # thread is about to hand back streams planned under an
+                    # epoch the resync supersedes — reconcile once, after.
+                    if recovering:
+                        fence_deferred = True
+                    else:
+                        resync(active)
                 else:  # "broken" — recover concurrently, keep draining
                     stream = streams.pop(sid)
                     active.discard(sid)
@@ -497,6 +667,7 @@ class ServiceBatchSource:
                 stream.close()
             with self._lock:
                 self._ready_queue = None
+                self._fence_pending = False
             for reader in readers:
                 reader.join(timeout=5)
 
@@ -516,6 +687,54 @@ class ServiceBatchSource:
             worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
         counters["batches"] += 1
         counters["inflight"] = max(0, counters["inflight"] - 1)
+
+    # -- liveness / fencing -------------------------------------------------
+
+    def _heartbeat_loop(self, stop_event):
+        """Poll ``client_heartbeat`` while a static drain is live. The
+        reply carries the dispatcher's fencing epoch and recovery
+        counters; an epoch past this drain's sync point (restart,
+        eviction) — or the dispatcher no longer knowing this client
+        (restart without a journal) — posts one ``fence`` event into the
+        drain. A dispatcher outage is a counted, retried tick, never an
+        error: the data plane keeps flowing without the control plane."""
+        while not stop_event.wait(self._heartbeat_interval_s):
+            try:
+                reply = self._dispatcher_request(
+                    {"type": "client_heartbeat", "client_id": self.client_id},
+                    retries=0)
+            except (ServiceError, OSError):
+                with self._lock:
+                    self._recovery["heartbeat_failures"] += 1
+                continue
+            fencing = int(reply.get("fencing_epoch", 0))
+            with self._lock:
+                self._recovery["dispatcher"] = dict(
+                    reply.get("recovery") or {})
+                stale = (fencing > self._synced_fencing_epoch
+                         or not reply.get("known", True))
+            if stale:
+                self._post_fence(fencing)
+
+    def _post_fence(self, fencing_epoch):
+        """Hand the drain a ``fence`` event (dedup'd: one outstanding at a
+        time; dropped when no drain is live — the next epoch's assignment
+        fetch syncs anyway, and the next heartbeat re-detects)."""
+        with self._lock:
+            ready = self._ready_queue
+            if ready is None or self._fence_pending:
+                return
+            self._fence_pending = True
+        for _ in range(20):  # bounded: never wedge the heartbeat thread
+            try:
+                ready.put(("fence", None, fencing_epoch), timeout=0.1)
+                return
+            except queue.Full:
+                with self._lock:
+                    if self._ready_queue is not ready:
+                        break  # drain torn down while we waited
+        with self._lock:
+            self._fence_pending = False  # next heartbeat re-detects
 
     def _retry_stream(self, stream):
         """Reconnect to the same worker and restart its piece set (the whole
@@ -548,14 +767,49 @@ class ServiceBatchSource:
 
     def _reassign(self, stream):
         """Report ``stream``'s worker dead; return fresh streams for its
-        pieces on the surviving workers the dispatcher names."""
+        pieces on the surviving workers the dispatcher names.
+
+        The report carries this client's synced fencing epoch: a
+        ``stale_fencing`` reply means the plan moved while this client
+        wasn't looking (dispatcher restart, an eviction it hasn't synced)
+        — instead of acting on the superseded takeover, re-fetch the
+        assignment under the current epoch and route the broken pieces
+        per the fresh plan (never double-delivering a piece another
+        mapping now owns, never skipping one)."""
         logger.warning(
             "worker %s unreachable after %d retries; requesting "
             "re-assignment of %d pieces", stream.worker_id,
             self._max_retries + 1, len(stream.pieces))
+        with self._lock:
+            token = self._synced_fencing_epoch
         reply = self._dispatcher_request({
             "type": "report_failure", "client_id": self.client_id,
-            "worker_id": stream.worker_id, "pieces": stream.pieces})
+            "worker_id": stream.worker_id, "pieces": stream.pieces,
+            "fencing_epoch": token})
+        if reply.get("type") == "stale_fencing":
+            with self._lock:
+                self._recovery["stale_fencing_retries"] += 1
+            # Raw request on purpose: this path only reroutes the BROKEN
+            # pieces. Syncing the drain's fencing epoch here would cancel
+            # the heartbeat-triggered resync that other live streams
+            # (moved by the same bump, e.g. a hung worker's eviction)
+            # still depend on.
+            fresh = self._request_assignment(stream.epoch)
+            broken = set(stream.pieces)
+            reply = {
+                "assignments": {
+                    wid: [p for p in pieces if p in broken]
+                    for wid, pieces in fresh["assignments"].items()},
+                "workers": fresh["workers"],
+            }
+            reply["assignments"] = {wid: ps for wid, ps
+                                    in reply["assignments"].items() if ps}
+        # NB: a successful report deliberately does NOT fast-forward the
+        # synced epoch — the reply's epoch may also cover an unrelated
+        # eviction this client hasn't reconciled; the next heartbeat then
+        # triggers a (no-op, if so) resync rather than silently skipping it.
+        with self._lock:
+            self._recovery["takeovers"] += 1
         return [
             _WorkerStream(wid, reply["workers"][wid], pieces, stream.epoch,
                           self._connect_timeout, credits=self._credits)
@@ -738,7 +992,13 @@ class ServiceBatchSource:
           (seconds its reader thread spent blocked waiting on the worker —
           a skewed worker shows up here, not in delivery latency), and
           ``credits_outstanding`` (batches received but not yet
-          consumed-and-acked).
+          consumed-and-acked);
+        - ``recovery``: control-plane recovery events this client observed
+          — ``resyncs`` (fence-triggered assignment refreshes),
+          ``streams_retired``, ``takeovers``, ``stale_fencing_retries``,
+          ``heartbeat_failures``, the last ``fencing_epoch`` seen, and
+          ``dispatcher`` (the dispatcher's own recovery counters — journal
+          replays, evictions, fencing bumps — from the last heartbeat).
 
         ``JaxDataLoader`` snapshots this into its own ``diagnostics`` under
         ``"source"`` when the source is plugged in.
@@ -756,6 +1016,10 @@ class ServiceBatchSource:
                           "stall_s": round(counters["stall_s"], 3),
                           "credits_outstanding": counters["inflight"]}
                     for wid, counters in self._per_worker.items()},
+                "recovery": {
+                    key: (dict(value) if isinstance(value, dict)
+                          else value)
+                    for key, value in self._recovery.items()},
             }
 
     def remote_diagnostics(self):

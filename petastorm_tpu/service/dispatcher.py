@@ -7,19 +7,45 @@ to stream from which workers. State is a few dicts under one lock; every
 request is a single framed message with a single framed reply, so the
 dispatcher stays trivially cheap even with many clients polling.
 
+Fault tolerance (``docs/guides/service.md#failure-model-and-recovery``):
+
+- **Durability** — with ``journal_dir`` set, every control-plane mutation
+  is appended to a JSONL WAL (:mod:`petastorm_tpu.service.journal`) with
+  periodic compacted snapshots; a restarted dispatcher replays it and
+  resumes with byte-identical assignments, so a dispatcher crash never
+  strands the fleet or loses epoch state.
+- **Liveness** — workers and clients heartbeat; a worker that misses its
+  ``lease_timeout_s`` lease is evicted (its splits re-assigned through the
+  existing takeover path) and re-admitted when it re-registers.
+- **Fencing** — a monotonically increasing ``fencing_epoch`` bumps on every
+  event that invalidates outstanding assignments (journal replay, worker
+  eviction, reported failure). Assignment-changing requests carry the
+  client's last-synced epoch; a stale one is rejected with
+  ``stale_fencing`` so a pre-restart client resyncs instead of acting on a
+  superseded plan (no double-delivery, no skipped splits).
+
 Request vocabulary (header ``type``):
 
-- ``register_worker`` ``{worker_id, host, port, num_pieces}`` → ``ok``
+- ``register_worker`` ``{worker_id, host, port, num_pieces[, re_register]}``
+  → ``ok``
+- ``worker_heartbeat`` ``{worker_id}`` → ``ok`` (lease renewed) or
+  ``unknown_worker`` (the worker must re-register — dispatcher restarted
+  without a journal, or the lease already expired)
+- ``client_heartbeat`` ``{client_id}`` → ``ok`` with the current
+  ``fencing_epoch`` + recovery counters (clients detect restarts/evictions
+  from the epoch moving past the one they synced at)
 - ``list_workers`` → ``workers`` (alive worker addresses + service config)
 - ``get_assignment`` ``{client_id, client_index, num_clients, epoch}``
   (static mode) → ``assignment``: this client's row-group shard partitioned
   across live workers
-- ``report_failure`` ``{client_id, worker_id, pieces}`` → ``assignment``:
-  the dead worker's pieces re-partitioned across survivors
+- ``report_failure`` ``{client_id, worker_id, pieces[, fencing_epoch]}`` →
+  ``assignment`` (the dead worker's pieces re-partitioned across survivors)
+  or ``stale_fencing``
 - ``next_split`` ``{client_id}`` (fcfs mode) → ``split`` or
   ``end_of_stream`` (dispatcher-owned epoch tracking: the shared queue
   refills until ``num_epochs`` is exhausted)
-- ``status`` → full control-plane snapshot (workers, clients, queue depth)
+- ``status`` → full control-plane snapshot (workers, clients, queue depth,
+  fencing epoch, recovery counters, journal stats)
 - ``worker_diagnostics`` → one fan-out to every live worker's
   ``diagnostics`` endpoint, aggregated — a trainer (or an operator's
   one-liner) reads the whole fleet's reader/flow-control state through the
@@ -31,6 +57,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 
 from petastorm_tpu.reader_impl.framed_socket import (
@@ -43,18 +70,48 @@ logger = logging.getLogger(__name__)
 
 MODES = ("static", "fcfs")
 
+#: Default worker-lease budget; a worker missing heartbeats this long is
+#: evicted and its splits become takeover candidates.
+DEFAULT_LEASE_TIMEOUT_S = 30.0
+
+#: Cap on the per-probe ``timeout`` header of ``worker_diagnostics``: a
+#: misbehaving client must not pin the probe pool's threads for minutes
+#: against an unreachable worker.
+PROBE_TIMEOUT_CAP_S = 30.0
+
 
 class Dispatcher:
     """Split-assignment server; start with :meth:`start`, stop with
-    :meth:`stop` (context manager supported)."""
+    :meth:`stop` (context manager supported).
 
-    def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1):
+    :param journal_dir: directory for the crash-recovery journal (WAL +
+        snapshots). ``None`` keeps state in memory only (a restart loses
+        it — the pre-journal behavior).
+    :param lease_timeout_s: evict a worker whose last heartbeat (or
+        registration) is older than this. ``None`` disables lease expiry.
+    :param journal_compact_every: WAL records between snapshot compactions.
+    :param journal_fsync: fsync the WAL per append (durable against OS
+        crash; the default survives process crashes).
+    :param max_frame_bytes: per-connection receive frame cap (control
+        messages are tiny; the default module cap is data-plane-sized).
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1,
+                 journal_dir=None, lease_timeout_s=DEFAULT_LEASE_TIMEOUT_S,
+                 journal_compact_every=256, journal_fsync=False,
+                 max_frame_bytes=None):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if num_epochs is not None and num_epochs <= 0:
             raise ValueError("num_epochs must be a positive integer or None")
         self.mode = mode
         self.num_epochs = num_epochs
+        self.journal_dir = journal_dir
+        # 0 and None both disable lease expiry (the CLI's documented
+        # contract); a literal 0 would otherwise expire every lease the
+        # instant it was granted.
+        self.lease_timeout_s = lease_timeout_s or None
+        self._max_frame_bytes = max_frame_bytes
         self._lock = threading.Lock()
         self._workers = {}   # worker_id -> {address, num_pieces, alive}
         self._clients = {}   # client_id -> {epoch, client_index, num_clients}
@@ -62,13 +119,42 @@ class Dispatcher:
         # fcfs shared queue: lazily built once the piece count is known.
         self._fcfs_queue = None
         self._fcfs_epoch = 0
+        # runtime-only liveness clocks (never persisted: wall-clock leases
+        # restart from "now" after a recovery — a restored worker gets a
+        # full lease to re-appear before it is declared dead).
+        self._worker_leases = {}       # worker_id -> monotonic expiry
+        self._client_heartbeats = {}   # client_id -> monotonic last-seen
+        self._fencing_epoch = 0
+        self._recovery = {
+            "journal_replays": 0,
+            "fencing_bumps": 0,
+            "evictions": 0,           # lease expiries
+            "failures_reported": 0,   # client-reported worker deaths
+            "re_registrations": 0,
+            "stale_fencing_rejections": 0,
+        }
+        self._journal = None
+        if journal_dir is not None:
+            from petastorm_tpu.service.journal import Journal
+
+            self._journal = Journal(journal_dir,
+                                    compact_every=journal_compact_every,
+                                    fsync=journal_fsync)
+        self._lease_thread = None
         self._server = FramedServer(self._serve_connection, host=host,
                                     port=port, name="service-dispatcher")
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
+        if self._journal is not None:
+            self._recover()
         self._server.start()
+        if self.lease_timeout_s is not None:
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True,
+                name="service-dispatcher-leases")
+            self._lease_thread.start()
         return self
 
     @property
@@ -78,6 +164,19 @@ class Dispatcher:
 
     def stop(self):
         self._server.stop()
+        # Drain handler threads BEFORE closing the journal: an in-flight
+        # mutation must finish its append (or fail its request), never
+        # write into a closed-then-resurrected WAL.
+        self._server.join(timeout=5)
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5)
+        if self._journal is not None:
+            self._journal.close()
+
+    def drop_connections(self):
+        """Abruptly drop every open connection without stopping the server
+        (fault injection: a network blip between control-plane peers)."""
+        self._server.close_connections()
 
     def __enter__(self):
         return self
@@ -85,10 +184,192 @@ class Dispatcher:
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.stop()
 
+    # -- durability --------------------------------------------------------
+
+    def state_snapshot(self):
+        """The dispatcher's full persistable state (what the journal's
+        compacted snapshot holds) — JSON-round-trippable, so a restart test
+        can assert byte-identical restoration."""
+        with self._lock:
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self):
+        return {
+            "mode": self.mode,
+            "num_epochs": self.num_epochs,
+            "num_pieces": self._num_pieces,
+            "workers": {wid: dict(w) for wid, w in self._workers.items()},
+            "clients": {cid: dict(c) for cid, c in self._clients.items()},
+            "fcfs_epoch": self._fcfs_epoch,
+            "fcfs_queue": (list(self._fcfs_queue)
+                           if self._fcfs_queue is not None else None),
+            "fencing_epoch": self._fencing_epoch,
+            "recovery": dict(self._recovery),
+        }
+
+    def _recover(self):
+        """Rebuild state from the journal (snapshot + WAL replay), then
+        record the recovery itself: the fencing epoch bumps so every
+        outstanding pre-crash assignment must resync, and the replay is
+        journaled so ``journal_replays`` survives the *next* restart."""
+        state, records = self._journal.load()
+        if state is None and not records:
+            # Fresh journal: seed it with the initial state so every later
+            # recovery (and the mode-compatibility check) has a snapshot
+            # to anchor on.
+            with self._lock:
+                self._journal.snapshot(self._state_dict_locked())
+            return
+        with self._lock:
+            if state is not None:
+                self._install_state_locked(state)
+            for record in records:
+                self._apply_record_locked(record)
+            now = time.monotonic()
+            lease = self.lease_timeout_s or 0.0
+            for wid, worker in self._workers.items():
+                if worker["alive"]:
+                    self._worker_leases[wid] = now + lease
+            self._recovery["journal_replays"] += 1
+            self._journal.append({"op": "replayed"})
+            self._bump_fencing_locked("journal_replay")
+        logger.warning(
+            "dispatcher recovered from journal %s: %d workers, %d clients, "
+            "%d WAL records replayed — fencing epoch now %d",
+            self.journal_dir, len(self._workers), len(self._clients),
+            len(records), self._fencing_epoch)
+
+    def _install_state_locked(self, state):
+        if state.get("mode") != self.mode:
+            raise ValueError(
+                f"journal at {self.journal_dir!r} was written by a "
+                f"{state.get('mode')!r}-mode dispatcher; this one runs "
+                f"{self.mode!r} — refusing to mix split-plan semantics")
+        self._num_pieces = state.get("num_pieces")
+        self._workers = {wid: dict(w)
+                         for wid, w in state.get("workers", {}).items()}
+        self._clients = {cid: dict(c)
+                         for cid, c in state.get("clients", {}).items()}
+        self._fcfs_epoch = int(state.get("fcfs_epoch", 0))
+        queue = state.get("fcfs_queue")
+        self._fcfs_queue = deque(queue) if queue is not None else None
+        self._fencing_epoch = int(state.get("fencing_epoch", 0))
+        recovered = state.get("recovery", {})
+        for key in self._recovery:
+            self._recovery[key] = int(recovered.get(key, 0))
+
+    def _apply_record_locked(self, record):
+        """Replay one WAL record through the same mutations the live
+        handlers perform (minus journaling — the record IS the journal)."""
+        op = record.get("op")
+        if op == "register_worker":
+            self._install_worker_locked(
+                record["worker_id"],
+                [record["host"], int(record["port"])],
+                int(record["num_pieces"]),
+                re_register=bool(record.get("re_register")))
+        elif op == "worker_dead":
+            self._mark_worker_dead_locked(record["worker_id"],
+                                          record.get("reason", "reported"))
+        elif op == "client":
+            self._clients[record["client_id"]] = {
+                "epoch": int(record["epoch"]),
+                "client_index": int(record["client_index"]),
+                "num_clients": int(record["num_clients"]),
+            }
+        elif op == "next_split":
+            self._replay_next_split_locked(int(record["piece"]),
+                                           int(record["epoch"]))
+        elif op == "fencing":
+            self._fencing_epoch = int(record["fencing_epoch"])
+            self._recovery["fencing_bumps"] += 1
+        elif op == "replayed":
+            self._recovery["journal_replays"] += 1
+        else:
+            logger.warning("journal: skipping unknown record op %r", op)
+
+    def _replay_next_split_locked(self, piece, epoch):
+        if self._fcfs_queue is None:
+            self._fcfs_queue = deque(range(self._num_pieces or 0))
+        if epoch > self._fcfs_epoch:
+            self._fcfs_epoch = epoch
+            self._fcfs_queue = deque(range(self._num_pieces or 0))
+        if self._fcfs_queue and self._fcfs_queue[0] == piece:
+            self._fcfs_queue.popleft()
+        else:  # defensive: a hand-edited journal must not corrupt the queue
+            try:
+                self._fcfs_queue.remove(piece)
+            except ValueError:
+                pass
+
+    def _journal_locked(self, record):
+        if self._journal is None:
+            return
+        self._journal.append(record)
+        self._journal.maybe_compact(self._state_dict_locked)
+
+    def _bump_fencing_locked(self, reason):
+        self._fencing_epoch += 1
+        self._recovery["fencing_bumps"] += 1
+        self._journal_locked({"op": "fencing",
+                              "fencing_epoch": self._fencing_epoch,
+                              "reason": reason})
+        logger.info("fencing epoch -> %d (%s)", self._fencing_epoch, reason)
+
+    # -- liveness ----------------------------------------------------------
+
+    def _lease_loop(self):
+        interval = max(0.05, (self.lease_timeout_s or 1.0) / 4.0)
+        while not self._server.stopped.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    wid for wid, worker in self._workers.items()
+                    if worker["alive"]
+                    and self._worker_leases.get(wid, now) <= now]
+                for wid in expired:
+                    logger.warning(
+                        "worker %s missed its %.1fs lease — evicting "
+                        "(its splits re-assign via the takeover path)",
+                        wid, self.lease_timeout_s)
+                    self._mark_worker_dead_locked(wid, "lease_expired")
+                    self._journal_locked({"op": "worker_dead",
+                                          "worker_id": wid,
+                                          "reason": "lease_expired"})
+                if expired:
+                    self._bump_fencing_locked("lease_expiry")
+
+    def _mark_worker_dead_locked(self, worker_id, reason):
+        worker = self._workers.get(worker_id)
+        if worker is None or not worker["alive"]:
+            return False
+        worker["alive"] = False
+        self._worker_leases.pop(worker_id, None)
+        if reason == "lease_expired":
+            self._recovery["evictions"] += 1
+        else:
+            self._recovery["failures_reported"] += 1
+        return True
+
+    def _install_worker_locked(self, worker_id, address, num_pieces,
+                               re_register=False):
+        known = worker_id in self._workers
+        self._num_pieces = num_pieces
+        self._workers[worker_id] = {
+            "address": list(address),
+            "num_pieces": num_pieces,
+            "alive": True,
+        }
+        if known or re_register:
+            self._recovery["re_registrations"] += 1
+        self._worker_leases[worker_id] = (
+            time.monotonic() + (self.lease_timeout_s or 0.0))
+        return known
+
     # -- serving -----------------------------------------------------------
 
     def _serve_connection(self, sock):
-        reader = FramedReader(sock)
+        reader = FramedReader(sock, max_frame_bytes=self._max_frame_bytes)
         while not self._server.stopped.is_set():
             header, _ = reader.recv()
             try:
@@ -119,6 +400,7 @@ class Dispatcher:
     def _handle_register_worker(self, header):
         worker_id = header["worker_id"]
         num_pieces = int(header["num_pieces"])
+        re_register = bool(header.get("re_register"))
         with self._lock:
             if self._num_pieces is not None \
                     and self._num_pieces != num_pieces:
@@ -127,15 +409,43 @@ class Dispatcher:
                     f"pieces but the service plan has {self._num_pieces} — "
                     f"all workers must read the same dataset with the same "
                     f"planning config")}
-            self._num_pieces = num_pieces
-            self._workers[worker_id] = {
-                "address": [header["host"], int(header["port"])],
-                "num_pieces": num_pieces,
-                "alive": True,
+            self._install_worker_locked(
+                worker_id, [header["host"], int(header["port"])],
+                num_pieces, re_register=re_register)
+            self._journal_locked({
+                "op": "register_worker", "worker_id": worker_id,
+                "host": header["host"], "port": int(header["port"]),
+                "num_pieces": num_pieces, "re_register": re_register})
+            fencing = self._fencing_epoch
+        logger.info("worker %s %sregistered at %s:%s (%d pieces)",
+                    worker_id, "re-" if re_register else "",
+                    header["host"], header["port"], num_pieces)
+        return {"type": "ok", "fencing_epoch": fencing}
+
+    def _handle_worker_heartbeat(self, header):
+        worker_id = header["worker_id"]
+        with self._lock:
+            worker = self._workers.get(worker_id)
+            if worker is None or not worker["alive"]:
+                # Unknown (restart without a journal) or evicted: the
+                # worker re-registers with its old worker_id and rejoins.
+                return {"type": "unknown_worker",
+                        "fencing_epoch": self._fencing_epoch}
+            self._worker_leases[worker_id] = (
+                time.monotonic() + (self.lease_timeout_s or 0.0))
+            return {"type": "ok", "fencing_epoch": self._fencing_epoch}
+
+    def _handle_client_heartbeat(self, header):
+        client_id = header.get("client_id")
+        with self._lock:
+            known = client_id in self._clients
+            self._client_heartbeats[client_id] = time.monotonic()
+            return {
+                "type": "ok",
+                "known": known,
+                "fencing_epoch": self._fencing_epoch,
+                "recovery": dict(self._recovery),
             }
-        logger.info("worker %s registered at %s:%s (%d pieces)",
-                    worker_id, header["host"], header["port"], num_pieces)
-        return {"type": "ok"}
 
     def _alive_workers(self):
         return {wid: w for wid, w in self._workers.items() if w["alive"]}
@@ -149,6 +459,7 @@ class Dispatcher:
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
                 "num_pieces": self._num_pieces,
+                "fencing_epoch": self._fencing_epoch,
             }
 
     @staticmethod
@@ -185,9 +496,15 @@ class Dispatcher:
                 "client_index": client_index,
                 "num_clients": num_clients,
             }
+            self._client_heartbeats[header["client_id"]] = time.monotonic()
+            self._journal_locked({
+                "op": "client", "client_id": header["client_id"],
+                "epoch": int(header.get("epoch", 0)),
+                "client_index": client_index, "num_clients": num_clients})
             return {
                 "type": "assignment",
                 "epoch": int(header.get("epoch", 0)),
+                "fencing_epoch": self._fencing_epoch,
                 "assignments": assignments,
                 "workers": {wid: alive[wid]["address"]
                             for wid in assignments},
@@ -196,9 +513,27 @@ class Dispatcher:
     def _handle_report_failure(self, header):
         worker_id = header["worker_id"]
         pieces = [int(p) for p in header.get("pieces", [])]
+        token = header.get("fencing_epoch")
         with self._lock:
-            if worker_id in self._workers:
-                self._workers[worker_id]["alive"] = False
+            if token is not None and int(token) < self._fencing_epoch:
+                # The client is acting on a plan the fencing epoch has
+                # since invalidated (dispatcher restart, eviction it has
+                # not seen): make it resync before any takeover — acting
+                # on the stale report could evict a worker that already
+                # re-registered, or re-partition splits the client no
+                # longer owns.
+                self._recovery["stale_fencing_rejections"] += 1
+                logger.warning(
+                    "rejecting stale report_failure from %s (token %s < "
+                    "fencing epoch %d)", header.get("client_id"), token,
+                    self._fencing_epoch)
+                return {"type": "stale_fencing",
+                        "fencing_epoch": self._fencing_epoch}
+            if self._mark_worker_dead_locked(worker_id, "reported"):
+                self._journal_locked({"op": "worker_dead",
+                                      "worker_id": worker_id,
+                                      "reason": "reported"})
+                self._bump_fencing_locked("report_failure")
             alive = self._alive_workers()
             if not alive:
                 return {"type": "error", "error": (
@@ -212,6 +547,7 @@ class Dispatcher:
                 len(pieces), len(worker_ids))
             return {
                 "type": "assignment",
+                "fencing_epoch": self._fencing_epoch,
                 "assignments": assignments,
                 "workers": {wid: alive[wid]["address"]
                             for wid in assignments},
@@ -236,8 +572,10 @@ class Dispatcher:
                             "epochs_completed": self._fcfs_epoch + 1}
                 self._fcfs_epoch += 1
                 self._fcfs_queue.extend(range(self._num_pieces))
-            return {"type": "split",
-                    "piece": self._fcfs_queue.popleft(),
+            piece = self._fcfs_queue.popleft()
+            self._journal_locked({"op": "next_split", "piece": piece,
+                                  "epoch": self._fcfs_epoch})
+            return {"type": "split", "piece": piece,
                     "epoch": self._fcfs_epoch}
 
     def _handle_worker_diagnostics(self, header):
@@ -251,7 +589,7 @@ class Dispatcher:
 
         from petastorm_tpu.reader_impl.framed_socket import FramedConnection
 
-        timeout = float(header.get("timeout", 5.0))
+        timeout = self._probe_timeout(header)
         with self._lock:
             workers = {wid: tuple(w["address"])
                        for wid, w in self._alive_workers().items()}
@@ -274,16 +612,35 @@ class Dispatcher:
                     out[wid] = payload
         return {"type": "diagnostics", "workers": sorted(workers)}, out
 
+    @staticmethod
+    def _probe_timeout(header):
+        """Clamp the client-supplied per-probe timeout to a sane range: a
+        misbehaving client must not pin probe threads for minutes."""
+        try:
+            timeout = float(header.get("timeout", 5.0))
+        except (TypeError, ValueError):
+            return 5.0
+        return min(max(timeout, 0.1), PROBE_TIMEOUT_CAP_S)
+
     def _handle_status(self, header):
+        now = time.monotonic()
         with self._lock:
             return {
                 "type": "status",
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
                 "num_pieces": self._num_pieces,
-                "workers": {wid: {"address": w["address"],
-                                  "alive": w["alive"]}
-                            for wid, w in self._workers.items()},
+                "fencing_epoch": self._fencing_epoch,
+                "recovery": dict(self._recovery),
+                "journal": (self._journal.stats
+                            if self._journal is not None else None),
+                "workers": {
+                    wid: {"address": w["address"],
+                          "alive": w["alive"],
+                          "lease_expires_in_s": (
+                              round(self._worker_leases[wid] - now, 3)
+                              if wid in self._worker_leases else None)}
+                    for wid, w in self._workers.items()},
                 "clients": {cid: dict(c) for cid, c in self._clients.items()},
                 "fcfs_epoch": self._fcfs_epoch,
                 "fcfs_remaining": (len(self._fcfs_queue)

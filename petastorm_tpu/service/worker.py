@@ -76,13 +76,25 @@ class BatchWorker:
     :param batch_delay_s: fault injection for benchmarks/tests — sleep this
         long before each ``batch`` send, simulating a slow worker (the
         ``--skew-ms`` knob of the ``service`` benchmark scenario).
+    :param heartbeat_interval_s: renew the dispatcher lease this often; a
+        worker that misses its lease (``Dispatcher(lease_timeout_s=...)``)
+        is evicted. The loop also heals restarts: an ``unknown_worker``
+        reply (dispatcher came back without this worker's state) triggers
+        automatic re-registration under the same ``worker_id``. ``None``
+        disables the loop (direct-addressed test workers).
+    :param rpc_deadline_s: total time budget for each control RPC against
+        the dispatcher (registration, heartbeats) across all its retries —
+        the shared ``retry_with_backoff`` deadline policy.
+    :param max_frame_bytes: per-connection receive frame cap (requests to
+        a worker are small control messages; batches only flow OUT).
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
                  host="127.0.0.1", port=0, batch_size=64,
                  reader_factory="row", reader_kwargs=None, worker_id=None,
                  register_retries=5, register_backoff=0.2,
-                 batch_delay_s=0.0):
+                 batch_delay_s=0.0, heartbeat_interval_s=5.0,
+                 rpc_deadline_s=30.0, max_frame_bytes=None):
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
@@ -106,10 +118,16 @@ class BatchWorker:
         self._register_retries = register_retries
         self._register_backoff = register_backoff
         self._batch_delay_s = float(batch_delay_s)
+        self._heartbeat_interval_s = heartbeat_interval_s
+        self._rpc_deadline_s = rpc_deadline_s
+        self._max_frame_bytes = max_frame_bytes
         self.num_pieces = None
         self._lock = threading.Lock()
         self._active = {}            # stream key -> {"reader", "flow"}
         self._completed = {}         # stream key -> final diagnostics dict
+        self._heartbeat_thread = None
+        self._heartbeat_stop = threading.Event()
+        self._heartbeat_paused = threading.Event()  # test hook: hung worker
         self._server = FramedServer(self._serve_connection, host=host,
                                     port=port,
                                     name=f"service-worker-{self.worker_id}")
@@ -121,17 +139,34 @@ class BatchWorker:
         self._server.start()
         if self._dispatcher_address is not None:
             self._register()
+            if self._heartbeat_interval_s is not None:
+                self._heartbeat_thread = threading.Thread(
+                    target=self._heartbeat_loop, daemon=True,
+                    name=f"service-worker-{self.worker_id}-heartbeat")
+                self._heartbeat_thread.start()
         return self
 
     @property
     def address(self):
         return self._server.address
 
-    def stop(self):
-        """Graceful teardown: stop accepting, stop active readers, and
-        close open connections so handler threads blocked in ``recv`` exit
-        (they would otherwise pin a thread + fd per idle client forever)."""
+    def stop(self, drain_timeout_s=5.0):
+        """Graceful teardown, in dependency order: stop accepting and close
+        the listener + open connections FIRST (stream threads blocked in
+        ``recv``/``send`` exit on the closed socket instead of raising into
+        a half-torn worker), then drain in-flight stream threads with a
+        bounded join, and only then stop any reader a straggler thread left
+        behind — a stop during an active stream can't leak a thread or
+        race reader teardown against a live send loop."""
         self._server.stopped.set()
+        self._heartbeat_stop.set()
+        self._server.stop()
+        stragglers = self._server.join(timeout=drain_timeout_s)
+        if stragglers:
+            logger.warning(
+                "worker %s: %d stream thread(s) still alive after the "
+                "%.1fs stop drain — stopping their readers under them",
+                self.worker_id, len(stragglers), drain_timeout_s)
         with self._lock:
             readers = [entry["reader"] for entry in self._active.values()]
         for reader in readers:
@@ -139,15 +174,31 @@ class BatchWorker:
                 reader.stop()
             except Exception:
                 pass
-        self._server.stop()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=drain_timeout_s)
 
     def kill(self):
         """Abrupt failure injection (tests): drop every open connection
         without sending ``end``, then tear down — clients see a mid-stream
         :class:`ConnectionClosedError`, exactly like a worker host dying."""
         self._server.stopped.set()
+        self._heartbeat_stop.set()
         self._server.close_connections()
         self.stop()
+
+    def drop_connections(self):
+        """Drop every open connection without stopping the server (fault
+        injection: a network blip — clients reconnect and re-stream)."""
+        self._server.close_connections()
+
+    def pause_heartbeats(self):
+        """Test hook: stop renewing the dispatcher lease while the server
+        keeps running — simulates a hung-but-connected worker so lease
+        expiry (not connection failure) is what evicts it."""
+        self._heartbeat_paused.set()
+
+    def resume_heartbeats(self):
+        self._heartbeat_paused.clear()
 
     def __enter__(self):
         return self
@@ -171,38 +222,79 @@ class BatchWorker:
         return len(enumerate_row_group_pieces(
             fs, path, self._reader_kwargs.get("filters")))
 
-    def _register(self):
+    def _register(self, re_register=False, retries=None):
+        host, port = self.address
+        reply = self._control_rpc({
+            "type": "register_worker",
+            "worker_id": self.worker_id,
+            "host": host,
+            "port": port,
+            "num_pieces": self.num_pieces,
+            "re_register": re_register,
+        }, description=f"worker {self.worker_id} registration",
+            retries=retries)
+        if reply.get("type") != "ok":
+            raise RuntimeError(
+                f"dispatcher rejected registration: "
+                f"{reply.get('error', reply)}")
+        return reply
+
+    def _control_rpc(self, header, description, retries=None):
+        """One control request/reply against the dispatcher under the
+        shared retry policy: bounded attempts, exponential backoff with
+        jitter, and a total ``rpc_deadline_s`` budget. Heartbeat ticks
+        pass ``retries=0`` — their loop IS the retry, and a stop() must
+        not wait out a backoff budget against a dead dispatcher."""
         from petastorm_tpu.reader_impl.framed_socket import FramedConnection
         from petastorm_tpu.utils import retry_with_backoff
-
-        host, port = self.address
 
         def attempt():
             with FramedConnection.connect(self._dispatcher_address,
                                           timeout=10.0) as conn:
-                reply, _ = conn.request({
-                    "type": "register_worker",
-                    "worker_id": self.worker_id,
-                    "host": host,
-                    "port": port,
-                    "num_pieces": self.num_pieces,
-                })
-            if reply.get("type") != "ok":
-                raise RuntimeError(
-                    f"dispatcher rejected registration: "
-                    f"{reply.get('error', reply)}")
+                reply, _ = conn.request(header)
             return reply
 
-        retry_with_backoff(
-            attempt, retries=self._register_retries,
+        return retry_with_backoff(
+            attempt,
+            retries=self._register_retries if retries is None else retries,
             base_delay=self._register_backoff,
-            retry_on=(OSError,),
-            description=f"worker {self.worker_id} registration")
+            retry_on=(OSError,), deadline_s=self._rpc_deadline_s,
+            description=description)
+
+    def _heartbeat_loop(self):
+        """Renew the dispatcher lease every ``heartbeat_interval_s``; an
+        ``unknown_worker`` reply (the dispatcher restarted without this
+        worker's state, or evicted it) triggers re-registration under the
+        same ``worker_id``. A dispatcher outage is just a missed tick —
+        the loop keeps trying until the dispatcher returns."""
+        while not self._heartbeat_stop.wait(self._heartbeat_interval_s):
+            if self._heartbeat_paused.is_set():
+                continue
+            try:
+                reply = self._control_rpc(
+                    {"type": "worker_heartbeat", "worker_id": self.worker_id},
+                    description=f"worker {self.worker_id} heartbeat",
+                    retries=0)
+            except OSError:
+                continue  # dispatcher down: retry next tick
+            if reply.get("type") == "unknown_worker" \
+                    and not self._heartbeat_stop.is_set():
+                logger.warning(
+                    "dispatcher no longer knows worker %s — re-registering",
+                    self.worker_id)
+                try:
+                    # retries=0 keeps the tick bounded by one dial: the
+                    # loop itself is the retry, and stop() must not wait
+                    # out a 30s backoff budget against a dead dispatcher.
+                    self._register(re_register=True, retries=0)
+                except (OSError, RuntimeError):
+                    continue  # registration retried on the next tick
 
     # -- serving -----------------------------------------------------------
 
     def _serve_connection(self, sock):
-        reader = FramedReader(sock)  # buffered, per-connection
+        reader = FramedReader(sock,  # buffered, per-connection
+                              max_frame_bytes=self._max_frame_bytes)
         while not self._server.stopped.is_set():
             header, _ = reader.recv()
             kind = header.get("type")

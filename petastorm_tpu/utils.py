@@ -109,14 +109,16 @@ def decode_row(row, schema):
 
 def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
                        jitter=0.5, retry_on=(Exception,), no_retry_on=(),
-                       description=None, sleep=None, rng=None):
+                       description=None, sleep=None, rng=None,
+                       deadline_s=None, clock=None):
     """Call ``fn()`` with bounded retries, exponential backoff and jitter.
 
     The shared transient-failure policy for network-facing control paths:
     the GCS listing sweep (one flaky ``objects.list`` page must not abort
-    reader construction for a whole pod) and the data-service client's
-    dispatcher/worker reconnects both route through here so the backoff
-    shape is tuned in one place.
+    reader construction for a whole pod) and every control RPC of the data
+    service (dispatcher requests, worker registration, heartbeats, stream
+    reconnects) route through here so the backoff shape AND the total
+    time budget are tuned in one place instead of ad-hoc per-call timeouts.
 
     :param retries: additional attempts after the first (``retries=3`` ⇒ up
         to 4 calls). The final failure re-raises the original exception.
@@ -132,11 +134,19 @@ def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
     :param description: label for the retry warning log line.
     :param sleep: injection point for tests (default ``time.sleep``).
     :param rng: injection point for tests (default module-level ``random``).
+    :param deadline_s: total time budget across all attempts AND backoff
+        sleeps, measured from the first call. Once sleeping for the next
+        retry would cross the budget, the last exception is re-raised even
+        if ``retries`` remain — a caller-facing bound on worst-case latency
+        that per-attempt socket timeouts alone cannot give.
+    :param clock: injection point for tests (default ``time.monotonic``).
     """
     import logging
     import time
 
     sleep = sleep if sleep is not None else time.sleep
+    clock = clock if clock is not None else time.monotonic
+    start = clock()
     delays = backoff_delays(retries, base_delay, max_delay, jitter=jitter,
                             rng=rng)
     for attempt in range(retries + 1):
@@ -148,6 +158,14 @@ def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
             if attempt == retries:
                 raise
             delay = next(delays)
+            if deadline_s is not None \
+                    and clock() - start + delay >= deadline_s:
+                logging.getLogger(__name__).warning(
+                    "%s failed (attempt %d/%d): %s — deadline budget "
+                    "%.2fs exhausted, not retrying",
+                    description or getattr(fn, "__name__", "call"),
+                    attempt + 1, retries + 1, exc, deadline_s)
+                raise
             logging.getLogger(__name__).warning(
                 "%s failed (attempt %d/%d): %s — retrying in %.2fs",
                 description or getattr(fn, "__name__", "call"),

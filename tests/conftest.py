@@ -26,9 +26,73 @@ import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import threading  # noqa: E402
+import time  # noqa: E402
 from types import SimpleNamespace  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+def _open_socket_fds():
+    """Snapshot of this process's open socket fds as (fd, inode) pairs —
+    Linux-only (/proc); empty elsewhere, which disables the socket check."""
+    out = set()
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return out
+    for fd in fds:
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue  # fd closed between listdir and readlink
+        if target.startswith("socket:"):
+            out.add((fd, target))
+    return out
+
+
+#: Process-lifetime thread pools libraries create on first use and keep
+#: forever (not per-test leaks): orbax-checkpoint's async machinery.
+_LIBRARY_SINGLETON_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
+                                      "orbax", "grpc")
+
+
+@pytest.fixture(autouse=True)
+def _resource_leak_guard(request):
+    """Fail any tier-1 test that leaks a non-daemon thread or a socket
+    past its teardown.
+
+    The service stack (dispatcher/worker/client, heartbeats, chaos) is all
+    threads + sockets; a test that forgets to stop a node would silently
+    tax every later test in the session. A short grace loop absorbs
+    asynchronous teardown (daemon handler threads closing sockets,
+    GC-collected connections); whatever survives it is a leak. Opt out
+    with ``@pytest.mark.allow_resource_leaks`` (and a reason)."""
+    if request.node.get_closest_marker("allow_resource_leaks"):
+        yield
+        return
+    before_threads = set(threading.enumerate())
+    before_sockets = _open_socket_fds()
+    yield
+    deadline = time.monotonic() + 2.0
+    while True:
+        leaked_threads = [
+            t for t in threading.enumerate()
+            if t not in before_threads and t.is_alive() and not t.daemon
+            and not t.name.startswith(_LIBRARY_SINGLETON_THREAD_PREFIXES)]
+        leaked_sockets = _open_socket_fds() - before_sockets
+        if not leaked_threads and not leaked_sockets:
+            return
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
+    pytest.fail(
+        f"test leaked resources past teardown: "
+        f"non-daemon threads {[t.name for t in leaked_threads]}, "
+        f"sockets {sorted(leaked_sockets)} — stop/close every service "
+        f"node, loader, and connection the test started "
+        f"(mark allow_resource_leaks only with a documented reason)",
+        pytrace=False)
 
 
 @pytest.fixture(scope="session")

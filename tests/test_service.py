@@ -911,45 +911,54 @@ def test_service_cli_runs_dispatcher_and_worker(petastorm_dataset, capsys):
     from petastorm_tpu.service.cli import main
 
     ready = {}
+    stop = threading.Event()  # tears both nodes down at test end (no
 
-    def run_dispatcher():
+    def run_dispatcher():     # leaked listeners past teardown)
         main(["dispatcher", "--port", "0", "--mode", "static"],
-             run_seconds=8)
+             run_seconds=30, stop_event=stop)
 
     disp_thread = threading.Thread(target=run_dispatcher, daemon=True)
     disp_thread.start()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline and "port" not in ready:
-        out = capsys.readouterr().out
-        for line in out.splitlines():
-            if line.startswith("{"):
-                ready.update(json.loads(line))
-        time.sleep(0.05)
-    assert ready.get("role") == "dispatcher"
+    worker_thread = None
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "port" not in ready:
+            out = capsys.readouterr().out
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    ready.update(json.loads(line))
+            time.sleep(0.05)
+        assert ready.get("role") == "dispatcher"
 
-    worker_thread = threading.Thread(
-        target=lambda: main(
-            ["worker", "--dispatcher", f"127.0.0.1:{ready['port']}",
-             "--dataset-url", petastorm_dataset.url, "--batch-size", "7",
-             "--workers-count", "2"],
-            run_seconds=8),
-        daemon=True)
-    worker_thread.start()
+        worker_thread = threading.Thread(
+            target=lambda: main(
+                ["worker", "--dispatcher", f"127.0.0.1:{ready['port']}",
+                 "--dataset-url", petastorm_dataset.url, "--batch-size", "7",
+                 "--workers-count", "2"],
+                run_seconds=30, stop_event=stop),
+            daemon=True)
+        worker_thread.start()
 
-    source = ServiceBatchSource(("127.0.0.1", ready["port"]), max_retries=8,
-                                backoff_base=0.1, backoff_max=0.5)
+        source = ServiceBatchSource(("127.0.0.1", ready["port"]),
+                                    max_retries=8,
+                                    backoff_base=0.1, backoff_max=0.5)
 
-    # The worker registers asynchronously; retry until the fleet serves.
-    deadline = time.monotonic() + 8
-    got = []
-    while time.monotonic() < deadline:
-        try:
-            got = [int(i) for batch in source() for i in batch["id"]]
-            if got:
-                break
-        except ServiceError:
-            time.sleep(0.2)
-    assert sorted(got) == _local_ids(petastorm_dataset.url)
+        # The worker registers asynchronously; retry until the fleet serves.
+        deadline = time.monotonic() + 8
+        got = []
+        while time.monotonic() < deadline:
+            try:
+                got = [int(i) for batch in source() for i in batch["id"]]
+                if got:
+                    break
+            except ServiceError:
+                time.sleep(0.2)
+        assert sorted(got) == _local_ids(petastorm_dataset.url)
+    finally:
+        stop.set()
+        disp_thread.join(timeout=10)
+        if worker_thread is not None:
+            worker_thread.join(timeout=10)
 
 
 def test_state_dict_respects_consumer_yield_position(petastorm_dataset):

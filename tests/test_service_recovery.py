@@ -1,0 +1,737 @@
+"""Fault-tolerant control plane: journal, heartbeats, leases, fencing.
+
+Layers under test (docs/guides/service.md#failure-model-and-recovery):
+
+- the journal (``service/journal.py``): WAL append/replay, snapshot
+  compaction, the seq watermark that makes the snapshot→truncate crash
+  window safe, torn-tail tolerance;
+- dispatcher crash recovery: a restart with a populated journal restores
+  the control-plane state byte-identically (static assignments, fcfs
+  cursor) and records the replay + fencing bump;
+- liveness: worker heartbeats renew leases, a hung worker is evicted at
+  lease expiry, an evicted/unknown worker re-registers automatically;
+- fencing: a request carrying a stale fencing epoch is rejected with
+  ``stale_fencing`` instead of acting on a superseded plan; a live client
+  resyncs on a fencing bump without duplicating rows when the restored
+  assignments are identical;
+- the satellite hardening: configurable frame cap (``ProtocolError``
+  before allocation), probe-timeout clamp, bounded worker stop-drain, and
+  the shared retry policy's total deadline budget.
+
+Slow-marked tests inject real mid-epoch failures (dispatcher kill/restart,
+lease expiry of a hung worker) and assert the delivery invariants.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.reader_impl.framed_socket import (
+    FramedConnection,
+    FramedReader,
+    ProtocolError,
+    recv_framed,
+    send_framed,
+)
+from petastorm_tpu.service import BatchWorker, Dispatcher, ServiceBatchSource
+from petastorm_tpu.service.journal import Journal
+
+pytestmark = pytest.mark.service
+
+
+def _request(address, header):
+    with FramedConnection.connect(address) as conn:
+        reply, _ = conn.request(header)
+    return reply
+
+
+def _register(dispatcher, worker_id, num_pieces, port=1):
+    return _request(dispatcher.address, {
+        "type": "register_worker", "worker_id": worker_id,
+        "host": "127.0.0.1", "port": port, "num_pieces": num_pieces})
+
+
+# ---------------------------------------------------------------------------
+# journal: write / compact / replay
+# ---------------------------------------------------------------------------
+
+def test_journal_append_load_roundtrip(tmp_path):
+    journal = Journal(tmp_path / "j")
+    journal.append({"op": "a", "x": 1})
+    journal.append({"op": "b", "y": [1, 2]})
+    journal.close()
+
+    state, records = Journal(tmp_path / "j").load()
+    assert state is None
+    assert [r["op"] for r in records] == ["a", "b"]
+    assert [r["seq"] for r in records] == [1, 2]
+
+
+def test_journal_compaction_truncates_wal_and_resumes_seq(tmp_path):
+    journal = Journal(tmp_path / "j", compact_every=3)
+    for i in range(3):
+        journal.append({"op": "r", "i": i})
+        journal.maybe_compact(lambda: {"upto": journal.records_appended})
+    journal.append({"op": "after"})
+    journal.close()
+    assert journal.compactions == 1
+
+    loaded = Journal(tmp_path / "j")
+    state, records = loaded.load()
+    assert state == {"upto": 3}
+    assert [r["op"] for r in records] == ["after"]
+    # The seq cursor continues past everything seen, snapshot included.
+    appended = loaded.append({"op": "next"})
+    assert appended["seq"] == 5
+    loaded.close()
+
+
+def test_journal_watermark_skips_records_already_in_snapshot(tmp_path):
+    """The crash window between snapshot replace and WAL truncation leaves
+    already-folded records in the WAL — the seq watermark must skip them
+    so nothing is applied twice."""
+    journal = Journal(tmp_path / "j")
+    journal.append({"op": "old"})      # seq 1
+    journal.snapshot({"folded": True})  # watermark 1, truncates
+    journal.append({"op": "new"})      # seq 2
+    journal.close()
+    # Simulate the crash: re-prepend the pre-snapshot record to the WAL.
+    wal = tmp_path / "j" / "wal.jsonl"
+    wal.write_text(json.dumps({"op": "old", "seq": 1}) + "\n"
+                   + wal.read_text())
+
+    state, records = Journal(tmp_path / "j").load()
+    assert state == {"folded": True}
+    assert [r["op"] for r in records] == ["new"]
+
+
+def test_journal_drops_and_truncates_torn_tail_line(tmp_path):
+    """A torn tail is not just skipped but TRUNCATED: the recovered
+    dispatcher appends more records, and without truncation they would be
+    welded onto the fragment into a corrupt MID-file line that bricks the
+    NEXT recovery (the exact double-crash sequence journals exist for)."""
+    journal = Journal(tmp_path / "j")
+    journal.append({"op": "whole"})
+    journal.close()
+    wal = tmp_path / "j" / "wal.jsonl"
+    with open(wal, "a", encoding="utf-8") as f:
+        f.write('{"op": "torn", "se')  # crash mid-append
+
+    recovered = Journal(tmp_path / "j")
+    _, records = recovered.load()
+    assert [r["op"] for r in records] == ["whole"]
+    recovered.append({"op": "post-recovery"})  # crash again here
+    recovered.close()
+
+    _, records = Journal(tmp_path / "j").load()
+    assert [r["op"] for r in records] == ["whole", "post-recovery"]
+
+
+def test_journal_refuses_writes_after_close(tmp_path):
+    journal = Journal(tmp_path / "j")
+    journal.append({"op": "a"})
+    journal.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        journal.append({"op": "late"})
+    with pytest.raises(RuntimeError, match="closed"):
+        journal.snapshot({})
+
+
+def test_journal_rejects_mid_file_corruption(tmp_path):
+    """A corrupt record that is NOT the torn tail means ambiguous history —
+    recovery must refuse, not silently skip."""
+    journal = Journal(tmp_path / "j")
+    journal.append({"op": "first"})
+    journal.append({"op": "last"})
+    journal.close()
+    wal = tmp_path / "j" / "wal.jsonl"
+    lines = wal.read_text().splitlines()
+    wal.write_text(lines[0] + "\ngarbage-not-json\n" + lines[1] + "\n")
+
+    with pytest.raises(ValueError, match="corrupt WAL record"):
+        Journal(tmp_path / "j").load()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher crash recovery (journal replay)
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_restart_restores_state_byte_identical(tmp_path):
+    """The ISSUE acceptance: a restart with a populated journal restores
+    the assignment-bearing state byte-identically to the pre-crash
+    snapshot (only the recovery bookkeeping — replay count, fencing epoch
+    — moves)."""
+    journal_dir = str(tmp_path / "journal")
+    with Dispatcher(port=0, mode="static", num_epochs=2,
+                    journal_dir=journal_dir).start() as disp:
+        _register(disp, "w0", 10)
+        _register(disp, "w1", 10)
+        _request(disp.address, {"type": "get_assignment", "client_id": "c0",
+                                "client_index": 0, "num_clients": 2,
+                                "epoch": 1})
+        _request(disp.address, {"type": "report_failure", "client_id": "c0",
+                                "worker_id": "w1", "pieces": [1, 3]})
+        before = disp.state_snapshot()
+        assignment_before = _request(disp.address, {
+            "type": "get_assignment", "client_id": "c0",
+            "client_index": 0, "num_clients": 2, "epoch": 1})
+
+    with Dispatcher(port=0, mode="static", num_epochs=2,
+                    journal_dir=journal_dir).start() as restarted:
+        after = restarted.state_snapshot()
+        # Everything that determines assignments is byte-identical...
+        volatile = ("fencing_epoch", "recovery")
+        plan_before = {k: v for k, v in before.items() if k not in volatile}
+        plan_after = {k: v for k, v in after.items() if k not in volatile}
+        assert (json.dumps(plan_before, sort_keys=True)
+                == json.dumps(plan_after, sort_keys=True))
+        # ...so the same request yields the same assignment.
+        assignment_after = _request(restarted.address, {
+            "type": "get_assignment", "client_id": "c0",
+            "client_index": 0, "num_clients": 2, "epoch": 1})
+        assert (assignment_after["assignments"]
+                == assignment_before["assignments"])
+        # The recovery is recorded: one replay, and the fencing epoch
+        # moved past every pre-crash token.
+        assert after["recovery"]["journal_replays"] == 1
+        assert after["fencing_epoch"] > before["fencing_epoch"]
+        status = _request(restarted.address, {"type": "status"})
+        assert status["recovery"]["journal_replays"] == 1
+        assert status["journal"]["path"] == journal_dir
+
+
+def test_dispatcher_restart_resumes_fcfs_cursor(tmp_path):
+    """fcfs epoch/queue state survives a crash: splits handed out before
+    it are not handed out again, and the epoch budget is honored."""
+    journal_dir = str(tmp_path / "journal")
+    seen = []
+    with Dispatcher(port=0, mode="fcfs", num_epochs=1,
+                    journal_dir=journal_dir).start() as disp:
+        _register(disp, "w0", 5)
+        for _ in range(3):
+            reply = _request(disp.address, {"type": "next_split",
+                                            "client_id": "c"})
+            seen.append((reply["epoch"], reply["piece"]))
+
+    with Dispatcher(port=0, mode="fcfs", num_epochs=1,
+                    journal_dir=journal_dir).start() as restarted:
+        while True:
+            reply = _request(restarted.address, {"type": "next_split",
+                                                 "client_id": "c"})
+            if reply["type"] == "end_of_stream":
+                break
+            seen.append((reply["epoch"], reply["piece"]))
+    # One epoch, every piece exactly once across the crash.
+    assert sorted(p for _, p in seen) == [0, 1, 2, 3, 4]
+
+
+def test_dispatcher_journal_mode_mismatch_rejected(tmp_path):
+    journal_dir = str(tmp_path / "journal")
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir).start() as disp:
+        _register(disp, "w0", 3)
+    with pytest.raises(ValueError, match="mode"):
+        Dispatcher(port=0, mode="fcfs", num_epochs=1,
+                   journal_dir=journal_dir).start()
+
+
+def test_dispatcher_double_restart_counts_two_replays(tmp_path):
+    journal_dir = str(tmp_path / "journal")
+    with Dispatcher(port=0, journal_dir=journal_dir).start() as disp:
+        _register(disp, "w0", 3)
+    with Dispatcher(port=0, journal_dir=journal_dir).start():
+        pass
+    with Dispatcher(port=0, journal_dir=journal_dir).start() as third:
+        assert third.state_snapshot()["recovery"]["journal_replays"] == 2
+        assert sorted(third.state_snapshot()["workers"]) == ["w0"]
+
+
+# ---------------------------------------------------------------------------
+# fencing
+# ---------------------------------------------------------------------------
+
+def test_stale_fencing_report_rejected():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 6)
+        _register(disp, "w1", 6)
+        token = _request(disp.address, {
+            "type": "get_assignment", "client_id": "c", "client_index": 0,
+            "num_clients": 1, "epoch": 0})["fencing_epoch"]
+        # A first failure bumps the fencing epoch...
+        first = _request(disp.address, {
+            "type": "report_failure", "client_id": "c", "worker_id": "w1",
+            "pieces": [1, 3], "fencing_epoch": token})
+        assert first["type"] == "assignment"
+        assert first["fencing_epoch"] > token
+        # ...so a second report still carrying the old token is fenced off.
+        stale = _request(disp.address, {
+            "type": "report_failure", "client_id": "c", "worker_id": "w0",
+            "pieces": [0], "fencing_epoch": token})
+        assert stale["type"] == "stale_fencing"
+        assert stale["fencing_epoch"] == first["fencing_epoch"]
+        status = _request(disp.address, {"type": "status"})
+        assert status["recovery"]["stale_fencing_rejections"] == 1
+        # w0 was NOT evicted by the stale report.
+        assert status["workers"]["w0"]["alive"]
+        # A tokenless report (pre-fencing client) still works as before.
+        legacy = _request(disp.address, {
+            "type": "report_failure", "client_id": "c", "worker_id": "w1",
+            "pieces": [1]})
+        assert legacy["type"] == "assignment"
+
+
+def test_client_heartbeat_reports_fencing_and_recovery():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 3)
+        reply = _request(disp.address, {"type": "client_heartbeat",
+                                        "client_id": "nobody"})
+        assert reply["type"] == "ok"
+        assert reply["known"] is False
+        assert reply["fencing_epoch"] == 0
+        assert reply["recovery"]["journal_replays"] == 0
+        _request(disp.address, {"type": "get_assignment", "client_id": "c",
+                                "client_index": 0, "num_clients": 1,
+                                "epoch": 0})
+        reply = _request(disp.address, {"type": "client_heartbeat",
+                                        "client_id": "c"})
+        assert reply["known"] is True
+
+
+# ---------------------------------------------------------------------------
+# heartbeats and lease expiry
+# ---------------------------------------------------------------------------
+
+def test_worker_heartbeat_renews_lease(petastorm_dataset):
+    with Dispatcher(port=0, lease_timeout_s=1.0).start() as disp:
+        worker = BatchWorker(petastorm_dataset.url,
+                             dispatcher_address=disp.address,
+                             worker_id="hb", heartbeat_interval_s=0.2,
+                             reader_kwargs={"workers_count": 2}).start()
+        try:
+            # Outlive the lease by 2x: heartbeats must keep it alive.
+            time.sleep(2.0)
+            status = _request(disp.address, {"type": "status"})
+            assert status["workers"]["hb"]["alive"]
+            assert status["recovery"]["evictions"] == 0
+        finally:
+            worker.stop()
+
+
+def test_lease_expiry_evicts_hung_worker(petastorm_dataset):
+    """A worker that stops heartbeating (hung host: TCP may still be up)
+    is evicted at lease expiry and the fencing epoch bumps; when it comes
+    back, it re-registers and is re-admitted."""
+    with Dispatcher(port=0, lease_timeout_s=0.4).start() as disp:
+        worker = BatchWorker(petastorm_dataset.url,
+                             dispatcher_address=disp.address,
+                             worker_id="hung", heartbeat_interval_s=0.1,
+                             reader_kwargs={"workers_count": 2}).start()
+        try:
+            worker.pause_heartbeats()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                status = _request(disp.address, {"type": "status"})
+                if not status["workers"]["hung"]["alive"]:
+                    break
+                time.sleep(0.05)
+            assert not status["workers"]["hung"]["alive"], \
+                "hung worker was never evicted"
+            assert status["recovery"]["evictions"] == 1
+            assert status["fencing_epoch"] >= 1
+            fenced = status["fencing_epoch"]
+            # The worker resumes heartbeating: unknown_worker → re-register.
+            worker.resume_heartbeats()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                status = _request(disp.address, {"type": "status"})
+                if status["workers"]["hung"]["alive"]:
+                    break
+                time.sleep(0.05)
+            assert status["workers"]["hung"]["alive"], \
+                "evicted worker never re-registered"
+            assert status["recovery"]["re_registrations"] >= 1
+            # Re-admission does not re-fence (nothing became stale).
+            assert status["fencing_epoch"] == fenced
+        finally:
+            worker.stop()
+
+
+def test_worker_reregisters_after_dispatcher_restart_without_journal(
+        petastorm_dataset):
+    """Dispatcher comes back empty (no journal): the worker's heartbeat
+    sees ``unknown_worker`` and re-registers under its old worker_id."""
+    disp = Dispatcher(port=0, lease_timeout_s=5.0).start()
+    port = disp.address[1]
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=disp.address,
+                         worker_id="phoenix", heartbeat_interval_s=0.15,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        disp.stop()
+        disp = Dispatcher(port=port, lease_timeout_s=5.0).start()
+        deadline = time.monotonic() + 8
+        workers = {}
+        while time.monotonic() < deadline:
+            workers = _request(disp.address,
+                               {"type": "list_workers"})["workers"]
+            if "phoenix" in workers:
+                break
+            time.sleep(0.05)
+        assert "phoenix" in workers, "worker never re-registered"
+        status = _request(disp.address, {"type": "status"})
+        assert status["recovery"]["re_registrations"] >= 1
+    finally:
+        worker.stop()
+        disp.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: frame cap, probe clamp, stop drain, retry deadline
+# ---------------------------------------------------------------------------
+
+def test_oversized_frame_rejected_before_allocation():
+    a, b = socket.socketpair()
+    try:
+        import struct
+        # Hand-craft a message whose single frame claims 1 GB.
+        header = json.dumps({"type": "x"}).encode()
+        a.sendall(struct.pack("!Q", len(header)) + header
+                  + struct.pack("!B", 1) + struct.pack("!I", 1)
+                  + struct.pack("!Q", 1 << 30))
+        with pytest.raises(ProtocolError, match="max_frame_bytes"):
+            FramedReader(b, max_frame_bytes=1 << 20).recv()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_frame_rejected_stateless_path():
+    a, b = socket.socketpair()
+    try:
+        import struct
+        header = json.dumps({"type": "x"}).encode()
+        a.sendall(struct.pack("!Q", len(header)) + header
+                  + struct.pack("!B", 1) + struct.pack("!I", 1)
+                  + struct.pack("!Q", 1 << 30))
+        with pytest.raises(ProtocolError, match="max_frame_bytes"):
+            recv_framed(b, max_frame_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_cap_allows_normal_batches():
+    import numpy as np
+
+    a, b = socket.socketpair()
+    try:
+        batch = {"x": np.arange(100)}
+        send_framed(a, {"type": "batch"}, batch)
+        _, payload = FramedReader(b, max_frame_bytes=1 << 20).recv()
+        np.testing.assert_array_equal(payload["x"], batch["x"])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_worker_frame_cap_is_a_protocol_error(petastorm_dataset):
+    """A worker with a small frame cap drops the connection of a peer
+    sending an oversized frame instead of allocating for it."""
+    import struct
+
+    worker = BatchWorker(petastorm_dataset.url, max_frame_bytes=1 << 16,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        sock = socket.create_connection(worker.address, timeout=5)
+        header = json.dumps({"type": "stream", "pieces": [0]}).encode()
+        sock.sendall(struct.pack("!Q", len(header)) + header
+                     + struct.pack("!B", 1) + struct.pack("!I", 1)
+                     + struct.pack("!Q", 1 << 40))
+        sock.settimeout(5)
+        # The server closes the desynced connection (no reply, EOF).
+        assert sock.recv(1) == b""
+        sock.close()
+    finally:
+        worker.stop()
+
+
+def test_probe_timeout_clamped():
+    assert Dispatcher._probe_timeout({"timeout": 3600}) == 30.0
+    assert Dispatcher._probe_timeout({"timeout": 2.5}) == 2.5
+    assert Dispatcher._probe_timeout({"timeout": -1}) == 0.1
+    assert Dispatcher._probe_timeout({"timeout": "bogus"}) == 5.0
+    assert Dispatcher._probe_timeout({}) == 5.0
+
+
+def test_worker_stop_drains_active_stream_threads(petastorm_dataset):
+    """stop() during an active stream joins the stream thread (bounded)
+    and tears the reader down without raising — no thread or socket
+    outlives the call (the conftest leak guard enforces the rest)."""
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"workers_count": 2}).start()
+    sock = socket.create_connection(worker.address, timeout=5)
+    try:
+        # credits=1 wedges the stream mid-flight: one batch in the socket,
+        # the stream thread parked waiting for a credit that never comes.
+        send_framed(sock, {"type": "stream", "pieces": [0, 1, 2],
+                           "epoch": 0, "credits": 1})
+        header, _ = recv_framed(sock)
+        assert header["type"] == "batch"
+        t0 = time.perf_counter()
+        worker.stop(drain_timeout_s=5.0)
+        assert time.perf_counter() - t0 < 10
+        assert worker._active == {}  # no reader left behind
+    finally:
+        sock.close()
+        worker.stop()
+
+
+def test_retry_with_backoff_deadline_budget():
+    from petastorm_tpu.utils import retry_with_backoff
+
+    calls = []
+    fake_now = [0.0]
+
+    def failing():
+        calls.append(fake_now[0])
+        raise OSError("down")
+
+    def fake_sleep(s):
+        fake_now[0] += s
+
+    with pytest.raises(OSError):
+        retry_with_backoff(failing, retries=50, base_delay=1.0,
+                           max_delay=1.0, jitter=0.0, retry_on=(OSError,),
+                           deadline_s=3.5, sleep=fake_sleep,
+                           clock=lambda: fake_now[0])
+    # 1s backoff per attempt, 3.5s budget: first call + 3 retries, not 51.
+    assert len(calls) == 4
+
+
+# ---------------------------------------------------------------------------
+# client resync under fencing (fast smoke: no faults, no duplicates)
+# ---------------------------------------------------------------------------
+
+def test_fencing_bump_resync_is_noop_when_plan_unchanged(tmp_path):
+    """A fencing bump whose re-fetched assignment is unchanged (the
+    dispatcher-restart-with-journal shape) must keep every live stream —
+    zero duplicate rows, and the resync is counted."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)  # 24 pieces
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=0.02,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    heartbeat_interval_s=0.05)
+        got, bumped = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not bumped and len(got) >= 8:
+                with dispatcher._lock:  # an eviction-shaped epoch bump
+                    dispatcher._bump_fencing_locked("test")
+                bumped = True
+        expected = sorted(int(r["id"]) for r in rows)
+        assert sorted(got) == expected  # zero lost AND zero duplicated
+        recovery = source.diagnostics["recovery"]
+        assert recovery["resyncs"] >= 1
+        assert recovery["streams_retired"] == 0
+        assert recovery["fencing_epoch"] >= 1
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_resync_failure_keeps_streams_and_training_alive(tmp_path):
+    """Regression: a resync that cannot complete (dispatcher restarted
+    WITHOUT a journal, no worker has re-registered yet → get_assignment
+    errors) must not raise into the training loop — the live streams keep
+    flowing, the failure is counted, and the heartbeat retries later."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    port = dispatcher.address[1]
+    workers = [
+        # heartbeat_interval_s=None: the workers never re-register, so the
+        # restarted dispatcher stays empty for the whole epoch.
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=0.03, heartbeat_interval_s=None,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=1,
+                                    backoff_base=0.02, backoff_max=0.1,
+                                    heartbeat_interval_s=0.05)
+        got, restarted = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not restarted and len(got) >= 8:
+                dispatcher.stop()
+                dispatcher = Dispatcher(port=port, mode="static",
+                                        num_epochs=1).start()  # amnesiac
+                restarted = True
+        assert restarted
+        expected = sorted(int(r["id"]) for r in rows)
+        assert sorted(got) == expected  # streams rode the restart out
+        recovery = source.diagnostics["recovery"]
+        assert recovery["resync_failures"] >= 1
+        assert recovery["streams_retired"] == 0
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault injection: dispatcher kill/restart mid-epoch, lease takeover (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dispatcher_kill_restart_mid_epoch_no_loss_no_dup(tmp_path):
+    """Kill the dispatcher mid-epoch and restart it from its journal on
+    the same port: the data plane keeps streaming through the outage, the
+    restarted control plane replays its WAL, the client's heartbeat
+    resyncs under the bumped fencing epoch without retiring any stream
+    (assignments restored identical), and the next epoch's assignment
+    comes from the restarted dispatcher — two epochs, every row exactly
+    twice (zero loss, zero duplicates)."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)  # 24 pieces
+    journal_dir = str(tmp_path / "journal")
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=2,
+                            journal_dir=journal_dir,
+                            lease_timeout_s=5.0).start()
+    port = dispatcher.address[1]
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=0.04, heartbeat_interval_s=0.2,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=6,
+                                    backoff_base=0.1, backoff_max=0.5,
+                                    heartbeat_interval_s=0.1)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 12:
+                dispatcher.stop()   # crash: no graceful snapshot
+                time.sleep(0.2)     # an outage the data plane rides out
+                dispatcher = Dispatcher(
+                    port=port, mode="static", num_epochs=2,
+                    journal_dir=journal_dir, lease_timeout_s=5.0).start()
+                killed = True
+        assert killed, "dataset too small to kill mid-epoch"
+        expected = sorted(int(r["id"]) for r in rows)
+        assert sorted(got) == sorted(expected * 2)  # exact ×2
+        status = source.dispatcher_status()
+        assert status["recovery"]["journal_replays"] >= 1
+        assert status["recovery"]["fencing_bumps"] >= 1
+        recovery = source.diagnostics["recovery"]
+        assert recovery["resyncs"] >= 1
+        assert recovery["streams_retired"] == 0  # identical plan restored
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.slow
+def test_worker_lease_expiry_triggers_takeover_no_loss(tmp_path):
+    """A worker whose heartbeats stop mid-epoch (hung, TCP alive) is
+    evicted at lease expiry; the client's heartbeat sees the fencing bump
+    and the resync moves the hung worker's pending pieces to survivors —
+    the epoch completes with no sample loss (duplicates allowed:
+    at-least-once)."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=120,
+                                      rows_per_row_group=5)
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1,
+                            lease_timeout_s=0.6).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=(0.15 if i == 0 else 0.03),
+                    heartbeat_interval_s=0.1,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=2,
+                                    backoff_base=0.05, backoff_max=0.2,
+                                    heartbeat_interval_s=0.1)
+        got, hung = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not hung and len(got) >= 8:
+                workers[0].pause_heartbeats()  # the slow worker hangs
+                hung = True
+        assert hung
+        assert set(int(r["id"]) for r in rows) <= set(got)  # no loss
+        status = source.dispatcher_status()
+        assert status["recovery"]["evictions"] >= 1
+        assert not status["workers"]["w0"]["alive"]
+        recovery = source.diagnostics["recovery"]
+        assert recovery["resyncs"] >= 1
+        assert recovery["streams_retired"] >= 1  # the hung stream moved
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.slow
+def test_chaos_scenario_dispatcher_restart_invariants():
+    """The ISSUE acceptance path: the chaos-armed service scenario
+    completes an epoch under dispatcher kill/restart with zero lost and
+    zero duplicate rows, >=1 journal replay and >=1 fencing bump (the
+    scenario itself raises on any violation)."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=2,
+                                       batch_size=32,
+                                       chaos="dispatcher-restart",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0
+    assert result["duplicate_rows"] == 0
+    assert result["dispatcher_recovery"]["journal_replays"] >= 1
+    assert result["dispatcher_recovery"]["fencing_bumps"] >= 1
+    assert result["chaos_events"], "no chaos event landed inside the epoch"
+
+
+@pytest.mark.slow
+def test_chaos_scenario_worker_kill_no_loss():
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=3,
+                                       batch_size=32, chaos="worker-kill",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0  # duplicates allowed (at-least-once)
