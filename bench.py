@@ -506,7 +506,7 @@ def leg_realstep(url):
                     # Exclude the pipeline fill (the first batch has nothing
                     # to overlap with — every architecture pays it once);
                     # disclosed via stall_excludes_pipeline_fill.
-                    loader.diagnostics["stall_s"] = 0.0
+                    loader.exclude_stall_so_far()
                     first = False
                 td = time.perf_counter()
                 params, loss = step(params, batch["image"], batch["label"],

@@ -103,6 +103,17 @@ def _scenario_main(argv):
     parser.add_argument("--journal-dir", default=None,
                         help="service scenario dispatcher journal "
                              "directory (default under chaos: a tmpdir)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        dest="metrics_port",
+                        help="serve the metrics registry in Prometheus "
+                             "text format on this port for the run's "
+                             "duration (0 picks a free port; address "
+                             "lands in the result)")
+    parser.add_argument("--trace-out", default=None, dest="trace_out",
+                        help="write a Perfetto-loadable Chrome "
+                             "trace_event JSON of per-batch lifecycle "
+                             "spans (worker decode → client queue → "
+                             "device dispatch) to this path")
     args = parser.parse_args(argv)
 
     scenario = SCENARIOS[args.name]
@@ -123,7 +134,9 @@ def _scenario_main(argv):
             ("chaos_interval_s", "--chaos-interval", args.chaos_interval_s),
             ("chaos_max_events", "--chaos-max-events",
              args.chaos_max_events),
-            ("journal_dir", "--journal-dir", args.journal_dir)):
+            ("journal_dir", "--journal-dir", args.journal_dir),
+            ("metrics_port", "--metrics-port", args.metrics_port),
+            ("trace_out", "--trace-out", args.trace_out)):
         if value is not None:
             if name not in accepted:
                 parser.error(f"{flag} is not a knob of "

@@ -535,7 +535,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               batch_size=512, mode="static", skew_ms=0.0,
                               credits=8, json_out=None, chaos=None,
                               chaos_interval_s=1.5, chaos_max_events=4,
-                              journal_dir=None):
+                              journal_dir=None, metrics_port=None,
+                              trace_out=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -571,7 +572,17 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     The result is BENCH-style (``metric``/``value``/``unit``/
     ``vs_baseline`` + detail keys, one JSON object); ``json_out`` appends
     it as one JSON line to that path so skew/loopback numbers land in the
-    perf trajectory instead of stdout only.
+    perf trajectory instead of stdout only. The ``telemetry`` key carries
+    the final metrics-registry snapshot plus per-stage p50/p99 latency
+    quantiles from the loader histograms — distributions, not just means.
+
+    ``metrics_port`` serves the process's metrics registry in Prometheus
+    text format for the run's duration (0 picks a free port; the bound
+    address lands in the result as ``metrics_address``). ``trace_out``
+    arms batch-lifecycle tracing and writes Perfetto-loadable Chrome
+    ``trace_event`` JSON there: every batch id carries contiguous spans
+    from worker decode through client queue to device dispatch
+    (``docs/guides/diagnostics.md#metrics-and-tracing``).
     """
     from petastorm_tpu.jax_utils.batcher import batch_iterator
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
@@ -620,10 +631,27 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                           journal_dir=journal_dir,
                           lease_timeout_s=lease_timeout_s)
 
-    dispatcher_holder = [make_dispatcher().start()]
+    # Telemetry arming and every node start happen INSIDE the try: a
+    # failing dispatcher/worker start must still stop the HTTP server +
+    # snapshot-ring threads and disarm the trace collector (the tier-1
+    # leak guard would otherwise cascade one failure into many).
+    metrics_server = None
+    trace_armed = False
+    dispatcher_holder = []
     fleet = []
     injector = None
     try:
+        if metrics_port is not None:
+            from petastorm_tpu.telemetry.http import MetricsServer
+
+            metrics_server = MetricsServer(port=metrics_port,
+                                           snapshot_interval_s=1.0).start()
+        if trace_out:
+            from petastorm_tpu.telemetry import tracing
+
+            tracing.COLLECTOR.acquire()
+            trace_armed = True
+        dispatcher_holder.append(make_dispatcher().start())
         for i in range(workers):
             # Appended one by one so a failing start() mid-fleet still
             # leaves the already-started workers in `fleet` for teardown.
@@ -640,7 +668,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             dispatcher_holder[0].address, credits=credits,
             heartbeat_interval_s=0.3 if chaos_kinds else 2.0)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
-                               stage_to_device=False)
+                               stage_to_device=False,
+                               trace_path=trace_out or None)
         if chaos_kinds:
             actions = []
             for kind in chaos_kinds:
@@ -719,6 +748,18 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 wid: counters["stall_s"]
                 for wid, counters in source_diag["per_worker"].items()},
         }
+        # Final registry snapshot + per-stage latency quantiles: BENCH
+        # artifacts capture distributions (p50/p99), not just means.
+        from petastorm_tpu.telemetry import REGISTRY as _registry
+
+        result["telemetry"] = {
+            "stage_quantiles_s": loader.stage_quantiles(),
+            "registry": _registry.snapshot(),
+        }
+        if metrics_server is not None:
+            result["metrics_address"] = list(metrics_server.address)
+        if trace_out:
+            result["trace_out"] = trace_out
         if chaos_kinds:
             # Control-plane-only faults must not repeat a single row; any
             # fault that kills or drops the data plane re-delivers pieces
@@ -764,7 +805,14 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             injector.stop()
         for worker in fleet:
             worker.stop()
-        dispatcher_holder[0].stop()
+        if dispatcher_holder:
+            dispatcher_holder[0].stop()
+        if metrics_server is not None:
+            metrics_server.stop()
+        if trace_armed:
+            from petastorm_tpu.telemetry import tracing
+
+            tracing.COLLECTOR.release()
         if tmpdir:
             shutil.rmtree(tmpdir, ignore_errors=True)
         if journal_tmp:

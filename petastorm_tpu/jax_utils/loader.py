@@ -23,6 +23,7 @@ default), drops them ("drop"), or rejects them ("error").
 from __future__ import annotations
 
 import contextlib
+import itertools
 import queue
 import sys
 import threading
@@ -31,8 +32,46 @@ import time
 import numpy as np
 
 from petastorm_tpu.jax_utils.batcher import PAD_MASK_KEY, batch_iterator
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.metrics import (
+    LOADER_BATCHES,
+    LOADER_ROWS,
+    LOADER_STAGE_SECONDS,
+)
 
 _SENTINEL = object()
+
+#: Loader pipeline stages, as histogram label values: ``decode`` (reader
+#: pull + collation), ``queue_wait`` (producer blocked on a full host
+#: queue), ``wait`` (consumer blocked on input — the stall), ``device_put``
+#: (H2D dispatch), ``consumer`` (the training step between yields).
+_STAGES = ("decode", "queue_wait", "wait", "device_put", "consumer")
+
+#: Per-process loader instance ids — the ``loader`` label value, so each
+#: loader's series are separable in a scrape and the legacy per-iteration
+#: diagnostics can be re-derived as (current - iteration-start baseline).
+#: Ids are RECYCLED: a garbage-collected loader's series are removed from
+#: the registry and its id returns to the pool (weakref.finalize), so a
+#: trainer constructing loaders in a loop does not grow the registry —
+#: live cardinality stays at the number of live loaders.
+_LOADER_IDS = itertools.count()
+_LOADER_ID_POOL = []
+
+
+def _acquire_loader_id():
+    try:
+        return _LOADER_ID_POOL.pop()
+    except IndexError:
+        return str(next(_LOADER_IDS))
+
+
+def _release_loader_metrics(loader_id):
+    """weakref.finalize callback: retire a dead loader's series."""
+    LOADER_BATCHES.remove(loader_id)
+    LOADER_ROWS.remove(loader_id)
+    for stage in _STAGES:
+        LOADER_STAGE_SECONDS.remove(loader_id, stage)
+    _LOADER_ID_POOL.append(loader_id)
 
 
 def _trace_span(name):
@@ -60,7 +99,8 @@ def make_jax_dataloader(reader, batch_size,
                         stage_to_device=True,
                         shuffle_buffer_size=0,
                         shuffle_seed=None,
-                        stage_in_producer=False):
+                        stage_in_producer=False,
+                        trace_path=None):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -100,6 +140,11 @@ def make_jax_dataloader(reader, batch_size,
         2·``device_prefetch`` + 1 — raise ``device_prefetch`` for deeper
         jitter absorption (decoded host batches additionally buffer up to
         ``host_prefetch`` between the two threads).
+    :param trace_path: write a Perfetto-loadable Chrome ``trace_event``
+        JSON of per-batch pipeline spans here at the end of each iteration
+        (arms the process trace collector; see
+        ``docs/guides/diagnostics.md#metrics-and-tracing``). ``None`` (the
+        default) records nothing.
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -109,7 +154,8 @@ def make_jax_dataloader(reader, batch_size,
                          stage_to_device=stage_to_device,
                          shuffle_buffer_size=shuffle_buffer_size,
                          shuffle_seed=shuffle_seed,
-                         stage_in_producer=stage_in_producer)
+                         stage_in_producer=stage_in_producer,
+                         trace_path=trace_path)
 
 
 class JaxDataLoader:
@@ -120,7 +166,7 @@ class JaxDataLoader:
                  device_prefetch=2, non_tensor_policy="host",
                  stage_to_device=True, shuffle_buffer_size=0,
                  shuffle_seed=None, stage_in_producer=False,
-                 batch_source=None):
+                 batch_source=None, trace_path=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
         if stage_in_producer and sharding is not None:
@@ -195,24 +241,107 @@ class JaxDataLoader:
         self._stop = threading.Event()
         self._total_rows_yielded = 0  # cumulative, pad-aware (resume support)
         self._yield_count_tracker = None  # tracker the count is relative to
-        self.diagnostics = {
-            "batches": 0,
-            "rows": 0,
-            "stall_s": 0.0,
-            "wall_s": 0.0,
-            "input_stall_pct": 0.0,
+        # Typed metrics behind the diagnostics dict: per-instance children
+        # of the registry families (telemetry.metrics), labeled by a
+        # process-unique loader id. The legacy per-iteration dict is
+        # RE-DERIVED from these on every `diagnostics` read — current
+        # child value minus the iteration-start baseline — so a
+        # monitoring thread polling mid-epoch sees live numbers (wall_s
+        # and input_stall_pct included) while a scraper sees the same
+        # series monotonic.
+        self._loader_id = _acquire_loader_id()
+        self._m_batches = LOADER_BATCHES.labels(self._loader_id)
+        self._m_rows = LOADER_ROWS.labels(self._loader_id)
+        self._m_stage = {stage: LOADER_STAGE_SECONDS.labels(self._loader_id,
+                                                            stage)
+                         for stage in _STAGES}
+        import weakref
+
+        self._metrics_finalizer = weakref.finalize(
+            self, _release_loader_metrics, self._loader_id)
+        # Cleanup matters for long-lived processes, not interpreter exit
+        # (module globals may already be torn down there).
+        self._metrics_finalizer.atexit = False
+        self._trace_path = trace_path
+        self._iter_start = None   # perf_counter at iteration start
+        self._iter_end = None     # set when the iteration finishes
+        self._source_diag = None  # batch_source diagnostics snapshot
+        self._base = self._metric_baseline()
+
+    # -- diagnostics (derived from the metrics registry) -------------------
+
+    def _metric_baseline(self):
+        """Current registry child values — subtracted on read so the
+        diagnostics dict stays per-iteration while the registry series
+        stay monotonic for scrapers."""
+        return {
+            "batches": self._m_batches.value,
+            "rows": self._m_rows.value,
+            "stage": {stage: child.sum
+                      for stage, child in self._m_stage.items()},
+        }
+
+    @property
+    def diagnostics(self):
+        """Per-iteration pipeline counters, derived live from the metrics
+        registry (``docs/guides/diagnostics.md``): ``batches``/``rows``
+        yielded, the per-stage time breakdown (``producer_decode_s``,
+        ``producer_queue_wait_s``, ``device_dispatch_s``, ``stall_s``,
+        ``consumer_s``), and ``wall_s`` / ``input_stall_pct`` — the
+        north-star metric — computed **at read time**, so a monitoring
+        thread polling mid-epoch sees this epoch's live stall percentage,
+        not the previous iteration's frozen one. ``source`` carries the
+        batch_source's own diagnostics when one is plugged in."""
+        now = time.perf_counter()
+        start, end = self._iter_start, self._iter_end
+        wall = 0.0 if start is None else max(0.0, (now if end is None
+                                                   else end) - start)
+        base = self._base
+        stage = {name: max(0.0, child.sum - base["stage"][name])
+                 for name, child in self._m_stage.items()}
+        stall = stage["wait"]
+        out = {
+            "batches": int(self._m_batches.value - base["batches"]),
+            "rows": int(self._m_rows.value - base["rows"]),
+            "stall_s": stall,
+            "wall_s": wall,
+            "input_stall_pct": (round(100.0 * stall / wall, 2)
+                                if wall > 0 else 0.0),
             "max_batches": self._max_batches,
             # per-stage breakdown (stall root-causing):
-            "producer_decode_s": 0.0,     # reader pull + collation
-            "producer_queue_wait_s": 0.0,  # blocked on full host queue
-            "device_dispatch_s": 0.0,      # device_put / global-array assembly
-            # Time the CONSUMER spends between taking a batch and asking for
-            # the next (its step dispatch + device wait) — the other side of
-            # the ledger from stall_s: wall ≈ stall_s + consumer_s + loader
-            # bookkeeping. Lets a training loop reconcile "low stall but
-            # below the step bound" (VERDICT r4 weak #1) by naming the
-            # consumer-side residual instead of leaving it unattributed.
-            "consumer_s": 0.0,
+            "producer_decode_s": stage["decode"],   # reader pull + collation
+            "producer_queue_wait_s": stage["queue_wait"],
+            "device_dispatch_s": stage["device_put"],
+            # Time the CONSUMER spends between taking a batch and asking
+            # for the next (its step dispatch + device wait) — the other
+            # side of the ledger from stall_s: wall ≈ stall_s + consumer_s
+            # + loader bookkeeping. Lets a training loop reconcile "low
+            # stall but below the step bound" by naming the consumer-side
+            # residual instead of leaving it unattributed.
+            "consumer_s": stage["consumer"],
+        }
+        if self._source_diag is not None:
+            out["source"] = dict(self._source_diag)
+        return out
+
+    def exclude_stall_so_far(self):
+        """Zero the per-iteration stall accounting up to this call — e.g.
+        to exclude the pipeline-fill stall of the first batch, which every
+        architecture pays once (``bench.py``'s realistic-step leg). The
+        registry histogram keeps the full history; only the derived
+        per-iteration view re-bases."""
+        self._base["stage"]["wait"] = self._m_stage["wait"].sum
+
+    def stage_quantiles(self, quantiles=(0.5, 0.99)):
+        """Approximate per-batch latency quantiles for each pipeline stage,
+        estimated from this loader's registry histograms (lifetime of the
+        instance, not just the last iteration) — what the service
+        scenario's ``--json-out`` telemetry block reports so BENCH
+        artifacts capture distributions, not just means."""
+        return {
+            stage: {f"p{int(q * 100)}": child.quantile(q)
+                    for q in quantiles}
+            for stage, child in self._m_stage.items()
         }
 
     # -- producer ---------------------------------------------------------
@@ -242,9 +371,12 @@ class JaxDataLoader:
                 t0 = time.perf_counter()
                 with _trace_span("petastorm_tpu.loader.decode"):
                     batch = next(batches, _SENTINEL)
-                self.diagnostics["producer_decode_s"] += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self._m_stage["decode"].observe(t1 - t0)
                 if batch is _SENTINEL:
                     break
+                if tracing.COLLECTOR.enabled:
+                    tracing.COLLECTOR.record_span("loader.decode", t0, t1)
                 t0 = time.perf_counter()
                 while not self._stop.is_set():
                     try:
@@ -252,8 +384,8 @@ class JaxDataLoader:
                         break
                     except queue.Full:
                         continue
-                self.diagnostics["producer_queue_wait_s"] += \
-                    time.perf_counter() - t0
+                self._m_stage["queue_wait"].observe(
+                    time.perf_counter() - t0)
                 if self._stop.is_set():
                     return
         except Exception as exc:  # surfaced on the consumer side
@@ -279,8 +411,11 @@ class JaxDataLoader:
                 t0 = time.perf_counter()
                 with _trace_span("petastorm_tpu.loader.device_put"):
                     batch = self._stage(batch)
-                self.diagnostics["device_dispatch_s"] += \
-                    time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self._m_stage["device_put"].observe(t1 - t0)
+                if tracing.COLLECTOR.enabled:
+                    tracing.COLLECTOR.record_span("loader.device_put",
+                                                  t0, t1)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)
@@ -382,11 +517,20 @@ class JaxDataLoader:
             self._yield_count_tracker = tracker
             self._total_rows_yielded = 0
         # Diagnostics are per-iteration: stall/wall must describe one pass or
-        # input_stall_pct (the north-star metric) is meaningless.
-        self.diagnostics.update(batches=0, rows=0, stall_s=0.0, wall_s=0.0,
-                                input_stall_pct=0.0, producer_decode_s=0.0,
-                                producer_queue_wait_s=0.0,
-                                device_dispatch_s=0.0, consumer_s=0.0)
+        # input_stall_pct (the north-star metric) is meaningless. The
+        # registry series are monotonic; the per-iteration view re-bases on
+        # this baseline.
+        self._base = self._metric_baseline()
+        self._iter_start = time.perf_counter()
+        self._iter_end = None
+        if self._trace_path is not None:
+            # Scoped arming: the first armer clears the buffer (each
+            # iteration exports a fresh trace — without the clear, epoch
+            # N's file would replay epochs 1..N-1 and the bounded buffer
+            # would eventually freeze on the earliest spans); a second
+            # trace-armed loader (mid-epoch eval) joins the running trace
+            # instead of wiping it.
+            tracing.COLLECTOR.acquire()
         if self._direct_iter is None:
             self._producer = threading.Thread(target=self._produce,
                                               daemon=True,
@@ -403,9 +547,11 @@ class JaxDataLoader:
         return self._iterate()
 
     def _iterate(self):
-        inflight = []  # device batches dispatched ahead (double buffer)
+        inflight = []       # device batches dispatched ahead (double buffer)
+        inflight_bids = []  # their trace batch ids (direct source path)
         done = False
         direct = self._direct_iter
+        collector = tracing.COLLECTOR
         # Captured so the finally tears down THIS iteration's source even
         # if a newer iteration has since replaced the attribute.
         source_iter = self._source_iter
@@ -414,7 +560,7 @@ class JaxDataLoader:
         # diagnostics mid-epoch — a stall dashboard, the chaos harness —
         # must see the "source" stage without waiting for the pass to end.
         self._snapshot_source_diagnostics()
-        start = time.perf_counter()
+        self._iter_start = time.perf_counter()
         try:
             while True:
                 # Keep device_prefetch batches in flight.
@@ -427,26 +573,41 @@ class JaxDataLoader:
                         host_batch = (next(direct, _SENTINEL)
                                       if direct is not None
                                       else self._queue.get())
-                    self.diagnostics["stall_s"] += time.perf_counter() - t0
+                    t1 = time.perf_counter()
+                    self._m_stage["wait"].observe(t1 - t0)
                     if host_batch is _SENTINEL:
                         done = True
                         if self._producer_error is not None:
                             raise self._producer_error
                         break
+                    # Direct-source batches carry the worker-minted batch
+                    # id (the source sets last_bid as it yields, on this
+                    # same thread) — the key that joins loader spans to
+                    # the batch's worker/client lifecycle in a trace.
+                    bid = (getattr(self._batch_source, "last_bid", None)
+                           if direct is not None else None)
+                    if collector.enabled:
+                        collector.record_span("loader.wait", t0, t1,
+                                              bid=bid)
                     if self._stage_in_producer:
                         inflight.append(host_batch)  # already on device
                     else:
                         t0 = time.perf_counter()
                         with _trace_span("petastorm_tpu.loader.device_put"):
                             inflight.append(self._stage(host_batch))
-                        self.diagnostics["device_dispatch_s"] += \
-                            time.perf_counter() - t0
+                        t1 = time.perf_counter()
+                        self._m_stage["device_put"].observe(t1 - t0)
+                        if collector.enabled:
+                            collector.record_span("loader.device_put",
+                                                  t0, t1, bid=bid)
+                    inflight_bids.append(bid)
                 if not inflight:
                     return
                 batch = inflight.pop(0)
-                self.diagnostics["batches"] += 1
+                bid = inflight_bids.pop(0) if inflight_bids else None
+                self._m_batches.inc()
                 rows_in_batch = self._batch_rows(batch)
-                self.diagnostics["rows"] += rows_in_batch
+                self._m_rows.inc(rows_in_batch)
                 if PAD_MASK_KEY in batch:
                     # Count only real rows toward resume accounting (the
                     # device pull happens at most once, on the padded final
@@ -456,19 +617,24 @@ class JaxDataLoader:
                 self._total_rows_yielded += rows_in_batch
                 t_yield = time.perf_counter()
                 yield batch
-                self.diagnostics["consumer_s"] += \
-                    time.perf_counter() - t_yield
+                t_back = time.perf_counter()
+                self._m_stage["consumer"].observe(t_back - t_yield)
+                if collector.enabled:
+                    collector.record_span("loader.consumer", t_yield,
+                                          t_back, bid=bid)
         finally:
-            self.diagnostics["wall_s"] = time.perf_counter() - start
-            if self.diagnostics["wall_s"] > 0:
-                self.diagnostics["input_stall_pct"] = round(
-                    100.0 * self.diagnostics["stall_s"]
-                    / self.diagnostics["wall_s"], 2)
+            self._iter_end = time.perf_counter()
             # A batch_source with its own delivery counters (e.g. the data
             # service's per-worker stall / ready-queue / credit numbers)
             # lands in the stage breakdown, so one diagnostics dict
             # root-causes a stall across the whole delivery path.
             self._snapshot_source_diagnostics()
+            if self._trace_path is not None:
+                collector.export(self._trace_path)
+                # Balance the __iter__ acquire: collection stops when the
+                # LAST trace-armed consumer finishes, not when the first
+                # one does.
+                collector.release()
             # Generator abandoned (break) or exhausted: stop the producer so
             # it doesn't keep decoding the rest of the dataset forever. On
             # the direct path, closing the source iterator is what tears
@@ -482,11 +648,11 @@ class JaxDataLoader:
 
     def _snapshot_source_diagnostics(self):
         """Copy the batch_source's diagnostics dict (if it has one) into
-        this loader's ``diagnostics["source"]`` stage slot."""
+        the ``diagnostics["source"]`` stage slot."""
         source_diag = (getattr(self._batch_source, "diagnostics", None)
                        if self._batch_source is not None else None)
         if isinstance(source_diag, dict):
-            self.diagnostics["source"] = dict(source_diag)
+            self._source_diag = dict(source_diag)
 
     @staticmethod
     def _batch_rows(batch):
@@ -568,8 +734,8 @@ class JaxDataLoader:
                     p.kind is inspect.Parameter.VAR_KEYWORD
                     for p in params.values())
                 if accepts_yielded:
-                    return source_state(
-                        yielded_batches=self.diagnostics["batches"])
+                    return source_state(yielded_batches=int(
+                        self._m_batches.value - self._base["batches"]))
                 return source_state()
             raise ValueError(
                 "state_dict is not supported with a custom batch_source "

@@ -500,6 +500,16 @@ class Reader:
             "items_per_epoch": len(items),
             "workers_count": getattr(reader_pool, "workers_count", 1),
         }
+        # Registry mirror (telemetry.metrics): readers constructed and the
+        # latest plan size become scrapeable alongside the pool/ventilator
+        # counters this reader's `diagnostics` property snapshots.
+        from petastorm_tpu.telemetry.metrics import (
+            READER_READERS,
+            READER_ROWGROUPS_PLANNED,
+        )
+
+        READER_READERS.inc()
+        READER_ROWGROUPS_PLANNED.set(len(pieces))
 
     # --- planning helpers -----------------------------------------------
 

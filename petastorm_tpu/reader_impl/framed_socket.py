@@ -43,6 +43,20 @@ import threading
 import time
 
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
+from petastorm_tpu.telemetry.metrics import (
+    TRANSPORT_BYTES,
+    TRANSPORT_FRAMES,
+    TRANSPORT_MESSAGES,
+)
+
+# Interned label children: one lock-guarded float add per message on the
+# hot path, no dict lookup (docs/guides/diagnostics.md#metrics-and-tracing).
+_TX_MESSAGES = TRANSPORT_MESSAGES.labels("sent")
+_TX_FRAMES = TRANSPORT_FRAMES.labels("sent")
+_TX_BYTES = TRANSPORT_BYTES.labels("sent")
+_RX_MESSAGES = TRANSPORT_MESSAGES.labels("recv")
+_RX_FRAMES = TRANSPORT_FRAMES.labels("recv")
+_RX_BYTES = TRANSPORT_BYTES.labels("recv")
 
 _LEN = struct.Struct("!Q")
 _FMT = struct.Struct("!B")
@@ -230,15 +244,20 @@ def send_framed(sock, header, payload=None):
     header_bytes = json.dumps(header).encode("utf-8")
     parts = [_LEN.pack(len(header_bytes)), header_bytes,
              _FMT.pack(fmt), _NFRAMES.pack(len(frames))]
+    total_bytes = len(header_bytes) + _LEN.size + _FMT.size + _NFRAMES.size
     for frame in frames:
         view = memoryview(frame)
         parts.append(_LEN.pack(view.nbytes))
         parts.append(view)
+        total_bytes += _LEN.size + view.nbytes
     if hasattr(sock, "sendmsg"):
         _sendmsg_all(sock, parts)
     else:  # platforms without scatter-gather (rare): field-by-field
         for part in parts:
             sock.sendall(part)
+    _TX_MESSAGES.inc()
+    _TX_FRAMES.inc(len(frames))
+    _TX_BYTES.inc(total_bytes)
 
 
 def recv_framed(sock, max_frame_bytes=None):
@@ -259,11 +278,16 @@ def recv_framed(sock, max_frame_bytes=None):
     header = json.loads(_recv_exact(sock, header_len).decode("utf-8"))
     fmt = _FMT.unpack(_recv_exact(sock, _FMT.size))[0]
     n_frames = _NFRAMES.unpack(_recv_exact(sock, _NFRAMES.size))[0]
+    total_bytes = _LEN.size + header_len + _FMT.size + _NFRAMES.size
     frames = []
     for _ in range(n_frames):
         frame_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
         _check_frame_len(frame_len, max_frame_bytes)
         frames.append(_recv_exact(sock, frame_len))
+        total_bytes += _LEN.size + frame_len
+    _RX_MESSAGES.inc()
+    _RX_FRAMES.inc(n_frames)
+    _RX_BYTES.inc(total_bytes)
     return header, _decode_payload(fmt, frames)
 
 
@@ -386,11 +410,13 @@ class FramedReader:
         meta = self._take(_FMT.size + _NFRAMES.size)
         fmt = _FMT.unpack_from(meta, 0)[0]
         n_frames = _NFRAMES.unpack_from(meta, _FMT.size)[0]
+        total_bytes = _LEN.size + header_len + _FMT.size + _NFRAMES.size
         frames = []
         head_buf = None
         for i in range(n_frames):
             frame_len = _LEN.unpack_from(self._take(_LEN.size))[0]
             _check_frame_len(frame_len, self._max_frame_bytes)
+            total_bytes += _LEN.size + frame_len
             if fmt == PAYLOAD_PICKLE and i == 0:
                 # Pickle head: consumed synchronously by pickle.loads and
                 # never referenced after — pooled, recycled post-decode.
@@ -407,6 +433,9 @@ class FramedReader:
         payload = _decode_payload(fmt, frames)
         if head_buf is not None:
             self._pool.release(head_buf)
+        _RX_MESSAGES.inc()
+        _RX_FRAMES.inc(n_frames)
+        _RX_BYTES.inc(total_bytes)
         return header, payload
 
 

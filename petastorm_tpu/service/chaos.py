@@ -15,11 +15,12 @@ invariant can be correlated with what the harness did when.
 
 from __future__ import annotations
 
-import logging
 import threading
 import time
 
-logger = logging.getLogger(__name__)
+from petastorm_tpu.telemetry.log import service_logger
+
+logger = service_logger(__name__)
 
 CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop")
 

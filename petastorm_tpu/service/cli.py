@@ -15,6 +15,14 @@ then, trainer-side::
 
 Each process prints one JSON line with its bound address (port 0 picks a
 free port) and serves until SIGINT.
+
+Observability (``docs/guides/diagnostics.md#metrics-and-tracing``):
+``--metrics-port`` on either role serves the process's metrics registry in
+Prometheus text format (plus ``/metrics.json`` and ``/rates``) from a tiny
+stdlib HTTP endpoint, and ``python -m petastorm_tpu.service status
+--dispatcher host:port --watch`` renders live fleet rates (rows/s,
+batches/s, credit waits) in the terminal by differencing two
+``worker_diagnostics`` polls.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ import json
 import signal
 import sys
 import threading
+import time
 
 
 def parse_address(value):
@@ -80,6 +89,22 @@ def _build_parser():
                       help="seconds between dispatcher lease renewals "
                            "(also drives automatic re-registration after "
                            "a dispatcher restart); 0 disables")
+    for role in (disp, work):
+        role.add_argument("--metrics-port", type=int, default=None,
+                          help="serve this process's metrics registry in "
+                               "Prometheus text format on this port "
+                               "(0 picks a free one, printed on stdout); "
+                               "omit to disable exposition")
+
+    stat = sub.add_parser(
+        "status", help="render the fleet's control-plane state and live "
+                       "delivery rates from two worker_diagnostics polls")
+    stat.add_argument("--dispatcher", required=True,
+                      help="dispatcher address host:port")
+    stat.add_argument("--watch", action="store_true",
+                      help="refresh continuously until interrupted")
+    stat.add_argument("--interval", type=float, default=2.0,
+                      help="seconds between polls (the rate window)")
     return parser
 
 
@@ -106,18 +131,159 @@ def build_service_node(args):
                        "reader_pool_type": args.reader_pool_type})
 
 
+# -- fleet status -----------------------------------------------------------
+
+def collect_fleet_sample(address, timeout=5.0, deadline_s=15.0):
+    """One poll: dispatcher ``status`` + the ``worker_diagnostics``
+    fan-out, timestamped — two of these straddling an interval give
+    rates. Transient dispatcher failures retry under the repo's shared
+    control-RPC policy (the status tool's advertised use case is watching
+    a fleet *through* restarts)."""
+    from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+    from petastorm_tpu.utils import retry_with_backoff
+
+    def poll():
+        with FramedConnection.connect(address, timeout=timeout) as conn:
+            status, _ = conn.request({"type": "status"})
+            _, workers = conn.request({"type": "worker_diagnostics"})
+        return {"t": time.monotonic(), "status": status,
+                "workers": workers or {}}
+
+    return retry_with_backoff(poll, retries=4, base_delay=0.2,
+                              retry_on=(OSError,), deadline_s=deadline_s,
+                              description="fleet status poll")
+
+
+def _worker_totals(sample, wid):
+    """The worker's lifetime registry totals, or ``None`` when the sample
+    has no usable snapshot for it (absent or unreachable) — a rate must
+    never be computed against an implicit zero baseline, or a worker
+    re-appearing after a blip renders its whole lifetime total as one
+    window's throughput."""
+    snapshot = sample["workers"].get(wid)
+    if not snapshot or "error" in snapshot:
+        return None
+    metrics = snapshot.get("metrics") or {}
+    return (metrics.get("rows_sent_total", 0.0),
+            metrics.get("batches_sent_total", 0.0),
+            metrics.get("credit_wait_seconds_total", 0.0),
+            metrics.get("active_streams", 0.0))
+
+
+def render_fleet_status(prev, cur):
+    """Two timestamped samples → the terminal view: control-plane header
+    plus one per-worker row of lifetime totals and per-second rates over
+    the sample interval (monotonic worker counters make the delta exact
+    even across client reconnects). Pure — testable without sockets."""
+    status = cur["status"]
+    dt = max(1e-9, cur["t"] - prev["t"])
+    workers_state = status.get("workers", {})
+    alive = sum(1 for w in workers_state.values() if w.get("alive"))
+    lines = [
+        f"mode={status.get('mode')} fencing_epoch="
+        f"{status.get('fencing_epoch')} workers={alive} alive/"
+        f"{len(workers_state) - alive} dead clients="
+        f"{len(status.get('clients', {}))} window={dt:.1f}s",
+        f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
+        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12}",
+    ]
+    fleet_rows = fleet_batches = 0.0
+    for wid in sorted(cur["workers"]):
+        now = _worker_totals(cur, wid)
+        if now is None:
+            lines.append(f"{wid:<20} {'unreachable':>10}")
+            continue
+        rows1, batches1, wait1, active = now
+        before = _worker_totals(prev, wid)
+        if before is None:
+            # No prior baseline (worker just appeared or was unreachable
+            # last poll): totals are real, rates are unknowable.
+            lines.append(
+                f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
+                f"{'--':>13} {int(rows1):>12}")
+            continue
+        rows0, batches0, wait0, _ = before
+        rows_rate = max(0.0, rows1 - rows0) / dt
+        batch_rate = max(0.0, batches1 - batches0) / dt
+        wait_rate = max(0.0, wait1 - wait0) / dt
+        fleet_rows += rows_rate
+        fleet_batches += batch_rate
+        lines.append(
+            f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
+            f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12}")
+    lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
+                 f"{fleet_batches:>8.2f}")
+    recovery = status.get("recovery") or {}
+    interesting = {k: v for k, v in recovery.items() if v}
+    if interesting:
+        lines.append("recovery: " + " ".join(
+            f"{k}={v}" for k, v in sorted(interesting.items())))
+    return "\n".join(lines)
+
+
+def run_status(address, watch=False, interval_s=2.0, out=None,
+               max_refreshes=None, stop_event=None):
+    """The ``status`` subcommand: poll, render, and (with ``watch``)
+    refresh until interrupted. ``max_refreshes``/``stop_event`` bound the
+    loop for tests."""
+    out = out if out is not None else sys.stdout
+    prev = collect_fleet_sample(address)
+    refreshes = 0
+    while True:
+        if stop_event is not None and stop_event.is_set():
+            return 0
+        time.sleep(interval_s)
+        try:
+            cur = collect_fleet_sample(address)
+        except OSError as exc:
+            # A watch must ride out a dispatcher restart, not die on it —
+            # the exact window the tool exists to observe. One-shot mode
+            # already exhausted the poll's own retry budget: report it.
+            if not watch:
+                out.write(f"dispatcher unreachable: {exc}\n")
+                return 1
+            out.write(f"dispatcher unreachable ({exc}); retrying...\n")
+            out.flush()
+            continue
+        if watch:
+            out.write("\x1b[2J\x1b[H")  # clear + home, top-style refresh
+        out.write(render_fleet_status(prev, cur) + "\n")
+        out.flush()
+        prev = cur
+        refreshes += 1
+        if not watch:
+            return 0
+        if max_refreshes is not None and refreshes >= max_refreshes:
+            return 0
+
+
 def main(argv=None, run_seconds=None, stop_event=None):
     """Entry point. ``run_seconds`` bounds the serve loop and
     ``stop_event`` stops it early (both for tests — an embedding test must
     be able to tear the node down instead of leaking its sockets for the
     rest of ``run_seconds``); the default serves until SIGINT/SIGTERM."""
     args = _build_parser().parse_args(argv)
+    if args.role == "status":
+        try:
+            return run_status(parse_address(args.dispatcher),
+                              watch=args.watch, interval_s=args.interval,
+                              stop_event=stop_event)
+        except KeyboardInterrupt:
+            return 0
     node = build_service_node(args)
     node.start()
+    metrics_server = None
+    if getattr(args, "metrics_port", None) is not None:
+        from petastorm_tpu.telemetry.http import MetricsServer
+
+        metrics_server = MetricsServer(host=args.host,
+                                       port=args.metrics_port).start()
     host, port = node.address
     print(json.dumps({"role": args.role, "host": host, "port": port,
                       **({"worker_id": node.worker_id}
-                         if args.role == "worker" else {})}),
+                         if args.role == "worker" else {}),
+                      **({"metrics_port": metrics_server.address[1]}
+                         if metrics_server is not None else {})}),
           flush=True)
     stop = stop_event if stop_event is not None else threading.Event()
     try:
@@ -129,6 +295,8 @@ def main(argv=None, run_seconds=None, stop_event=None):
     except KeyboardInterrupt:
         pass
     finally:
+        if metrics_server is not None:
+            metrics_server.stop()
         node.stop()
     return 0
 

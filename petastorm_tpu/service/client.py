@@ -35,7 +35,6 @@ restart (static mode only — fcfs has no per-client resumable position).
 from __future__ import annotations
 
 import itertools
-import logging
 import os
 import queue
 import threading
@@ -46,9 +45,17 @@ from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedConnection,
 )
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import (
+    CLIENT_BATCHES,
+    CLIENT_READY_QUEUE_DEPTH,
+    CLIENT_RECOVERY_EVENTS,
+    CLIENT_RECV_STALL,
+)
 from petastorm_tpu.utils import retry_with_backoff
 
-logger = logging.getLogger(__name__)
+logger = service_logger(__name__)
 
 
 class ServiceError(RuntimeError):
@@ -76,6 +83,10 @@ class _WorkerStream:
         self.pieces = list(pieces)
         self.epoch = epoch
         self.credits = credits
+        #: Batch id (minted worker-side at decode) of the batch the last
+        #: ``next_batch`` returned — the tracing key correlating this
+        #: stream's receive with the worker's decode/send spans.
+        self.last_bid = None
         self._auto_replenish = auto_replenish
         self._connect_timeout = connect_timeout
         self._conn = None
@@ -113,6 +124,7 @@ class _WorkerStream:
         header, payload = self._conn.recv()
         kind = header.get("type")
         if kind == "batch":
+            self.last_bid = header.get("bid")
             if self._auto_replenish:
                 self.add_credit(1)
             return payload
@@ -190,6 +202,7 @@ class _StreamReader(threading.Thread):
         self._note_recv = note_recv
 
     def run(self):
+        collector = tracing.COLLECTOR
         try:
             while not self._stopped.is_set():
                 t0 = time.perf_counter()
@@ -202,12 +215,18 @@ class _StreamReader(threading.Thread):
                     if not self._stopped.is_set():
                         self._put(("broken", self._sid, exc))
                     return
-                self._note_recv(self._stream.worker_id,
-                                time.perf_counter() - t0, batch is not None)
+                t1 = time.perf_counter()
+                self._note_recv(self._stream.worker_id, t1 - t0,
+                                batch is not None)
                 if batch is None:
                     self._put(("end", self._sid, None))
                     return
-                self._put(("batch", self._sid, batch))
+                bid = self._stream.last_bid
+                if collector.enabled:
+                    collector.record_span("client.recv", t0, t1, bid=bid)
+                # The enqueue timestamp travels with the batch so the
+                # consumer can record the ready-queue residency span.
+                self._put(("batch", self._sid, (batch, bid, t1)))
         except BaseException as exc:
             # ServiceError and anything unexpected: forward as a terminal
             # event for the consumer to re-raise — a reader dying silently
@@ -290,6 +309,11 @@ class ServiceBatchSource:
         self._ready_queue = None      # live queue while a drain is active
         self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
+        self._log = logger.bind(client_id=self.client_id)
+        #: Batch id of the most recently yielded batch (tracing: the
+        #: loader reads it right after pulling on the direct path — same
+        #: thread, so the association is exact).
+        self.last_bid = None
         self._mode = None
         self._epoch = 0
         self._completed = set()
@@ -324,6 +348,14 @@ class ServiceBatchSource:
         self._production_count = 0
         self._events = []        # (production_count, epoch, [pieces])
         self._epoch_starts = [(0, self._epoch, set(self._completed))]
+
+    def _recovery_inc(self, event, n=1):
+        """Bump a client recovery counter in BOTH surfaces at once: the
+        legacy ``diagnostics["recovery"]`` dict and the registry family
+        (``petastorm_service_client_recovery_events_total``). Callers must
+        hold ``_lock``."""
+        self._recovery[event] += n
+        CLIENT_RECOVERY_EVENTS.labels(event).inc(n)
 
     # -- dispatcher control channel ---------------------------------------
 
@@ -437,11 +469,11 @@ class ServiceBatchSource:
                 # would otherwise spin get_assignment requests forever with
                 # nothing to yield — end the stream instead; the shard can
                 # never become non-empty (num_pieces is fixed).
-                logger.warning(
-                    "client %s (index %d of %d) received an empty static "
-                    "shard and num_epochs is None — ending the stream "
-                    "(prefer num_clients <= row-group count)",
-                    self.client_id, self.client_index, self.num_clients)
+                self._log.warning(
+                    "empty static shard and num_epochs is None — ending "
+                    "the stream (prefer num_clients <= row-group count)",
+                    client_index=self.client_index,
+                    num_clients=self.num_clients)
                 return
             with self._lock:
                 skip = set(self._completed)
@@ -546,17 +578,17 @@ class ServiceBatchSource:
             try:
                 reply = self._fetch_assignment(epoch)
             except (ServiceError, OSError) as exc:
-                logger.warning(
+                self._log.warning(
                     "resync under fencing epoch change failed (%s) — "
                     "keeping current streams; the next heartbeat retries",
                     exc)
                 with self._lock:
-                    self._recovery["resync_failures"] += 1
+                    self._recovery_inc("resync_failures")
                     self._fence_pending = False
                 return
             with self._lock:
                 completed = set(self._completed)
-                self._recovery["resyncs"] += 1
+                self._recovery_inc("resyncs")
             desired = {}  # pending piece -> (worker_id, address)
             for wid, pieces in reply["assignments"].items():
                 for piece in pieces:
@@ -580,11 +612,11 @@ class ServiceBatchSource:
                     retired.add(sid)
                     stream.close()
                     with self._lock:
-                        self._recovery["streams_retired"] += 1
-                    logger.warning(
-                        "resync: retiring stream to %s (pieces %s moved "
-                        "under fencing epoch %s)", stream.worker_id,
-                        stream.pieces, reply.get("fencing_epoch"))
+                        self._recovery_inc("streams_retired")
+                    self._log.warning(
+                        "resync: retiring stream (pieces %s moved)",
+                        stream.pieces, worker_id=stream.worker_id,
+                        fencing_epoch=reply.get("fencing_epoch"))
             regroup = {}
             for piece, (wid, address) in sorted(desired.items()):
                 regroup.setdefault((wid, address), []).append(piece)
@@ -612,6 +644,7 @@ class ServiceBatchSource:
                         retired.discard(sid)
                     continue
                 if kind == "batch":
+                    batch, bid, t_enqueued = item
                     stream = streams[sid]
                     # Ack BEFORE yielding: the worker refills its window
                     # while the trainer computes on this batch.
@@ -619,7 +652,15 @@ class ServiceBatchSource:
                     with self._lock:
                         self._production_count += 1
                         self._note_consumed_locked(stream.worker_id)
-                    yield item
+                    collector = tracing.COLLECTOR
+                    if collector.enabled:
+                        collector.record_span("client.queue", t_enqueued,
+                                              time.perf_counter(), bid=bid)
+                    # Sampled on dequeue: what a scraper sees is the depth
+                    # the consumer actually experienced.
+                    CLIENT_READY_QUEUE_DEPTH.set(ready.qsize())
+                    self.last_bid = bid
+                    yield batch
                 elif kind == "end":
                     stream = streams.pop(sid)
                     with self._lock:
@@ -674,6 +715,7 @@ class ServiceBatchSource:
     def _note_stream_recv(self, worker_id, stall_s, got_batch):
         """Reader-thread callback: receive-stall seconds (time blocked
         waiting on the worker) and one more batch held client-side."""
+        CLIENT_RECV_STALL.labels(worker_id).inc(stall_s)
         with self._lock:
             counters = self._per_worker.setdefault(
                 worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
@@ -683,6 +725,7 @@ class ServiceBatchSource:
 
     def _note_consumed_locked(self, worker_id):
         """One batch consumed (and its credit acked) — callers hold _lock."""
+        CLIENT_BATCHES.labels(worker_id).inc()
         counters = self._per_worker.setdefault(
             worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
         counters["batches"] += 1
@@ -705,7 +748,7 @@ class ServiceBatchSource:
                     retries=0)
             except (ServiceError, OSError):
                 with self._lock:
-                    self._recovery["heartbeat_failures"] += 1
+                    self._recovery_inc("heartbeat_failures")
                 continue
             fencing = int(reply.get("fencing_epoch", 0))
             with self._lock:
@@ -776,10 +819,10 @@ class ServiceBatchSource:
         assignment under the current epoch and route the broken pieces
         per the fresh plan (never double-delivering a piece another
         mapping now owns, never skipping one)."""
-        logger.warning(
-            "worker %s unreachable after %d retries; requesting "
-            "re-assignment of %d pieces", stream.worker_id,
-            self._max_retries + 1, len(stream.pieces))
+        self._log.warning(
+            "worker unreachable after %d retries; requesting "
+            "re-assignment of %d pieces", self._max_retries + 1,
+            len(stream.pieces), worker_id=stream.worker_id)
         with self._lock:
             token = self._synced_fencing_epoch
         reply = self._dispatcher_request({
@@ -788,7 +831,7 @@ class ServiceBatchSource:
             "fencing_epoch": token})
         if reply.get("type") == "stale_fencing":
             with self._lock:
-                self._recovery["stale_fencing_retries"] += 1
+                self._recovery_inc("stale_fencing_retries")
             # Raw request on purpose: this path only reroutes the BROKEN
             # pieces. Syncing the drain's fencing epoch here would cancel
             # the heartbeat-triggered resync that other live streams
@@ -809,7 +852,7 @@ class ServiceBatchSource:
         # eviction this client hasn't reconciled; the next heartbeat then
         # triggers a (no-op, if so) resync rather than silently skipping it.
         with self._lock:
-            self._recovery["takeovers"] += 1
+            self._recovery_inc("takeovers")
         return [
             _WorkerStream(wid, reply["workers"][wid], pieces, stream.epoch,
                           self._connect_timeout, credits=self._credits)
@@ -901,25 +944,30 @@ class ServiceBatchSource:
                 if attempt == self._max_retries:
                     return False
                 sleep_s = next(delays)
-                logger.warning(
-                    "split %s from worker %s failed (%s); retry %d/%d in "
-                    "%.2fs", piece, wid, exc, attempt + 1,
-                    self._max_retries, sleep_s)
+                self._log.warning(
+                    "split %s failed (%s); retry %d/%d in %.2fs", piece,
+                    exc, attempt + 1, self._max_retries, sleep_s,
+                    worker_id=wid)
                 time.sleep(sleep_s)
         return False
 
     def _drain_one(self, stream):
+        collector = tracing.COLLECTOR
         try:
             while True:
                 t0 = time.perf_counter()
                 batch = stream.next_batch()
-                self._note_stream_recv(stream.worker_id,
-                                       time.perf_counter() - t0,
+                t1 = time.perf_counter()
+                self._note_stream_recv(stream.worker_id, t1 - t0,
                                        batch is not None)
                 if batch is None:
                     return
+                if collector.enabled:
+                    collector.record_span("client.recv", t0, t1,
+                                          bid=stream.last_bid)
                 with self._lock:
                     self._note_consumed_locked(stream.worker_id)
+                self.last_bid = stream.last_bid
                 yield batch
         finally:
             stream.close()
@@ -1054,12 +1102,15 @@ class _BufferedStream:
         self.pieces = stream.pieces
         self.epoch = stream.epoch
         self.credits = stream.credits
+        self.last_bid = stream.last_bid  # bid of the buffered probe batch
 
     def next_batch(self):
         if self._first is not None:
             batch, self._first = self._first, None
             return batch
-        return self._stream.next_batch()
+        batch = self._stream.next_batch()
+        self.last_bid = self._stream.last_bid
+        return batch
 
     def add_credit(self, n=1):
         self._stream.add_credit(n)
@@ -1077,6 +1128,7 @@ class _EndedStream:
         self.pieces = stream.pieces
         self.epoch = stream.epoch
         self.credits = stream.credits
+        self.last_bid = None
 
     def next_batch(self):
         return None

@@ -55,7 +55,6 @@ Request vocabulary (header ``type``):
 
 from __future__ import annotations
 
-import logging
 import threading
 import time
 from collections import deque
@@ -65,8 +64,15 @@ from petastorm_tpu.reader_impl.framed_socket import (
     FramedServer,
     send_framed,
 )
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import (
+    DISPATCHER_FENCING_EPOCH,
+    DISPATCHER_RECOVERY_EVENTS,
+    DISPATCHER_REQUESTS,
+    DISPATCHER_WORKERS,
+)
 
-logger = logging.getLogger(__name__)
+logger = service_logger(__name__)
 
 MODES = ("static", "fcfs")
 
@@ -233,11 +239,12 @@ class Dispatcher:
             self._recovery["journal_replays"] += 1
             self._journal.append({"op": "replayed"})
             self._bump_fencing_locked("journal_replay")
+            self._sync_telemetry_locked()
         logger.warning(
             "dispatcher recovered from journal %s: %d workers, %d clients, "
-            "%d WAL records replayed — fencing epoch now %d",
-            self.journal_dir, len(self._workers), len(self._clients),
-            len(records), self._fencing_epoch)
+            "%d WAL records replayed", self.journal_dir,
+            len(self._workers), len(self._clients), len(records),
+            fencing_epoch=self._fencing_epoch)
 
     def _install_state_locked(self, state):
         if state.get("mode") != self.mode:
@@ -314,7 +321,8 @@ class Dispatcher:
         self._journal_locked({"op": "fencing",
                               "fencing_epoch": self._fencing_epoch,
                               "reason": reason})
-        logger.info("fencing epoch -> %d (%s)", self._fencing_epoch, reason)
+        logger.info("fencing epoch bumped",
+                    fencing_epoch=self._fencing_epoch, reason=reason)
 
     # -- liveness ----------------------------------------------------------
 
@@ -329,15 +337,17 @@ class Dispatcher:
                     and self._worker_leases.get(wid, now) <= now]
                 for wid in expired:
                     logger.warning(
-                        "worker %s missed its %.1fs lease — evicting "
-                        "(its splits re-assign via the takeover path)",
-                        wid, self.lease_timeout_s)
+                        "worker missed its %.1fs lease — evicting (its "
+                        "splits re-assign via the takeover path)",
+                        self.lease_timeout_s, worker_id=wid,
+                        fencing_epoch=self._fencing_epoch)
                     self._mark_worker_dead_locked(wid, "lease_expired")
                     self._journal_locked({"op": "worker_dead",
                                           "worker_id": wid,
                                           "reason": "lease_expired"})
                 if expired:
                     self._bump_fencing_locked("lease_expiry")
+                    self._sync_telemetry_locked()
 
     def _mark_worker_dead_locked(self, worker_id, reason):
         worker = self._workers.get(worker_id)
@@ -389,8 +399,29 @@ class Dispatcher:
         kind = header.get("type")
         handler = getattr(self, f"_handle_{kind}", None)
         if handler is None:
+            DISPATCHER_REQUESTS.labels("unknown").inc()
             return {"type": "error", "error": f"unknown request {kind!r}"}
-        return handler(header)
+        DISPATCHER_REQUESTS.labels(kind).inc()
+        try:
+            return handler(header)
+        finally:
+            # Control-plane rates are a few requests/second at most, so
+            # re-deriving the scrapeable gauges (fencing epoch, worker
+            # liveness, recovery counters) after every request keeps them
+            # exact without littering each mutation site.
+            with self._lock:
+                self._sync_telemetry_locked()
+
+    def _sync_telemetry_locked(self):
+        """Mirror control-plane state into the registry gauges (recovery
+        values are journaled and can jump on replay — gauges, not
+        counters, are the honest type for them)."""
+        DISPATCHER_FENCING_EPOCH.set(self._fencing_epoch)
+        alive = sum(1 for w in self._workers.values() if w["alive"])
+        DISPATCHER_WORKERS.labels("alive").set(alive)
+        DISPATCHER_WORKERS.labels("dead").set(len(self._workers) - alive)
+        for event, count in self._recovery.items():
+            DISPATCHER_RECOVERY_EVENTS.labels(event).set(count)
 
     # -- handlers ----------------------------------------------------------
 
@@ -417,9 +448,10 @@ class Dispatcher:
                 "host": header["host"], "port": int(header["port"]),
                 "num_pieces": num_pieces, "re_register": re_register})
             fencing = self._fencing_epoch
-        logger.info("worker %s %sregistered at %s:%s (%d pieces)",
-                    worker_id, "re-" if re_register else "",
-                    header["host"], header["port"], num_pieces)
+        logger.info("worker %sregistered at %s:%s (%d pieces)",
+                    "re-" if re_register else "",
+                    header["host"], header["port"], num_pieces,
+                    worker_id=worker_id, fencing_epoch=fencing)
         return {"type": "ok", "fencing_epoch": fencing}
 
     def _handle_worker_heartbeat(self, header):
@@ -524,9 +556,9 @@ class Dispatcher:
                 # longer owns.
                 self._recovery["stale_fencing_rejections"] += 1
                 logger.warning(
-                    "rejecting stale report_failure from %s (token %s < "
-                    "fencing epoch %d)", header.get("client_id"), token,
-                    self._fencing_epoch)
+                    "rejecting stale report_failure (token %s)", token,
+                    client_id=header.get("client_id"),
+                    fencing_epoch=self._fencing_epoch)
                 return {"type": "stale_fencing",
                         "fencing_epoch": self._fencing_epoch}
             if self._mark_worker_dead_locked(worker_id, "reported"):
@@ -542,9 +574,10 @@ class Dispatcher:
             worker_ids = sorted(alive)
             assignments = self._partition(pieces, worker_ids)
             logger.warning(
-                "worker %s reported failed by %s; reassigning %d pieces "
-                "across %d survivors", worker_id, header.get("client_id"),
-                len(pieces), len(worker_ids))
+                "worker reported failed; reassigning %d pieces across %d "
+                "survivors", len(pieces), len(worker_ids),
+                worker_id=worker_id, client_id=header.get("client_id"),
+                fencing_epoch=self._fencing_epoch)
             return {
                 "type": "assignment",
                 "fencing_epoch": self._fencing_epoch,

@@ -29,10 +29,11 @@ live handlers use (``docs/guides/service.md#failure-model-and-recovery``).
 from __future__ import annotations
 
 import json
-import logging
 import os
 
-logger = logging.getLogger(__name__)
+from petastorm_tpu.telemetry.log import service_logger
+
+logger = service_logger(__name__)
 
 SNAPSHOT_NAME = "snapshot.json"
 WAL_NAME = "wal.jsonl"

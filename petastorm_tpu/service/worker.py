@@ -18,7 +18,6 @@ same way it would a local one (``docs/guides/diagnostics.md``).
 
 from __future__ import annotations
 
-import logging
 import threading
 import time
 import uuid
@@ -29,8 +28,18 @@ from petastorm_tpu.reader_impl.framed_socket import (
     FramedServer,
     send_framed,
 )
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import (
+    WORKER_ACTIVE_STREAMS,
+    WORKER_BATCHES_SENT,
+    WORKER_CREDIT_WAIT,
+    WORKER_DECODE_SECONDS,
+    WORKER_ROWS_SENT,
+    WORKER_STREAMS,
+)
 
-logger = logging.getLogger(__name__)
+logger = service_logger(__name__)
 
 _FACTORIES = ("row", "batch", "columnar")
 
@@ -125,6 +134,14 @@ class BatchWorker:
         self._lock = threading.Lock()
         self._active = {}            # stream key -> {"reader", "flow"}
         self._completed = {}         # stream key -> final diagnostics dict
+        self._log = logger.bind(worker_id=self.worker_id)
+        # Interned registry children (telemetry.metrics): typed, scrapeable
+        # counters behind the legacy diagnostics snapshots.
+        self._m_batches = WORKER_BATCHES_SENT.labels(self.worker_id)
+        self._m_rows = WORKER_ROWS_SENT.labels(self.worker_id)
+        self._m_credit_wait = WORKER_CREDIT_WAIT.labels(self.worker_id)
+        self._m_active = WORKER_ACTIVE_STREAMS.labels(self.worker_id)
+        self._m_decode = WORKER_DECODE_SECONDS.labels(self.worker_id)
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self._heartbeat_paused = threading.Event()  # test hook: hung worker
@@ -163,10 +180,10 @@ class BatchWorker:
         self._server.stop()
         stragglers = self._server.join(timeout=drain_timeout_s)
         if stragglers:
-            logger.warning(
-                "worker %s: %d stream thread(s) still alive after the "
-                "%.1fs stop drain — stopping their readers under them",
-                self.worker_id, len(stragglers), drain_timeout_s)
+            self._log.warning(
+                "%d stream thread(s) still alive after the %.1fs stop "
+                "drain — stopping their readers under them",
+                len(stragglers), drain_timeout_s)
         with self._lock:
             readers = [entry["reader"] for entry in self._active.values()]
         for reader in readers:
@@ -279,9 +296,10 @@ class BatchWorker:
                 continue  # dispatcher down: retry next tick
             if reply.get("type") == "unknown_worker" \
                     and not self._heartbeat_stop.is_set():
-                logger.warning(
-                    "dispatcher no longer knows worker %s — re-registering",
-                    self.worker_id)
+                self._log.warning(
+                    "dispatcher no longer knows this worker — "
+                    "re-registering",
+                    fencing_epoch=reply.get("fencing_epoch"))
                 try:
                     # retries=0 keeps the tick bounded by one dial: the
                     # loop itself is the retry, and stop() must not wait
@@ -328,7 +346,14 @@ class BatchWorker:
         per-worker in-flight batches stay <= the window instead of growing
         with the socket buffer (unbounded push) or collapsing to
         request/response lockstep. Without the field the stream is
-        unbounded (pre-credit clients)."""
+        unbounded (pre-credit clients).
+
+        Telemetry: each batch gets an id minted here
+        (``<worker_id>:<stream>:<seq>``) and carried in the ``batch``
+        header — the cross-process key batch-lifecycle tracing correlates
+        spans on (decode/send worker-side; recv/queue/dispatch
+        client-side). Decode and send times land in the registry whether or
+        not tracing is armed."""
         from petastorm_tpu.jax_utils.batcher import batch_iterator
 
         pieces = [int(p) for p in header["pieces"]]
@@ -339,6 +364,10 @@ class BatchWorker:
         stream_key = f"{uuid.uuid4().hex[:8]}"
         reader = None
         rows_sent = 0
+        # "aborted" covers the early returns (worker stop mid-stream, no
+        # `end` frame sent); only the `end` send flips it to "completed".
+        outcome = "aborted"
+        collector = tracing.COLLECTOR
         try:
             # cur_shard=0/shard_count=1 pins sharding OFF: the factory
             # defaults would silently fill jax.process_index()/count() on a
@@ -351,8 +380,22 @@ class BatchWorker:
                                    **self._reader_kwargs)
             with self._lock:
                 self._active[stream_key] = {"reader": reader, "flow": flow}
-            for batch in batch_iterator(reader, self._batch_size,
-                                        last_batch="keep"):
+            self._m_active.inc()
+            batches = iter(batch_iterator(reader, self._batch_size,
+                                          last_batch="keep"))
+            while True:
+                # Manual iteration so the pull itself (read + collate) is
+                # a measured decode span, attributable per batch id.
+                t_decode = time.perf_counter()
+                batch = next(batches, None)
+                t_decoded = time.perf_counter()
+                if batch is None:
+                    break
+                self._m_decode.observe(t_decoded - t_decode)
+                bid = f"{self.worker_id}:{stream_key}:{flow['batches_sent']}"
+                if collector.enabled:
+                    collector.record_span("worker.decode", t_decode,
+                                          t_decoded, bid=bid)
                 if self._server.stopped.is_set():
                     return
                 if credits is not None:
@@ -375,31 +418,47 @@ class BatchWorker:
                         reply, _ = conn_reader.recv()
                         if reply.get("type") == "credit":
                             flow["credits_left"] += int(reply.get("n", 1))
-                    flow["credit_wait_s"] += time.perf_counter() - t0
+                    waited = time.perf_counter() - t0
+                    flow["credit_wait_s"] += waited
+                    self._m_credit_wait.inc(waited)
                 if self._batch_delay_s:
                     time.sleep(self._batch_delay_s)
                 n = self._batch_rows(batch)
-                send_framed(sock, {"type": "batch", "rows": n}, batch)
+                t_send = time.perf_counter()
+                send_framed(sock, {"type": "batch", "rows": n, "bid": bid},
+                            batch)
+                if collector.enabled:
+                    collector.record_span("worker.send", t_send,
+                                          time.perf_counter(), bid=bid)
                 rows_sent += n
                 flow["batches_sent"] += 1
+                self._m_batches.inc()
+                self._m_rows.inc(n)
                 if credits is not None:
                     flow["credits_left"] -= 1
             send_framed(sock, {"type": "end", "rows": rows_sent,
                                "pieces": pieces})
+            outcome = "completed"
         except (ConnectionClosedError, OSError):
+            outcome = "disconnected"
             raise  # client hung up — nothing to tell it
         except Exception as exc:
-            logger.exception("stream %s over pieces %s failed",
-                             stream_key, pieces)
+            outcome = "error"
+            self._log.exception("stream failed", stream=stream_key,
+                                pieces=pieces)
             send_framed(sock, {"type": "error", "error": str(exc)})
         finally:
             with self._lock:
+                started = stream_key in self._active
                 self._active.pop(stream_key, None)
                 if reader is not None:
                     self._completed[stream_key] = dict(reader.diagnostics,
                                                        **flow)
                     while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
                         self._completed.pop(next(iter(self._completed)))
+            if started:
+                self._m_active.dec()
+            WORKER_STREAMS.labels(self.worker_id, outcome).inc()
             if reader is not None:
                 reader.stop()
                 reader.join()
@@ -414,7 +473,10 @@ class BatchWorker:
         """``Reader.diagnostics`` of every active stream (merged with its
         flow-control state — credits window/left, batches sent, seconds
         blocked waiting for replenishment) plus the final snapshot of
-        recently finished ones — what a remote client sees."""
+        recently finished ones — what a remote client sees. The
+        ``metrics`` block carries this worker's lifetime registry counters
+        (monotonic, so two probes give fleet rates — what ``python -m
+        petastorm_tpu.service status --watch`` renders)."""
         with self._lock:
             active = {key: dict(entry["reader"].diagnostics,
                                 **entry["flow"])
@@ -426,4 +488,10 @@ class BatchWorker:
             "num_pieces": self.num_pieces,
             "active_streams": active,
             "completed_streams": completed,
+            "metrics": {
+                "batches_sent_total": self._m_batches.value,
+                "rows_sent_total": self._m_rows.value,
+                "credit_wait_seconds_total": self._m_credit_wait.value,
+                "active_streams": self._m_active.value,
+            },
         }

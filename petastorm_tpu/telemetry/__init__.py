@@ -1,0 +1,49 @@
+"""Unified telemetry layer: metrics registry, batch tracing, exposition.
+
+Three legs (``docs/guides/diagnostics.md#metrics-and-tracing``):
+
+- **metrics** — a process-wide, thread-safe, label-aware registry
+  (:mod:`~petastorm_tpu.telemetry.registry`) with every family declared in
+  :mod:`~petastorm_tpu.telemetry.metrics`; the reader pools, framed-socket
+  transport, service dispatcher/worker/client, and JAX loader all publish
+  into it, and the legacy ``diagnostics`` dicts are derived views;
+- **tracing** — per-batch lifecycle spans keyed by a batch id minted at
+  worker decode and propagated in the stream frame header
+  (:mod:`~petastorm_tpu.telemetry.tracing`), exported as Perfetto-loadable
+  Chrome ``trace_event`` JSON via ``JaxDataLoader(trace_path=...)`` or the
+  service scenario's ``--trace-out``;
+- **exposition** — Prometheus text format over a stdlib HTTP endpoint
+  (:mod:`~petastorm_tpu.telemetry.http`, ``--metrics-port`` on the service
+  CLIs), a :class:`~petastorm_tpu.telemetry.registry.SnapshotRing` for
+  in-process ``rate()`` deltas, and ``python -m petastorm_tpu.service
+  status --watch`` for a live terminal view of fleet rates.
+
+Everything is stdlib-only and off-by-default on the hot path: with no
+scraper, no trace path, and no watcher armed, producers pay a counter
+increment per batch/message and nothing else.
+"""
+
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.http import MetricsServer, start_metrics_server
+from petastorm_tpu.telemetry.log import StructuredLogger, service_logger
+from petastorm_tpu.telemetry.registry import (
+    REGISTRY,
+    MetricsRegistry,
+    SnapshotRing,
+    expose_prometheus,
+)
+from petastorm_tpu.telemetry.tracing import COLLECTOR, TraceCollector
+
+__all__ = [
+    "REGISTRY",
+    "COLLECTOR",
+    "MetricsRegistry",
+    "MetricsServer",
+    "SnapshotRing",
+    "StructuredLogger",
+    "TraceCollector",
+    "expose_prometheus",
+    "service_logger",
+    "start_metrics_server",
+    "tracing",
+]

@@ -1,0 +1,156 @@
+"""Every metric family the repo exports, declared in one place.
+
+Central declaration is deliberate: producers import their families from
+here, the Prometheus endpoint exposes exactly this vocabulary (families
+appear in a scrape even before their first sample), and ``tests/test_docs``
+asserts each name below is documented in
+``docs/guides/diagnostics.md`` — a new counter cannot ship undocumented.
+
+Naming follows Prometheus conventions: ``petastorm_<layer>_...``, base
+units (seconds, bytes), ``_total`` suffix on counters. Label cardinality is
+bounded by construction — worker/client ids, stage names, event names;
+never row- or batch-scoped values. The one per-instance label
+(``loader``) is recycled: a garbage-collected loader's series are removed
+from the registry and its id is reused, so live cardinality tracks live
+instances.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.telemetry.registry import REGISTRY
+
+# -- transport (reader_impl/framed_socket.py) --------------------------------
+
+TRANSPORT_MESSAGES = REGISTRY.counter(
+    "petastorm_transport_messages_total",
+    "Framed messages moved over stream sockets, by direction (sent/recv)",
+    labels=("direction",))
+TRANSPORT_FRAMES = REGISTRY.counter(
+    "petastorm_transport_frames_total",
+    "Payload frames inside framed messages, by direction (a wide numpy "
+    "batch is dozens of frames per message)",
+    labels=("direction",))
+TRANSPORT_BYTES = REGISTRY.counter(
+    "petastorm_transport_bytes_total",
+    "Bytes moved by the framed transport, by direction (header + framing "
+    "prefixes + payload frames)",
+    labels=("direction",))
+
+# -- service: batch worker (service/worker.py) -------------------------------
+
+WORKER_BATCHES_SENT = REGISTRY.counter(
+    "petastorm_service_worker_batches_sent_total",
+    "Collated batches streamed to clients, per worker",
+    labels=("worker",))
+WORKER_ROWS_SENT = REGISTRY.counter(
+    "petastorm_service_worker_rows_sent_total",
+    "Rows streamed to clients, per worker",
+    labels=("worker",))
+WORKER_CREDIT_WAIT = REGISTRY.counter(
+    "petastorm_service_worker_credit_wait_seconds_total",
+    "Seconds a worker's stream loop spent blocked waiting for credit "
+    "replenishment (high = the trainer is the bottleneck, flow control is "
+    "holding workers back as designed)",
+    labels=("worker",))
+WORKER_STREAMS = REGISTRY.counter(
+    "petastorm_service_worker_streams_total",
+    "Stream requests finished, per worker and outcome "
+    "(completed/error/disconnected/aborted — aborted = the worker "
+    "stopped mid-stream without sending `end`)",
+    labels=("worker", "outcome"))
+WORKER_ACTIVE_STREAMS = REGISTRY.gauge(
+    "petastorm_service_worker_active_streams",
+    "Streams a worker is serving right now",
+    labels=("worker",))
+WORKER_DECODE_SECONDS = REGISTRY.histogram(
+    "petastorm_service_worker_decode_seconds",
+    "Per-batch read+collate time inside a worker's stream loop (the time "
+    "to pull the next batch from its reader pipeline)",
+    labels=("worker",))
+
+# -- service: dispatcher (service/dispatcher.py) -----------------------------
+
+DISPATCHER_REQUESTS = REGISTRY.counter(
+    "petastorm_service_dispatcher_requests_total",
+    "Control-plane requests handled, by request type",
+    labels=("type",))
+DISPATCHER_FENCING_EPOCH = REGISTRY.gauge(
+    "petastorm_service_dispatcher_fencing_epoch",
+    "Current fencing epoch (bumps invalidate outstanding assignments)")
+DISPATCHER_WORKERS = REGISTRY.gauge(
+    "petastorm_service_dispatcher_workers",
+    "Registered workers by liveness state (alive/dead)",
+    labels=("state",))
+DISPATCHER_RECOVERY_EVENTS = REGISTRY.gauge(
+    "petastorm_service_dispatcher_recovery_events",
+    "Dispatcher recovery counters (journal_replays, evictions, "
+    "failures_reported, re_registrations, fencing_bumps, "
+    "stale_fencing_rejections). A gauge, not a counter: the values are "
+    "journaled and restored across restarts, so they can jump on replay",
+    labels=("event",))
+
+# -- service: trainer client (service/client.py) -----------------------------
+
+CLIENT_BATCHES = REGISTRY.counter(
+    "petastorm_service_client_batches_total",
+    "Remote batches consumed by this trainer, per source worker",
+    labels=("worker",))
+CLIENT_RECV_STALL = REGISTRY.counter(
+    "petastorm_service_client_recv_stall_seconds_total",
+    "Seconds a client stream-reader thread spent blocked waiting on its "
+    "worker (a skewed worker shows up here, not in delivery latency)",
+    labels=("worker",))
+CLIENT_READY_QUEUE_DEPTH = REGISTRY.gauge(
+    "petastorm_service_client_ready_queue_depth",
+    "Batches waiting in the multiplexed drain's shared ready-queue "
+    "(sampled as the consumer dequeues)")
+CLIENT_RECOVERY_EVENTS = REGISTRY.counter(
+    "petastorm_service_client_recovery_events_total",
+    "Client-observed recovery events (resyncs, resync_failures, "
+    "streams_retired, takeovers, stale_fencing_retries, "
+    "heartbeat_failures)",
+    labels=("event",))
+
+# -- JAX loader (jax_utils/loader.py) ----------------------------------------
+
+LOADER_BATCHES = REGISTRY.counter(
+    "petastorm_loader_batches_total",
+    "Batches yielded to the training loop, per loader instance",
+    labels=("loader",))
+LOADER_ROWS = REGISTRY.counter(
+    "petastorm_loader_rows_total",
+    "Rows yielded to the training loop, per loader instance",
+    labels=("loader",))
+LOADER_STAGE_SECONDS = REGISTRY.histogram(
+    "petastorm_loader_stage_seconds",
+    "Per-batch time in each loader pipeline stage (decode, queue_wait, "
+    "wait, device_put, consumer) — the legacy diagnostics stage sums are "
+    "derived from these series",
+    labels=("loader", "stage"))
+
+# -- reader / worker pools / ventilator --------------------------------------
+
+READER_READERS = REGISTRY.counter(
+    "petastorm_reader_readers_total",
+    "Reader instances constructed in this process")
+READER_ROWGROUPS_PLANNED = REGISTRY.gauge(
+    "petastorm_reader_rowgroups_planned",
+    "Row-group pieces in the most recently constructed reader's plan "
+    "(after filters/selector/shard)")
+POOL_ITEMS_VENTILATED = REGISTRY.counter(
+    "petastorm_pool_items_ventilated_total",
+    "Work items handed to reader worker pools (all pools in-process)")
+POOL_ITEMS_PROCESSED = REGISTRY.counter(
+    "petastorm_pool_items_processed_total",
+    "Work items fully processed by reader worker pools")
+POOL_RESULTS_QUEUE_DEPTH = REGISTRY.gauge(
+    "petastorm_pool_results_queue_depth",
+    "Decoded payloads sitting in thread-pool results queues right now, "
+    "summed over live pools (pinned at its cap = the consumer can't keep "
+    "up; process pools report depth via reader diagnostics only)")
+VENTILATOR_ITEMS = REGISTRY.counter(
+    "petastorm_ventilator_items_ventilated_total",
+    "Items ventilated into pools across all ventilators in-process")
+VENTILATOR_EPOCHS = REGISTRY.counter(
+    "petastorm_ventilator_epochs_completed_total",
+    "Full ventilation epochs completed across all ventilators in-process")

@@ -28,6 +28,10 @@ import threading
 import time
 from collections import deque
 
+from petastorm_tpu.telemetry.metrics import (
+    POOL_ITEMS_PROCESSED,
+    POOL_ITEMS_VENTILATED,
+)
 from petastorm_tpu.workers_pool import (
     DEFAULT_TIMEOUT_S,
     EmptyResultError,
@@ -161,6 +165,7 @@ class ProcessPool:
         # cloudpickle: work items may carry lambdas (e.g. in_lambda predicates)
         payload = cloudpickle.dumps((args, kwargs))
         self._ventilated_items += 1
+        POOL_ITEMS_VENTILATED.inc()
         self._vent_socket.send(payload)
 
     def _recv_frames(self):
@@ -219,6 +224,7 @@ class ProcessPool:
                 return self._serializer.deserialize(payload)
             if kind == _FRAME_DONE:
                 self._completed_items += 1
+                POOL_ITEMS_PROCESSED.inc()
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 continue

@@ -19,6 +19,11 @@ from petastorm_tpu.workers_pool import (
     TimeoutWaitingForResultError,
     VentilatedItemProcessedMessage,
 )
+from petastorm_tpu.telemetry.metrics import (
+    POOL_ITEMS_PROCESSED,
+    POOL_ITEMS_VENTILATED,
+    POOL_RESULTS_QUEUE_DEPTH,
+)
 from petastorm_tpu.workers_pool.worker_base import EOFSentinel
 
 
@@ -70,6 +75,7 @@ class ThreadPool:
         # (the raw queue also carries DONE markers and exceptions).
         with self._counter_lock:
             self._results_pending += 1
+        POOL_RESULTS_QUEUE_DEPTH.inc()
         self._results_queue.put(item)
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
@@ -110,6 +116,7 @@ class ThreadPool:
     def ventilate(self, *args, **kwargs):
         with self._counter_lock:
             self._ventilated_items += 1
+        POOL_ITEMS_VENTILATED.inc()
         self._ventilator_queue.put((args, kwargs))
 
     def get_results(self, timeout=DEFAULT_TIMEOUT_S):
@@ -141,6 +148,7 @@ class ThreadPool:
             if isinstance(result, VentilatedItemProcessedMessage):
                 with self._counter_lock:
                     self._completed_items += 1
+                POOL_ITEMS_PROCESSED.inc()
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
                 continue
@@ -148,6 +156,7 @@ class ThreadPool:
                 raise result
             with self._counter_lock:
                 self._results_pending -= 1
+            POOL_RESULTS_QUEUE_DEPTH.dec()
             return result
 
     def _raise_on_ventilator_error(self):
@@ -185,6 +194,7 @@ class ThreadPool:
                     self._results_queue.get_nowait()
             except queue.Empty:
                 with self._counter_lock:
+                    POOL_RESULTS_QUEUE_DEPTH.dec(self._results_pending)
                     self._results_pending = 0
             if time.monotonic() > deadline:  # pragma: no cover - stuck worker
                 break

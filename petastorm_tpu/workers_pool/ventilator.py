@@ -12,6 +12,11 @@ import random
 import threading
 from abc import ABC, abstractmethod
 
+from petastorm_tpu.telemetry.metrics import (
+    VENTILATOR_EPOCHS,
+    VENTILATOR_ITEMS,
+)
+
 
 class Ventilator(ABC):
     """Base ventilator: feeds work items to a pool via ``ventilate_fn``."""
@@ -149,9 +154,11 @@ class ConcurrentVentilator(Ventilator):
                         return
                     self._in_flight += 1
                     self._items_ventilated += 1
+                VENTILATOR_ITEMS.inc()
                 self._ventilate_fn(**item)
             with self._lock:
                 self._epochs_completed += 1
+            VENTILATOR_EPOCHS.inc()
             epoch += 1
             if iterations_left is not None:
                 iterations_left -= 1

@@ -40,6 +40,53 @@ def test_internal_links_resolve():
                                        f"missing {target}"
 
 
+def test_every_metric_family_documented():
+    """Every metric family the registry exports must appear in
+    docs/guides/diagnostics.md — a new counter cannot ship undocumented.
+    Families are declared centrally in telemetry.metrics, so importing it
+    enumerates the full vocabulary."""
+    import petastorm_tpu.telemetry.metrics  # noqa: F401 - declares families
+    from petastorm_tpu.telemetry.registry import REGISTRY
+
+    doc = (DOCS / "guides" / "diagnostics.md").read_text()
+    families = sorted(REGISTRY.families())
+    assert len(families) >= 20
+    missing = [name for name in families if name not in doc]
+    assert not missing, (
+        f"metric families exported but not documented in "
+        f"docs/guides/diagnostics.md: {missing}")
+
+
+#: time.time() is wall-clock: NTP steps and DST make it wrong for duration
+#: math — perf_counter/monotonic only. The tree is clean; keep it that way.
+_WALL_CLOCK_RE = re.compile(r"\btime\.time\(\)")
+
+#: The one legitimate wall-clock read: the trace collector anchors its
+#: perf_counter timestamps to the epoch so multi-process traces line up.
+#: (This file is excluded because the ban's own comment and failure
+#: message spell the banned call.)
+_WALL_CLOCK_ALLOWED = {"petastorm_tpu/telemetry/tracing.py",
+                       "tests/test_docs.py"}
+
+
+def test_no_wall_clock_duration_math():
+    offenders = []
+    for root in ("petastorm_tpu", "tests", "examples", "bench.py"):
+        path = REPO / root
+        files = [path] if path.is_file() else sorted(path.rglob("*.py"))
+        for py in files:
+            rel = str(py.relative_to(REPO))
+            if rel in _WALL_CLOCK_ALLOWED:
+                continue
+            for lineno, line in enumerate(py.read_text().splitlines(), 1):
+                if _WALL_CLOCK_RE.search(line):
+                    offenders.append(f"{rel}:{lineno}")
+    assert not offenders, (
+        f"time.time() found (use time.perf_counter()/time.monotonic() for "
+        f"durations; telemetry.tracing owns the one wall-clock anchor): "
+        f"{offenders}")
+
+
 def test_documented_apis_exist():
     """Spot-check that names the docs teach are importable."""
     from petastorm_tpu import (  # noqa: F401

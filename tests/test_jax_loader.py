@@ -288,8 +288,8 @@ def test_loader_break_stops_producer():
     loader = make_jax_dataloader(_mock_reader(None), 5, stage_to_device=False)
     for _ in loader:
         break
-    deadline = time.time() + 5
-    while loader._producer.is_alive() and time.time() < deadline:
+    deadline = time.monotonic() + 5
+    while loader._producer.is_alive() and time.monotonic() < deadline:
         time.sleep(0.05)
     assert not loader._producer.is_alive()
 

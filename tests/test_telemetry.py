@@ -1,0 +1,628 @@
+"""Telemetry layer tests: registry thread-safety, Prometheus exposition
+golden output, trace export round-trips, snapshot-ring rates, structured
+logging, the HTTP endpoint, and end-to-end batch tracing through the
+loopback data service (docs/guides/diagnostics.md#metrics-and-tracing)."""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from petastorm_tpu.telemetry.registry import (
+    MetricsRegistry,
+    SnapshotRing,
+    expose_prometheus,
+    log_buckets,
+)
+from petastorm_tpu.telemetry.tracing import TraceCollector
+
+
+# --- registry: typed metrics and thread safety -----------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter", labels=("who",))
+    c.labels("a").inc()
+    c.labels("a").inc(2.5)
+    assert c.labels("a").value == 3.5
+    assert c.labels("b").value == 0.0
+    with pytest.raises(ValueError):
+        c.labels("a").inc(-1)
+
+    g = reg.gauge("g", "a gauge")
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3.0
+
+    h = reg.histogram("h_seconds", "a histogram", buckets=(1.0, 10.0))
+    for v in (0.5, 0.7, 5.0, 100.0):
+        h.observe(v)
+    child = h.labels()
+    assert child.count == 4
+    assert child.sum == pytest.approx(106.2)
+    assert child.bucket_counts() == [2, 1, 1]  # <=1, <=10, +Inf
+
+
+def test_registry_declaration_idempotent_and_conflict_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "x", labels=("l",))
+    assert reg.counter("x_total", "x", labels=("l",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "x", labels=("l",))
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "x", labels=("other",))
+
+
+def test_labels_by_keyword_and_arity_checked():
+    reg = MetricsRegistry()
+    c = reg.counter("kw_total", "kw", labels=("a", "b"))
+    assert c.labels(a="1", b="2") is c.labels("1", "2")
+    with pytest.raises(ValueError):
+        c.labels("only-one")
+
+
+def test_concurrent_updates_lose_nothing():
+    """8+ threads hammering one counter child, one labeled counter, and one
+    histogram: every update must land (the satellite's no-lost-updates
+    contract)."""
+    reg = MetricsRegistry()
+    counter = reg.counter("hits_total", "hits", labels=("worker",))
+    hist = reg.histogram("lat_seconds", "lat")
+    threads_n, per_thread = 10, 2_000
+
+    def hammer(idx):
+        child = counter.labels(f"w{idx % 4}")  # contended label children
+        for i in range(per_thread):
+            child.inc()
+            hist.observe(0.001 * (i % 7))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(threads_n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(child.value for child in counter.children().values())
+    assert total == threads_n * per_thread
+    assert hist.labels().count == threads_n * per_thread
+
+
+def test_log_buckets_are_log_spaced():
+    bounds = log_buckets(1e-3, 1.0, factor=10)
+    assert bounds == (1e-3, 1e-2, 1e-1, 1.0)
+
+
+def test_histogram_quantiles_interpolate():
+    reg = MetricsRegistry()
+    h = reg.histogram("q_seconds", "q", buckets=(1.0, 2.0, 4.0))
+    assert h.labels().quantile(0.5) is None  # empty
+    for v in (0.5,) * 50 + (3.0,) * 50:
+        h.observe(v)
+    p50 = h.labels().quantile(0.5)
+    p99 = h.labels().quantile(0.99)
+    assert 0.0 < p50 <= 1.0
+    assert 2.0 < p99 <= 4.0
+
+
+# --- Prometheus exposition --------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    """Escaping, sorted label names, cumulative histogram buckets with +Inf
+    terminal, _sum/_count — the text-format contract scrapers parse."""
+    reg = MetricsRegistry()
+    c = reg.counter("evil_total", 'help with \\ and\nnewline',
+                    labels=("b", "a"))
+    c.labels('va"l\n', "x\\y").inc(2)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.5, 1.0))
+    h.observe(0.1)
+    h.observe(0.7)
+    h.observe(9.0)
+    text = expose_prometheus(reg)
+    lines = text.strip().split("\n")
+    assert "# HELP evil_total help with \\\\ and\\nnewline" in lines
+    assert "# TYPE evil_total counter" in lines
+    # label names sorted (a before b), values escaped
+    assert 'evil_total{a="x\\\\y",b="va\\"l\\n"} 2' in lines
+    # histogram: cumulative buckets, +Inf, sum, count
+    assert 'lat_seconds_bucket{le="0.5"} 1' in lines
+    assert 'lat_seconds_bucket{le="1"} 2' in lines
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in lines
+    assert "lat_seconds_sum 9.8" in lines
+    assert "lat_seconds_count 3" in lines
+
+
+def test_exposition_lists_families_before_first_sample():
+    reg = MetricsRegistry()
+    reg.counter("declared_only_total", "declared, never incremented")
+    text = expose_prometheus(reg)
+    assert "# TYPE declared_only_total counter" in text
+
+
+def test_every_registered_family_appears_in_scrape():
+    """The process registry's full vocabulary (declared centrally in
+    telemetry.metrics) shows up in one scrape — ≥ 20 families spanning
+    transport, service, and loader layers."""
+    import petastorm_tpu.telemetry.metrics  # noqa: F401 - declares families
+    from petastorm_tpu.telemetry.registry import REGISTRY
+
+    text = expose_prometheus(REGISTRY)
+    families = [line.split()[2] for line in text.splitlines()
+                if line.startswith("# TYPE ")]
+    assert len(families) >= 20
+    for layer in ("petastorm_transport_", "petastorm_service_",
+                  "petastorm_loader_"):
+        assert any(name.startswith(layer) for name in families), layer
+
+
+# --- snapshot ring / rates --------------------------------------------------
+
+def test_snapshot_ring_rates():
+    reg = MetricsRegistry()
+    c = reg.counter("rows_total", "rows", labels=("w",))
+    ring = SnapshotRing(reg, interval_s=60.0, capacity=8)
+    ring.take()
+    c.labels("w0").inc(100)
+    c.labels("w1").inc(50)
+    time.sleep(0.05)
+    ring.take()
+    rate = ring.rate("rows_total")
+    assert rate is not None and rate > 0
+    # label-filtered rate sums only matching series
+    w0 = ring.rate("rows_total", labels={"w": "w0"})
+    w1 = ring.rate("rows_total", labels={"w": "w1"})
+    assert w0 == pytest.approx(2 * w1, rel=0.01)
+    assert ring.rate("missing_total") is None
+
+
+def test_snapshot_ring_bounded():
+    reg = MetricsRegistry()
+    ring = SnapshotRing(reg, interval_s=60.0, capacity=3)
+    for _ in range(10):
+        ring.take()
+    assert len(ring.snapshots()) == 3
+
+
+# --- tracing ----------------------------------------------------------------
+
+def test_trace_export_round_trips(tmp_path):
+    """Spans exported as Chrome trace_event JSON: loadable via json.load,
+    every B event has a matching E on the same (name, pid, tid)."""
+    collector = TraceCollector()
+    collector.enable()
+    t0 = time.perf_counter()
+    collector.record_span("worker.decode", t0, t0 + 0.01, bid="w0:s0:0")
+    collector.record_span("client.recv", t0 + 0.02, t0 + 0.03,
+                          bid="w0:s0:0")
+    collector.instant("fence", t0 + 0.04)
+    path = tmp_path / "trace.json"
+    n = collector.export(str(path))
+    assert n == 5  # two B/E pairs + one instant
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    begins = [e for e in events if e["ph"] == "B"]
+    ends = [e for e in events if e["ph"] == "E"]
+    assert len(begins) == len(ends) == 2
+    for b in begins:
+        matches = [e for e in ends
+                   if (e["name"], e["pid"], e["tid"])
+                   == (b["name"], b["pid"], b["tid"])
+                   and e["ts"] >= b["ts"]]
+        assert matches, f"no E pair for {b['name']}"
+    assert begins[0]["args"]["bid"] == "w0:s0:0"
+
+
+def test_trace_disabled_records_nothing_and_buffer_bounded():
+    collector = TraceCollector(max_events=4)
+    t = time.perf_counter()
+    collector.record_span("x", t, t + 1)  # disabled: dropped silently
+    assert collector.events() == []
+    collector.enable()
+    for _ in range(5):
+        collector.record_span("x", t, t + 1)
+    assert len(collector.events()) == 4  # two pairs fit, rest dropped
+    assert collector.dropped > 0
+
+
+# --- structured logging -----------------------------------------------------
+
+def test_structured_logger_namespace_and_fields(caplog):
+    from petastorm_tpu.telemetry.log import service_logger
+
+    log = service_logger("petastorm_tpu.some_module")
+    assert log.name == "petastorm_tpu.service.some_module"
+    bound = log.bind(worker_id="w-1")
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.service.some_module"):
+        bound.warning("lease missed after %.1fs", 2.5, fencing_epoch=7)
+    assert caplog.records
+    msg = caplog.records[-1].getMessage()
+    assert "lease missed after 2.5s" in msg
+    assert "worker_id=w-1" in msg
+    assert "fencing_epoch=7" in msg
+    # non-petastorm callers keep their own namespace
+    assert service_logger("thirdparty.mod").name == "thirdparty.mod"
+
+
+def test_structured_logger_survives_percent_in_field_values(caplog):
+    """A context-field value containing '%' (a client_id off the wire)
+    must never be re-interpreted as a format directive — the line lands
+    verbatim instead of raising inside logging and being dropped."""
+    from petastorm_tpu.telemetry.log import service_logger
+
+    log = service_logger("petastorm_tpu.pct_module")
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.service.pct_module"):
+        log.warning("rejecting token %s", "tok-1",
+                    client_id="cli-100%d", reason="50% stalled")
+    msg = caplog.records[-1].getMessage()
+    assert "rejecting token tok-1" in msg
+    assert "client_id=cli-100%d" in msg
+    assert "reason=50% stalled" in msg
+
+
+def test_trace_collector_acquire_release_refcounts():
+    """Two concurrent armers (train + eval loaders): the second acquire
+    joins the running trace instead of wiping it, and collection stays on
+    until the LAST release."""
+    collector = TraceCollector()
+    t = time.perf_counter()
+    collector.acquire()              # train
+    collector.record_span("a", t, t + 1)
+    collector.acquire()              # eval joins — must NOT clear
+    assert len(collector.events()) == 2
+    collector.record_span("b", t, t + 1)
+    collector.release()              # eval done — still collecting
+    assert collector.enabled
+    collector.record_span("c", t, t + 1)
+    collector.release()              # train done — off
+    assert not collector.enabled
+    assert len(collector.events()) == 6
+    collector.acquire()              # fresh session clears
+    assert collector.events() == []
+    collector.release()
+
+
+# --- HTTP exposition --------------------------------------------------------
+
+def test_metrics_server_endpoints():
+    from petastorm_tpu.telemetry.http import MetricsServer
+
+    reg = MetricsRegistry()
+    c = reg.counter("served_total", "served")
+    c.inc(3)
+    with MetricsServer(registry=reg, port=0,
+                       snapshot_interval_s=0.05) as server:
+        host, port = server.address
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=5) as resp:
+                return resp.status, resp.read().decode()
+
+        status, text = get("/metrics")
+        assert status == 200
+        assert "served_total 3" in text
+        status, body = get("/metrics.json")
+        snap = json.loads(body)
+        assert snap["served_total"]["series"][0]["value"] == 3.0
+        c.inc(10)
+        time.sleep(0.15)  # let the ring tick
+        status, body = get("/rates")
+        rates = json.loads(body)["per_second"]
+        assert rates.get("served_total", 0) > 0
+        assert get("/healthz")[0] == 200
+        with pytest.raises(urllib.error.HTTPError):
+            get("/nope")
+
+
+# --- service integration: metrics + end-to-end batch tracing ---------------
+
+@pytest.fixture()
+def service_fleet(petastorm_dataset):
+    from petastorm_tpu.service import BatchWorker, Dispatcher
+
+    dispatcher = Dispatcher(mode="static", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=10, worker_id="tele-worker",
+                         heartbeat_interval_s=None,
+                         reader_kwargs={"reader_pool_type": "dummy"}).start()
+    yield dispatcher, worker
+    worker.stop()
+    dispatcher.stop()
+
+
+def test_service_loopback_populates_registry(service_fleet):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.service import ServiceBatchSource
+    from petastorm_tpu.telemetry.metrics import (
+        CLIENT_BATCHES,
+        TRANSPORT_MESSAGES,
+        WORKER_BATCHES_SENT,
+        WORKER_ROWS_SENT,
+    )
+
+    dispatcher, worker = service_fleet
+    sent_before = TRANSPORT_MESSAGES.labels("sent").value
+    batches_before = WORKER_BATCHES_SENT.labels("tele-worker").value
+    rows_before = WORKER_ROWS_SENT.labels("tele-worker").value
+    source = ServiceBatchSource(dispatcher.address,
+                                heartbeat_interval_s=None)
+    loader = JaxDataLoader(None, 10, batch_source=source,
+                           stage_to_device=False)
+    with loader:
+        rows = sum(len(next(iter(b.values()))) for b in loader)
+    assert rows == 30
+    assert WORKER_ROWS_SENT.labels("tele-worker").value - rows_before == 30
+    delta_batches = (WORKER_BATCHES_SENT.labels("tele-worker").value
+                     - batches_before)
+    assert delta_batches >= 3
+    assert TRANSPORT_MESSAGES.labels("sent").value > sent_before
+    assert CLIENT_BATCHES.labels("tele-worker").value >= 3
+    # worker diagnostics carry the registry totals for status --watch
+    snap = worker.diagnostics_snapshot()
+    assert snap["metrics"]["rows_sent_total"] - rows_before == 30
+
+
+def test_batch_trace_spans_contiguous_across_layers(service_fleet,
+                                                    tmp_path):
+    """The acceptance contract: one batch id carries spans from worker
+    decode through client recv/queue to loader device dispatch, in
+    non-overlapping chronological order, in one Perfetto-loadable file."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.service import ServiceBatchSource
+    from petastorm_tpu.telemetry import tracing
+
+    dispatcher, _ = service_fleet
+    trace_path = tmp_path / "trace.json"
+    tracing.COLLECTOR.clear()
+    source = ServiceBatchSource(dispatcher.address,
+                                heartbeat_interval_s=None)
+    loader = JaxDataLoader(None, 10, batch_source=source,
+                           stage_to_device=False,
+                           trace_path=str(trace_path))
+    try:
+        with loader:
+            batches = sum(1 for _ in loader)
+    finally:
+        tracing.COLLECTOR.disable()
+    assert batches == 3
+    with open(trace_path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    begins, ends = {}, {}
+    for event in events:
+        bid = (event.get("args") or {}).get("bid")
+        if event["ph"] == "B" and bid is not None:
+            begins.setdefault(bid, {})[event["name"]] = event
+        elif event["ph"] == "E":
+            key = (event["name"], event["pid"], event["tid"])
+            ends.setdefault(key, []).append(event["ts"])
+    assert len(begins) >= 3  # one id per batch
+    stage_order = ["worker.decode", "worker.send", "client.recv",
+                   "client.queue", "loader.device_put"]
+    full = {bid: spans for bid, spans in begins.items()
+            if all(name in spans for name in stage_order)}
+    assert full, f"no bid with all stages; saw {list(begins)}"
+    for bid, spans in full.items():
+        # Contiguity runs on span COMPLETION: the client's recv span
+        # legitimately BEGINS before the worker decodes (it blocks
+        # waiting), but each stage finishes no earlier than its
+        # predecessor finished.
+        end_ts = []
+        for name in stage_order:
+            begin = spans[name]
+            key = (name, begin["pid"], begin["tid"])
+            after = [ts for ts in ends.get(key, ())
+                     if ts >= begin["ts"]]
+            assert after, f"{bid}: no E event for {name}"
+            end_ts.append(min(after))
+        assert end_ts == sorted(end_ts), \
+            f"{bid}: stages complete out of order: {dict(zip(stage_order, end_ts))}"
+
+
+def test_loader_diagnostics_live_mid_epoch():
+    """Satellite fix: wall_s and input_stall_pct are computed on snapshot
+    read, so a monitoring thread polling mid-epoch sees this epoch's live
+    numbers, not the previous iteration's frozen ones."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    def slow_source():
+        def gen():
+            import numpy as np
+
+            for _ in range(3):
+                time.sleep(0.05)
+                yield {"x": np.zeros(4)}
+        return gen()
+
+    loader = JaxDataLoader(None, 4, batch_source=slow_source,
+                           stage_to_device=False)
+    mid_walls = []
+    with loader:
+        for i, _ in enumerate(loader):
+            diag = loader.diagnostics
+            mid_walls.append(diag["wall_s"])
+            if i == 1:
+                # mid-epoch: wall is live and stall pct reflects THIS
+                # epoch's accumulating stall, not a stale end-of-epoch calc
+                assert diag["wall_s"] > 0.05
+                assert diag["input_stall_pct"] > 0
+    assert mid_walls == sorted(mid_walls)
+    final = loader.diagnostics
+    assert final["batches"] == 3
+    # frozen after the iteration ends
+    time.sleep(0.05)
+    assert loader.diagnostics["wall_s"] == pytest.approx(final["wall_s"])
+
+
+def test_loader_exclude_stall_rebases_derived_view():
+    """bench.py's pipeline-fill exclusion: zeroing stall-so-far re-bases
+    the derived diagnostics without touching the registry history."""
+    import numpy as np
+
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    def source():
+        def gen():
+            for i in range(3):
+                if i == 0:
+                    time.sleep(0.05)  # the "pipeline fill"
+                yield {"x": np.zeros(4)}
+        return gen()
+
+    loader = JaxDataLoader(None, 4, batch_source=source,
+                           stage_to_device=False)
+    with loader:
+        for i, _ in enumerate(loader):
+            if i == 0:
+                assert loader.diagnostics["stall_s"] > 0.04
+                loader.exclude_stall_so_far()
+                assert loader.diagnostics["stall_s"] < 0.04
+    assert loader.diagnostics["stall_s"] < 0.04
+    # the registry series kept the full history
+    total = loader._m_stage["wait"].sum
+    assert total > 0.04
+
+
+def test_fleet_status_rendering(service_fleet):
+    """collect_fleet_sample + render_fleet_status: live fleet rates from
+    two polls (what `service status --watch` prints)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.service import ServiceBatchSource
+    from petastorm_tpu.service.cli import (
+        collect_fleet_sample,
+        render_fleet_status,
+    )
+
+    dispatcher, _ = service_fleet
+    prev = collect_fleet_sample(dispatcher.address)
+    source = ServiceBatchSource(dispatcher.address,
+                                heartbeat_interval_s=None)
+    loader = JaxDataLoader(None, 10, batch_source=source,
+                           stage_to_device=False)
+    with loader:
+        assert sum(1 for _ in loader) == 3
+    prev["t"] -= 1.0  # widen the window so rates are finite and positive
+    cur = collect_fleet_sample(dispatcher.address)
+    text = render_fleet_status(prev, cur)
+    assert "tele-worker" in text
+    assert "fleet" in text
+    assert "mode=static" in text
+    row = next(line for line in text.splitlines()
+               if line.startswith("tele-worker"))
+    assert float(row.split()[1]) > 0  # rows/s over the window
+
+
+def test_service_cli_metrics_port(capsys):
+    """`--metrics-port 0` on the dispatcher CLI serves the registry; the
+    bound port is printed in the startup JSON line."""
+    from petastorm_tpu.service.cli import main
+
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=lambda: main(["dispatcher", "--port", "0",
+                             "--metrics-port", "0"],
+                            run_seconds=30, stop_event=stop),
+        daemon=True)
+    thread.start()
+    try:
+        ready = {}
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "metrics_port" not in ready:
+            for line in capsys.readouterr().out.splitlines():
+                if line.startswith("{"):
+                    ready.update(json.loads(line))
+            time.sleep(0.05)
+        assert ready.get("metrics_port", 0) > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ready['metrics_port']}/metrics",
+                timeout=5) as resp:
+            text = resp.read().decode()
+        assert "petastorm_service_dispatcher_fencing_epoch" in text
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+
+
+def test_loader_metric_series_recycled_on_gc():
+    """A garbage-collected loader's registry series are removed and its
+    `loader` label id returns to the pool — live cardinality tracks live
+    instances instead of growing per construction."""
+    import gc
+
+    import numpy as np
+
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.telemetry.metrics import LOADER_BATCHES
+
+    def source():
+        return iter([{"x": np.zeros(2)}])
+
+    loader = JaxDataLoader(None, 2, batch_source=source,
+                           stage_to_device=False)
+    with loader:
+        assert sum(1 for _ in loader) == 1
+    loader_id = loader._loader_id
+    assert (loader_id,) in LOADER_BATCHES.children()
+    del loader
+    gc.collect()
+    assert (loader_id,) not in LOADER_BATCHES.children()
+    # the id is recycled by the next construction
+    fresh = JaxDataLoader(None, 2, batch_source=source,
+                          stage_to_device=False)
+    assert fresh._loader_id == loader_id
+    assert fresh._m_batches.value == 0.0  # fresh series, no stale history
+
+
+def test_fleet_status_no_rate_spike_for_reappearing_worker():
+    """A worker unreachable in the previous sample renders '--' rates, not
+    its lifetime total divided by one window."""
+    from petastorm_tpu.service.cli import render_fleet_status
+
+    status = {"mode": "static", "fencing_epoch": 1, "recovery": {},
+              "workers": {"w0": {"alive": True}}, "clients": {}}
+    prev = {"t": 0.0, "status": status,
+            "workers": {"w0": {"error": "unreachable: boom"}}}
+    cur = {"t": 2.0, "status": status,
+           "workers": {"w0": {"metrics": {"rows_sent_total": 160_000,
+                                          "batches_sent_total": 300,
+                                          "credit_wait_seconds_total": 0.0,
+                                          "active_streams": 1}}}}
+    text = render_fleet_status(prev, cur)
+    row = next(line for line in text.splitlines() if line.startswith("w0"))
+    assert "--" in row and "160000" in row
+    assert "80000" not in text  # the lifetime-total-as-rate spike
+    fleet = next(line for line in text.splitlines()
+                 if line.startswith("fleet"))
+    assert "0.0" in fleet
+
+
+def test_scenario_exposes_metrics_and_trace(tmp_path):
+    """The loopback service scenario with --metrics-port/--trace-out: the
+    scrape carries ≥20 families, the trace is Perfetto-loadable, and the
+    result gains the telemetry block (registry snapshot + stage
+    quantiles)."""
+    pytest.importorskip("pyarrow")
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    trace_path = tmp_path / "scenario_trace.json"
+    result = service_loopback_scenario(
+        rows=2_000, workers=2, batch_size=256,
+        metrics_port=0, trace_out=str(trace_path))
+    assert result["rows"] == 2_000
+    telemetry = result["telemetry"]
+    assert "wait" in telemetry["stage_quantiles_s"]
+    registry_snapshot = telemetry["registry"]
+    assert len(registry_snapshot) >= 20
+    assert result["trace_out"] == str(trace_path)
+    assert result["metrics_address"][1] > 0  # a real bound port
+    with open(trace_path) as f:
+        doc = json.load(f)
+    bids = {(e.get("args") or {}).get("bid") for e in doc["traceEvents"]}
+    assert len(bids - {None}) >= 4
