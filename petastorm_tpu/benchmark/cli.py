@@ -114,6 +114,22 @@ def _scenario_main(argv):
                              "trace_event JSON of per-batch lifecycle "
                              "spans (worker decode → client queue → "
                              "device dispatch) to this path")
+    parser.add_argument("--epochs", type=int, default=None,
+                        help="service scenario: stream the dataset this "
+                             "many times (per-epoch rows/s + cache hit "
+                             "rate land in the result)")
+    parser.add_argument("--cache", default=None,
+                        choices=["off", "mem", "mem+disk"],
+                        help="service scenario: arm the workers' decoded-"
+                             "batch cache so warm epochs skip Parquet + "
+                             "decode (docs/guides/caching.md)")
+    parser.add_argument("--cache-mem-mb", type=float, default=None,
+                        dest="cache_mem_mb",
+                        help="per-worker memory-tier budget for --cache")
+    parser.add_argument("--cache-dir", default=None, dest="cache_dir",
+                        help="shared disk-tier directory for "
+                             "--cache mem+disk (default: a scenario-owned "
+                             "tempdir)")
     args = parser.parse_args(argv)
 
     scenario = SCENARIOS[args.name]
@@ -136,7 +152,11 @@ def _scenario_main(argv):
              args.chaos_max_events),
             ("journal_dir", "--journal-dir", args.journal_dir),
             ("metrics_port", "--metrics-port", args.metrics_port),
-            ("trace_out", "--trace-out", args.trace_out)):
+            ("trace_out", "--trace-out", args.trace_out),
+            ("epochs", "--epochs", args.epochs),
+            ("cache", "--cache", args.cache),
+            ("cache_mem_mb", "--cache-mem-mb", args.cache_mem_mb),
+            ("cache_dir", "--cache-dir", args.cache_dir)):
         if value is not None:
             if name not in accepted:
                 parser.error(f"{flag} is not a knob of "

@@ -536,7 +536,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               credits=8, json_out=None, chaos=None,
                               chaos_interval_s=1.5, chaos_max_events=4,
                               journal_dir=None, metrics_port=None,
-                              trace_out=None):
+                              trace_out=None, epochs=1, cache="off",
+                              cache_mem_mb=256.0, cache_dir=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -583,6 +584,17 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     ``trace_event`` JSON there: every batch id carries contiguous spans
     from worker decode through client queue to device dispatch
     (``docs/guides/diagnostics.md#metrics-and-tracing``).
+
+    ``epochs`` streams the dataset that many times through ONE loader
+    iteration (dispatcher-owned epoch tracking), and the result carries a
+    per-epoch breakdown (``epochs_detail``: rows, wall, rows/s, and the
+    fleet's cache hit rate within each epoch) — the cold-vs-warm epoch
+    trajectory. ``cache`` arms the workers' decoded-batch cache
+    (``off`` | ``mem`` | ``mem+disk``; ``docs/guides/caching.md``) with
+    ``cache_mem_mb`` of host RAM per worker; under ``mem+disk`` every
+    worker shares ``cache_dir`` (default: a scenario-owned tempdir), so a
+    takeover after ``--chaos worker-kill`` re-serves the victim's pieces
+    from the disk tier instead of re-decoding them.
     """
     from petastorm_tpu.jax_utils.batcher import batch_iterator
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
@@ -610,6 +622,27 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "own synthesized dataset (unique sample_index per row, known "
             "row count) — omit --dataset-url when --chaos is armed")
 
+    from petastorm_tpu.cache_impl import CacheConfig
+
+    if epochs < 1:
+        raise ValueError("epochs must be >= 1")
+    if epochs > 1 and mode != "static":
+        raise ValueError(
+            "--epochs > 1 requires static sharding: fcfs clients report no "
+            "per-client epoch boundaries, so the per-epoch breakdown would "
+            "silently lump every epoch into one row")
+    cache_tmp = None
+    if cache == "mem+disk" and cache_dir is None:
+        # One SHARED disk tier for the whole fleet (atomic-rename writes
+        # make that safe): a worker-kill takeover re-serves the victim's
+        # warm pieces from disk instead of re-decoding.
+        cache_tmp = tempfile.mkdtemp(prefix="petastorm_tpu_batchcache_")
+        cache_dir = cache_tmp
+    # Constructed with the FINAL directory so CacheConfig's own
+    # validation runs (e.g. --cache-dir without mem+disk is rejected).
+    cache_config = CacheConfig(mode=cache, mem_mb=cache_mem_mb,
+                               cache_dir=cache_dir)
+
     tmpdir = None
     if dataset_url is None:
         tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_service_")
@@ -627,8 +660,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     lease_timeout_s = 2.0 if chaos_kinds else 30.0
 
     def make_dispatcher(host="127.0.0.1", port=0):
-        return Dispatcher(host=host, port=port, mode=mode, num_epochs=1,
-                          journal_dir=journal_dir,
+        return Dispatcher(host=host, port=port, mode=mode,
+                          num_epochs=epochs, journal_dir=journal_dir,
                           lease_timeout_s=lease_timeout_s)
 
     # Telemetry arming and every node start happen INSIDE the try: a
@@ -663,6 +696,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 batch_delay_s=max(skew_ms / 1000.0 if i == 0 else 0.0,
                                   chaos_pace_s),
                 heartbeat_interval_s=0.5 if chaos_kinds else 5.0,
+                batch_cache=cache_config.build(),
                 reader_kwargs={"workers_count": 2}).start())
         source = ServiceBatchSource(
             dispatcher_holder[0].address, credits=credits,
@@ -685,6 +719,19 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                      interval_s=chaos_interval_s,
                                      max_events=(chaos_max_events
                                                  or None)).start()
+        def fleet_cache_totals():
+            """Summed (hits, misses) across the fleet's batch caches, or
+            ``None`` when caching is off."""
+            hits = misses = 0
+            armed = False
+            for worker in fleet:
+                stats = worker.cache_stats()
+                if stats is not None:
+                    armed = True
+                    hits += stats["hits"]
+                    misses += stats["misses"]
+            return (hits, misses) if armed else None
+
         served_rows = batches = 0
         got_ids = []
         arrivals = []  # (elapsed_s, cumulative rows) per batch
@@ -697,6 +744,18 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                     got_ids.extend(int(i) for i in batch["sample_index"])
                 arrivals.append((time.perf_counter() - t0, served_rows))
         service_wall = time.perf_counter() - t0
+        epoch_starts = [(int(count), int(epoch_num)) for count, epoch_num
+                        in source.diagnostics["epoch_starts"]]
+        # Exact per-epoch cache attribution: workers bucket every lookup
+        # by the requesting stream's epoch (the stream header carries it),
+        # so prefetch-ahead lookups never smear into the previous epoch.
+        cache_by_epoch = {}
+        for worker in fleet:
+            for worker_epoch, bucket in worker.cache_stats_by_epoch().items():
+                totals = cache_by_epoch.setdefault(worker_epoch,
+                                                   {"hits": 0, "misses": 0})
+                totals["hits"] += bucket["hits"]
+                totals["misses"] += bucket["misses"]
         if injector is not None:
             injector.stop()
         # Delivery timeline: when half the rows had reached the trainer.
@@ -708,6 +767,41 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                              if n >= served_rows / 2), service_wall)
         stall_pct = loader.diagnostics["input_stall_pct"]
         source_diag = source.diagnostics
+
+        # Per-epoch breakdown: the client's epoch_starts give exact batch
+        # boundaries in production order (= consumption order, FIFO), so
+        # each epoch's rows and wall fall straight out of the arrivals
+        # timeline — cold-vs-warm throughput becomes visible in BENCH
+        # trajectories instead of being averaged away.
+        epochs_detail = []
+        # Keep the client-reported epoch NUMBER with each boundary (a
+        # resumed client starts past 0; an empty epoch shares its start
+        # count with the next) — the worker cache buckets are keyed by
+        # that same number via the stream header, so the join is exact.
+        for index, (first, epoch_num) in enumerate(epoch_starts):
+            last = (epoch_starts[index + 1][0]
+                    if index + 1 < len(epoch_starts) else len(arrivals))
+            if first >= last:
+                continue
+            prev_t, prev_rows = ((0.0, 0) if first == 0
+                                 else arrivals[first - 1])
+            end_t, end_rows = arrivals[last - 1]
+            epoch_wall = max(1e-9, end_t - prev_t)
+            epoch_rows = end_rows - prev_rows
+            detail = {
+                "epoch": epoch_num,
+                "rows": epoch_rows,
+                "wall_s": round(epoch_wall, 3),
+                "rows_per_s": round(epoch_rows / epoch_wall, 1),
+            }
+            bucket = cache_by_epoch.get(epoch_num)
+            if bucket is not None:
+                lookups = bucket["hits"] + bucket["misses"]
+                detail["cache_hits"] = bucket["hits"]
+                detail["cache_misses"] = bucket["misses"]
+                detail["cache_hit_rate"] = round(
+                    bucket["hits"] / lookups, 4) if lookups else None
+            epochs_detail.append(detail)
 
         # Local baseline: the same dataset through the same collation,
         # no network tier.
@@ -732,6 +826,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "workers": workers,
             "skew_ms": skew_ms,
             "credits": credits,
+            "epochs": epochs,
+            "epochs_detail": epochs_detail,
             "rows": served_rows,
             "batches": batches,
             "service_rows_per_sec": service_rps,
@@ -748,6 +844,23 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 wid: counters["stall_s"]
                 for wid, counters in source_diag["per_worker"].items()},
         }
+        if cache != "off":
+            totals = fleet_cache_totals() or (0, 0)
+            per_worker_stats = [w.cache_stats() for w in fleet]
+            result["cache"] = {
+                "mode": cache,
+                "mem_mb": cache_mem_mb,
+                "dir": cache_dir,
+                "hits": totals[0],
+                "misses": totals[1],
+                "hit_rate": round(totals[0] / max(1, sum(totals)), 4),
+                "bytes_mem": sum(s["bytes_mem"]
+                                 for s in per_worker_stats if s),
+                "evictions_mem": sum(s["evictions_mem"]
+                                     for s in per_worker_stats if s),
+                "evictions_disk": sum(s["evictions_disk"]
+                                      for s in per_worker_stats if s),
+            }
         # Final registry snapshot + per-stage latency quantiles: BENCH
         # artifacts capture distributions (p50/p99), not just means.
         from petastorm_tpu.telemetry import REGISTRY as _registry
@@ -766,8 +879,11 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             # (at-least-once — duplicates are the contract, loss never is).
             allow_duplicates = any(k != "dispatcher-restart"
                                    for k in chaos_kinds)
-            invariants = delivery_invariants(range(rows), got_ids,
-                                             allow_duplicates)
+            # Every epoch delivers the full id set once: the expected
+            # multiset scales with the epoch count (zero-dup under
+            # control-plane-only faults still holds per epoch).
+            invariants = delivery_invariants(
+                list(range(rows)) * epochs, got_ids, allow_duplicates)
             status = source.dispatcher_status()
             recovery = status.get("recovery", {})
             result.update({
@@ -817,6 +933,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             shutil.rmtree(tmpdir, ignore_errors=True)
         if journal_tmp:
             shutil.rmtree(journal_tmp, ignore_errors=True)
+        if cache_tmp:
+            shutil.rmtree(cache_tmp, ignore_errors=True)
 
 
 SCENARIOS = {

@@ -1,0 +1,62 @@
+"""Epoch-aware decoded-batch caching.
+
+The decode-bypass tier of the input pipeline (ISSUE 5 / PAPERS: tf.data
+service and cedar both name materialized-output caching as the highest-
+leverage optimization once the pipeline is disaggregated): collated numpy
+batches — the exact payloads the service workers stream and the JAX
+loader's producer collates — are cached under a content fingerprint, in a
+memory-budgeted LRU tier with an optional disk tier, so epoch ≥ 2 of a
+multi-epoch training run skips Parquet read + decode + collate entirely.
+
+- :mod:`~petastorm_tpu.cache_impl.fingerprint` — content keys: dataset url
+  + piece identity + fields/schema + batch/transform config.
+- :mod:`~petastorm_tpu.cache_impl.batch_cache` — :class:`BatchCache`, the
+  tiered store. Entries hold each batch as serializer frames packed into
+  one contiguous buffer, so the service worker's hit path scatter-gathers
+  frames straight out of cache memory (``framed_socket.send_framed_frames``)
+  with zero re-serialization.
+- :mod:`~petastorm_tpu.cache_impl.eviction` — the shared size-budget LRU
+  eviction policy for on-disk caches (also behind the seed-parity
+  ``LocalDiskCache``).
+
+Cache-directory tracking: every directory a cache *creates* is registered
+here and deregistered by its ``cleanup()``; the test suite's leak guard
+fails any test that orphans one (the worker-restart leak class).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from petastorm_tpu.cache_impl.batch_cache import BatchCache, CacheConfig
+from petastorm_tpu.cache_impl.fingerprint import batch_fingerprint
+
+__all__ = [
+    "BatchCache",
+    "CacheConfig",
+    "batch_fingerprint",
+    "register_cache_dir",
+    "deregister_cache_dir",
+    "live_cache_dirs",
+]
+
+_DIRS_LOCK = threading.Lock()
+_LIVE_CACHE_DIRS = set()
+
+
+def register_cache_dir(path):
+    """Record that a cache created ``path`` and has not cleaned it up yet.
+    The tier-1 leak guard snapshots this set around every test."""
+    with _DIRS_LOCK:
+        _LIVE_CACHE_DIRS.add(str(path))
+
+
+def deregister_cache_dir(path):
+    with _DIRS_LOCK:
+        _LIVE_CACHE_DIRS.discard(str(path))
+
+
+def live_cache_dirs():
+    """Snapshot of cache-created directories not yet cleaned up."""
+    with _DIRS_LOCK:
+        return set(_LIVE_CACHE_DIRS)

@@ -1,0 +1,500 @@
+"""Memory-budgeted, disk-spilling cache of collated batches.
+
+The tentpole of the decode-bypass tier (``docs/guides/caching.md``): an
+entry is one *batch sequence* — every collated batch decoded for one cache
+key (a service worker keys per row-group piece; the JAX loader keys per
+reader plan) — stored not as live numpy dicts but as the **serializer
+frames** the framed-socket transport already speaks, packed back-to-back
+into one contiguous buffer per entry:
+
+- the service worker's hit path hands ``memoryview`` slices of that buffer
+  straight to ``framed_socket.send_framed_frames`` — one ``sendmsg``
+  scatter-gather per batch with **zero re-serialization** (the decode AND
+  the pickle are both skipped on a warm epoch);
+- the JAX loader's hit path rebuilds numpy dicts from the same frames via
+  the serializer's zero-copy out-of-band reconstruction;
+- the disk tier writes/reads the entry as one meta header plus that same
+  contiguous payload, so spilled entries round-trip without re-framing and
+  **survive worker restarts** (composing with the control plane's
+  re-registration: a restarted worker re-serves warm pieces from disk).
+
+Tiers: a memory LRU under ``mem_budget_bytes`` (evictions drop the entry,
+or merely drop the *memory copy* when the disk tier holds it — entries are
+written through to disk at fill time, so an abrupt worker death never loses
+the disk tier's warmth), and an optional disk tier under
+``disk_budget_bytes`` enforced by the shared LRU policy
+(:mod:`~petastorm_tpu.cache_impl.eviction`).
+
+Thread-safe: concurrent streams look up, fill, and evict under one lock
+with file I/O outside it; duplicate fills of one key are benign (last
+commit wins, byte-identical by construction). Multi-process safe on a
+shared directory: entry files are temp-written and atomically renamed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+
+from petastorm_tpu.telemetry.metrics import (
+    CACHE_BYTES,
+    CACHE_ENTRIES,
+    CACHE_EVICTIONS,
+    CACHE_FILL_SECONDS,
+    CACHE_HITS,
+    CACHE_MISSES,
+    CACHE_SERVE_SECONDS,
+)
+
+_MAGIC = b"PTBCACHE1\n"
+_LEN = struct.Struct("!Q")
+
+#: Disk-tier entry suffix (the shared eviction policy scopes to it).
+ENTRY_SUFFIX = ".ptbc"
+
+CACHE_MODES = ("off", "mem", "mem+disk")
+
+
+class CacheConfig:
+    """The three CLI knobs (``--cache``, ``--cache-mem-mb``,
+    ``--cache-dir``) as a value object; :meth:`build` turns it into a
+    :class:`BatchCache` (or ``None`` for ``off``)."""
+
+    def __init__(self, mode="off", mem_mb=256, cache_dir=None, disk_mb=None):
+        if mode not in CACHE_MODES:
+            raise ValueError(
+                f"cache mode must be one of {CACHE_MODES}, got {mode!r}")
+        if mode != "mem+disk" and (cache_dir is not None
+                                   or disk_mb is not None):
+            # Silently dropping these would run an operator who asked for
+            # restart persistence with a cold, memory-only cache.
+            raise ValueError(
+                f"cache_dir/disk_mb only apply to mode='mem+disk' "
+                f"(got mode={mode!r} with cache_dir={cache_dir!r}, "
+                f"disk_mb={disk_mb!r})")
+        self.mode = mode
+        self.mem_mb = mem_mb
+        self.cache_dir = cache_dir
+        self.disk_mb = disk_mb
+
+    def build(self):
+        if self.mode == "off":
+            return None
+        return BatchCache(
+            mem_budget_bytes=int(self.mem_mb * (1 << 20)),
+            cache_dir=self.cache_dir if self.mode == "mem+disk" else None,
+            spill_to_disk=self.mode == "mem+disk",
+            disk_budget_bytes=(int(self.disk_mb * (1 << 20))
+                               if self.disk_mb else None))
+
+
+class CachedBatch:
+    """One batch of an entry: its row count and the serializer frames as
+    zero-copy views into the entry's contiguous buffer."""
+
+    __slots__ = ("rows", "fmt", "frames")
+
+    def __init__(self, rows, fmt, frames):
+        self.rows = rows
+        self.fmt = fmt
+        self.frames = frames
+
+    def to_dict(self):
+        """Rebuild the ``{field: ndarray}`` batch (the loader's hit path).
+        Out-of-band frames are copied out of the shared entry buffer first:
+        protocol-5 reconstruction aliases frame memory into the rebuilt
+        arrays, and a cached entry's buffer must never be writable through
+        a served batch (nor pinned by one after eviction)."""
+        from petastorm_tpu.reader_impl.framed_socket import decode_payload
+
+        frames = [self.frames[0]] + [bytearray(f) for f in self.frames[1:]]
+        return decode_payload(self.fmt, frames)
+
+
+class CachedEntry:
+    """One key's batch sequence: per-batch meta + one contiguous buffer."""
+
+    __slots__ = ("meta", "buf", "nbytes")
+
+    def __init__(self, meta, buf):
+        self.meta = meta          # [(rows, fmt, [frame_len, ...]), ...]
+        self.buf = buf            # bytes: every batch's frames back to back
+        self.nbytes = len(buf)
+
+    @property
+    def rows(self):
+        return sum(rows for rows, _, _ in self.meta)
+
+    def batches(self):
+        view = memoryview(self.buf)
+        offset = 0
+        for rows, fmt, frame_lens in self.meta:
+            frames = []
+            for length in frame_lens:
+                frames.append(view[offset:offset + length])
+                offset += length
+            yield CachedBatch(rows, fmt, frames)
+
+    def to_dicts(self):
+        return [batch.to_dict() for batch in self.batches()]
+
+
+class EntryBuilder:
+    """Accumulates one entry's batches during a fill (a cache miss being
+    decoded). ``commit()`` publishes atomically — an abandoned builder
+    (stream aborted mid-decode) publishes nothing, so a partial epoch can
+    never be served as a complete one."""
+
+    def __init__(self, cache, key):
+        self._cache = cache
+        self._key = key
+        self._meta = []
+        self._chunks = []
+        self._spent_s = 0.0
+        self._committed = False
+
+    def add_batch(self, batch, rows=None):
+        """Serialize ``batch`` and append it; returns ``(rows, fmt,
+        frames)`` — the freshly-encoded frames, so a worker sends the very
+        frames it just cached (one serialize per batch, not two)."""
+        from petastorm_tpu.reader_impl.framed_socket import encode_payload
+
+        t0 = time.perf_counter()
+        fmt, frames = encode_payload(batch)
+        if rows is None:
+            rows = batch_rows(batch)
+        self._append(rows, fmt, frames)
+        self._spent_s += time.perf_counter() - t0
+        return rows, fmt, frames
+
+    def add_frames(self, rows, fmt, frames):
+        """Append an already-encoded batch (caller did the serialization)."""
+        t0 = time.perf_counter()
+        self._append(rows, fmt, frames)
+        self._spent_s += time.perf_counter() - t0
+
+    def _append(self, rows, fmt, frames):
+        views = [memoryview(f) for f in frames]
+        self._meta.append((int(rows), int(fmt),
+                           [v.nbytes for v in views]))
+        # Copy NOW: out-of-band frames alias the decoded arrays' memory,
+        # which the producer reuses/free's after the batch is sent.
+        self._chunks.extend(bytes(v) for v in views)
+
+    def commit(self):
+        """Freeze into a :class:`CachedEntry` and publish it to the tiers.
+        Returns the entry (callers may serve from it immediately)."""
+        if self._committed:
+            raise RuntimeError("EntryBuilder.commit() called twice")
+        self._committed = True
+        t0 = time.perf_counter()
+        entry = CachedEntry(self._meta, b"".join(self._chunks))
+        self._chunks = None
+        self._cache._publish(self._key, entry)
+        CACHE_FILL_SECONDS.observe(self._spent_s
+                                   + (time.perf_counter() - t0))
+        return entry
+
+
+def batch_rows(batch):
+    """Row count of a collated ``{field: array}`` batch (every column has
+    equal length; an empty dict is zero rows). Shared by the cache's
+    builders and the service worker's send accounting — one definition,
+    so stored and streamed row counts can never diverge."""
+    for value in batch.values():
+        return int(len(value))
+    return 0
+
+
+class BatchCache:
+    """See the module docstring. ``cache_dir=None`` with
+    ``spill_to_disk=True`` creates a private temp directory that
+    ``cleanup()`` removes; a caller-provided directory persists (the
+    restart-warmth contract) and ``cleanup()`` only releases tracking."""
+
+    def __init__(self, mem_budget_bytes=256 << 20, cache_dir=None,
+                 spill_to_disk=False, disk_budget_bytes=None):
+        if mem_budget_bytes <= 0:
+            raise ValueError("mem_budget_bytes must be positive")
+        self._mem_budget = int(mem_budget_bytes)
+        self._disk_budget = disk_budget_bytes
+        self._disk = bool(spill_to_disk)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()   # key -> CachedEntry (LRU order)
+        self._mem_bytes = 0
+        self._owns_dir = False
+        self._dir = None
+        if self._disk:
+            from petastorm_tpu import cache_impl as tracking
+
+            if cache_dir is None:
+                self._dir = tempfile.mkdtemp(prefix="petastorm_batch_cache_")
+                self._owns_dir = True
+                tracking.register_cache_dir(self._dir)
+            else:
+                self._dir = str(cache_dir)
+                if not os.path.isdir(self._dir):
+                    os.makedirs(self._dir, exist_ok=True)
+                    tracking.register_cache_dir(self._dir)
+        # Instance counters (the registry families aggregate across every
+        # cache in the process; a worker's diagnostics report its own).
+        self.hits_mem = 0
+        self.hits_disk = 0
+        self.misses = 0
+        self.evictions_mem = 0
+        self.evictions_disk = 0
+        self._m_hits_mem = CACHE_HITS.labels("mem")
+        self._m_hits_disk = CACHE_HITS.labels("disk")
+        self._m_bytes_mem = CACHE_BYTES.labels("mem")
+        self._m_entries_mem = CACHE_ENTRIES.labels("mem")
+        self._m_bytes_disk = CACHE_BYTES.labels("disk")
+        self._m_entries_disk = CACHE_ENTRIES.labels("disk")
+        self._m_evict_mem = CACHE_EVICTIONS.labels("mem")
+        self._m_evict_disk = CACHE_EVICTIONS.labels("disk")
+        # This instance's contribution to the disk-tier gauges (what
+        # cleanup() retracts): per-instance write/evict deltas — on a
+        # directory shared across processes each process reports its own
+        # writes, matching the gauges' "summed over cache instances in
+        # the process" contract.
+        self._disk_bytes_acct = 0
+        self._disk_entries_acct = 0
+
+    @property
+    def cache_dir(self):
+        return self._dir
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, key):
+        """The :class:`CachedEntry` for ``key`` or ``None`` (a miss).
+        Checks memory, then disk; a disk hit is promoted into the memory
+        tier (it is about to be hot)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits_mem += 1
+        if entry is not None:
+            self._m_hits_mem.inc()
+            CACHE_SERVE_SECONDS.observe(time.perf_counter() - t0)
+            return entry
+        if self._disk:
+            entry = self._load_disk(key)
+            if entry is not None:
+                with self._lock:
+                    self.hits_disk += 1
+                    self._insert_locked(key, entry)
+                self._m_hits_disk.inc()
+                CACHE_SERVE_SECONDS.observe(time.perf_counter() - t0)
+                return entry
+        with self._lock:
+            self.misses += 1
+        CACHE_MISSES.inc()
+        return None
+
+    def get_batches(self, key):
+        """The decoded ``[{field: ndarray}, ...]`` sequence, or ``None``."""
+        entry = self.get(key)
+        return None if entry is None else entry.to_dicts()
+
+    def contains(self, key):
+        with self._lock:
+            if key in self._entries:
+                return True
+        return self._disk and os.path.exists(self._entry_path(key))
+
+    #: ``contains`` without counter side effects — fillers use it to check
+    #: whether a just-committed entry was actually retained by any tier
+    #: (an entry larger than every budget is committed but kept nowhere).
+    retained = contains
+
+    # -- fill --------------------------------------------------------------
+
+    def begin_fill(self, key):
+        return EntryBuilder(self, key)
+
+    def put_batches(self, key, batches):
+        """Convenience: cache a complete batch sequence in one call."""
+        builder = self.begin_fill(key)
+        for batch in batches:
+            builder.add_batch(batch)
+        return builder.commit()
+
+    def _publish(self, key, entry):
+        if self._disk:
+            self._store_disk(key, entry)
+        with self._lock:
+            self._insert_locked(key, entry)
+
+    def _insert_locked(self, key, entry):
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._account_mem_locked(-old.nbytes, -1)
+        if entry.nbytes <= self._mem_budget:
+            self._entries[key] = entry
+            self._account_mem_locked(entry.nbytes, 1)
+        # else: a single entry larger than the whole budget lives on disk
+        # only (or, memory-only mode, is simply not retained).
+        while self._mem_bytes > self._mem_budget and self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self._account_mem_locked(-evicted.nbytes, -1)
+            self.evictions_mem += 1
+            self._m_evict_mem.inc()
+            # Disk tier already holds it (write-through at fill): dropping
+            # the memory copy loses nothing but the memcpy saved.
+
+    def _account_mem_locked(self, bytes_delta, entries_delta):
+        self._mem_bytes += bytes_delta
+        self._m_bytes_mem.inc(bytes_delta)
+        self._m_entries_mem.inc(entries_delta)
+
+    # -- disk tier ---------------------------------------------------------
+
+    def _entry_path(self, key):
+        digest = hashlib.sha256(str(key).encode("utf-8")).hexdigest()
+        return os.path.join(self._dir, digest + ENTRY_SUFFIX)
+
+    def _store_disk(self, key, entry):
+        import json
+
+        meta = json.dumps([{"rows": rows, "fmt": fmt, "frame_lens": lens}
+                           for rows, fmt, lens in entry.meta]).encode("utf-8")
+        path = self._entry_path(key)
+        tmp_path = None
+        try:
+            old_size = os.path.getsize(path)
+        except OSError:
+            old_size = None
+        try:
+            # mkstemp INSIDE the guard: a vanished/unwritable cache dir is
+            # a degraded cache, not a stream error — the tier is
+            # best-effort end to end.
+            fd, tmp_path = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(_MAGIC)
+                f.write(_LEN.pack(len(meta)))
+                f.write(meta)
+                f.write(entry.buf)
+            os.replace(tmp_path, path)
+        except OSError:  # disk full, dir removed, fd exhaustion — skip
+            if tmp_path is not None:
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+            return
+        new_size = len(_MAGIC) + _LEN.size + len(meta) + entry.nbytes
+        self._account_disk(new_size - (old_size or 0),
+                           0 if old_size is not None else 1)
+        if self._disk_budget is not None:
+            from petastorm_tpu.cache_impl.eviction import evict_dir_to_limit
+
+            deleted, freed = evict_dir_to_limit(self._dir, self._disk_budget,
+                                                ENTRY_SUFFIX)
+            if deleted:
+                with self._lock:
+                    self.evictions_disk += deleted
+                self._m_evict_disk.inc(deleted)
+                self._account_disk(-freed, -deleted)
+
+    def _account_disk(self, bytes_delta, entries_delta):
+        """Track this instance's disk-tier residency contribution (clamped
+        at zero: an eviction may free files another instance wrote)."""
+        with self._lock:
+            bytes_delta = max(bytes_delta, -self._disk_bytes_acct)
+            entries_delta = max(entries_delta, -self._disk_entries_acct)
+            self._disk_bytes_acct += bytes_delta
+            self._disk_entries_acct += entries_delta
+        self._m_bytes_disk.inc(bytes_delta)
+        self._m_entries_disk.inc(entries_delta)
+
+    def _load_disk(self, key):
+        import json
+
+        path = self._entry_path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        try:
+            if not blob.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            meta_off = len(_MAGIC)
+            meta_len = _LEN.unpack_from(blob, meta_off)[0]
+            payload_off = meta_off + _LEN.size + meta_len
+            meta = json.loads(blob[meta_off + _LEN.size:payload_off]
+                              .decode("utf-8"))
+            entry = CachedEntry(
+                [(m["rows"], m["fmt"], list(m["frame_lens"])) for m in meta],
+                blob[payload_off:])
+            expected = sum(length for _, _, lens in entry.meta
+                           for length in lens)
+            if expected != entry.nbytes:
+                raise ValueError("truncated payload")
+        except (ValueError, KeyError, TypeError):
+            # Corrupt/torn/old-format entry: a miss, and remove the file so
+            # it cannot keep failing every epoch.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        try:
+            os.utime(path)  # LRU touch for the shared eviction policy
+        except OSError:
+            pass
+        return entry
+
+    # -- observability / lifecycle -----------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {
+                "mode": "mem+disk" if self._disk else "mem",
+                "hits": self.hits_mem + self.hits_disk,
+                "hits_mem": self.hits_mem,
+                "hits_disk": self.hits_disk,
+                "misses": self.misses,
+                "hit_rate": round(
+                    (self.hits_mem + self.hits_disk)
+                    / max(1, self.hits_mem + self.hits_disk + self.misses),
+                    4),
+                "entries_mem": len(self._entries),
+                "bytes_mem": self._mem_bytes,
+                "entries_disk": self._disk_entries_acct,
+                "bytes_disk": self._disk_bytes_acct,
+                "mem_budget_bytes": self._mem_budget,
+                "evictions_mem": self.evictions_mem,
+                "evictions_disk": self.evictions_disk,
+                "cache_dir": self._dir,
+            }
+
+    def cleanup(self):
+        """Release everything this cache owns: the memory tier always; the
+        disk directory only when this instance created it as a private
+        tempdir (a caller-provided directory is the persistence contract —
+        its files outlive the process so a restarted worker re-serves warm
+        pieces). Always deregisters from the leak-tracking registry."""
+        with self._lock:
+            while self._entries:
+                _, entry = self._entries.popitem(last=False)
+                self._account_mem_locked(-entry.nbytes, -1)
+        # Retract this instance's disk-tier gauge contribution: gauges
+        # track LIVE cache instances (shared-directory files may persist,
+        # but nobody in this process owns them anymore).
+        self._account_disk(-self._disk_bytes_acct, -self._disk_entries_acct)
+        if self._dir is not None:
+            from petastorm_tpu import cache_impl as tracking
+
+            if self._owns_dir:
+                import shutil
+
+                shutil.rmtree(self._dir, ignore_errors=True)
+            tracking.deregister_cache_dir(self._dir)

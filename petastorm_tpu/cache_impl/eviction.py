@@ -1,0 +1,81 @@
+"""Shared size-budget LRU eviction for on-disk cache directories.
+
+One policy, two users: the :class:`~petastorm_tpu.cache_impl.batch_cache.
+BatchCache` disk tier and the seed-parity row-group caches
+(``local_disk_cache.LocalDiskCache`` / ``LocalDiskArrowTableCache``) —
+before this module each grew its own ad-hoc scan. Eviction is measured
+(actual ``stat`` sizes, never estimates) and LRU by access time with an
+mtime fallback (``relatime``/``noatime`` mounts may not advance atime; the
+caches ``utime`` on every hit so both clocks move).
+
+Concurrent-safe by construction: entries are one file per key written via
+temp-file + atomic rename, so a concurrently-deleted file during the scan
+is skipped, and two processes evicting the same directory converge on the
+same budget.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def dir_size(path, suffix):
+    """Total bytes of ``suffix``-named entries under ``path``."""
+    total = 0
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(suffix):
+            continue
+        try:
+            total += os.stat(os.path.join(path, name)).st_size
+        except OSError:
+            continue
+    return total
+
+
+def evict_dir_to_limit(path, size_limit, suffix):
+    """Delete least-recently-used ``suffix`` entries under ``path`` until
+    the directory fits ``size_limit`` bytes. Returns ``(files_deleted,
+    bytes_deleted)`` — callers feed these into their eviction counters.
+
+    ``size_limit=None`` disables the budget (nothing is deleted).
+    """
+    if size_limit is None:
+        return 0, 0
+    entries = []
+    total = 0
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0, 0
+    for name in names:
+        if not name.endswith(suffix):
+            continue
+        full = os.path.join(path, name)
+        try:
+            stat = os.stat(full)
+        except OSError:
+            continue
+        # atime when the mount maintains it, else mtime: the caches utime()
+        # entries on every hit, so either clock orders by recency.
+        recency = max(stat.st_atime, stat.st_mtime)
+        entries.append((recency, stat.st_size, full))
+        total += stat.st_size
+    deleted = freed = 0
+    if total <= size_limit:
+        return deleted, freed
+    entries.sort()  # least recently used first
+    for _, size, full in entries:
+        if total <= size_limit:
+            break
+        try:
+            os.unlink(full)
+        except OSError:
+            continue
+        total -= size
+        deleted += 1
+        freed += size
+    return deleted, freed

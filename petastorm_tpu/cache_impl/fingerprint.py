@@ -1,0 +1,70 @@
+"""Content fingerprints for decoded-batch cache keys.
+
+A cached batch sequence is only reusable when *everything that shaped it*
+matches: the dataset, the row-group pieces read, the selected fields /
+schema view, the batch size and last-batch policy, and any transform. The
+fingerprint canonicalizes all of that into one hex digest; changing any
+ingredient changes the key, so a stale entry is simply never found (miss →
+re-decode → refill) rather than ever being served wrong.
+
+Two keying granularities share this function:
+
+- the service worker keys **per piece** (``pieces=[piece_index]``), so an
+  epoch's stream is a sequence of per-piece lookups and a re-partitioned
+  plan (worker takeover) still hits on the pieces both plans share;
+- the JAX loader keys **per reader plan** (``pieces=[(path, row_group),
+  ...]``), one entry for the whole epoch's batch sequence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+#: Bump when the on-wire/cached entry layout changes: old entries must
+#: become misses, not deserialization errors.
+FINGERPRINT_VERSION = 1
+
+
+def _canonical(value):
+    """JSON-stable canonical form; non-JSON leaves fall back to ``repr``
+    (transform specs, predicates, NGram objects — their repr is what the
+    seed-parity row-group caches already key on)."""
+    if isinstance(value, dict):
+        return {str(k): _canonical(v) for k, v in sorted(value.items())}
+    if isinstance(value, (list, tuple)):
+        return [_canonical(v) for v in value]
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return repr(value)
+
+
+def batch_fingerprint(dataset_url, pieces, batch_size, fields=None,
+                      transform=None, factory=None, extra=None):
+    """Hex digest keying a cached batch sequence.
+
+    :param dataset_url: the dataset the batches were decoded from.
+    :param pieces: piece identity — indices into the canonical row-group
+        enumeration (service worker) or ``(path, row_group)`` pairs (local
+        reader plan).
+    :param batch_size: rows per collated batch.
+    :param fields: the selected fields / schema view (names, regexes, or an
+        NGram — anything with a stable repr).
+    :param transform: transform config (a TransformSpec or its repr).
+    :param factory: which reader family decoded the batches (``"row"`` /
+        ``"batch"`` / ``"columnar"`` or a callable's qualname) — the three
+        families emit different collation layouts for codec columns.
+    :param extra: any further invalidation inputs (filters, predicate,
+        last-batch policy, ...).
+    """
+    payload = json.dumps({
+        "v": FINGERPRINT_VERSION,
+        "url": str(dataset_url),
+        "pieces": _canonical(list(pieces)),
+        "batch_size": int(batch_size),
+        "fields": _canonical(fields),
+        "transform": _canonical(transform),
+        "factory": _canonical(getattr(factory, "__qualname__", factory)),
+        "extra": _canonical(extra),
+    }, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()

@@ -100,7 +100,8 @@ def make_jax_dataloader(reader, batch_size,
                         shuffle_buffer_size=0,
                         shuffle_seed=None,
                         stage_in_producer=False,
-                        trace_path=None):
+                        trace_path=None,
+                        batch_cache=None):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -145,6 +146,16 @@ def make_jax_dataloader(reader, batch_size,
         (arms the process trace collector; see
         ``docs/guides/diagnostics.md#metrics-and-tracing``). ``None`` (the
         default) records nothing.
+    :param batch_cache: a :class:`~petastorm_tpu.cache_impl.BatchCache`
+        (or ``None``). The producer consults it before pulling the reader:
+        on a hit the whole epoch's collated batch sequence is served from
+        cache (the reader — and the Parquet read + decode behind it — is
+        not touched, so iterating the loader again replays the epoch even
+        though the underlying ``num_epochs=1`` reader is exhausted); on a
+        miss the decoded sequence is written through as it streams.
+        Requires deterministic order: ``shuffle_buffer_size=0`` and a
+        reader constructed with ``shuffle_row_groups=False``
+        (``docs/guides/caching.md``).
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -155,7 +166,8 @@ def make_jax_dataloader(reader, batch_size,
                          shuffle_buffer_size=shuffle_buffer_size,
                          shuffle_seed=shuffle_seed,
                          stage_in_producer=stage_in_producer,
-                         trace_path=trace_path)
+                         trace_path=trace_path,
+                         batch_cache=batch_cache)
 
 
 class JaxDataLoader:
@@ -166,7 +178,7 @@ class JaxDataLoader:
                  device_prefetch=2, non_tensor_policy="host",
                  stage_to_device=True, shuffle_buffer_size=0,
                  shuffle_seed=None, stage_in_producer=False,
-                 batch_source=None, trace_path=None):
+                 batch_source=None, trace_path=None, batch_cache=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
         if stage_in_producer and sharding is not None:
@@ -194,6 +206,20 @@ class JaxDataLoader:
                     "dependent per host, so without an agreed step count "
                     "pjit deadlocks the pod (agree via "
                     "jax_utils.sharding.agree_max_batches)")
+        if batch_cache is not None:
+            if batch_source is not None:
+                raise ValueError(
+                    "batch_cache is the local-reader decode bypass; the "
+                    "data service's workers own caching on the remote path "
+                    "(BatchWorker(batch_cache=...)) — arming both here "
+                    "would cache an opaque stream under a key that cannot "
+                    "see the remote plan")
+            if shuffle_buffer_size:
+                raise ValueError(
+                    "batch_cache requires a deterministic batch sequence; "
+                    "a shuffle buffer reorders rows per epoch, so a cached "
+                    "replay would silently freeze epoch 1's order — "
+                    "shuffle at materialization time or disable caching")
         self.reader = reader
         self._batch_size = batch_size
         self._last_batch = last_batch
@@ -213,6 +239,16 @@ class JaxDataLoader:
         # row-batching knobs (batch_size/last_batch/shuffle buffer) are the
         # source's concern, not this class's.
         self._batch_source = batch_source
+        self._batch_cache = batch_cache
+        # A cache fill is valid ONLY from the reader's start position —
+        # i.e. the first pass this loader ever pulls from it. Set when
+        # that pass begins and never cleared: any later cache miss
+        # (abandoned fill, evicted entry, an entry that never fit the
+        # memory budget) finds the reader mid-stream or exhausted, and
+        # filling from there would commit a truncated/shifted/empty
+        # sequence under the full-epoch key. Once set, misses stream
+        # uncached (correct, just not accelerated).
+        self._cache_fill_attempted = False
         if sharding is not None and max_batches is None \
                 and batch_source is None:
             # (With a custom batch_source the reader-metadata derivation
@@ -355,12 +391,7 @@ class JaxDataLoader:
 
                     batches = itertools.islice(batches, self._max_batches)
             else:
-                batches = iter(batch_iterator(
-                    self.reader, self._batch_size,
-                    last_batch=self._last_batch,
-                    max_batches=self._max_batches,
-                    shuffle_buffer_size=self._shuffle_buffer_size,
-                    shuffle_seed=self._shuffle_seed))
+                batches = iter(self._reader_batches())
             # With producer-side staging, decode feeds a separate staging
             # thread (see _stage_loop) so decode and H2D dispatch OVERLAP —
             # both release the GIL (pyarrow/cv2; transport writes), so even
@@ -394,6 +425,112 @@ class JaxDataLoader:
             target = (self._host_queue if self._stage_in_producer
                       else self._queue)
             self._put_sentinel(target)
+
+    def _reader_batches(self):
+        """The producer's batch stream off the local reader, with the
+        decoded-batch cache in front when one is armed: a hit serves the
+        whole epoch's collated sequence out of the cache (the reader is
+        never pulled — re-iterating the loader replays the epoch even
+        though the exhausted ``num_epochs=1`` reader would yield nothing);
+        a miss streams batches through while writing them into an entry
+        that is published only on clean exhaustion (an abandoned iteration
+        can never be served as a complete epoch)."""
+        if self._batch_cache is None:
+            yield from batch_iterator(
+                self.reader, self._batch_size,
+                last_batch=self._last_batch,
+                max_batches=self._max_batches,
+                shuffle_buffer_size=self._shuffle_buffer_size,
+                shuffle_seed=self._shuffle_seed)
+            return
+        key = self._reader_cache_key()
+        entry = self._batch_cache.get(key)
+        if entry is not None:
+            for cached in entry.batches():
+                yield cached.to_dict()
+            return
+        if self._cache_fill_attempted:
+            # The reader's start position was already consumed (by a
+            # complete OR abandoned earlier pass): what it yields now is a
+            # tail of the stream, not an epoch — serve it uncached and
+            # never commit it under the epoch key.
+            produced = 0
+            for batch in batch_iterator(self.reader, self._batch_size,
+                                        last_batch=self._last_batch,
+                                        max_batches=self._max_batches):
+                produced += 1
+                yield batch
+            if produced == 0:
+                # Miss over an exhausted reader: the epoch WAS cached once
+                # (this loader filled it) but no tier holds it now — e.g.
+                # a sibling loader's fill LRU-evicted it. The "replay"
+                # is an empty epoch; say so instead of letting a
+                # range(N)-epoch training loop end early in silence.
+                import warnings
+
+                warnings.warn(
+                    "batch_cache miss over an exhausted reader: the "
+                    "previously cached epoch entry is no longer retained "
+                    "(evicted by other fills?), so this iteration yields "
+                    "no batches — raise the cache budgets or enable the "
+                    "disk tier", RuntimeWarning, stacklevel=2)
+            return
+        self._cache_fill_attempted = True
+        builder = self._batch_cache.begin_fill(key)
+        for batch in batch_iterator(self.reader, self._batch_size,
+                                    last_batch=self._last_batch,
+                                    max_batches=self._max_batches):
+            builder.add_batch(batch)
+            yield batch
+        builder.commit()
+        if not self._batch_cache.retained(key):
+            # Committed but kept by no tier (the epoch outgrew every
+            # budget): the replay contract cannot be honored — the next
+            # iteration finds a miss over an exhausted reader and yields
+            # an EMPTY epoch. Say so now, while the user can still raise
+            # the budget, instead of ending training N-1 epochs early in
+            # silence.
+            import warnings
+
+            warnings.warn(
+                "batch_cache could not retain this epoch's entry (larger "
+                "than the memory budget and no disk tier kept it); "
+                "re-iterating this exhausted reader will yield no batches "
+                "— raise mem_budget_bytes or enable the disk tier",
+                RuntimeWarning, stacklevel=2)
+
+    def _reader_cache_key(self):
+        """Content fingerprint of everything that shapes this loader's
+        batch sequence: the reader's resolved piece plan (path + row-group
+        identity, so a re-materialized dataset misses), its schema view,
+        transform, predicate, epoch count and resume position, plus this
+        loader's batching knobs. Refuses row-group shuffling — a shuffled
+        reader's order differs per epoch, so a cached replay would
+        silently train on a frozen order."""
+        from petastorm_tpu.cache_impl import batch_fingerprint
+
+        reader = self.reader
+        ventilator = getattr(reader, "_ventilator", None)
+        if ventilator is not None \
+                and getattr(ventilator, "_randomize_item_order", False):
+            raise ValueError(
+                "batch_cache requires shuffle_row_groups=False on the "
+                "reader: row-group shuffling changes the batch sequence "
+                "every epoch, so serving a cached epoch would silently "
+                "freeze the first epoch's order")
+        pieces = [(piece.path, piece.row_group)
+                  for piece in getattr(reader, "_pieces", [])]
+        return batch_fingerprint(
+            reader._dataset_path_signature(), pieces, self._batch_size,
+            fields=sorted(reader.schema.fields),
+            transform=getattr(reader, "_transform_spec", None),
+            factory=type(reader).__name__ + "/"
+            + type(reader._results_queue_reader).__name__,
+            extra={"last_batch": self._last_batch,
+                   "max_batches": self._max_batches,
+                   "num_epochs": reader.num_epochs,
+                   "predicate": repr(getattr(reader, "_predicate", None)),
+                   "resume": repr(getattr(reader, "_resume_state", None))})
 
     def _stage_loop(self):
         """Staging thread (producer-side staging only): host batches →

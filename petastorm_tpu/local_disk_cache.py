@@ -3,13 +3,22 @@
 Reference parity: ``petastorm/local_disk_cache.py::LocalDiskCache``. The
 reference delegates storage to the third-party ``diskcache`` package; that is
 absent in this environment (SURVEY.md §7 preamble), so the store is
-self-written: one file per key (sha256-named), LRU eviction by access time
-when the directory exceeds ``size_limit``. Concurrent readers on one host are
-safe: writes go through a temp file + atomic rename, and eviction tolerates
+self-written: one file per key (sha256-named), with ``cache_size_limit``
+enforced as a real eviction budget by the shared LRU policy
+(:mod:`petastorm_tpu.cache_impl.eviction` — the same policy behind the
+decoded-batch cache's disk tier). Concurrent readers on one host are safe:
+writes go through a temp file + atomic rename, and eviction tolerates
 concurrently-deleted files.
 
 Repeated-epoch accelerator: on a TPU pod reading from GCS, epoch 2+ hits
-local NVMe instead of the network.
+local NVMe instead of the network. (For bypassing the *decode* as well, see
+``docs/guides/caching.md`` — this cache stores pre-decode row-group
+payloads.)
+
+Directories this cache creates are registered with the cache-dir tracker
+(``cache_impl``); ``cleanup()`` deregisters (and removes the directory when
+constructed with ``cleanup=True``) — the tier-1 leak guard fails tests that
+orphan one.
 """
 
 from __future__ import annotations
@@ -21,6 +30,8 @@ import tempfile
 
 
 class LocalDiskCache:
+    _SUFFIX = ".cache"
+
     def __init__(self, path, size_limit, expected_row_size_estimate=None,
                  shards=None, cleanup=False, **settings):
         """``size_limit`` in bytes; ``expected_row_size_estimate`` kept for
@@ -28,11 +39,16 @@ class LocalDiskCache:
         self._path = path
         self._size_limit = size_limit
         self._cleanup_on_exit = cleanup
+        self._registered = not os.path.isdir(path)
         os.makedirs(path, exist_ok=True)
+        if self._registered:
+            from petastorm_tpu import cache_impl as tracking
+
+            tracking.register_cache_dir(path)
 
     def _key_path(self, key):
         digest = hashlib.sha256(repr(key).encode("utf-8")).hexdigest()
-        return os.path.join(self._path, digest + ".cache")
+        return os.path.join(self._path, digest + self._SUFFIX)
 
     def get(self, key, fill_cache_func):
         file_path = self._key_path(key)
@@ -58,57 +74,37 @@ class LocalDiskCache:
         return pickle.loads(payload)  # noqa: S301
 
     def _store(self, file_path, payload):
-        fd, tmp_path = tempfile.mkstemp(dir=self._path, suffix=".tmp")
+        tmp_path = None
         try:
+            # mkstemp inside the guard: the directory can vanish under a
+            # concurrent cleanup() (reader teardown signals pool workers
+            # before joining them) — a failed store is a skipped cache
+            # write, never an error in the decode path.
+            fd, tmp_path = tempfile.mkstemp(dir=self._path, suffix=".tmp")
             with os.fdopen(fd, "wb") as f:
                 f.write(payload)
             os.replace(tmp_path, file_path)
-        except OSError:  # pragma: no cover - disk full etc.; cache is best-effort
-            try:
-                os.unlink(tmp_path)
-            except OSError:
-                pass
+        except OSError:  # disk full, dir removed; cache is best-effort
+            if tmp_path is not None:
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
             return
-        self._evict_if_needed()
+        from petastorm_tpu.cache_impl.eviction import evict_dir_to_limit
 
-    def _evict_if_needed(self):
-        entries = []
-        total = 0
-        try:
-            names = os.listdir(self._path)
-        except OSError:  # pragma: no cover
-            return
-        for name in names:
-            if not name.endswith(".cache"):
-                continue
-            full = os.path.join(self._path, name)
-            try:
-                stat = os.stat(full)
-            except OSError:
-                continue
-            entries.append((stat.st_atime, stat.st_size, full))
-            total += stat.st_size
-        if total <= self._size_limit:
-            return
-        entries.sort()  # oldest access first
-        for _, size, full in entries:
-            if total <= self._size_limit:
-                break
-            try:
-                os.unlink(full)
-                total -= size
-            except OSError:
-                continue
+        evict_dir_to_limit(self._path, self._size_limit, self._SUFFIX)
 
     def size_on_disk(self):
-        return sum(
-            os.stat(os.path.join(self._path, n)).st_size
-            for n in os.listdir(self._path) if n.endswith(".cache")
-        )
+        from petastorm_tpu.cache_impl.eviction import dir_size
+
+        return dir_size(self._path, self._SUFFIX)
 
     def cleanup(self):
-        if not self._cleanup_on_exit:
-            return
-        import shutil
+        from petastorm_tpu import cache_impl as tracking
 
-        shutil.rmtree(self._path, ignore_errors=True)
+        if self._cleanup_on_exit:
+            import shutil
+
+            shutil.rmtree(self._path, ignore_errors=True)
+        tracking.deregister_cache_dir(self._path)

@@ -491,8 +491,12 @@ class Reader:
             max_ventilation_queue_size=min(len(items), 1000) or 1,
             per_item_iterations=per_item_iterations,
         )
+        # Kept as an attribute so lifecycle owners (``stop()``, the service
+        # worker's drain) can release cache resources — a local-disk cache
+        # with ``cleanup=True`` would otherwise leak its directory.
+        self.cache = cache or NullCache()
         worker_args = (pyarrow_filesystem, pieces, schema, read_schema,
-                       self.ngram, cache or NullCache(), transform_spec)
+                       self.ngram, self.cache, transform_spec)
         self._workers_pool.start(worker_class, worker_args,
                                  ventilator=self._ventilator)
         self._static_diagnostics = {
@@ -685,6 +689,10 @@ class Reader:
     def stop(self):
         self._workers_pool.stop()
         self.stopped = True
+        try:
+            self.cache.cleanup()
+        except Exception:  # cache teardown must never mask the stop
+            logger.warning("reader cache cleanup failed", exc_info=True)
 
     def join(self):
         self._workers_pool.join()

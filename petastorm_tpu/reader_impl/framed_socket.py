@@ -188,6 +188,13 @@ def _decode_payload(fmt, frames):
     raise ValueError(f"Unknown payload format tag {fmt}")
 
 
+#: Public aliases: the decoded-batch cache (``cache_impl``) stores payloads
+#: as these exact frames, so a cached batch re-enters the wire (or the
+#: loader) without ever being re-serialized.
+encode_payload = _encode_payload
+decode_payload = _decode_payload
+
+
 def _recv_into_exact(sock, view, n):
     """Fill ``view[:n]`` from ``sock`` or raise :class:`ConnectionClosedError`."""
     got = 0
@@ -241,6 +248,15 @@ def _sendmsg_all(sock, parts):
 def send_framed(sock, header, payload=None):
     """Send one ``(header dict, payload)`` message on ``sock``."""
     fmt, frames = _encode_payload(payload)
+    send_framed_frames(sock, header, fmt, frames)
+
+
+def send_framed_frames(sock, header, fmt, frames):
+    """Send one message whose payload is ALREADY encoded as serializer
+    frames — the decoded-batch cache's hit path: frames are memoryview
+    slices of one contiguous cache buffer, scatter-gathered straight onto
+    the socket by ``sendmsg`` with zero re-serialization (no pickle, no
+    copy — the cached bytes are the wire bytes)."""
     header_bytes = json.dumps(header).encode("utf-8")
     parts = [_LEN.pack(len(header_bytes)), header_bytes,
              _FMT.pack(fmt), _NFRAMES.pack(len(frames))]

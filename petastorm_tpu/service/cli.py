@@ -89,6 +89,23 @@ def _build_parser():
                       help="seconds between dispatcher lease renewals "
                            "(also drives automatic re-registration after "
                            "a dispatcher restart); 0 disables")
+    work.add_argument("--cache", choices=["off", "mem", "mem+disk"],
+                      default="off", dest="cache",
+                      help="decoded-batch cache: serve repeat-epoch "
+                           "streams from memory (mem) with disk spill + "
+                           "restart persistence (mem+disk) instead of "
+                           "re-decoding (docs/guides/caching.md)")
+    work.add_argument("--cache-mem-mb", type=float, default=256.0,
+                      help="host-RAM budget of the cache's memory tier "
+                           "(LRU eviction beyond it)")
+    work.add_argument("--cache-dir", default=None,
+                      help="mem+disk tier directory; a provided directory "
+                           "persists across worker restarts (warm "
+                           "restart), omitted = a private tempdir removed "
+                           "on stop")
+    work.add_argument("--cache-disk-mb", type=float, default=None,
+                      help="optional disk-tier budget (LRU eviction of "
+                           "spill files beyond it); default unlimited")
     for role in (disp, work):
         role.add_argument("--metrics-port", type=int, default=None,
                           help="serve this process's metrics registry in "
@@ -118,6 +135,7 @@ def build_service_node(args):
                           journal_dir=args.journal_dir,
                           lease_timeout_s=args.lease_timeout or None,
                           journal_fsync=args.journal_fsync)
+    from petastorm_tpu.cache_impl import CacheConfig
     from petastorm_tpu.service.worker import BatchWorker
 
     return BatchWorker(
@@ -127,6 +145,11 @@ def build_service_node(args):
         host=args.host, port=args.port, batch_size=args.batch_size,
         reader_factory=args.reader, worker_id=args.worker_id,
         heartbeat_interval_s=args.heartbeat_interval or None,
+        batch_cache=CacheConfig(mode=getattr(args, "cache", "off"),
+                                mem_mb=getattr(args, "cache_mem_mb", 256.0),
+                                cache_dir=getattr(args, "cache_dir", None),
+                                disk_mb=getattr(args, "cache_disk_mb",
+                                                None)).build(),
         reader_kwargs={"workers_count": args.workers_count,
                        "reader_pool_type": args.reader_pool_type})
 
@@ -167,7 +190,11 @@ def _worker_totals(sample, wid):
     return (metrics.get("rows_sent_total", 0.0),
             metrics.get("batches_sent_total", 0.0),
             metrics.get("credit_wait_seconds_total", 0.0),
-            metrics.get("active_streams", 0.0))
+            metrics.get("active_streams", 0.0),
+            # None (not 0) when the worker has no batch cache armed, so
+            # the render shows "--" instead of a fake 0% hit rate.
+            metrics.get("cache_hits_total"),
+            metrics.get("cache_misses_total"))
 
 
 def render_fleet_status(prev, cur):
@@ -185,7 +212,7 @@ def render_fleet_status(prev, cur):
         f"{len(workers_state) - alive} dead clients="
         f"{len(status.get('clients', {}))} window={dt:.1f}s",
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
-        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12}",
+        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} {'CACHEHIT%':>10}",
     ]
     fleet_rows = fleet_batches = 0.0
     for wid in sorted(cur["workers"]):
@@ -193,24 +220,34 @@ def render_fleet_status(prev, cur):
         if now is None:
             lines.append(f"{wid:<20} {'unreachable':>10}")
             continue
-        rows1, batches1, wait1, active = now
+        rows1, batches1, wait1, active, hits1, misses1 = now
         before = _worker_totals(prev, wid)
         if before is None:
             # No prior baseline (worker just appeared or was unreachable
             # last poll): totals are real, rates are unknowable.
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
-                f"{'--':>13} {int(rows1):>12}")
+                f"{'--':>13} {int(rows1):>12} {'--':>10}")
             continue
-        rows0, batches0, wait0, _ = before
+        rows0, batches0, wait0, _, hits0, misses0 = before
         rows_rate = max(0.0, rows1 - rows0) / dt
         batch_rate = max(0.0, batches1 - batches0) / dt
         wait_rate = max(0.0, wait1 - wait0) / dt
         fleet_rows += rows_rate
         fleet_batches += batch_rate
+        hit_pct = "--"
+        if hits1 is not None and misses1 is not None:
+            # Hit rate over THIS window (delta-based, like the rates): the
+            # decode-bypass signal for the epoch currently streaming, not
+            # a lifetime average that dilutes a cold first epoch forever.
+            hit_delta = max(0.0, hits1 - (hits0 or 0.0))
+            lookups = hit_delta + max(0.0, misses1 - (misses0 or 0.0))
+            if lookups > 0:
+                hit_pct = f"{100.0 * hit_delta / lookups:.1f}"
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
-            f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12}")
+            f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12} "
+            f"{hit_pct:>10}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
     recovery = status.get("recovery") or {}

@@ -1041,6 +1041,8 @@ class ServiceBatchSource:
           a skewed worker shows up here, not in delivery latency), and
           ``credits_outstanding`` (batches received but not yet
           consumed-and-acked);
+        - ``epoch_starts``: ``[produced_batch_count, epoch]`` boundaries in
+          production order (per-epoch throughput attribution);
         - ``recovery``: control-plane recovery events this client observed
           — ``resyncs`` (fence-triggered assignment refreshes),
           ``streams_retired``, ``takeovers``, ``stale_fencing_retries``,
@@ -1059,6 +1061,13 @@ class ServiceBatchSource:
                 "ready_queue_capacity": ready.maxsize if ready is not None
                 else 0,
                 "credits_window": self._credits,
+                # Epoch boundaries in production order: the n-th entry says
+                # "epoch `epoch` began at produced-batch `count`" — a
+                # consumer correlating its own per-batch timeline (the
+                # `service` scenario's per-epoch rows/s breakdown) reads
+                # the boundary without private state.
+                "epoch_starts": [[count, epoch] for count, epoch, _
+                                 in self._epoch_starts],
                 "per_worker": {
                     wid: {"batches": counters["batches"],
                           "stall_s": round(counters["stall_s"], 3),

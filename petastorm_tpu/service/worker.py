@@ -26,7 +26,9 @@ from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedReader,
     FramedServer,
+    encode_payload,
     send_framed,
+    send_framed_frames,
 )
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
@@ -96,6 +98,19 @@ class BatchWorker:
         the shared ``retry_with_backoff`` deadline policy.
     :param max_frame_bytes: per-connection receive frame cap (requests to
         a worker are small control messages; batches only flow OUT).
+    :param batch_cache: a :class:`~petastorm_tpu.cache_impl.BatchCache` (or
+        ``None``). When armed, every ``stream`` request consults the cache
+        **per piece** before constructing a reader: warm pieces are served
+        as pre-serialized frames scatter-gathered straight from cache
+        memory (epoch ≥ 2 of a multi-epoch run skips Parquet + decode +
+        pickle entirely), cold pieces are decoded through a per-piece
+        reader and written through to the cache (and its disk tier, which
+        survives worker restarts). Keys fingerprint the dataset url, piece
+        index, batch size, selected fields, and transform config
+        (``docs/guides/caching.md``). NOTE batch boundaries then align to
+        piece boundaries (a ragged batch per piece tail, not just per
+        stream). The worker owns the instance: ``stop()`` calls its
+        ``cleanup()``.
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
@@ -103,12 +118,20 @@ class BatchWorker:
                  reader_factory="row", reader_kwargs=None, worker_id=None,
                  register_retries=5, register_backoff=0.2,
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
-                 rpc_deadline_s=30.0, max_frame_bytes=None):
+                 rpc_deadline_s=30.0, max_frame_bytes=None,
+                 batch_cache=None):
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
                                     if dispatcher_address else None)
         self._batch_size = batch_size
+        self._batch_cache = batch_cache
+        # The cache fingerprint's factory tag: the three reader families
+        # collate codec columns differently, so entries must not cross them.
+        self._factory_name = (reader_factory if isinstance(reader_factory,
+                                                           str)
+                              else getattr(reader_factory, "__qualname__",
+                                           repr(reader_factory)))
         self._factory = _resolve_factory(reader_factory)
         self._reader_kwargs = dict(reader_kwargs or {})
         # piece_indices/num_epochs/shuffle_row_groups belong to the stream
@@ -131,9 +154,15 @@ class BatchWorker:
         self._rpc_deadline_s = rpc_deadline_s
         self._max_frame_bytes = max_frame_bytes
         self.num_pieces = None
+        self._piece_signatures = None  # set by start()/_count_pieces
         self._lock = threading.Lock()
         self._active = {}            # stream key -> {"reader", "flow"}
         self._completed = {}         # stream key -> final diagnostics dict
+        # Exact per-epoch cache attribution: the stream request carries the
+        # client's epoch, so hits/misses are bucketed by the epoch that
+        # caused them (consumer-side boundary sampling would smear
+        # prefetched lookups into the previous epoch). Bounded dict.
+        self._cache_epochs = {}      # epoch -> {"hits": n, "misses": n}
         self._log = logger.bind(worker_id=self.worker_id)
         # Interned registry children (telemetry.metrics): typed, scrapeable
         # counters behind the legacy diagnostics snapshots.
@@ -174,7 +203,13 @@ class BatchWorker:
         a half-torn worker), then drain in-flight stream threads with a
         bounded join, and only then stop any reader a straggler thread left
         behind — a stop during an active stream can't leak a thread or
-        race reader teardown against a live send loop."""
+        race reader teardown against a live send loop. The drain also
+        releases every cache this worker owns: a straggler reader's
+        row-group cache (``Reader.stop()`` cleans its own) and the
+        decoded-batch cache's tiers — a restarted worker must not
+        accumulate temp directories or spill files (a caller-provided
+        disk-tier directory keeps its files: that persistence is the
+        restart-warmth contract; only worker-private temp state goes)."""
         self._server.stopped.set()
         self._heartbeat_stop.set()
         self._server.stop()
@@ -185,12 +220,19 @@ class BatchWorker:
                 "drain — stopping their readers under them",
                 len(stragglers), drain_timeout_s)
         with self._lock:
-            readers = [entry["reader"] for entry in self._active.values()]
+            readers = [entry["reader"] for entry in self._active.values()
+                       if entry["reader"] is not None]
         for reader in readers:
             try:
-                reader.stop()
+                reader.stop()  # also cleans the reader's row-group cache
             except Exception:
                 pass
+        if self._batch_cache is not None:
+            try:
+                self._batch_cache.cleanup()
+            except Exception:
+                self._log.warning("batch cache cleanup failed",
+                                  exc_info=True)
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=drain_timeout_s)
 
@@ -228,7 +270,11 @@ class BatchWorker:
     def _count_pieces(self):
         """Enumerate the dataset's row-group pieces with the same planning
         config every stream reader will use — the count the dispatcher's
-        split plan is denominated in."""
+        split plan is denominated in. The enumeration's (path, row_group)
+        identities are kept as the cache key's content signature: a
+        re-materialized dataset (new part-file names under the same url)
+        must MISS the persistent disk tier, not serve yesterday's
+        batches."""
         from petastorm_tpu.fs_utils import get_filesystem_and_path_or_paths
         from petastorm_tpu.reader.reader import enumerate_row_group_pieces
 
@@ -236,8 +282,11 @@ class BatchWorker:
             self.dataset_url,
             storage_options=self._reader_kwargs.get("storage_options"),
             filesystem=self._reader_kwargs.get("filesystem"))
-        return len(enumerate_row_group_pieces(
-            fs, path, self._reader_kwargs.get("filters")))
+        pieces = enumerate_row_group_pieces(
+            fs, path, self._reader_kwargs.get("filters"))
+        self._piece_signatures = [(piece.path, piece.row_group)
+                                  for piece in pieces]
+        return len(pieces)
 
     def _register(self, re_register=False, retries=None):
         host, port = self.address
@@ -353,89 +402,39 @@ class BatchWorker:
         header — the cross-process key batch-lifecycle tracing correlates
         spans on (decode/send worker-side; recv/queue/dispatch
         client-side). Decode and send times land in the registry whether or
-        not tracing is armed."""
-        from petastorm_tpu.jax_utils.batcher import batch_iterator
+        not tracing is armed.
 
+        Caching: with a ``batch_cache`` armed, pieces are looked up (and
+        filled) individually — see :meth:`_stream_pieces_cached`. The
+        uncached path is byte-for-byte the pre-cache behavior (one reader
+        over the whole piece set, batches collated across pieces)."""
         pieces = [int(p) for p in header["pieces"]]
         credits = header.get("credits")
         credits = int(credits) if credits is not None else None
         flow = {"credits_window": credits, "credits_left": credits,
                 "batches_sent": 0, "credit_wait_s": 0.0}
         stream_key = f"{uuid.uuid4().hex[:8]}"
-        reader = None
-        rows_sent = 0
+        # The stream's mutable serving state: the cached path swaps
+        # per-piece readers through "reader" (None while serving from
+        # cache); diagnostics snapshots read it under the lock.
+        state = {"reader": None, "flow": flow}
         # "aborted" covers the early returns (worker stop mid-stream, no
         # `end` frame sent); only the `end` send flips it to "completed".
         outcome = "aborted"
-        collector = tracing.COLLECTOR
+        with self._lock:
+            self._active[stream_key] = state
+        self._m_active.inc()
         try:
-            # cur_shard=0/shard_count=1 pins sharding OFF: the factory
-            # defaults would silently fill jax.process_index()/count() on a
-            # host with multi-process JAX initialized, dropping (N-1)/N of
-            # the assigned pieces AFTER piece_indices selection — the
-            # dispatcher's plan is the only sharding a worker applies.
-            reader = self._factory(self.dataset_url, piece_indices=pieces,
-                                   num_epochs=1, shuffle_row_groups=False,
-                                   cur_shard=0, shard_count=1,
-                                   **self._reader_kwargs)
-            with self._lock:
-                self._active[stream_key] = {"reader": reader, "flow": flow}
-            self._m_active.inc()
-            batches = iter(batch_iterator(reader, self._batch_size,
-                                          last_batch="keep"))
-            while True:
-                # Manual iteration so the pull itself (read + collate) is
-                # a measured decode span, attributable per batch id.
-                t_decode = time.perf_counter()
-                batch = next(batches, None)
-                t_decoded = time.perf_counter()
-                if batch is None:
-                    break
-                self._m_decode.observe(t_decoded - t_decode)
-                bid = f"{self.worker_id}:{stream_key}:{flow['batches_sent']}"
-                if collector.enabled:
-                    collector.record_span("worker.decode", t_decode,
-                                          t_decoded, bid=bid)
-                if self._server.stopped.is_set():
-                    return
-                if credits is not None:
-                    # Drain replenishments OPPORTUNISTICALLY every batch,
-                    # not only when starved: un-read credit messages would
-                    # otherwise pile up in the TCP buffers all stream long
-                    # until the client's blocking ack send wedges against
-                    # this worker's blocking batch send (a four-way
-                    # distributed deadlock on long streams).
-                    while conn_reader.data_pending():
-                        reply, _ = conn_reader.recv()
-                        if reply.get("type") == "credit":
-                            flow["credits_left"] += int(reply.get("n", 1))
-                        # anything else mid-stream is out of protocol; skip
-                if credits is not None and flow["credits_left"] <= 0:
-                    t0 = time.perf_counter()
-                    while flow["credits_left"] <= 0:
-                        if self._server.stopped.is_set():
-                            return
-                        reply, _ = conn_reader.recv()
-                        if reply.get("type") == "credit":
-                            flow["credits_left"] += int(reply.get("n", 1))
-                    waited = time.perf_counter() - t0
-                    flow["credit_wait_s"] += waited
-                    self._m_credit_wait.inc(waited)
-                if self._batch_delay_s:
-                    time.sleep(self._batch_delay_s)
-                n = self._batch_rows(batch)
-                t_send = time.perf_counter()
-                send_framed(sock, {"type": "batch", "rows": n, "bid": bid},
-                            batch)
-                if collector.enabled:
-                    collector.record_span("worker.send", t_send,
-                                          time.perf_counter(), bid=bid)
-                rows_sent += n
-                flow["batches_sent"] += 1
-                self._m_batches.inc()
-                self._m_rows.inc(n)
-                if credits is not None:
-                    flow["credits_left"] -= 1
+            if self._batch_cache is not None:
+                rows_sent = self._stream_pieces_cached(
+                    sock, conn_reader, state, pieces, flow, credits,
+                    stream_key, epoch=header.get("epoch"))
+            else:
+                rows_sent = self._stream_pieces_direct(
+                    sock, conn_reader, state, pieces, flow, credits,
+                    stream_key)
+            if rows_sent is None:
+                return  # worker stopped mid-stream
             send_framed(sock, {"type": "end", "rows": rows_sent,
                                "pieces": pieces})
             outcome = "completed"
@@ -449,25 +448,225 @@ class BatchWorker:
             send_framed(sock, {"type": "error", "error": str(exc)})
         finally:
             with self._lock:
-                started = stream_key in self._active
                 self._active.pop(stream_key, None)
-                if reader is not None:
-                    self._completed[stream_key] = dict(reader.diagnostics,
-                                                       **flow)
-                    while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
-                        self._completed.pop(next(iter(self._completed)))
-            if started:
-                self._m_active.dec()
+                reader = state["reader"]
+                snapshot = (dict(reader.diagnostics)
+                            if reader is not None else {})
+                self._completed[stream_key] = dict(snapshot, **flow)
+                while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
+                    self._completed.pop(next(iter(self._completed)))
+            self._m_active.dec()
             WORKER_STREAMS.labels(self.worker_id, outcome).inc()
             if reader is not None:
                 reader.stop()
                 reader.join()
 
+    def _stream_pieces_direct(self, sock, conn_reader, state, pieces, flow,
+                              credits, stream_key):
+        """Uncached serving: one reader over the whole piece set, batches
+        collated across piece boundaries. Returns rows sent, or ``None``
+        when the worker stopped mid-stream."""
+        from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+        collector = tracing.COLLECTOR
+        # cur_shard=0/shard_count=1 pins sharding OFF: the factory
+        # defaults would silently fill jax.process_index()/count() on a
+        # host with multi-process JAX initialized, dropping (N-1)/N of
+        # the assigned pieces AFTER piece_indices selection — the
+        # dispatcher's plan is the only sharding a worker applies.
+        reader = self._make_stream_reader(pieces)
+        with self._lock:
+            state["reader"] = reader
+        rows_sent = 0
+        batches = iter(batch_iterator(reader, self._batch_size,
+                                      last_batch="keep"))
+        while True:
+            # Manual iteration so the pull itself (read + collate) is
+            # a measured decode span, attributable per batch id.
+            t_decode = time.perf_counter()
+            batch = next(batches, None)
+            t_decoded = time.perf_counter()
+            if batch is None:
+                return rows_sent
+            self._m_decode.observe(t_decoded - t_decode)
+            bid = f"{self.worker_id}:{stream_key}:{flow['batches_sent']}"
+            if collector.enabled:
+                collector.record_span("worker.decode", t_decode,
+                                      t_decoded, bid=bid)
+            n = self._batch_rows(batch)
+            fmt, frames = encode_payload(batch)
+            if not self._send_stream_batch(sock, conn_reader, flow, credits,
+                                           bid, n, fmt, frames, collector):
+                return None
+            rows_sent += n
+
+    def _stream_pieces_cached(self, sock, conn_reader, state, pieces, flow,
+                              credits, stream_key, epoch=None):
+        """Cache-armed serving, piece by piece: a warm piece's batches are
+        scatter-gathered straight out of cache memory (zero decode, zero
+        re-serialization — ``send_framed_frames``); a cold piece is decoded
+        through a per-piece reader, each batch serialized ONCE and both
+        sent and written through to the cache. Per-piece keying means a
+        re-partitioned plan (worker takeover, fleet resize) still hits on
+        every piece both plans share, and the disk tier re-serves warm
+        pieces across worker restarts. Returns rows sent, or ``None`` when
+        the worker stopped mid-stream (the partially-filled piece entry is
+        discarded, never published)."""
+        from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+        cache = self._batch_cache
+        collector = tracing.COLLECTOR
+        rows_sent = 0
+        for piece in pieces:
+            key = self._piece_cache_key(piece)
+            entry = cache.get(key)
+            self._note_cache_lookup(epoch, hit=entry is not None)
+            if entry is not None:
+                for cached in entry.batches():
+                    bid = (f"{self.worker_id}:{stream_key}:"
+                           f"{flow['batches_sent']}")
+                    if not self._send_stream_batch(
+                            sock, conn_reader, flow, credits, bid,
+                            cached.rows, cached.fmt, cached.frames,
+                            collector):
+                        return None
+                    rows_sent += cached.rows
+                continue
+            reader = self._make_stream_reader([piece])
+            with self._lock:
+                state["reader"] = reader
+            builder = cache.begin_fill(key)
+            try:
+                batches = iter(batch_iterator(reader, self._batch_size,
+                                              last_batch="keep"))
+                while True:
+                    t_decode = time.perf_counter()
+                    batch = next(batches, None)
+                    t_decoded = time.perf_counter()
+                    if batch is None:
+                        break
+                    self._m_decode.observe(t_decoded - t_decode)
+                    bid = (f"{self.worker_id}:{stream_key}:"
+                           f"{flow['batches_sent']}")
+                    if collector.enabled:
+                        collector.record_span("worker.decode", t_decode,
+                                              t_decoded, bid=bid)
+                    n, fmt, frames = builder.add_batch(batch)
+                    if not self._send_stream_batch(
+                            sock, conn_reader, flow, credits, bid, n, fmt,
+                            frames, collector):
+                        return None
+                    rows_sent += n
+                builder.commit()
+            finally:
+                with self._lock:
+                    state["reader"] = None
+                reader.stop()
+                reader.join()
+        return rows_sent
+
+    _CACHE_EPOCHS_KEPT = 64
+
+    def _note_cache_lookup(self, epoch, hit):
+        """Bucket one cache lookup by the requesting stream's epoch —
+        exact cold-vs-warm attribution for the per-epoch breakdown."""
+        if epoch is None:
+            return
+        with self._lock:
+            bucket = self._cache_epochs.setdefault(
+                int(epoch), {"hits": 0, "misses": 0})
+            bucket["hits" if hit else "misses"] += 1
+            while len(self._cache_epochs) > self._CACHE_EPOCHS_KEPT:
+                self._cache_epochs.pop(min(self._cache_epochs))
+
+    def cache_stats_by_epoch(self):
+        """``{epoch: {"hits", "misses"}}`` for recent epochs (empty when
+        uncached) — the ``service`` scenario's per-epoch hit rates."""
+        with self._lock:
+            return {epoch: dict(bucket)
+                    for epoch, bucket in self._cache_epochs.items()}
+
+    def _make_stream_reader(self, pieces):
+        return self._factory(self.dataset_url, piece_indices=pieces,
+                             num_epochs=1, shuffle_row_groups=False,
+                             cur_shard=0, shard_count=1,
+                             **self._reader_kwargs)
+
+    def _piece_cache_key(self, piece):
+        from petastorm_tpu.cache_impl import batch_fingerprint
+
+        kwargs = self._reader_kwargs
+        # Content signature: the piece's (path, row_group) identity, not
+        # just its index — re-materializing the dataset under the same url
+        # (fresh part-file names, same row-group count) must miss the
+        # persistent disk tier. (In-place overwrites that keep identical
+        # file names remain invisible — docs/guides/caching.md.)
+        signature = (self._piece_signatures[int(piece)]
+                     if self._piece_signatures is not None
+                     and int(piece) < len(self._piece_signatures)
+                     else int(piece))
+        return batch_fingerprint(
+            self.dataset_url, [signature], self._batch_size,
+            fields=kwargs.get("schema_fields"),
+            transform=kwargs.get("transform_spec"),
+            factory=self._factory_name,
+            extra={"filters": kwargs.get("filters"),
+                   "predicate": repr(kwargs.get("predicate")),
+                   "piece_index": int(piece),
+                   "num_pieces": self.num_pieces,
+                   "last_batch": "keep"})
+
+    def _send_stream_batch(self, sock, conn_reader, flow, credits, bid,
+                           rows, fmt, frames, collector):
+        """The shared per-batch send step: honor stop, drain/await credits,
+        apply fault-injection pacing, scatter-gather the frames, account.
+        Returns ``False`` when the worker stopped (caller aborts the
+        stream without an ``end`` frame)."""
+        if self._server.stopped.is_set():
+            return False
+        if credits is not None:
+            # Drain replenishments OPPORTUNISTICALLY every batch,
+            # not only when starved: un-read credit messages would
+            # otherwise pile up in the TCP buffers all stream long
+            # until the client's blocking ack send wedges against
+            # this worker's blocking batch send (a four-way
+            # distributed deadlock on long streams).
+            while conn_reader.data_pending():
+                reply, _ = conn_reader.recv()
+                if reply.get("type") == "credit":
+                    flow["credits_left"] += int(reply.get("n", 1))
+                # anything else mid-stream is out of protocol; skip
+            if flow["credits_left"] <= 0:
+                t0 = time.perf_counter()
+                while flow["credits_left"] <= 0:
+                    if self._server.stopped.is_set():
+                        return False
+                    reply, _ = conn_reader.recv()
+                    if reply.get("type") == "credit":
+                        flow["credits_left"] += int(reply.get("n", 1))
+                waited = time.perf_counter() - t0
+                flow["credit_wait_s"] += waited
+                self._m_credit_wait.inc(waited)
+        if self._batch_delay_s:
+            time.sleep(self._batch_delay_s)
+        t_send = time.perf_counter()
+        send_framed_frames(sock, {"type": "batch", "rows": rows,
+                                  "bid": bid}, fmt, frames)
+        if collector.enabled:
+            collector.record_span("worker.send", t_send,
+                                  time.perf_counter(), bid=bid)
+        flow["batches_sent"] += 1
+        self._m_batches.inc()
+        self._m_rows.inc(rows)
+        if credits is not None:
+            flow["credits_left"] -= 1
+        return True
+
     @staticmethod
     def _batch_rows(batch):
-        for value in batch.values():
-            return int(len(value))
-        return 0
+        from petastorm_tpu.cache_impl.batch_cache import batch_rows
+
+        return batch_rows(batch)
 
     def diagnostics_snapshot(self):
         """``Reader.diagnostics`` of every active stream (merged with its
@@ -476,22 +675,40 @@ class BatchWorker:
         recently finished ones — what a remote client sees. The
         ``metrics`` block carries this worker's lifetime registry counters
         (monotonic, so two probes give fleet rates — what ``python -m
-        petastorm_tpu.service status --watch`` renders)."""
+        petastorm_tpu.service status --watch`` renders; cache hit/miss
+        totals ride along when a batch cache is armed, so the watch view
+        can render a live hit rate). ``cache`` carries the batch cache's
+        own stats block (tiers, bytes, evictions)."""
         with self._lock:
-            active = {key: dict(entry["reader"].diagnostics,
+            # A cache-armed stream serving a warm piece has no live reader.
+            active = {key: dict((entry["reader"].diagnostics
+                                 if entry["reader"] is not None else {}),
                                 **entry["flow"])
                       for key, entry in self._active.items()}
             completed = {key: dict(diag)
                          for key, diag in self._completed.items()}
-        return {
+        metrics = {
+            "batches_sent_total": self._m_batches.value,
+            "rows_sent_total": self._m_rows.value,
+            "credit_wait_seconds_total": self._m_credit_wait.value,
+            "active_streams": self._m_active.value,
+        }
+        out = {
             "worker_id": self.worker_id,
             "num_pieces": self.num_pieces,
             "active_streams": active,
             "completed_streams": completed,
-            "metrics": {
-                "batches_sent_total": self._m_batches.value,
-                "rows_sent_total": self._m_rows.value,
-                "credit_wait_seconds_total": self._m_credit_wait.value,
-                "active_streams": self._m_active.value,
-            },
+            "metrics": metrics,
         }
+        if self._batch_cache is not None:
+            stats = self._batch_cache.stats()
+            metrics["cache_hits_total"] = stats["hits"]
+            metrics["cache_misses_total"] = stats["misses"]
+            out["cache"] = stats
+        return out
+
+    def cache_stats(self):
+        """The batch cache's stats block, or ``None`` when uncached —
+        what the ``service`` scenario samples at epoch boundaries."""
+        return (self._batch_cache.stats()
+                if self._batch_cache is not None else None)

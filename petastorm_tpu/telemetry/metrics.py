@@ -128,6 +128,42 @@ LOADER_STAGE_SECONDS = REGISTRY.histogram(
     "derived from these series",
     labels=("loader", "stage"))
 
+# -- decoded-batch cache (cache_impl/batch_cache.py) -------------------------
+
+CACHE_HITS = REGISTRY.counter(
+    "petastorm_cache_hits_total",
+    "Decoded-batch cache lookups served without re-decoding, by tier "
+    "(mem = LRU memory tier, disk = spill tier; a disk hit is promoted "
+    "into memory)",
+    labels=("tier",))
+CACHE_MISSES = REGISTRY.counter(
+    "petastorm_cache_misses_total",
+    "Decoded-batch cache lookups absent from every tier (the key's pieces "
+    "were decoded and the entry filled)")
+CACHE_BYTES = REGISTRY.gauge(
+    "petastorm_cache_bytes",
+    "Bytes resident in the decoded-batch cache right now, by tier "
+    "(summed over every cache instance in the process)",
+    labels=("tier",))
+CACHE_ENTRIES = REGISTRY.gauge(
+    "petastorm_cache_entries",
+    "Entries resident in the decoded-batch cache right now, by tier",
+    labels=("tier",))
+CACHE_EVICTIONS = REGISTRY.counter(
+    "petastorm_cache_evictions_total",
+    "Entries evicted from a decoded-batch cache tier to honor its size "
+    "budget (mem evictions are harmless when the disk tier holds the "
+    "entry — fills write through)",
+    labels=("tier",))
+CACHE_FILL_SECONDS = REGISTRY.histogram(
+    "petastorm_cache_fill_seconds",
+    "Per-entry time to serialize, pack, and store a decoded-batch cache "
+    "entry (decode time excluded — that is the cost caching removes)")
+CACHE_SERVE_SECONDS = REGISTRY.histogram(
+    "petastorm_cache_serve_seconds",
+    "Per-hit time to fetch a decoded-batch cache entry (memory hits are "
+    "~free; disk hits pay one contiguous file read)")
+
 # -- reader / worker pools / ventilator --------------------------------------
 
 READER_READERS = REGISTRY.counter(

@@ -59,20 +59,28 @@ _LIBRARY_SINGLETON_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
-    """Fail any tier-1 test that leaks a non-daemon thread or a socket
-    past its teardown.
+    """Fail any tier-1 test that leaks a non-daemon thread, a socket, or a
+    cache-created directory past its teardown.
 
     The service stack (dispatcher/worker/client, heartbeats, chaos) is all
     threads + sockets; a test that forgets to stop a node would silently
-    tax every later test in the session. A short grace loop absorbs
-    asynchronous teardown (daemon handler threads closing sockets,
-    GC-collected connections); whatever survives it is a leak. Opt out
-    with ``@pytest.mark.allow_resource_leaks`` (and a reason)."""
+    tax every later test in the session. Caches (the decoded-batch cache's
+    tiers, ``LocalDiskCache``) register every directory they *create* with
+    ``cache_impl`` and deregister on ``cleanup()`` — an entry surviving the
+    test means some owner (a worker, a reader, the cache itself) was never
+    cleaned up, the exact leak class that accumulates spill dirs across
+    worker restarts. A short grace loop absorbs asynchronous teardown
+    (daemon handler threads closing sockets, GC-collected connections);
+    whatever survives it is a leak. Opt out with
+    ``@pytest.mark.allow_resource_leaks`` (and a reason)."""
+    from petastorm_tpu.cache_impl import live_cache_dirs
+
     if request.node.get_closest_marker("allow_resource_leaks"):
         yield
         return
     before_threads = set(threading.enumerate())
     before_sockets = _open_socket_fds()
+    before_cache_dirs = live_cache_dirs()
     yield
     deadline = time.monotonic() + 2.0
     while True:
@@ -81,7 +89,9 @@ def _resource_leak_guard(request):
             if t not in before_threads and t.is_alive() and not t.daemon
             and not t.name.startswith(_LIBRARY_SINGLETON_THREAD_PREFIXES)]
         leaked_sockets = _open_socket_fds() - before_sockets
-        if not leaked_threads and not leaked_sockets:
+        leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
+        if not leaked_threads and not leaked_sockets \
+                and not leaked_cache_dirs:
             return
         if time.monotonic() >= deadline:
             break
@@ -89,8 +99,10 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
-        f"sockets {sorted(leaked_sockets)} — stop/close every service "
-        f"node, loader, and connection the test started "
+        f"sockets {sorted(leaked_sockets)}, "
+        f"cache dirs {sorted(leaked_cache_dirs)} — stop/close every "
+        f"service node, loader, and connection the test started, and "
+        f"cleanup() every cache "
         f"(mark allow_resource_leaks only with a documented reason)",
         pytrace=False)
 

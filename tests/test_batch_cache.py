@@ -1,0 +1,497 @@
+"""Decoded-batch cache tests (ISSUE 5): fingerprinting, tiered storage,
+eviction budgets, and the two hot-path integrations — the service worker's
+per-piece decode bypass and the JAX loader's epoch replay.
+
+Correctness bar (the ISSUE acceptance): batches served from cache are
+byte-identical to freshly decoded batches (same order under static
+sharding), eviction respects the memory budget under concurrent streams,
+and the chaos ``worker-kill`` run with ``mem+disk`` caching preserves the
+zero-lost delivery invariant while re-serving from the shared disk tier.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache_impl import (
+    BatchCache,
+    CacheConfig,
+    batch_fingerprint,
+    live_cache_dirs,
+)
+from petastorm_tpu.jax_utils.batcher import batch_iterator
+from petastorm_tpu.reader_impl.framed_socket import (
+    FramedConnection,
+    encode_payload,
+)
+from petastorm_tpu.service import BatchWorker
+
+pytestmark = pytest.mark.service
+
+
+def _batches_equal(a, b):
+    assert sorted(a) == sorted(b)
+    for name in a:
+        left, right = np.asarray(a[name]), np.asarray(b[name])
+        assert left.dtype == right.dtype, name
+        if left.dtype == object:
+            assert len(left) == len(right)
+            for x, y in zip(left, right):
+                if isinstance(x, np.ndarray):
+                    np.testing.assert_array_equal(x, y)
+                else:
+                    assert x == y, name
+        else:
+            np.testing.assert_array_equal(left, right, err_msg=name)
+
+
+def _make_batch(seed, kb=8):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.rand(kb * 128).astype(np.float64),  # kb KiB
+            "i": np.arange(4, dtype=np.int64)}
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_and_sensitive():
+    base = dict(dataset_url="file:///ds", pieces=[3], batch_size=64,
+                fields=["a", "b"], transform=None, factory="batch",
+                extra={"filters": None})
+    key = batch_fingerprint(**base)
+    assert key == batch_fingerprint(**base)  # deterministic
+    for mutated in (
+            dict(base, dataset_url="file:///other"),
+            dict(base, pieces=[4]),
+            dict(base, batch_size=65),
+            dict(base, fields=["a"]),
+            dict(base, transform="TransformSpec(f)"),
+            dict(base, factory="row"),
+            dict(base, extra={"filters": [("day", "=", 1)]})):
+        assert batch_fingerprint(**mutated) != key, mutated
+
+
+# ---------------------------------------------------------------------------
+# tiers, eviction, persistence
+# ---------------------------------------------------------------------------
+
+def test_mem_roundtrip_is_byte_identical():
+    cache = BatchCache(mem_budget_bytes=8 << 20)
+    batches = [_make_batch(0), _make_batch(1)]
+    cache.put_batches("k", batches)
+    entry = cache.get("k")
+    # True byte identity: the cached contiguous buffer IS the freshly
+    # re-encoded frame stream of the same batches.
+    fresh = b"".join(bytes(memoryview(frame))
+                     for batch in batches
+                     for frame in encode_payload(batch)[1])
+    assert bytes(entry.buf) == fresh
+    for got, want in zip(cache.get_batches("k"), batches):
+        _batches_equal(got, want)
+    assert cache.stats()["hits_mem"] == 2
+    cache.cleanup()
+
+
+def test_mem_budget_lru_eviction():
+    cache = BatchCache(mem_budget_bytes=64 << 10)  # 64 KiB
+    for i in range(12):  # ~8KiB entries: 12 > budget
+        cache.put_batches(f"k{i}", [_make_batch(i)])
+    stats = cache.stats()
+    assert stats["bytes_mem"] <= 64 << 10
+    assert stats["evictions_mem"] > 0
+    assert cache.get("k0") is None            # LRU went first
+    assert cache.get("k11") is not None       # newest survives
+    cache.cleanup()
+
+
+def test_mem_budget_respected_under_concurrent_streams():
+    """Many threads filling and reading at once (the worker serves streams
+    concurrently): resident bytes never exceed the budget and every
+    lookup returns either None or the exact stored content."""
+    cache = BatchCache(mem_budget_bytes=96 << 10)
+    errors = []
+
+    def stream(tid):
+        try:
+            for i in range(10):
+                key = f"t{tid}-{i}"
+                cache.put_batches(key, [_make_batch(tid * 100 + i)])
+                assert cache.stats()["bytes_mem"] <= 96 << 10
+                entry = cache.get(key)
+                if entry is not None:
+                    _batches_equal(entry.to_dicts()[0],
+                                   _make_batch(tid * 100 + i))
+        except Exception as exc:  # surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=stream, args=(t,)) for t in range(6)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert not errors, errors
+    assert cache.stats()["bytes_mem"] <= 96 << 10
+    cache.cleanup()
+
+
+def test_disk_tier_survives_restart(tmp_path):
+    """Write-through + a fresh instance on the same directory = the
+    restart-warmth contract (a restarted worker re-serves from disk)."""
+    cache_dir = str(tmp_path / "tier")
+    first = BatchCache(mem_budget_bytes=8 << 20, cache_dir=cache_dir,
+                       spill_to_disk=True)
+    batches = [_make_batch(7), _make_batch(8)]
+    first.put_batches("k", batches)
+    stats = first.stats()
+    assert stats["entries_disk"] == 1 and stats["bytes_disk"] > 0
+    first.cleanup()  # "restart": memory tier gone, directory persists
+    assert first.stats()["entries_disk"] == 0  # gauge contribution retracted
+
+    second = BatchCache(mem_budget_bytes=8 << 20, cache_dir=cache_dir,
+                        spill_to_disk=True)
+    got = second.get_batches("k")
+    assert got is not None
+    for got_batch, want in zip(got, batches):
+        _batches_equal(got_batch, want)
+    stats = second.stats()
+    assert stats["hits_disk"] == 1
+    assert second.get("k") is not None  # promoted to memory
+    assert second.stats()["hits_mem"] == 1
+    second.cleanup()
+
+
+def test_disk_budget_evicts_lru_files(tmp_path):
+    cache = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=str(tmp_path / "tier"),
+                       spill_to_disk=True,
+                       disk_budget_bytes=48 << 10)
+    for i in range(10):  # ~8KiB files: 10 > the 48KiB budget
+        cache.put_batches(f"k{i}", [_make_batch(i)])
+    from petastorm_tpu.cache_impl.batch_cache import ENTRY_SUFFIX
+    from petastorm_tpu.cache_impl.eviction import dir_size
+
+    assert dir_size(str(tmp_path / "tier"), ENTRY_SUFFIX) <= 48 << 10
+    assert cache.stats()["evictions_disk"] > 0
+    cache.cleanup()
+
+
+def test_corrupt_disk_entry_is_a_miss_not_an_error(tmp_path):
+    cache = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=str(tmp_path / "tier"), spill_to_disk=True)
+    cache.put_batches("k", [_make_batch(1)])
+    path = cache._entry_path("k")
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)  # torn write
+    fresh = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=cache.cache_dir, spill_to_disk=True)
+    assert fresh.get("k") is None
+    assert not os.path.exists(path)  # the bad file was removed
+    fresh.cleanup()
+    cache.cleanup()
+
+
+def test_ephemeral_disk_tier_tracked_and_removed_on_cleanup():
+    cache = BatchCache(mem_budget_bytes=1 << 20, spill_to_disk=True)
+    assert cache.cache_dir in live_cache_dirs()
+    assert os.path.isdir(cache.cache_dir)
+    cache.cleanup()
+    assert cache.cache_dir not in live_cache_dirs()
+    assert not os.path.exists(cache.cache_dir)
+
+
+def test_cache_config_builds_modes(tmp_path):
+    assert CacheConfig(mode="off").build() is None
+    mem = CacheConfig(mode="mem", mem_mb=1).build()
+    assert mem is not None and mem.cache_dir is None
+    disk = CacheConfig(mode="mem+disk", mem_mb=1,
+                       cache_dir=str(tmp_path / "d")).build()
+    assert disk.cache_dir == str(tmp_path / "d")
+    mem.cleanup()
+    disk.cleanup()
+    with pytest.raises(ValueError, match="cache mode"):
+        CacheConfig(mode="bogus")
+    # A dir with a memory-only mode is a misconfiguration (the operator
+    # asked for persistence they would silently not get), not a no-op.
+    with pytest.raises(ValueError, match="mem\\+disk"):
+        CacheConfig(mode="mem", cache_dir=str(tmp_path / "x"))
+
+
+# ---------------------------------------------------------------------------
+# service worker integration
+# ---------------------------------------------------------------------------
+
+def _stream_worker(worker, pieces):
+    """Stream ``pieces`` from a directly-addressed worker; returns the
+    batch dicts in arrival order."""
+    batches = []
+    with FramedConnection.connect(worker.address, timeout=5) as conn:
+        conn.send({"type": "stream", "pieces": pieces, "epoch": 0})
+        while True:
+            header, payload = conn.recv()
+            if header["type"] == "end":
+                return batches
+            assert header["type"] == "batch", header
+            batches.append(payload)
+
+
+def test_worker_cached_epoch_skips_reader_and_matches_decode(
+        petastorm_dataset):
+    """Epoch 2 of a cache-armed worker constructs ZERO readers and serves
+    batches identical (values, dtypes, order) to the decode epoch."""
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"reader_pool_type": "dummy"},
+                         batch_cache=cache).start()
+    constructed = []
+    real_factory = worker._factory
+    worker._factory = lambda *a, **kw: (constructed.append(1)
+                                        or real_factory(*a, **kw))
+    try:
+        epoch1 = _stream_worker(worker, [0, 1, 2])
+        assert len(constructed) == 3  # one reader per cold piece
+        epoch2 = _stream_worker(worker, [0, 1, 2])
+        assert len(constructed) == 3  # warm epoch: no readers at all
+        assert len(epoch1) == len(epoch2)
+        for cold, warm in zip(epoch1, epoch2):
+            _batches_equal(cold, warm)
+        stats = cache.stats()
+        assert stats["misses"] == 3 and stats["hits"] == 3
+        rows = sum(len(next(iter(b.values()))) for b in epoch2)
+        assert rows == 30
+    finally:
+        worker.stop()
+
+
+def test_worker_cached_piece_byte_identical_to_uncached(petastorm_dataset):
+    """Per-piece streams from a cached and an uncached worker deliver the
+    same batch sequence (single-piece streams share batch boundaries, so
+    this is an exact order + content comparison)."""
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    cached_worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                                reader_kwargs={"reader_pool_type": "dummy"},
+                                batch_cache=cache).start()
+    plain_worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                               reader_kwargs={"reader_pool_type": "dummy"}
+                               ).start()
+    try:
+        for piece in (0, 1, 2):
+            plain = _stream_worker(plain_worker, [piece])
+            filled = _stream_worker(cached_worker, [piece])   # miss path
+            warm = _stream_worker(cached_worker, [piece])     # hit path
+            assert len(plain) == len(filled) == len(warm)
+            for want, miss, hit in zip(plain, filled, warm):
+                _batches_equal(want, miss)
+                _batches_equal(want, hit)
+    finally:
+        cached_worker.stop()
+        plain_worker.stop()
+
+
+def test_worker_stop_cleans_ephemeral_cache_dir(petastorm_dataset):
+    worker = BatchWorker(
+        petastorm_dataset.url, batch_size=4,
+        reader_kwargs={"reader_pool_type": "dummy"},
+        batch_cache=CacheConfig(mode="mem+disk", mem_mb=4).build()).start()
+    cache_dir = worker._batch_cache.cache_dir
+    try:
+        _stream_worker(worker, [0])
+        assert cache_dir in live_cache_dirs()
+    finally:
+        worker.stop()
+    assert cache_dir not in live_cache_dirs()
+    assert not os.path.exists(cache_dir)
+
+
+def test_worker_restart_re_serves_from_disk_tier(petastorm_dataset,
+                                                 tmp_path):
+    """Kill a cache-armed worker, start a replacement on the same cache
+    directory: the warm pieces come back from the disk tier (hits, no
+    re-decode) with identical content — the PR 3 re-registration story
+    composed with the disk tier."""
+    cache_dir = str(tmp_path / "shared_tier")
+
+    def make_worker():
+        return BatchWorker(
+            petastorm_dataset.url, batch_size=4,
+            reader_kwargs={"reader_pool_type": "dummy"},
+            batch_cache=CacheConfig(mode="mem+disk", mem_mb=64,
+                                    cache_dir=cache_dir).build()).start()
+
+    first = make_worker()
+    try:
+        cold = _stream_worker(first, [0, 1, 2])
+    finally:
+        first.kill()
+    second = make_worker()
+    try:
+        warm = _stream_worker(second, [0, 1, 2])
+        stats = second._batch_cache.stats()
+        assert stats["hits_disk"] == 3 and stats["misses"] == 0
+        assert len(cold) == len(warm)
+        for want, got in zip(cold, warm):
+            _batches_equal(want, got)
+    finally:
+        second.stop()
+
+
+def test_worker_cache_key_signs_piece_content_identity(petastorm_dataset):
+    """The per-piece key folds in the piece's (path, row_group) identity:
+    a re-materialized dataset (new part-file names, same piece count)
+    changes the key, so the persistent disk tier misses instead of
+    serving yesterday's batches."""
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"reader_pool_type": "dummy"},
+                         batch_cache=BatchCache(mem_budget_bytes=1 << 20))
+    worker.num_pieces = worker._count_pieces()
+    key = worker._piece_cache_key(0)
+    assert key == worker._piece_cache_key(0)  # stable across lookups
+    path, row_group = worker._piece_signatures[0]
+    worker._piece_signatures[0] = (path + ".rewritten", row_group)
+    assert worker._piece_cache_key(0) != key
+    worker._batch_cache.cleanup()
+
+
+def test_worker_diagnostics_carry_cache_stats(petastorm_dataset):
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"reader_pool_type": "dummy"},
+                         batch_cache=cache).start()
+    try:
+        _stream_worker(worker, [0])
+        _stream_worker(worker, [0])
+        snapshot = worker.diagnostics_snapshot()
+        assert snapshot["metrics"]["cache_hits_total"] == 1
+        assert snapshot["metrics"]["cache_misses_total"] == 1
+        assert snapshot["cache"]["hit_rate"] == 0.5
+        assert worker.cache_stats()["hits"] == 1
+    finally:
+        worker.stop()
+
+
+# ---------------------------------------------------------------------------
+# JAX loader integration
+# ---------------------------------------------------------------------------
+
+def test_loader_replays_epoch_from_cache(petastorm_dataset):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=1, shuffle_row_groups=False)
+    loader = JaxDataLoader(reader, 7, last_batch="keep",
+                           stage_to_device=False, batch_cache=cache)
+    with loader:
+        epoch1 = list(loader)
+        # The num_epochs=1 reader is exhausted — without the cache this
+        # second pass would yield nothing.
+        epoch2 = list(loader)
+        epoch3 = list(loader)
+    assert len(epoch1) == len(epoch2) == len(epoch3) == 5
+    for want, got in zip(epoch1, epoch2):
+        _batches_equal(want, got)
+    for want, got in zip(epoch1, epoch3):
+        _batches_equal(want, got)
+    stats = cache.stats()
+    assert stats["misses"] == 1 and stats["hits"] == 2
+    cache.cleanup()
+
+
+def test_loader_partial_iteration_never_commits(petastorm_dataset):
+    """Abandoning the consumer mid-epoch must not publish a truncated
+    entry that later replays as a 'complete' epoch — not on the abandoned
+    pass, and not on a LATER pass either (the reader then resumes from an
+    unknown mid-stream position, so a re-iteration's batches are a tail:
+    they stream through uncached and are never committed)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=1, shuffle_row_groups=False)
+    loader = JaxDataLoader(reader, 7, last_batch="keep",
+                           stage_to_device=False, batch_cache=cache)
+    with loader:
+        for _ in loader:
+            break  # abandon after one batch
+        assert cache.stats()["entries_mem"] == 0
+        # Re-iterating a spoiled loader serves the reader's remainder
+        # uncached; nothing may ever be committed under the epoch key.
+        tail = list(loader)
+        assert len(tail) < 5  # strictly a tail, not the full 5-batch epoch
+        assert cache.stats()["entries_mem"] == 0
+        assert list(loader) == []  # exhausted, still nothing committed
+    assert cache.stats()["entries_mem"] == 0
+    cache.cleanup()
+
+
+def test_loader_cache_rejects_shuffling(petastorm_dataset):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    cache = BatchCache(mem_budget_bytes=1 << 20)
+    with pytest.raises(ValueError, match="decode bypass"):
+        JaxDataLoader(None, 4, batch_source=lambda: iter([]),
+                      stage_to_device=False, batch_cache=cache)
+    with pytest.raises(ValueError, match="shuffle"):
+        JaxDataLoader(object(), 4, shuffle_buffer_size=8,
+                      stage_to_device=False, batch_cache=cache)
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=1, shuffle_row_groups=True)
+    loader = JaxDataLoader(reader, 7, last_batch="keep",
+                           stage_to_device=False, batch_cache=cache)
+    with pytest.raises(ValueError, match="shuffle_row_groups"):
+        with loader:
+            list(loader)
+    reader.stop()
+    reader.join()
+    cache.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# scenario: per-epoch breakdown + warm-epoch acceptance
+# ---------------------------------------------------------------------------
+
+def test_service_scenario_epoch_breakdown_and_warm_hit_rate(tmp_path):
+    """Tier-1 scale of the ISSUE acceptance A/B: 2 workers, 2 epochs,
+    cache=mem — the per-epoch breakdown lands in --json-out, epoch 2 is
+    served ≥95% from cache, and both epochs deliver every row."""
+    import json
+
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    json_out = tmp_path / "bench.jsonl"
+    result = service_loopback_scenario(rows=2000, days=4, workers=2,
+                                       batch_size=128, epochs=2,
+                                       cache="mem",
+                                       json_out=str(json_out))
+    detail = result["epochs_detail"]
+    assert [d["epoch"] for d in detail] == [0, 1]
+    assert all(d["rows"] == 2000 for d in detail)
+    assert all(d["rows_per_s"] > 0 for d in detail)
+    assert detail[1]["cache_hit_rate"] >= 0.95
+    assert detail[1]["cache_misses"] == 0
+    assert result["cache"]["hits"] == result["cache"]["misses"] == 4
+    line = json.loads(json_out.read_text().splitlines()[0])
+    assert line["epochs_detail"] == detail
+
+
+@pytest.mark.slow
+def test_chaos_worker_kill_with_disk_cache_keeps_invariants():
+    """Satellite: chaos worker-kill under mem+disk caching — the PR 3
+    zero-lost invariant holds (duplicates allowed: at-least-once), and the
+    shared disk tier serves hits (the takeover re-serves warm pieces
+    without a full re-decode)."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=3,
+                                       batch_size=32, epochs=2,
+                                       cache="mem+disk",
+                                       chaos="worker-kill",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0
+    assert result["chaos_events"], "no chaos event landed inside the run"
+    assert result["cache"]["hits"] > 0

@@ -217,6 +217,37 @@ def test_local_disk_cache_hit_and_eviction(tmp_path):
     for i in range(20):  # ~160KB total >> 50KB limit
         cache.get(("piece", i + 1), lambda: np.zeros(1000))
     assert cache.size_on_disk() <= 50_000
+    cache.cleanup()
+
+
+def test_local_disk_cache_eviction_is_lru(tmp_path):
+    """The shared eviction policy drops the LEAST recently used entry:
+    after touching the oldest key, an overflow evicts the next-oldest
+    instead."""
+    import os
+
+    cache = LocalDiskCache(str(tmp_path / "cache"), size_limit=30_000)
+    for key in ("a", "b", "c"):       # ~8KB each: 3 entries fit the budget
+        cache.get(key, lambda: np.zeros(1000))
+    # Touch "a" (updates atime AND mtime, so noatime mounts still order
+    # by recency): "b" becomes the LRU entry.
+    os.utime(cache._key_path("a"))
+    cache.get("overflow", lambda: np.zeros(1000))  # pushes past 30KB
+    assert cache.size_on_disk() <= 30_000
+    refills = []
+    cache.get("a", lambda: refills.append("a") or np.zeros(1000))
+    cache.get("b", lambda: refills.append("b") or np.zeros(1000))
+    cache.cleanup()
+    assert refills == ["b"], "LRU should have evicted 'b', kept 'a'"
+
+
+def test_local_disk_cache_cleanup_flag_removes_directory(tmp_path):
+    path = tmp_path / "ephemeral"
+    cache = LocalDiskCache(str(path), size_limit=10**6, cleanup=True)
+    cache.get("k", lambda: np.zeros(10))
+    assert path.is_dir()
+    cache.cleanup()
+    assert not path.exists()
 
 
 def test_local_disk_arrow_table_cache(tmp_path):
@@ -233,6 +264,18 @@ def test_local_disk_arrow_table_cache(tmp_path):
     assert len(calls) == 1
     with pytest.raises(ValueError, match="pa.Table"):
         cache.get("bad", lambda: [1, 2, 3])
+    cache.cleanup()
+
+
+def test_local_disk_arrow_table_cache_honors_size_limit(tmp_path):
+    """The arrow-table variant inherits the shared eviction budget."""
+    cache = LocalDiskArrowTableCache(str(tmp_path / "acache"),
+                                     size_limit=40_000)
+    for i in range(20):  # ~8KB of float64 per table >> the 40KB budget
+        cache.get(("t", i),
+                  lambda: pa.table({"x": np.zeros(1000)}))
+    assert cache.size_on_disk() <= 40_000
+    cache.cleanup()
 
 
 def test_reader_local_disk_cache_speeds_second_epoch(petastorm_dataset, tmp_path):
@@ -242,6 +285,26 @@ def test_reader_local_disk_cache_speeds_second_epoch(petastorm_dataset, tmp_path
                      cache_size_limit=10**8) as reader:
         ids = [row.id for row in reader]
     assert sorted(ids) == sorted(list(range(30)) * 2)
+    # Reader.stop() released the cache (deregistered from the leak
+    # tracker); files persist — cleanup=True is the deletion opt-in.
+    assert (tmp_path / "rcache").is_dir()
+
+
+def test_reader_local_disk_cache_enforces_size_limit(petastorm_dataset,
+                                                     tmp_path):
+    """Seed-parity coverage: `make_reader(cache_type="local-disk")` honors
+    `cache_size_limit` as a real eviction budget (the directory never
+    settles above it), and still serves every row."""
+    from petastorm_tpu.cache_impl.eviction import dir_size
+
+    location = tmp_path / "tiny_cache"
+    with make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                     num_epochs=2, cache_type="local-disk",
+                     cache_location=str(location),
+                     cache_size_limit=20_000) as reader:
+        ids = [row.id for row in reader]
+    assert sorted(ids) == sorted(list(range(30)) * 2)
+    assert dir_size(str(location), ".cache") <= 20_000
 
 
 # ---- weighted sampling ---------------------------------------------------
