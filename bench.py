@@ -22,6 +22,15 @@ removes the bias the honest way):
   TransformSpec (reference users cast on host; the reference has no device
   path at all — SURVEY.md §3 boundary summary), synchronous
   read → device_put → blocked step.
+- ``device_decode``: the accelerator-side decode stage A/B
+  (docs/guides/device_decode.md) — raw uint8 staged + fused on-device
+  cast/normalize with donated buffers vs the identical arithmetic host-side
+  with float32 staging; reports both paths' ``h2d_bytes_per_image`` (4x)
+  and the device-stage path's distance from the raw decode ceiling.
+- ``multichip_scaling`` (oneshot): sharding-aware direct-to-device delivery
+  at 1 vs 8 devices on a virtual CPU mesh — end-to-end rows/s plus the
+  isolated on-device decode kernel rows/s (needs >= 8 host cores to
+  execute device-parallel; host_cores disclosed in the result).
 
 Also reported: decode-only ceilings for both reader paths (no device in the
 loop), so the input-bound floor is visible next to the headline
@@ -394,6 +403,210 @@ def leg_cached_epochs(url):
                 "cache_bytes_mem": stats["bytes_mem"]}
 
     return _best_of(one, REPEATS)
+
+
+# --------------------------------------------------------------------------
+# Device decode stage A/B (docs/guides/device_decode.md): the SAME dataset
+# through the same loader + model step, with the last decode stages
+# (cast + normalize) either fused ON-DEVICE over a raw uint8 staging
+# (device_stage=DeviceStage(...)) or executed host-side with float32
+# staging (the reference architecture's placement). The ledger that moves:
+# h2d_bytes_per_image (uint8 bytes vs float32 pixels — 4x) and the
+# pipeline's distance from the raw decode ceiling.
+# --------------------------------------------------------------------------
+
+def leg_device_decode(url):
+    import jax
+
+    from petastorm_tpu.jax_utils import (DeviceStage, JaxDataLoader,
+                                         make_jax_dataloader)
+    from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+    params, step = _make_model()
+    params = _warm(params, step, committed=True, image_dtype=np.float32)
+    mask = jax.device_put(np.ones((BATCH,), bool), jax.local_devices()[0])
+    state = {"params": params}
+
+    def raw_ceiling():
+        # Decode to raw uint8 batches, no device in the loop — the ceiling
+        # BOTH paths share (neither can beat its own producer).
+        reader = _columnar_reader(url)
+        n, t0 = 0, time.perf_counter()
+        with reader:
+            for _ in batch_iterator(reader, BATCH, last_batch="drop"):
+                n += BATCH
+        return n / (time.perf_counter() - t0)
+
+    raw_ceiling()  # warm: page cache, adaptive interpreter
+    ceiling = raw_ceiling()
+
+    def run(loader):
+        n, loss = 0, None
+        params = state["params"]
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                params, loss = step(params, batch["image"], batch["label"],
+                                    mask)
+                n += BATCH
+        if loss is not None:
+            jax.block_until_ready(loss)
+        wall = time.perf_counter() - t0
+        state["params"] = params
+        diag = loader.diagnostics
+        return {"images_per_sec": n / wall,
+                "input_stall_pct": diag["input_stall_pct"],
+                "dispatch_overlap_pct": diag["dispatch_overlap_pct"],
+                "h2d_bytes_per_image": round(
+                    diag["h2d_bytes"] / max(1, diag["rows"]), 1)}
+
+    # ONE stage instance shared by every ON pass: jax.jit caches per wrapped
+    # function, so a fresh DeviceStage per pass would put a full kernel
+    # retrace+compile (~70 ms measured) inside each timed window — a cost
+    # the float32 baseline never pays. _best_of's warm-up pass warms THIS
+    # instance's kernel.
+    on_stage = DeviceStage(normalize=(127.5, 127.5))
+    paced_stage = DeviceStage(normalize=(127.5, 127.5))
+
+    def on_pass():
+        # Raw uint8 staged; cast + normalize fuse in the on-device kernel.
+        return run(make_jax_dataloader(
+            _columnar_reader(url), BATCH, last_batch="drop",
+            non_tensor_policy="drop", host_prefetch=6, device_prefetch=2,
+            device_stage=on_stage))
+
+    def off_pass():
+        # float32-staging baseline: the identical cast + normalize executed
+        # on the HOST in the producer, float32 pixels staged (4x the H2D
+        # bytes) — same loader machinery via the batch_source seam.
+        def source():
+            reader = _columnar_reader(url)
+
+            def gen():
+                with reader:
+                    for b in batch_iterator(reader, BATCH,
+                                            last_batch="drop"):
+                        img = (b["image"].astype(np.float32)
+                               - np.float32(127.5)) * np.float32(1 / 127.5)
+                        yield {"image": img, "label": b["label"]}
+            return gen()
+
+        return run(JaxDataLoader(None, BATCH, batch_source=source,
+                                 non_tensor_policy="drop",
+                                 host_prefetch=6, device_prefetch=2))
+
+    on = _best_of(on_pass, REPEATS)
+    off = _best_of(off_pass, REPEATS)
+
+    def paced_on_pass():
+        # The stall number at a REALISTIC device step time (the regime the
+        # stage targets; the free-compute stall above is structural on a
+        # 1-core host where the unpadded step is ~0.07 ms): device stage +
+        # producer-side staging, consumer pays queue-get + step dispatch +
+        # a GIL-releasing emulated step wait — decode, raw staging, and
+        # the on-device decode all ride inside the wait window.
+        step_s = REAL_STEP_MS / 1000.0
+        loader = make_jax_dataloader(
+            _columnar_reader(url), BATCH, last_batch="drop",
+            non_tensor_policy="drop", host_prefetch=4, device_prefetch=4,
+            stage_in_producer=True, device_stage=paced_stage)
+        params, n, loss, first = state["params"], 0, None, True
+        t0 = time.perf_counter()
+        with loader:
+            for batch in loader:
+                if first:
+                    # pipeline fill: every architecture pays it once
+                    loader.exclude_stall_so_far()
+                    first = False
+                params, loss = step(params, batch["image"], batch["label"],
+                                    mask)
+                time.sleep(step_s)  # emulated device-step completion
+                n += BATCH
+        if loss is not None:
+            jax.block_until_ready(loss)
+        wall = time.perf_counter() - t0
+        state["params"] = params
+        diag = loader.diagnostics
+        return {"images_per_sec": n / wall,
+                "input_stall_pct": diag["input_stall_pct"]}
+
+    paced_on_pass()  # warm the producer-staging path at this shape
+    paced = paced_on_pass()
+    return {
+        "images_per_sec": on["images_per_sec"],  # rounds comparator
+        "device_stage_images_per_sec": round(on["images_per_sec"], 1),
+        "float32_staging_images_per_sec": round(off["images_per_sec"], 1),
+        "device_stage_vs_float32": round(
+            on["images_per_sec"] / off["images_per_sec"], 2),
+        "h2d_bytes_per_image": {
+            "device_stage": on["h2d_bytes_per_image"],
+            "float32_staging": off["h2d_bytes_per_image"]},
+        "h2d_bytes_reduction": round(
+            off["h2d_bytes_per_image"]
+            / max(1.0, on["h2d_bytes_per_image"]), 2),
+        "input_stall_pct": on["input_stall_pct"],
+        "float32_input_stall_pct": off["input_stall_pct"],
+        "paced_step_ms": REAL_STEP_MS,
+        "paced_input_stall_pct": paced["input_stall_pct"],
+        "paced_images_per_sec": round(paced["images_per_sec"], 1),
+        "stall_excludes_pipeline_fill": True,
+        "dispatch_overlap_pct": on["dispatch_overlap_pct"],
+        "decode_ceiling_images_per_sec": round(ceiling, 1),
+        "pipeline_vs_decode_ceiling": round(
+            on["images_per_sec"] / ceiling, 2),
+        "augment": "cast+normalize fused on device; raw uint8 staged with "
+                   "donated input buffers",
+    }
+
+
+# --------------------------------------------------------------------------
+# MULTICHIP scaling leg: sharding-aware direct-to-device delivery + the
+# on-device decode kernel at 1 vs N devices (per-device batch fixed). The
+# bench chip is a single device, so the sweep runs on a virtual N-CPU-device
+# mesh in a fresh subprocess (same recipe as __graft_entry__'s dryrun);
+# genuinely parallel device execution needs >= N host cores — host_cores
+# rides in the result so a core-starved run is readable as such. The same
+# helper runs inside dryrun_multichip on the real 8-device MULTICHIP rig.
+# --------------------------------------------------------------------------
+
+MULTICHIP_DEVICES = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+
+
+def leg_multichip_child(_url):
+    import jax
+
+    # The axon sitecustomize pins the platform via jax.config, overriding
+    # the env var — pin CPU back the same way (see conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    from petastorm_tpu.benchmark.device_scaling import (
+        measure_device_stage_scaling,
+    )
+
+    out = measure_device_stage_scaling(
+        device_counts=(1, MULTICHIP_DEVICES))
+    out["images_per_sec"] = 0.0
+    return out
+
+
+def leg_multichip_scaling(_url):
+    import re
+
+    env = dict(os.environ)
+    env["BENCH_LEG"] = "multichip_child"
+    env["BENCH_URL"] = _url
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count"
+                f"={MULTICHIP_DEVICES}").strip()
+    result = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                            env=env, capture_output=True, text=True,
+                            timeout=2400)
+    if result.returncode != 0:
+        raise RuntimeError(f"multichip scaling subprocess failed:\n"
+                           f"{result.stderr[-2000:]}")
+    return json.loads(result.stdout.strip().splitlines()[-1])
 
 
 REAL_STEP_MS = float(os.environ.get("BENCH_REAL_STEP_MS", "25"))
@@ -1037,22 +1250,27 @@ LEGS = {
     "sync_columnar": leg_sync_columnar,
     "pipelined": leg_pipelined,
     "cached_epochs": leg_cached_epochs,
+    "device_decode": leg_device_decode,
     "realstep": leg_realstep,
     "flash_oracle": leg_flash_oracle,
     "flash_numerics": leg_flash_numerics,
     "flash_memsweep": leg_flash_memsweep,
+    "multichip_child": leg_multichip_child,
+    "multichip_scaling": leg_multichip_scaling,
 }
 
 # Legs that measure evidence, not throughput: run ONCE outside the
 # best-of-ROUNDS loop (numerics and OOM ceilings are not host-weather).
-ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep")
+ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
+                "multichip_child", "multichip_scaling")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
 # trials of up to 900 s each — a flat 1200 s would kill the whole bench
 # (losing every already-measured leg) exactly when a big-T compile runs
 # long.
-_LEG_TIMEOUT_S = {"flash_memsweep": 12000, "flash_numerics": 2400}
+_LEG_TIMEOUT_S = {"flash_memsweep": 12000, "flash_numerics": 2400,
+                  "multichip_scaling": 3000}
 
 
 def _run_leg_subprocess(leg, url):
@@ -1103,7 +1321,8 @@ def main():
                     results[leg] = r
         flash_numerics = _run_leg_subprocess("flash_numerics", url)
         flash_memory = _run_leg_subprocess("flash_memsweep", url)
-        for extra in (flash_numerics, flash_memory):
+        multichip = _run_leg_subprocess("multichip_scaling", url)
+        for extra in (flash_numerics, flash_memory, multichip):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -1175,6 +1394,19 @@ def main():
                 "cache_hit_rate":
                     results["cached_epochs"]["cache_hit_rate"],
             },
+            # Device decode stage A/B (the decode-ceiling work): raw uint8
+            # staged + fused on-device cast/normalize vs host-side float32
+            # staging, same dataset/loader/step — h2d_bytes_per_image is
+            # the uint8-vs-float32 ledger (4x), and its
+            # pipeline_vs_decode_ceiling is the new ceiling ratio tracked
+            # in BENCH_r06+.
+            "device_decode": {
+                k: v for k, v in results["device_decode"].items()
+                if k != "images_per_sec"},
+            # Sharding-aware direct-to-device delivery at 1 vs 8 devices
+            # (virtual CPU mesh on this single-chip host; near-linear
+            # scaling needs >= 8 host cores — host_cores discloses).
+            "multichip_scaling": multichip,
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),

@@ -130,6 +130,17 @@ def _scenario_main(argv):
                         help="shared disk-tier directory for "
                              "--cache mem+disk (default: a scenario-owned "
                              "tempdir)")
+    parser.add_argument("--device-stage", default=None,
+                        choices=["on", "off"], dest="device_stage",
+                        help="image scenario: run the accelerator-side "
+                             "decode leg — raw uint8 staged, cast/"
+                             "normalize fused on-device "
+                             "(docs/guides/device_decode.md)")
+    parser.add_argument("--device-prefetch", type=int, default=None,
+                        dest="device_prefetch",
+                        help="batches kept in flight on device by the "
+                             "device-stage leg (>=2 = double buffering; "
+                             "each costs one batch of HBM)")
     args = parser.parse_args(argv)
 
     scenario = SCENARIOS[args.name]
@@ -156,7 +167,10 @@ def _scenario_main(argv):
             ("epochs", "--epochs", args.epochs),
             ("cache", "--cache", args.cache),
             ("cache_mem_mb", "--cache-mem-mb", args.cache_mem_mb),
-            ("cache_dir", "--cache-dir", args.cache_dir)):
+            ("cache_dir", "--cache-dir", args.cache_dir),
+            ("device_stage", "--device-stage", args.device_stage),
+            ("device_prefetch", "--device-prefetch",
+             args.device_prefetch)):
         if value is not None:
             if name not in accepted:
                 parser.error(f"{flag} is not a knob of "

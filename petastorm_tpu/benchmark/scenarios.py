@@ -219,22 +219,40 @@ def make_image_dataset(dataset_url, rows=1024, image_shape=(64, 64, 3),
 
 
 def image_pipeline_scenario(dataset_url=None, rows=1024, workers=3,
-                            batch_size=128):
+                            batch_size=128, device_stage="off",
+                            device_prefetch=2, json_out=None):
     """Row vs columnar decode throughput + loader stall on an image schema.
 
     The config-#2 shape (ImageNet + CompressedImageCodec): the number that
     matters is images/sec through the full delivery path and the columnar
     path's decode advantage over the reference's per-row architecture.
+
+    ``device_stage="on"`` adds the accelerator-side decode leg
+    (``docs/guides/device_decode.md``): the same columnar stream through
+    ``make_jax_dataloader`` with a :class:`DeviceStage` — raw uint8 staged,
+    cast + normalize fused on the device, ``device_prefetch`` batches
+    double-buffered in flight — reporting its images/sec, measured
+    ``h2d_bytes_per_image``, and dispatch overlap. ``json_out`` appends
+    the result (knobs included) as one JSON line, BENCH-style.
     """
-    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.jax_utils import DeviceStage, make_jax_dataloader
     from petastorm_tpu.jax_utils.batcher import batch_iterator
     from petastorm_tpu.reader.reader import make_columnar_reader, make_reader
 
+    if device_stage not in ("on", "off"):
+        raise ValueError(f"device_stage must be on|off, got {device_stage!r}")
     tmpdir = None
     if dataset_url is None:
         tmpdir = tempfile.mkdtemp(prefix="petastorm_tpu_image_")
         dataset_url = f"file://{tmpdir}/ds"
         make_image_dataset(dataset_url, rows=rows)
+
+    def columnar_reader():
+        return make_columnar_reader(dataset_url, num_epochs=1,
+                                    shuffle_row_groups=False,
+                                    reader_pool_type="thread",
+                                    workers_count=workers,
+                                    schema_fields=["image", "label"])
 
     def decode_leg(factory):
         reader = factory(dataset_url, num_epochs=1, shuffle_row_groups=False,
@@ -254,16 +272,11 @@ def image_pipeline_scenario(dataset_url=None, rows=1024, workers=3,
                 f"Dataset at {dataset_url} yields no full batch of "
                 f"{batch_size} rows — pass a smaller batch size")
         _, col_ips = decode_leg(make_columnar_reader)
-        reader = make_columnar_reader(dataset_url, num_epochs=1,
-                                      shuffle_row_groups=False,
-                                      reader_pool_type="thread",
-                                      workers_count=workers,
-                                      schema_fields=["image", "label"])
-        with make_jax_dataloader(reader, batch_size,
+        with make_jax_dataloader(columnar_reader(), batch_size,
                                  stage_to_device=False) as loader:
             n = sum(1 for _ in loader)
             stall = loader.diagnostics["input_stall_pct"]
-        return {
+        result = {
             "scenario": "image_pipeline",
             "rows": measured_rows,  # full batches measured (drop policy)
             "row_decode_images_per_sec": round(row_ips, 1),
@@ -271,7 +284,42 @@ def image_pipeline_scenario(dataset_url=None, rows=1024, workers=3,
             "columnar_vs_row": round(col_ips / row_ips, 2),
             "loader_batches": n,
             "loader_input_stall_pct": stall,
+            "device_stage": device_stage,
+            "device_prefetch": device_prefetch,
         }
+        if device_stage == "on":
+            stage = DeviceStage(normalize=(127.5, 127.5))
+
+            def device_stage_pass():
+                loader = make_jax_dataloader(columnar_reader(), batch_size,
+                                             last_batch="drop",
+                                             non_tensor_policy="drop",
+                                             device_prefetch=device_prefetch,
+                                             device_stage=stage)
+                rows_seen, t0 = 0, time.perf_counter()
+                with loader:
+                    for batch in loader:
+                        rows_seen += batch_size
+                return rows_seen, time.perf_counter() - t0, loader
+
+            # Warm pass first: the fused kernel's jit compile (and page
+            # cache) would otherwise ride inside the one timed window.
+            device_stage_pass()
+            n_rows, wall, loader = device_stage_pass()
+            diag = loader.diagnostics
+            result.update({
+                "device_stage_images_per_sec": round(n_rows / wall, 1),
+                "device_stage_input_stall_pct": diag["input_stall_pct"],
+                "dispatch_overlap_pct": diag["dispatch_overlap_pct"],
+                "h2d_bytes_per_image": round(
+                    diag["h2d_bytes"] / max(1, diag["rows"]), 1),
+            })
+        if json_out:
+            import json
+
+            with open(json_out, "a", encoding="utf-8") as f:
+                f.write(json.dumps(result) + "\n")
+        return result
     finally:
         if tmpdir:
             shutil.rmtree(tmpdir, ignore_errors=True)

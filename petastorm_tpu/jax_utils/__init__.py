@@ -25,6 +25,7 @@ from petastorm_tpu.jax_utils.checkpoint import (
     restore_training_state,
     save_training_state,
 )
+from petastorm_tpu.jax_utils.device_stage import DeviceStage
 from petastorm_tpu.jax_utils.loader import JaxDataLoader, make_jax_dataloader
 from petastorm_tpu.jax_utils.packing import (
     PACK_POSITION_KEY,
@@ -48,6 +49,7 @@ from petastorm_tpu.jax_utils.sharding import (
 __all__ = [
     "make_jax_dataloader",
     "JaxDataLoader",
+    "DeviceStage",
     "batch_iterator",
     "collate_rows",
     "collate_ngram_rows",

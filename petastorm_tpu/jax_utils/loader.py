@@ -8,7 +8,14 @@ Pipeline (SURVEY.md §7 stage 5, hard-part #6 "pipelined host→HBM staging"):
       → consumer: async ``jax.device_put`` kept ``device_prefetch`` batches
         ahead (double buffering — H2D DMA overlaps the caller's compute)
       → yields jax.Array batches (or globally-sharded arrays when a
-        ``sharding`` is given, via ``make_array_from_process_local_data``)
+        ``sharding`` is given — per-shard direct-to-device placement when
+        every device is addressable, ``make_array_from_process_local_data``
+        on a pod)
+
+With a :class:`~petastorm_tpu.jax_utils.DeviceStage` armed
+(``device_stage=``), image fields are staged as RAW uint8 bytes and a
+fused JIT kernel performs cast/normalize/crop/flip on the accelerator —
+H2D moves bytes, not float32 pixels (``docs/guides/device_decode.md``).
 
 Input-stall instrumentation is built in: time the consumer blocks waiting on
 the host queue is "stall", measured against wall time between yields —
@@ -35,6 +42,7 @@ from petastorm_tpu.jax_utils.batcher import PAD_MASK_KEY, batch_iterator
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.metrics import (
     LOADER_BATCHES,
+    LOADER_DISPATCH_OVERLAP,
     LOADER_ROWS,
     LOADER_STAGE_SECONDS,
 )
@@ -43,9 +51,20 @@ _SENTINEL = object()
 
 #: Loader pipeline stages, as histogram label values: ``decode`` (reader
 #: pull + collation), ``queue_wait`` (producer blocked on a full host
-#: queue), ``wait`` (consumer blocked on input — the stall), ``device_put``
-#: (H2D dispatch), ``consumer`` (the training step between yields).
-_STAGES = ("decode", "queue_wait", "wait", "device_put", "consumer")
+#: queue), ``wait`` (consumer blocked on input — the stall), ``raw_stage``
+#: (staging the raw uint8 bytes batch for the device decode stage),
+#: ``device_decode`` (the fused on-device decode/augment kernel dispatch),
+#: ``shard_put`` (each per-shard device_put inside a sharded delivery),
+#: ``device_put`` (H2D dispatch of ordinary tensors), ``consumer`` (the
+#: training step between yields).
+_STAGES = ("decode", "queue_wait", "wait", "raw_stage", "device_decode",
+           "shard_put", "device_put", "consumer")
+
+#: Stages that are device-dispatch work (the ledger ``device_dispatch_s``
+#: sums and the overlap gauge measures). ``shard_put`` is excluded: its
+#: observations happen INSIDE the raw_stage/device_put windows (one per
+#: target device) — summing it too would double-count.
+_DISPATCH_STAGES = ("raw_stage", "device_decode", "device_put")
 
 #: Per-process loader instance ids — the ``loader`` label value, so each
 #: loader's series are separable in a scrape and the legacy per-iteration
@@ -69,6 +88,7 @@ def _release_loader_metrics(loader_id):
     """weakref.finalize callback: retire a dead loader's series."""
     LOADER_BATCHES.remove(loader_id)
     LOADER_ROWS.remove(loader_id)
+    LOADER_DISPATCH_OVERLAP.remove(loader_id)
     for stage in _STAGES:
         LOADER_STAGE_SECONDS.remove(loader_id, stage)
     _LOADER_ID_POOL.append(loader_id)
@@ -101,7 +121,8 @@ def make_jax_dataloader(reader, batch_size,
                         shuffle_seed=None,
                         stage_in_producer=False,
                         trace_path=None,
-                        batch_cache=None):
+                        batch_cache=None,
+                        device_stage=None):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -120,7 +141,13 @@ def make_jax_dataloader(reader, batch_size,
         ``make_array_from_process_local_data``.
     :param host_prefetch: bounded host-queue depth (collated numpy batches).
     :param device_prefetch: how many batches to keep in-flight on device
-        (≥2 ⇒ double buffering).
+        (≥2 ⇒ double buffering). HBM cost: every in-flight batch is
+        device-resident, so deep prefetch holds up to
+        ``device_prefetch × batch_bytes`` of HBM beyond the model's
+        working set (2× that under ``stage_in_producer``, which adds a
+        device-resident queue of the same depth) — the loader drops its
+        own references the moment a batch is consumed, so this bound is
+        tight: raise it for jitter absorption only as HBM allows.
     :param non_tensor_policy: "host" | "drop" | "error" for object-dtype
         columns.
     :param stage_to_device: False ⇒ yield plain numpy dicts (no JAX import;
@@ -156,6 +183,15 @@ def make_jax_dataloader(reader, batch_size,
         Requires deterministic order: ``shuffle_buffer_size=0`` and a
         reader constructed with ``shuffle_row_groups=False``
         (``docs/guides/caching.md``).
+    :param device_stage: a :class:`~petastorm_tpu.jax_utils.DeviceStage`
+        (or ``None``). When armed, the loader stages each batch's raw
+        uint8 image fields AS BYTES (4x fewer H2D bytes than float32
+        pixels) and a fused JIT kernel performs cast/normalize/crop/flip
+        ON the device, with the raw buffer donated to the kernel on
+        TPU/GPU so in-flight HBM stays bounded. With ``sharding``, the raw
+        batch is delivered shard-by-shard directly onto each target device
+        and decoded as one global array (``docs/guides/device_decode.md``).
+        Requires ``stage_to_device=True``.
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -167,7 +203,8 @@ def make_jax_dataloader(reader, batch_size,
                          shuffle_seed=shuffle_seed,
                          stage_in_producer=stage_in_producer,
                          trace_path=trace_path,
-                         batch_cache=batch_cache)
+                         batch_cache=batch_cache,
+                         device_stage=device_stage)
 
 
 class JaxDataLoader:
@@ -178,9 +215,15 @@ class JaxDataLoader:
                  device_prefetch=2, non_tensor_policy="host",
                  stage_to_device=True, shuffle_buffer_size=0,
                  shuffle_seed=None, stage_in_producer=False,
-                 batch_source=None, trace_path=None, batch_cache=None):
+                 batch_source=None, trace_path=None, batch_cache=None,
+                 device_stage=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
+        if device_stage is not None and not stage_to_device:
+            raise ValueError(
+                "device_stage decodes ON the device; it cannot run with "
+                "stage_to_device=False (the numpy-only path never touches "
+                "a device) — drop the stage or enable device staging")
         if stage_in_producer and sharding is not None:
             raise ValueError(
                 "stage_in_producer is not supported with a global sharding "
@@ -240,6 +283,17 @@ class JaxDataLoader:
         # source's concern, not this class's.
         self._batch_source = batch_source
         self._batch_cache = batch_cache
+        self._device_stage = device_stage
+        # Production ordinal of the next staged batch — the device stage's
+        # augment seed. Monotonic across iterations (epoch 2 draws fresh
+        # augments) and assigned in production order on whichever thread
+        # stages, so the augment sequence is reproducible across runs and
+        # invariant to device_prefetch depth / stage_in_producer placement.
+        self._stage_step = 0
+        # Cumulative H2D payload bytes this loader staged (raw bytes + ordinary
+        # tensors); the per-iteration diagnostics view re-bases like the
+        # registry-backed stages.
+        self._h2d_bytes = 0
         # A cache fill is valid ONLY from the reader's start position —
         # i.e. the first pass this loader ever pulls from it. Set when
         # that pass begins and never cleared: any later cache miss
@@ -291,6 +345,7 @@ class JaxDataLoader:
         self._m_stage = {stage: LOADER_STAGE_SECONDS.labels(self._loader_id,
                                                             stage)
                          for stage in _STAGES}
+        self._m_overlap = LOADER_DISPATCH_OVERLAP.labels(self._loader_id)
         import weakref
 
         self._metrics_finalizer = weakref.finalize(
@@ -313,6 +368,7 @@ class JaxDataLoader:
         return {
             "batches": self._m_batches.value,
             "rows": self._m_rows.value,
+            "h2d_bytes": self._h2d_bytes,
             "stage": {stage: child.sum
                       for stage, child in self._m_stage.items()},
         }
@@ -322,8 +378,11 @@ class JaxDataLoader:
         """Per-iteration pipeline counters, derived live from the metrics
         registry (``docs/guides/diagnostics.md``): ``batches``/``rows``
         yielded, the per-stage time breakdown (``producer_decode_s``,
-        ``producer_queue_wait_s``, ``device_dispatch_s``, ``stall_s``,
-        ``consumer_s``), and ``wall_s`` / ``input_stall_pct`` — the
+        ``producer_queue_wait_s``, ``device_dispatch_s`` with its
+        device-stage components ``raw_stage_s``/``device_decode_s``/
+        ``shard_put_s``, ``stall_s``, ``consumer_s``), the dispatch
+        ledger's ``dispatch_overlap_pct`` and staged ``h2d_bytes``, and
+        ``wall_s`` / ``input_stall_pct`` — the
         north-star metric — computed **at read time**, so a monitoring
         thread polling mid-epoch sees this epoch's live stall percentage,
         not the previous iteration's frozen one. ``source`` carries the
@@ -336,6 +395,22 @@ class JaxDataLoader:
         stage = {name: max(0.0, child.sum - base["stage"][name])
                  for name, child in self._m_stage.items()}
         stall = stage["wait"]
+        # Dispatch ledger: every device-dispatch stage (plain device_put,
+        # raw-bytes staging, the fused on-device decode). The overlap gauge
+        # reports how much of it rode inside the pipeline's OTHER work —
+        # the producer's decode windows or the consumer's step window
+        # (stage_in_producer dispatches inside the step wait) — instead of
+        # extending the wall; 100 means dispatch is fully hidden. Crediting
+        # only decode would misread the paced stage_in_producer regime as
+        # 0% overlap while input_stall_pct ≈ 0 shows dispatch extended
+        # nothing.
+        dispatch = sum(stage[name] for name in _DISPATCH_STAGES)
+        overlap_pct = (
+            round(100.0 * max(0.0, min(1.0, (stage["decode"]
+                                             + stage["consumer"] + dispatch
+                                             - wall) / dispatch)), 2)
+            if dispatch > 0 else 100.0)
+        self._m_overlap.set(overlap_pct)
         out = {
             "batches": int(self._m_batches.value - base["batches"]),
             "rows": int(self._m_rows.value - base["rows"]),
@@ -347,7 +422,14 @@ class JaxDataLoader:
             # per-stage breakdown (stall root-causing):
             "producer_decode_s": stage["decode"],   # reader pull + collation
             "producer_queue_wait_s": stage["queue_wait"],
-            "device_dispatch_s": stage["device_put"],
+            "device_dispatch_s": dispatch,
+            "raw_stage_s": stage["raw_stage"],
+            "device_decode_s": stage["device_decode"],
+            "shard_put_s": stage["shard_put"],
+            "dispatch_overlap_pct": overlap_pct,
+            # H2D payload bytes staged this iteration (raw uint8 bytes when
+            # a device stage is armed — the uint8-vs-float32 ledger).
+            "h2d_bytes": int(self._h2d_bytes - base["h2d_bytes"]),
             # Time the CONSUMER spends between taking a batch and asking
             # for the next (its step dispatch + device wait) — the other
             # side of the ledger from stall_s: wall ≈ stall_s + consumer_s
@@ -415,6 +497,10 @@ class JaxDataLoader:
                         break
                     except queue.Full:
                         continue
+                # Drop the producer's reference the moment the queue owns
+                # the batch: while the producer blocks on a full queue for
+                # the NEXT batch, it must not pin a consumed one alive.
+                batch = None
                 self._m_stage["queue_wait"].observe(
                     time.perf_counter() - t0)
                 if self._stop.is_set():
@@ -547,9 +633,10 @@ class JaxDataLoader:
                     break
                 t0 = time.perf_counter()
                 with _trace_span("petastorm_tpu.loader.device_put"):
+                    # _stage observes the dispatch-stage histograms itself
+                    # (device_put / raw_stage / device_decode).
                     batch = self._stage(batch)
                 t1 = time.perf_counter()
-                self._m_stage["device_put"].observe(t1 - t0)
                 if tracing.COLLECTOR.enabled:
                     tracing.COLLECTOR.record_span("loader.device_put",
                                                   t0, t1)
@@ -559,6 +646,11 @@ class JaxDataLoader:
                         break
                     except queue.Full:
                         continue
+                # The batch is DEVICE-resident here: a lingering reference
+                # while this thread blocks on the bounded device queue
+                # would hold one extra batch of HBM beyond the
+                # device_prefetch budget.
+                batch = None
         except Exception as exc:  # surfaced on the consumer side
             self._producer_error = exc
         finally:
@@ -731,12 +823,17 @@ class JaxDataLoader:
                     else:
                         t0 = time.perf_counter()
                         with _trace_span("petastorm_tpu.loader.device_put"):
+                            # _stage observes the dispatch-stage histograms
+                            # itself (device_put/raw_stage/device_decode).
                             inflight.append(self._stage(host_batch))
                         t1 = time.perf_counter()
-                        self._m_stage["device_put"].observe(t1 - t0)
                         if collector.enabled:
                             collector.record_span("loader.device_put",
                                                   t0, t1, bid=bid)
+                    # Release the host copy now that the device owns one:
+                    # keeping it across further fill iterations would pin
+                    # up to device_prefetch extra host batches.
+                    host_batch = None
                     inflight_bids.append(bid)
                 if not inflight:
                     return
@@ -755,6 +852,11 @@ class JaxDataLoader:
                 t_yield = time.perf_counter()
                 yield batch
                 t_back = time.perf_counter()
+                # Drop the loader's reference to the consumed batch BEFORE
+                # dispatching the next fill: if the consumer's step donated
+                # (or discarded) these buffers, a lingering reference here
+                # would pin one extra batch of HBM per deep-prefetch slot.
+                batch = None
                 self._m_stage["consumer"].observe(t_back - t_yield)
                 if collector.enabled:
                     collector.record_span("loader.consumer", t_yield,
@@ -766,6 +868,11 @@ class JaxDataLoader:
             # lands in the stage breakdown, so one diagnostics dict
             # root-causes a stall across the whole delivery path.
             self._snapshot_source_diagnostics()
+            # Reading diagnostics refreshes the dispatch-overlap gauge, so
+            # a scrape-only consumer (metrics server armed, dict never
+            # read) still sees the iteration's final overlap, not the
+            # gauge's 0.0 birth value.
+            self.diagnostics
             if self._trace_path is not None:
                 collector.export(self._trace_path)
                 # Balance the __iter__ acquire: collection stops when the
@@ -804,12 +911,33 @@ class JaxDataLoader:
         return 0
 
     def _stage(self, host_batch):
-        """Numpy batch dict → device (or pass through when staging is off)."""
+        """Numpy batch dict → device (or pass through when staging is off).
+
+        With a :class:`DeviceStage` armed the image fields take the raw
+        path instead: stage the uint8 BYTES (timed as ``raw_stage``; 4x
+        fewer H2D bytes than float32 pixels), then dispatch the fused
+        on-device decode/augment kernel (timed as ``device_decode``) which
+        the stage donates its raw input to — the loader drops its own raw
+        references immediately, so in-flight HBM is the decoded outputs
+        plus at most one raw batch.
+        """
         if not self._stage_to_device:
             return host_batch
         import jax
 
+        from petastorm_tpu.jax_utils.sharding import (
+            local_data_to_global_array,
+        )
+
+        raw = {}
+        if self._device_stage is not None:
+            raw, host_batch = self._device_stage.split(host_batch)
         out, tensors = {}, {}
+        # All dispatch timing lives HERE (not in the callers): the
+        # ``device_put`` stage is the plain-tensor put time only, so the
+        # dispatch ledger (device_put + raw_stage + device_decode) never
+        # double-counts.
+        put_s = 0.0
         for name, col in host_batch.items():
             arr = np.asarray(col)
             if arr.dtype == object or arr.dtype.kind in ("U", "S", "M", "m"):
@@ -823,18 +951,48 @@ class JaxDataLoader:
                 out[name] = arr  # host-side passthrough
                 continue
             if self._sharding is not None:
-                from petastorm_tpu.jax_utils.sharding import (
-                    local_data_to_global_array,
-                )
-
-                out[name] = local_data_to_global_array(self._sharding, arr)
+                self._h2d_bytes += arr.nbytes
+                t0 = time.perf_counter()
+                out[name] = local_data_to_global_array(
+                    self._sharding, arr,
+                    observe_shard_put=self._m_stage["shard_put"].observe)
+                put_s += time.perf_counter() - t0
             else:
                 tensors[name] = arr
         if tensors:
             # One device_put for the whole batch pytree: one dispatch, and the
             # runtime can batch the transfers.
             device = self._device or jax.local_devices()[0]
+            self._h2d_bytes += sum(a.nbytes for a in tensors.values())
+            t0 = time.perf_counter()
             out.update(jax.device_put(tensors, device))
+            put_s += time.perf_counter() - t0
+        self._m_stage["device_put"].observe(put_s)
+        if raw:
+            step = self._stage_step
+            self._stage_step += 1
+            observe_shard = self._m_stage["shard_put"].observe
+            t0 = time.perf_counter()
+            with _trace_span("petastorm_tpu.loader.raw_stage"):
+                if self._sharding is not None:
+                    raw_dev = {
+                        name: local_data_to_global_array(
+                            self._sharding, arr,
+                            observe_shard_put=observe_shard)
+                        for name, arr in raw.items()}
+                else:
+                    device = self._device or jax.local_devices()[0]
+                    raw_dev = jax.device_put(raw, device)
+            self._m_stage["raw_stage"].observe(time.perf_counter() - t0)
+            raw_bytes = sum(a.nbytes for a in raw.values())
+            self._h2d_bytes += raw_bytes
+            self._device_stage.h2d_bytes += raw_bytes
+            raw = None  # the kernel owns (and may donate) the raw buffers
+            t0 = time.perf_counter()
+            with _trace_span("petastorm_tpu.loader.device_decode"):
+                out.update(self._device_stage.apply(raw_dev, step))
+            raw_dev = None  # donated to the kernel — drop ours immediately
+            self._m_stage["device_decode"].observe(time.perf_counter() - t0)
         return out
 
     # -- checkpoint / resume ----------------------------------------------

@@ -124,9 +124,22 @@ LOADER_ROWS = REGISTRY.counter(
 LOADER_STAGE_SECONDS = REGISTRY.histogram(
     "petastorm_loader_stage_seconds",
     "Per-batch time in each loader pipeline stage (decode, queue_wait, "
-    "wait, device_put, consumer) — the legacy diagnostics stage sums are "
-    "derived from these series",
+    "wait, raw_stage, device_decode, shard_put, device_put, consumer) — "
+    "the legacy diagnostics stage sums are derived from these series. "
+    "raw_stage = staging the raw uint8 bytes batch onto the device(s), "
+    "device_decode = the fused on-device decode/augment kernel dispatch, "
+    "shard_put = each per-shard device_put inside a sharded delivery "
+    "(observed once per target device per batch)",
     labels=("loader", "stage"))
+LOADER_DISPATCH_OVERLAP = REGISTRY.gauge(
+    "petastorm_loader_dispatch_overlap_pct",
+    "Share of the loader's device-dispatch time that rode inside the "
+    "producer's decode windows or the consumer's step window instead of "
+    "extending the wall ((decode + consumer + dispatch - wall) / "
+    "dispatch, clipped to [0, 100]; refreshed on every diagnostics read "
+    "and at iteration end) — 100 means H2D staging and on-device decode "
+    "are fully hidden behind decode/compute",
+    labels=("loader",))
 
 # -- decoded-batch cache (cache_impl/batch_cache.py) -------------------------
 

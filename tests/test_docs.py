@@ -99,6 +99,7 @@ def test_documented_apis_exist():
         make_reader,
     )
     from petastorm_tpu.jax_utils import (  # noqa: F401
+        DeviceStage,
         batch_sharding,
         global_step_count,
     )

@@ -40,6 +40,28 @@ def test_image_scenario_reports_both_decode_paths():
     assert 0 <= result["loader_input_stall_pct"] <= 100
 
 
+def test_image_scenario_device_stage_leg(tmp_path):
+    json_out = tmp_path / "image_bench.json"
+    result = image_pipeline_scenario(rows=256, workers=2, batch_size=64,
+                                     device_stage="on", device_prefetch=3,
+                                     json_out=str(json_out))
+    assert result["device_stage"] == "on"
+    assert result["device_prefetch"] == 3
+    assert result["device_stage_images_per_sec"] > 0
+    assert 0 <= result["device_stage_input_stall_pct"] <= 100
+    assert 0 <= result["dispatch_overlap_pct"] <= 100
+    # uint8 staged: ~image bytes + the int32 label, nowhere near float32.
+    img_bytes = 64 * 64 * 3
+    assert img_bytes <= result["h2d_bytes_per_image"] < img_bytes * 2
+    # knobs surface in the --json-out line (BENCH trajectory contract)
+    assert json.loads(json_out.read_text().strip()) == result
+
+
+def test_image_scenario_rejects_bad_device_stage():
+    with pytest.raises(ValueError, match="on|off"):
+        image_pipeline_scenario(rows=64, batch_size=32, device_stage="wat")
+
+
 def test_weighted_scenario_tracks_target_mix():
     result = weighted_mixing_scenario(rows=2048, workers=1,
                                       weights=(0.75, 0.25))
@@ -114,6 +136,24 @@ def test_scenario_cli_rejects_knobs_the_scenario_lacks(capsys):
     with pytest.raises(SystemExit):
         main(["scenario", "ngram", "--batch-size", "64"])
     assert "not a knob" in capsys.readouterr().err
+
+
+def test_scenario_cli_forwards_device_stage_knobs(capsys, monkeypatch):
+    import petastorm_tpu.benchmark.scenarios as scenarios
+
+    seen = {}
+
+    def fake(dataset_url=None, workers=3, device_stage="off",
+             device_prefetch=2):
+        seen.update(device_stage=device_stage,
+                    device_prefetch=device_prefetch)
+        return {"ok": True}
+
+    monkeypatch.setitem(scenarios.SCENARIOS, "image", fake)
+    assert main(["scenario", "image", "--device-stage", "on",
+                 "--device-prefetch", "4"]) == 0
+    assert seen == {"device_stage": "on", "device_prefetch": 4}
+    assert json.loads(capsys.readouterr().out.strip()) == {"ok": True}
 
 
 def test_scenario_cli_forwards_service_knobs(capsys, monkeypatch):

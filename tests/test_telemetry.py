@@ -564,6 +564,11 @@ def test_loader_metric_series_recycled_on_gc():
     def source():
         return iter([{"x": np.zeros(2)}])
 
+    # Flush OTHER tests' pending cyclic garbage first (an abandoned loader
+    # generator is a frame<->loader cycle): collected later, inside this
+    # test's gc.collect(), its finalizer would land a different id on top
+    # of the LIFO pool and the reuse assertion below turns order-dependent.
+    gc.collect()
     loader = JaxDataLoader(None, 2, batch_source=source,
                            stage_to_device=False)
     with loader:
