@@ -406,6 +406,62 @@ def leg_cached_epochs(url):
 
 
 # --------------------------------------------------------------------------
+# Slow-worker epoch-wall A/B (docs/guides/service.md#sharding-modes): the
+# service scenario with one worker skewed 50 ms/batch under static vs
+# dynamic sharding, against the no-skew wall. Static is slow-worker-bound
+# by construction (the straggler's fixed share sets the wall at ~2x);
+# dynamic work-stealing drains the straggler's backlog onto the fast
+# worker, so its wall should land near the no-skew wall.
+# --------------------------------------------------------------------------
+
+def leg_skewed_service(url):
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    def run(mode, skew_ms):
+        # days=32: ~625-row pieces (2 batches each) — the steal granularity
+        # the rebalancer trades in; a started piece is committed to its
+        # worker, so smaller pieces shrink the straggler's unsheddable tail.
+        r = service_loopback_scenario(rows=20_000, days=32, workers=2,
+                                      batch_size=512, mode=mode,
+                                      skew_ms=skew_ms)
+        return {
+            "epoch_wall_s": r["service_wall_s"],
+            "rows_per_sec": r["service_rows_per_sec"],
+            "time_to_half_rows_s": r["time_to_half_rows_s"],
+            "per_worker_pieces": r["per_worker_pieces"],
+            "steals_applied": r.get("steals_applied"),
+        }
+
+    # Interleaved best-of-3 rounds: loopback walls are host-weather
+    # sensitive, and interleaving means drift hits every mode alike
+    # instead of biasing whichever leg ran last.
+    best = {}
+    for _ in range(3):
+        for name, mode, skew in (("no_skew", "static", 0.0),
+                                 ("static_skewed", "static", 50.0),
+                                 ("dynamic_skewed", "dynamic", 50.0)):
+            result = run(mode, skew)
+            if (name not in best
+                    or result["epoch_wall_s"] < best[name]["epoch_wall_s"]):
+                best[name] = result
+    no_skew, static, dynamic = (best["no_skew"], best["static_skewed"],
+                                best["dynamic_skewed"])
+    return {
+        "skew_ms": 50.0,
+        "workers": 2,
+        "no_skew": no_skew,
+        "static_skewed": static,
+        "dynamic_skewed": dynamic,
+        "static_wall_vs_no_skew": round(
+            static["epoch_wall_s"] / no_skew["epoch_wall_s"], 2),
+        "dynamic_wall_vs_no_skew": round(
+            dynamic["epoch_wall_s"] / no_skew["epoch_wall_s"], 2),
+        "dynamic_vs_static_wall_speedup": round(
+            static["epoch_wall_s"] / dynamic["epoch_wall_s"], 2),
+    }
+
+
+# --------------------------------------------------------------------------
 # Device decode stage A/B (docs/guides/device_decode.md): the SAME dataset
 # through the same loader + model step, with the last decode stages
 # (cast + normalize) either fused ON-DEVICE over a raw uint8 staging
@@ -1250,6 +1306,7 @@ LEGS = {
     "sync_columnar": leg_sync_columnar,
     "pipelined": leg_pipelined,
     "cached_epochs": leg_cached_epochs,
+    "skewed_service": leg_skewed_service,
     "device_decode": leg_device_decode,
     "realstep": leg_realstep,
     "flash_oracle": leg_flash_oracle,
@@ -1262,7 +1319,7 @@ LEGS = {
 # Legs that measure evidence, not throughput: run ONCE outside the
 # best-of-ROUNDS loop (numerics and OOM ceilings are not host-weather).
 ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
-                "multichip_child", "multichip_scaling")
+                "multichip_child", "multichip_scaling", "skewed_service")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
@@ -1322,7 +1379,9 @@ def main():
         flash_numerics = _run_leg_subprocess("flash_numerics", url)
         flash_memory = _run_leg_subprocess("flash_memsweep", url)
         multichip = _run_leg_subprocess("multichip_scaling", url)
-        for extra in (flash_numerics, flash_memory, multichip):
+        skewed_service = _run_leg_subprocess("skewed_service", url)
+        for extra in (flash_numerics, flash_memory, multichip,
+                      skewed_service):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -1407,6 +1466,10 @@ def main():
             # (virtual CPU mesh on this single-chip host; near-linear
             # scaling needs >= 8 host cores — host_cores discloses).
             "multichip_scaling": multichip,
+            # Slow-worker epoch wall under static vs dynamic sharding
+            # (work-stealing piece rebalancing): dynamic_wall_vs_no_skew
+            # is the kill-the-epoch-wall number tracked in BENCH_r06+.
+            "skewed_service": skewed_service,
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),

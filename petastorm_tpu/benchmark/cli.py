@@ -75,9 +75,15 @@ def _scenario_main(argv):
                              "fleet size)")
     parser.add_argument("--batch-size", type=int, default=None,
                         help="rows per batch (scenarios that batch)")
+    parser.add_argument("--sharding", default=None,
+                        choices=["static", "fcfs", "dynamic"],
+                        help="service scenario sharding mode: static "
+                             "per-client splits, fcfs shared queue, or "
+                             "dynamic work-stealing piece rebalancing "
+                             "(docs/guides/service.md#sharding-modes)")
     parser.add_argument("--mode", default=None,
-                        choices=["static", "fcfs"],
-                        help="service scenario sharding mode")
+                        choices=["static", "fcfs", "dynamic"],
+                        help="legacy alias of --sharding")
     parser.add_argument("--skew-ms", type=float, default=None,
                         help="service scenario fault injection: delay one "
                              "worker this many ms per batch (head-of-line "
@@ -153,6 +159,7 @@ def _scenario_main(argv):
     accepted = set(inspect.signature(scenario).parameters)
     for name, flag, value in (
             ("batch_size", "--batch-size", args.batch_size),
+            ("sharding", "--sharding", args.sharding),
             ("mode", "--mode", args.mode),
             ("skew_ms", "--skew-ms", args.skew_ms),
             ("credits", "--credits", args.credits),

@@ -585,7 +585,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               chaos_interval_s=1.5, chaos_max_events=4,
                               journal_dir=None, metrics_port=None,
                               trace_out=None, epochs=1, cache="off",
-                              cache_mem_mb=256.0, cache_dir=None):
+                              cache_mem_mb=256.0, cache_dir=None,
+                              sharding=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -655,15 +656,22 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                              dispatcher_restart_action,
                                              worker_kill_action)
 
+    # --sharding is the canonical knob name (static|fcfs|dynamic); `mode`
+    # stays as the original spelling.
+    mode = sharding or mode
+    if mode not in ("static", "fcfs", "dynamic"):
+        raise ValueError(
+            f"sharding must be static|fcfs|dynamic, got {mode!r}")
     chaos_kinds = ([k.strip() for k in chaos.split(",") if k.strip()]
                    if isinstance(chaos, str) else list(chaos or []))
     for kind in chaos_kinds:
         if kind not in CHAOS_KINDS:
             raise ValueError(
                 f"unknown chaos kind {kind!r}; choose from {CHAOS_KINDS}")
-    if chaos_kinds and mode != "static":
-        raise ValueError("chaos invariants need static sharding (fcfs has "
-                         "no per-client delivery contract to check)")
+    if chaos_kinds and mode == "fcfs":
+        raise ValueError("chaos invariants need static or dynamic sharding "
+                         "(fcfs has no per-client delivery contract to "
+                         "check)")
     if chaos_kinds and dataset_url is not None:
         raise ValueError(
             "chaos delivery invariants are checked against the scenario's "
@@ -674,11 +682,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
 
     if epochs < 1:
         raise ValueError("epochs must be >= 1")
-    if epochs > 1 and mode != "static":
+    if epochs > 1 and mode == "fcfs":
         raise ValueError(
-            "--epochs > 1 requires static sharding: fcfs clients report no "
-            "per-client epoch boundaries, so the per-epoch breakdown would "
-            "silently lump every epoch into one row")
+            "--epochs > 1 requires static or dynamic sharding: fcfs "
+            "clients report no per-client epoch boundaries, so the "
+            "per-epoch breakdown would silently lump every epoch into one "
+            "row — use --sharding dynamic for multi-epoch streams with "
+            "work-stealing rebalancing")
     cache_tmp = None
     if cache == "mem+disk" and cache_dir is None:
         # One SHARED disk tier for the whole fleet (atomic-rename writes
@@ -748,7 +758,12 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 reader_kwargs={"workers_count": 2}).start())
         source = ServiceBatchSource(
             dispatcher_holder[0].address, credits=credits,
-            heartbeat_interval_s=0.3 if chaos_kinds else 2.0)
+            heartbeat_interval_s=0.3 if chaos_kinds else 2.0,
+            # Snappy rebalance loop: steal latency is what the dynamic
+            # skew leg measures, and the sync RPC is a tiny control
+            # message (drained workers poke the loop anyway). Every 50 ms
+            # the straggler commits to ~1 more batch it could have shed.
+            dynamic_sync_interval_s=0.05)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False,
                                trace_path=trace_out or None)
@@ -891,7 +906,15 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "per_worker_stall_s": {
                 wid: counters["stall_s"]
                 for wid, counters in source_diag["per_worker"].items()},
+            "per_worker_pieces": {
+                wid: counters.get("pieces", 0)
+                for wid, counters in source_diag["per_worker"].items()},
         }
+        if mode == "dynamic":
+            recovery = source_diag.get("recovery", {})
+            result["steals_applied"] = recovery.get("steals_applied", 0)
+            result["steals_failed"] = recovery.get("steals_failed", 0)
+            result["dedup_dropped"] = recovery.get("dedup_dropped", 0)
         if cache != "off":
             totals = fleet_cache_totals() or (0, 0)
             per_worker_stats = [w.cache_stats() for w in fleet]

@@ -109,17 +109,22 @@ class ArrowResultsQueueReader:
     def __init__(self):
         self._buffer = deque()
         self.delivery_tracker = None  # set by Reader for resumable iteration
+        #: Work-item tag of the most recently returned output (``"piece:
+        #: drop_partition"``) — consumers that attribute outputs per piece
+        #: (the streaming piece engine) read it right after ``read_next``.
+        self.last_item_key = None
 
     @property
     def batched_output(self):
         return True
 
-    def read_next(self, pool, schema, ngram):
-        table = pool.get_results()  # raises EmptyResultError at end of data
-        if self.delivery_tracker is not None:
-            key = read_table_tag(table)
-            if key is not None:
-                self.delivery_tracker.record(key, table.num_rows)
+    def read_next(self, pool, schema, ngram, timeout=None):
+        kwargs = {} if timeout is None else {"timeout": timeout}
+        table = pool.get_results(**kwargs)  # raises EmptyResultError at end
+        key = read_table_tag(table)
+        self.last_item_key = key
+        if self.delivery_tracker is not None and key is not None:
+            self.delivery_tracker.record(key, table.num_rows)
         return table_to_batch(table, schema)
 
 

@@ -146,14 +146,19 @@ class ColumnarResultsQueueReader:
 
     def __init__(self):
         self.delivery_tracker = None  # set by Reader for resumable iteration
+        #: Work-item tag of the most recently returned column batch.
+        self.last_item_key = None
 
     @property
     def batched_output(self):
         return True
 
-    def read_next(self, pool, schema, ngram):
-        batch = pool.get_results()  # raises EmptyResultError at end of data
+    def read_next(self, pool, schema, ngram, timeout=None):
+        kwargs = {} if timeout is None else {"timeout": timeout}
+        batch = pool.get_results(**kwargs)  # raises EmptyResultError at end
+        self.last_item_key = None
         if isinstance(batch, PiecePayload):
+            self.last_item_key = batch.item_key
             if self.delivery_tracker is not None:
                 num_rows = len(next(iter(batch.payload.values()), ()))
                 self.delivery_tracker.record(batch.item_key, num_rows)

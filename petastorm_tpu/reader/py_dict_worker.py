@@ -156,22 +156,29 @@ class PyDictResultsQueueReader:
         self._buffer = deque()
         self.delivery_tracker = None  # set by Reader for resumable iteration
         self._pending_item = None  # (item_key, num_rows) awaiting last row
+        #: Work-item tag of the payload the returned row came from — rows of
+        #: one payload drain contiguously (the buffer refills only when
+        #: empty), so the tag is valid for every row until the next refill.
+        self.last_item_key = None
 
     @property
     def batched_output(self):
         return False
 
-    def read_next(self, pool, schema, ngram):
+    def read_next(self, pool, schema, ngram, timeout=None):
+        kwargs = {} if timeout is None else {"timeout": timeout}
         while not self._buffer:
-            rows = pool.get_results()  # raises EmptyResultError at end of data
+            rows = pool.get_results(**kwargs)  # raises EmptyResultError at end
             if isinstance(rows, PiecePayload):
                 # Delivery is recorded only when the payload's LAST row is
                 # handed out (bottom of this method): rows still buffered at
                 # checkpoint time must be re-read on resume (at-least-once).
                 self._pending_item = (rows.item_key, len(rows.payload))
+                self.last_item_key = rows.item_key
                 rows = rows.payload
             else:
                 self._pending_item = None
+                self.last_item_key = None
             # Convert the whole delivered row-group at once: namedtuple
             # construction via map(row.get, fields) is the consumer's hot
             # loop and caps pool throughput (it is serial no matter how many

@@ -69,7 +69,8 @@ def make_reader(dataset_url,
                 reader_engine=None,
                 resume_state=None,
                 fast_gcs_listing=True,
-                piece_indices=None):
+                piece_indices=None,
+                dynamic_ventilation=False):
     """Reader for **petastorm-format** datasets (Unischema + codecs attached).
 
     Reference parity: ``petastorm/reader.py::make_reader`` — same knob surface.
@@ -128,7 +129,8 @@ def make_reader(dataset_url,
                   transform_spec=transform_spec,
                   filters=filters,
                   resume_state=resume_state,
-                  piece_indices=piece_indices)
+                  piece_indices=piece_indices,
+                  dynamic_ventilation=dynamic_ventilation)
 
 
 def make_columnar_reader(dataset_url,
@@ -152,7 +154,8 @@ def make_columnar_reader(dataset_url,
                          filesystem=None,
                          resume_state=None,
                          fast_gcs_listing=True,
-                         piece_indices=None):
+                         piece_indices=None,
+                         dynamic_ventilation=False):
     """Columnar reader for **petastorm-format** datasets — the TPU-native
     fast path feeding :func:`petastorm_tpu.jax_utils.make_jax_dataloader`.
 
@@ -216,7 +219,8 @@ def make_columnar_reader(dataset_url,
                   transform_spec=transform_spec,
                   filters=filters,
                   resume_state=resume_state,
-                  piece_indices=piece_indices)
+                  piece_indices=piece_indices,
+                  dynamic_ventilation=dynamic_ventilation)
 
 
 def make_batch_reader(dataset_url_or_urls,
@@ -239,7 +243,8 @@ def make_batch_reader(dataset_url_or_urls,
                       filesystem=None,
                       resume_state=None,
                       fast_gcs_listing=True,
-                      piece_indices=None):
+                      piece_indices=None,
+                      dynamic_ventilation=False):
     """Batch reader for **plain Parquet** stores (no petastorm metadata needed).
 
     Reference parity: ``petastorm/reader.py::make_batch_reader``. Yields
@@ -292,7 +297,8 @@ def make_batch_reader(dataset_url_or_urls,
                   transform_spec=transform_spec,
                   filters=filters,
                   resume_state=resume_state,
-                  piece_indices=piece_indices)
+                  piece_indices=piece_indices,
+                  dynamic_ventilation=dynamic_ventilation)
 
 
 def _default_shard_options(cur_shard, shard_count):
@@ -349,7 +355,8 @@ class Reader:
                  predicate=None, rowgroup_selector=None, num_epochs=1,
                  cur_shard=None, shard_count=None, shard_seed=None,
                  cache=None, transform_spec=None, filters=None,
-                 resume_state=None, piece_indices=None):
+                 resume_state=None, piece_indices=None,
+                 dynamic_ventilation=False):
         if predicate is not None and not isinstance(predicate, PredicateBase):
             raise ValueError("predicate must be an instance of PredicateBase")
         if (cur_shard is None) != (shard_count is None):
@@ -451,6 +458,24 @@ class Reader:
         from petastorm_tpu.reader_impl.delivery_tracker import (
             DeliveryTracker, item_key)
 
+        self._dynamic = dynamic_ventilation
+        if dynamic_ventilation:
+            # The externally-fed mode behind the service's streaming piece
+            # engine: the piece queue is owned by the caller (mutable
+            # mid-stream — work stealing appends/revokes), so pre-planned
+            # epochs, shuffling and resume trimming have no meaning here.
+            if resume_state is not None:
+                raise ValueError(
+                    "dynamic_ventilation readers have no pre-planned "
+                    "ventilation to trim — resume_state is not supported")
+            if shuffle_row_groups:
+                raise ValueError(
+                    "dynamic_ventilation serves an externally-ordered piece "
+                    "queue; shuffle_row_groups must be False")
+            if shuffle_row_drop_partitions != 1:
+                raise ValueError(
+                    "dynamic_ventilation does not support "
+                    "shuffle_row_drop_partitions")
         self._shard_seed = shard_seed
         self._shuffle_row_drop_partitions = shuffle_row_drop_partitions
         # filters/selector (and an explicit piece_indices plan) change which
@@ -482,15 +507,22 @@ class Reader:
         self._delivery_tracker = DeliveryTracker(preload=prior_counts)
         self._results_queue_reader.delivery_tracker = self._delivery_tracker
 
-        self._ventilator = ConcurrentVentilator(
-            self._workers_pool.ventilate,
-            items,
-            iterations=iterations if items else 1,
-            randomize_item_order=shuffle_row_groups,
-            random_seed=shard_seed,
-            max_ventilation_queue_size=min(len(items), 1000) or 1,
-            per_item_iterations=per_item_iterations,
-        )
+        if dynamic_ventilation:
+            from petastorm_tpu.workers_pool.ventilator import (
+                DynamicVentilator,
+            )
+
+            self._ventilator = DynamicVentilator(self._workers_pool.ventilate)
+        else:
+            self._ventilator = ConcurrentVentilator(
+                self._workers_pool.ventilate,
+                items,
+                iterations=iterations if items else 1,
+                randomize_item_order=shuffle_row_groups,
+                random_seed=shard_seed,
+                max_ventilation_queue_size=min(len(items), 1000) or 1,
+                per_item_iterations=per_item_iterations,
+            )
         # Kept as an attribute so lifecycle owners (``stop()``, the service
         # worker's drain) can release cache resources — a local-disk cache
         # with ``cleanup=True`` would otherwise leak its directory.
@@ -721,6 +753,61 @@ class Reader:
         self._delivery_tracker = DeliveryTracker()
         self._results_queue_reader.delivery_tracker = self._delivery_tracker
         self._ventilator.reset()
+
+    # --- dynamic piece feed (dynamic_ventilation=True readers) -----------
+
+    @property
+    def dynamic(self):
+        """True for externally-fed readers (``dynamic_ventilation=True``)."""
+        return self._dynamic
+
+    def _require_dynamic(self):
+        if not self._dynamic:
+            raise RuntimeError(
+                "this Reader was not constructed with "
+                "dynamic_ventilation=True")
+
+    def submit_piece(self, piece_index):
+        """Feed one planned piece (canonical enumeration index) into the
+        pool. Dynamic readers only; the caller owns admission control."""
+        self._require_dynamic()
+        piece_index = int(piece_index)
+        if not 0 <= piece_index < len(self._pieces):
+            raise ValueError(
+                f"piece_index {piece_index} out of range for the "
+                f"{len(self._pieces)} row-group pieces planned")
+        self._ventilator.submit({
+            "piece_index": piece_index,
+            "worker_predicate": self._predicate,
+            "shuffle_row_drop_partition": (0, 1)})
+
+    def finish_pieces(self):
+        """Declare the piece feed closed: once in-flight pieces drain, the
+        consumer sees end-of-data instead of blocking."""
+        self._require_dynamic()
+        self._ventilator.finish()
+
+    def set_item_done_hook(self, hook):
+        """Install ``hook(item_kwargs)``, fired on the consuming thread as
+        it drains a work item's completion marker — strictly after every
+        output of that item was returned (thread/dummy pools only)."""
+        self._require_dynamic()
+        if not getattr(self._workers_pool, "supports_item_done_hook", False):
+            raise ValueError(
+                "the streaming piece feed needs per-item completion "
+                "attribution, which only thread and dummy reader pools "
+                "provide — use reader_pool_type='thread' (or 'dummy')")
+        self._workers_pool.item_done_hook = hook
+
+    def read_next_tagged(self, timeout=None):
+        """``(next output, piece_index)`` — one reader output plus the
+        canonical index of the piece it came from (``None`` if untagged).
+        Raises the pool's timeout/end-of-data exceptions unchanged."""
+        out = self._results_queue_reader.read_next(
+            self._workers_pool, self.schema, self.ngram, timeout=timeout)
+        key = getattr(self._results_queue_reader, "last_item_key", None)
+        piece = int(key.split(":", 1)[0]) if key else None
+        return out, piece
 
 
 def enumerate_row_group_pieces(filesystem, dataset_path, filters=None):

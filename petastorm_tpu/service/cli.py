@@ -53,7 +53,12 @@ def _build_parser():
     disp.add_argument("--host", default="127.0.0.1")
     disp.add_argument("--port", type=int, default=7077,
                       help="0 picks a free port (printed on stdout)")
-    disp.add_argument("--mode", choices=["static", "fcfs"], default="static")
+    disp.add_argument("--mode", choices=["static", "fcfs", "dynamic"],
+                      default="static",
+                      help="split assignment: static per-client shards, "
+                           "fcfs shared queue, or dynamic work-stealing "
+                           "piece rebalancing (docs/guides/service.md"
+                           "#sharding-modes)")
     disp.add_argument("--num-epochs", type=int, default=1,
                       help="epochs to serve; 0 means serve forever")
     disp.add_argument("--journal-dir", default=None,
@@ -206,14 +211,29 @@ def render_fleet_status(prev, cur):
     dt = max(1e-9, cur["t"] - prev["t"])
     workers_state = status.get("workers", {})
     alive = sum(1 for w in workers_state.values() if w.get("alive"))
+    dynamic = status.get("dynamic") or {}
+    dyn_workers = dynamic.get("per_worker", {})
+    header = (f"mode={status.get('mode')} fencing_epoch="
+              f"{status.get('fencing_epoch')} workers={alive} alive/"
+              f"{len(workers_state) - alive} dead clients="
+              f"{len(status.get('clients', {}))} window={dt:.1f}s")
+    if dynamic:
+        header += f" generation={dynamic.get('generation')}"
     lines = [
-        f"mode={status.get('mode')} fencing_epoch="
-        f"{status.get('fencing_epoch')} workers={alive} alive/"
-        f"{len(workers_state) - alive} dead clients="
-        f"{len(status.get('clients', {}))} window={dt:.1f}s",
+        header,
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
-        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} {'CACHEHIT%':>10}",
+        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} {'CACHEHIT%':>10} "
+        f"{'STEALS':>9} {'BACKLOG':>8}",
     ]
+
+    def steal_cols(wid):
+        """Dynamic-mode steal/backlog columns (``in/out`` moves and the
+        pieces currently booked); ``--`` outside dynamic mode."""
+        entry = dyn_workers.get(wid)
+        if entry is None:
+            return f"{'--':>9} {'--':>8}"
+        steals = f"{entry['steals_in']}/{entry['steals_out']}"
+        return f"{steals:>9} {entry['backlog']:>8}"
     fleet_rows = fleet_batches = 0.0
     for wid in sorted(cur["workers"]):
         now = _worker_totals(cur, wid)
@@ -227,7 +247,8 @@ def render_fleet_status(prev, cur):
             # last poll): totals are real, rates are unknowable.
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
-                f"{'--':>13} {int(rows1):>12} {'--':>10}")
+                f"{'--':>13} {int(rows1):>12} {'--':>10} "
+                f"{steal_cols(wid)}")
             continue
         rows0, batches0, wait0, _, hits0, misses0 = before
         rows_rate = max(0.0, rows1 - rows0) / dt
@@ -247,7 +268,7 @@ def render_fleet_status(prev, cur):
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
             f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12} "
-            f"{hit_pct:>10}")
+            f"{hit_pct:>10} {steal_cols(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
     recovery = status.get("recovery") or {}

@@ -244,6 +244,183 @@ class _StreamReader(threading.Thread):
                 continue
 
 
+class _DynamicStream:
+    """One persistent dynamic-mode stream against one worker.
+
+    Unlike :class:`_WorkerStream`, the piece set is editable mid-stream:
+    :meth:`extend` appends steal grants, :meth:`revoke` asks the worker's
+    streaming engine to drop not-yet-sent pieces (acked with a ``revoked``
+    frame naming the subset actually removed), and :meth:`finish` closes
+    the queue so the worker drains and sends ``end``. All senders are
+    send-only and safe against the reader thread's concurrent ``recv``
+    (opposite directions of one socket, like credit replenishment); a
+    broken socket is swallowed — the receive path owns failure detection,
+    and every piece still outstanding on this worker is re-granted by the
+    takeover path when the stream reports broken."""
+
+    def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
+                 credits=None):
+        self.worker_id = worker_id
+        self.address = tuple(address)
+        self.pairs = list(pairs)          # initial [(piece, generation)]
+        self.epoch = epoch
+        self.credits = credits
+        self._connect_timeout = connect_timeout
+        self._conn = None
+        self._closed = False
+        self._send_lock = threading.Lock()
+        self._pre_conn = []  # control messages queued before the handshake
+
+    def _ensure_conn(self):
+        if self._closed:
+            raise ConnectionClosedError("stream closed")
+        with self._send_lock:
+            if self._conn is not None:
+                return self._conn
+            conn = FramedConnection.connect(
+                self.address, timeout=self._connect_timeout,
+                stream_timeout=None, keepalive=True)
+            if self._closed:
+                conn.close()
+                raise ConnectionClosedError("stream closed")
+            request = {"type": "stream", "dynamic": True,
+                       "pieces": [[int(p), int(g)] for p, g in self.pairs],
+                       "epoch": self.epoch}
+            if self.credits is not None:
+                request["credits"] = self.credits
+            try:
+                conn.send(request)
+                # Flush control traffic (extend/revoke/finish) that raced
+                # the handshake: the stream request always goes first, and
+                # queued edits follow in their original order.
+                for message in self._pre_conn:
+                    conn.send(message)
+            except BaseException:
+                conn.close()
+                raise
+            del self._pre_conn[:]
+            self._conn = conn
+            return self._conn
+
+    def next_event(self):
+        """``(kind, payload)`` — ``("batch", (piece, gen, payload, bid))``,
+        ``("piece_done", (piece, gen, rows))``, ``("revoked", (req,
+        pieces))``, or ``("end", None)``."""
+        conn = self._ensure_conn()
+        header, payload = conn.recv()
+        kind = header.get("type")
+        if kind == "batch":
+            return ("batch", (int(header.get("piece", -1)),
+                              int(header.get("generation", 0)),
+                              payload, header.get("bid")))
+        if kind == "piece_done":
+            return ("piece_done", (int(header["piece"]),
+                                   int(header.get("generation", 0)),
+                                   int(header.get("rows", 0))))
+        if kind == "revoked":
+            return ("revoked", (header.get("req"),
+                                [int(p) for p in header.get("pieces", [])]))
+        if kind == "end":
+            self.close()
+            return ("end", None)
+        if kind == "error":
+            raise ServiceError(
+                f"worker {self.worker_id} failed its dynamic stream: "
+                f"{header.get('error')}")
+        raise ServiceError(f"unexpected dynamic stream message {kind!r}")
+
+    def _send(self, message):
+        with self._send_lock:
+            if self._closed:
+                return
+            if self._conn is None:
+                # The reader thread has not dialed yet: queue the edit —
+                # dropping it would orphan a stolen piece (ownership maps
+                # already say this worker has it) and hang the epoch.
+                self._pre_conn.append(message)
+                return
+            try:
+                self._conn.send(message)
+            except OSError:
+                pass  # receive path detects and recovers the broken stream
+
+    def extend(self, pairs):
+        self._send({"type": "extend",
+                    "pieces": [[int(p), int(g)] for p, g in pairs]})
+
+    def revoke(self, pieces, req):
+        self._send({"type": "revoke", "pieces": [int(p) for p in pieces],
+                    "req": req})
+
+    def finish(self):
+        self._send({"type": "finish_pieces"})
+
+    def add_credit(self, n=1):
+        if self.credits is None:
+            return
+        self._send({"type": "credit", "n": n})
+
+    def close(self):
+        self._closed = True
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+class _DynamicStreamReader(threading.Thread):
+    """Receive loop of one dynamic stream: every event is posted to the
+    shared ready-queue as ``(kind, sid, item)`` — the dynamic analogue of
+    :class:`_StreamReader`, with the richer event vocabulary (``dbatch``,
+    ``piece_done``, ``revoked``, terminal ``end``/``broken``/``error``)."""
+
+    def __init__(self, sid, stream, ready, stop, note_recv):
+        super().__init__(daemon=True,
+                         name=f"service-dynstream-{stream.worker_id}")
+        self._sid = sid
+        self._stream = stream
+        self._ready = ready
+        self._stopped = stop
+        self._note_recv = note_recv
+
+    def run(self):
+        collector = tracing.COLLECTOR
+        try:
+            while not self._stopped.is_set():
+                t0 = time.perf_counter()
+                try:
+                    kind, item = self._stream.next_event()
+                except (ConnectionClosedError, ConnectionError,
+                        OSError) as exc:
+                    if not self._stopped.is_set():
+                        self._put(("broken", self._sid, exc))
+                    return
+                t1 = time.perf_counter()
+                self._note_recv(self._stream.worker_id, t1 - t0,
+                                kind == "batch")
+                if kind == "end":
+                    self._put(("end", self._sid, None))
+                    return
+                if kind == "batch":
+                    piece, gen, payload, bid = item
+                    if collector.enabled:
+                        collector.record_span("client.recv", t0, t1,
+                                              bid=bid)
+                    self._put(("dbatch", self._sid,
+                               (piece, gen, payload, bid, t1)))
+                else:  # piece_done / revoked
+                    self._put((kind, self._sid, item))
+        except BaseException as exc:
+            self._put(("error", self._sid, exc))
+
+    def _put(self, event):
+        while not self._stopped.is_set():
+            try:
+                self._ready.put(event, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+
 class ServiceBatchSource:
     """Stream remote batches from a dispatcher's worker fleet.
 
@@ -280,13 +457,20 @@ class ServiceBatchSource:
         bounds how long a dispatcher outage can stall a control call.
     :param max_frame_bytes: receive frame cap for this client's
         connections (``None`` = the module default).
+    :param dynamic_sync_interval_s: dynamic mode only — how often the
+        rebalance loop reports progress/backlog to the dispatcher and
+        applies the steal deltas it replies with. A drained worker also
+        pokes the loop immediately, so steal latency is not bounded by
+        this interval; it mostly caps how stale the dispatcher's
+        backlog/rate view may get.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
                  client_id=None, connect_timeout=10.0, max_retries=3,
                  backoff_base=0.05, backoff_max=2.0, resume_state=None,
                  credits=8, ready_queue_depth=None, heartbeat_interval_s=2.0,
-                 rpc_deadline_s=30.0, max_frame_bytes=None):
+                 rpc_deadline_s=30.0, max_frame_bytes=None,
+                 dynamic_sync_interval_s=0.25):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if ready_queue_depth is not None and ready_queue_depth < 1:
@@ -306,6 +490,7 @@ class ServiceBatchSource:
         self._heartbeat_interval_s = heartbeat_interval_s
         self._rpc_deadline_s = rpc_deadline_s
         self._max_frame_bytes = max_frame_bytes
+        self._dynamic_sync_interval_s = dynamic_sync_interval_s
         self._ready_queue = None      # live queue while a drain is active
         self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
@@ -331,6 +516,9 @@ class ServiceBatchSource:
             "takeovers": 0,           # dead-worker piece re-assignments
             "stale_fencing_retries": 0,
             "heartbeat_failures": 0,  # dispatcher unreachable at a tick
+            "steals_applied": 0,      # dynamic: revoke-ack'd piece moves
+            "steals_failed": 0,       # dynamic: steals the donor beat
+            "dedup_dropped": 0,       # dynamic: stale-generation batches
             "fencing_epoch": 0,       # last fencing epoch observed
             "dispatcher": {},         # dispatcher recovery counters (last
         }                             # heartbeat reply)
@@ -406,13 +594,16 @@ class ServiceBatchSource:
             # The multiplexed drain prefetches into its ready-queue behind
             # reader threads — consumers may pull it directly.
             return _SourceIterator(self._iter_static(info), prefetched=True)
+        if info["mode"] == "dynamic":
+            return _SourceIterator(self._iter_dynamic(info),
+                                   prefetched=True)
         if self._resumed:
             raise ValueError(
                 "resume_state was supplied but the dispatcher is in fcfs "
                 "mode: fcfs has no per-client resumable position, so the "
                 "snapshot's completed pieces cannot be skipped — silently "
                 "re-streaming everything would duplicate trained data. "
-                "Run the dispatcher in static mode to resume")
+                "Run the dispatcher in static or dynamic mode to resume")
         # fcfs consumes streams sequentially (no reader threads): a
         # prefetching consumer should keep its own producer thread.
         return _SourceIterator(self._iter_fcfs(info), prefetched=False)
@@ -670,6 +861,8 @@ class ServiceBatchSource:
                         # yielded that many, these pieces are truly done.
                         self._events.append((self._production_count, epoch,
                                              sorted(stream.pieces)))
+                        self._note_pieces_locked(stream.worker_id,
+                                                 len(stream.pieces))
                     active.discard(sid)
                 elif kind == "error":
                     raise item
@@ -730,6 +923,563 @@ class ServiceBatchSource:
             worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
         counters["batches"] += 1
         counters["inflight"] = max(0, counters["inflight"] - 1)
+
+    def _note_pieces_locked(self, worker_id, n):
+        """``n`` more pieces fully served by this worker — the per-worker
+        piece counts the skew/steal benches report. Callers hold _lock."""
+        counters = self._per_worker.setdefault(
+            worker_id, {"batches": 0, "stall_s": 0.0, "inflight": 0})
+        counters["pieces"] = counters.get("pieces", 0) + n
+
+    # -- dynamic mode ------------------------------------------------------
+
+    def _fetch_dynamic_plan(self, epoch):
+        """This epoch's initial per-worker piece deques (pieces stamped
+        with their ownership generation); syncs the fencing bookkeeping —
+        the plan is the freshest state there is."""
+        reply = self._dispatcher_request({
+            "type": "dynamic_plan", "client_id": self.client_id,
+            "client_index": self.client_index,
+            "num_clients": self.num_clients, "epoch": epoch})
+        with self._lock:
+            self._synced_fencing_epoch = int(reply.get("fencing_epoch", 0))
+            self._fence_pending = False
+        return reply
+
+    def _iter_dynamic(self, info):
+        num_epochs = info["num_epochs"]
+        epoch = self._epoch
+        heartbeat_stop = threading.Event()
+        heartbeat = None
+        if self._heartbeat_interval_s is not None:
+            heartbeat = threading.Thread(
+                target=self._heartbeat_loop, args=(heartbeat_stop,),
+                daemon=True, name=f"service-heartbeat-{self.client_id}")
+            heartbeat.start()
+        try:
+            while num_epochs is None or epoch < num_epochs:
+                plan = self._fetch_dynamic_plan(epoch)
+                if not plan["assignments"] and num_epochs is None:
+                    self._log.warning(
+                        "empty dynamic shard and num_epochs is None — "
+                        "ending the stream",
+                        client_index=self.client_index,
+                        num_clients=self.num_clients)
+                    return
+                yield from self._drain_dynamic(plan, epoch)
+                epoch += 1
+                with self._lock:
+                    self._completed = set()
+                    self._epoch = epoch
+                    self._epoch_starts.append(
+                        (self._production_count, epoch, set()))
+        finally:
+            heartbeat_stop.set()
+            if heartbeat is not None:
+                heartbeat.join(timeout=5)
+
+    def _drain_dynamic(self, plan, epoch):
+        """The dynamic-mode drain: persistent per-worker streams fed from
+        dispatcher-owned deques, rebalanced mid-epoch by work stealing.
+
+        Exactly-once across a steal is enforced client-side by the
+        **revoke-then-extend handshake**: a steal delta is applied by
+        asking the donor's engine to revoke the piece first; only the
+        subset the worker ACKS as revoked (meaning zero batches of it were
+        or ever will be sent by that engine) is granted to the receiving
+        worker's stream — the rest is reported back as ``failed_steals``
+        so the dispatcher reverts ownership. ``(piece, generation)`` tags
+        on every batch are the safety net on top: a batch whose generation
+        does not match the client's current grant is dropped, not yielded.
+
+        Delivery bookkeeping matches static mode (production-order FIFO
+        through one ready-queue; ``piece_done`` dequeues strictly after
+        the piece's batches), so ``state_dict`` resume works per piece —
+        finer grained than static's per-stream completion."""
+        with self._lock:
+            skip = set(self._completed)
+        piece_state = {}   # piece -> {"wid", "gen", "done", "received"}
+        outstanding = {}   # wid -> set of not-done pieces granted to it
+        addresses = {wid: tuple(addr)
+                     for wid, addr in plan["workers"].items()}
+        initial_grants = {}
+        for wid, pairs in plan["assignments"].items():
+            outstanding.setdefault(wid, set())
+            for piece, gen in pairs:
+                piece, gen = int(piece), int(gen)
+                done = piece in skip
+                piece_state[piece] = {"wid": wid, "gen": gen,
+                                      "done": done, "received": False}
+                if not done:
+                    outstanding[wid].add(piece)
+                    initial_grants.setdefault(wid, []).append((piece, gen))
+        remaining = sum(len(ps) for ps in outstanding.values())
+        if remaining == 0:
+            return
+        depth = (self._ready_queue_depth
+                 if self._ready_queue_depth is not None
+                 else max(4, 2 * max(1, len(initial_grants))))
+        ready = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        sync_stop = threading.Event()
+        sync_poke = threading.Event()
+        readers = []
+        streams = {}          # sid -> _DynamicStream
+        sid_by_wid = {}       # wid -> live sid
+        recovering = set()    # wids mid-takeover (grants deferred)
+        deferred_grants = {}  # wid -> [(piece, gen)] awaiting recovery
+        pending_steals = {}   # req -> {"wid": donor, "moves": [...]}
+        failed_steals = []    # [[piece, kept_wid, kept_gen]] for next sync
+        rows_by_wid = {}      # consumed-row totals (sync-loop rates)
+        sid_counter = itertools.count()
+        req_counter = itertools.count()
+        with self._lock:
+            self._ready_queue = ready
+
+        def launch(wid, pairs):
+            sid = next(sid_counter)
+            stream = _DynamicStream(wid, addresses[wid], pairs, epoch,
+                                    self._connect_timeout,
+                                    credits=self._credits)
+            streams[sid] = stream
+            sid_by_wid[wid] = sid
+            reader = _DynamicStreamReader(sid, stream, ready, stop,
+                                          self._note_stream_recv)
+            readers.append(reader)
+            reader.start()
+            return sid
+
+        def post(event):
+            while not stop.is_set():
+                try:
+                    ready.put(event, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def note_failed_steal(piece, failed_gen):
+            """Report a steal that could not be applied. ``failed_gen`` is
+            the generation the dispatcher stamped on the failed steal: the
+            revert is only valid against exactly that assignment — the
+            dispatcher ignores the report if a newer grant (takeover,
+            re-plan) has since moved the piece (the report may be retried
+            across a sync failure and arrive arbitrarily late)."""
+            st = piece_state[piece]
+            with self._lock:
+                failed_steals.append(
+                    [piece, st["wid"], st["gen"], failed_gen])
+                self._recovery_inc("steals_failed")
+
+        def grant(wid, pairs):
+            """Hand pieces to a worker's live stream (or open one)."""
+            if wid in recovering:
+                deferred_grants.setdefault(wid, []).extend(pairs)
+                return
+            sid = sid_by_wid.get(wid)
+            if sid is not None and sid in streams:
+                streams[sid].extend(pairs)
+            elif wid in addresses:
+                launch(wid, pairs)
+            else:  # no address for this worker: give the pieces back
+                for piece, gen in pairs:
+                    note_failed_steal(piece, gen)
+
+        def apply_deltas(reply):
+            with self._lock:
+                self._synced_fencing_epoch = max(
+                    self._synced_fencing_epoch,
+                    int(reply.get("fencing_epoch", 0)))
+                self._fence_pending = False
+            for wid, addr in (reply.get("workers") or {}).items():
+                addresses[wid] = tuple(addr)
+            by_donor = {}
+            for steal in reply.get("steals", []):
+                piece = int(steal["piece"])
+                gen = int(steal["generation"])
+                from_wid, to_wid = steal["from"], steal["to"]
+                st = piece_state.get(piece)
+                if st is None or st["done"]:
+                    continue  # reported done at the next sync anyway
+                if st["wid"] == to_wid and st["gen"] == gen:
+                    continue  # already applied
+                if st["wid"] != from_wid or from_wid in recovering \
+                        or sid_by_wid.get(from_wid) not in streams:
+                    # The donor moved/broke since the dispatcher planned —
+                    # report where the piece actually is.
+                    note_failed_steal(piece, gen)
+                    continue
+                by_donor.setdefault(from_wid, []).append(
+                    (piece, to_wid, gen))
+            for donor, moves in by_donor.items():
+                req = next(req_counter)
+                pending_steals[req] = {"wid": donor, "moves": moves}
+                streams[sid_by_wid[donor]].revoke(
+                    [piece for piece, _, _ in moves], req)
+
+        def on_revoked(sid, item):
+            req, revoked_pieces = item
+            entry = pending_steals.pop(req, None)
+            if entry is None:
+                return
+            revoked_pieces = set(int(p) for p in revoked_pieces)
+            regroup = {}
+            for piece, to_wid, gen in entry["moves"]:
+                st = piece_state.get(piece)
+                if st is None or st["done"]:
+                    continue
+                if piece in revoked_pieces:
+                    with self._lock:
+                        outstanding.get(st["wid"], set()).discard(piece)
+                        st["wid"], st["gen"] = to_wid, gen
+                        outstanding.setdefault(to_wid, set()).add(piece)
+                        self._recovery_inc("steals_applied")
+                    regroup.setdefault(to_wid, []).append((piece, gen))
+                else:
+                    # The donor had already sent (or is sending) it: the
+                    # steal loses, the piece stays where it is.
+                    note_failed_steal(piece, gen)
+            for to_wid, pairs in regroup.items():
+                grant(to_wid, pairs)
+
+        def fail_pending_steals_via(wid):
+            """A donor broke mid-handshake: its un-acked steals fail (the
+            pieces ride the takeover path with everything else)."""
+            for req in [r for r, entry in pending_steals.items()
+                        if entry["wid"] == wid]:
+                for piece, _to, gen in pending_steals.pop(req)["moves"]:
+                    st = piece_state.get(piece)
+                    if st is not None and not st["done"]:
+                        note_failed_steal(piece, gen)
+
+        def recover(wid, sid):
+            """Retry-then-takeover off the consumer thread (same shape as
+            static's recovery)."""
+            with self._lock:
+                pairs = sorted(
+                    (piece, piece_state[piece]["gen"])
+                    for piece in outstanding.get(wid, set()))
+            if not pairs:
+                post(("dgone", sid, wid))
+                return
+            try:
+                def attempt():
+                    fresh = _DynamicStream(wid, addresses[wid], pairs,
+                                           epoch, self._connect_timeout,
+                                           credits=self._credits)
+                    try:
+                        fresh._ensure_conn()  # dial + stream request
+                    except BaseException:
+                        fresh.close()
+                        raise
+                    return fresh
+                try:
+                    fresh = retry_with_backoff(
+                        attempt, retries=self._max_retries,
+                        base_delay=self._backoff_base,
+                        max_delay=self._backoff_max, retry_on=(OSError,),
+                        no_retry_on=(ServiceError,),
+                        description=f"reconnect to worker {wid}")
+                except OSError:
+                    fresh = None
+                if fresh is not None:
+                    if not post(("drecovered", sid, (wid, fresh))):
+                        fresh.close()
+                    return
+                with self._lock:
+                    token = self._synced_fencing_epoch
+                reply = self._dispatcher_request({
+                    "type": "report_failure", "client_id": self.client_id,
+                    "worker_id": wid,
+                    "pieces": [piece for piece, _ in pairs],
+                    "fencing_epoch": token})
+                if reply.get("type") == "stale_fencing":
+                    with self._lock:
+                        self._recovery_inc("stale_fencing_retries")
+                    reply = self._dispatcher_request({
+                        "type": "report_failure",
+                        "client_id": self.client_id, "worker_id": wid,
+                        "pieces": [piece for piece, _ in pairs],
+                        "fencing_epoch": int(reply["fencing_epoch"])})
+                post(("dtakeover", sid, (wid, reply)))
+            except BaseException as exc:
+                post(("error", None, exc))
+
+        def sync_loop():
+            last_t = time.monotonic()
+            last_rows = {}
+            rate_ema = {}
+            while not sync_stop.is_set():
+                sync_poke.wait(self._dynamic_sync_interval_s)
+                sync_poke.clear()
+                if sync_stop.is_set():
+                    return
+                now = time.monotonic()
+                dt = max(1e-6, now - last_t)
+                with self._lock:
+                    done = sorted(p for p, st in piece_state.items()
+                                  if st["done"])
+                    owned = {wid: sorted(ps)
+                             for wid, ps in outstanding.items()}
+                    stealable = {
+                        wid: [p for p in ps
+                              if not piece_state[p]["received"]]
+                        for wid, ps in outstanding.items()}
+                    rows_now = dict(rows_by_wid)
+                    failed = list(failed_steals)
+                    del failed_steals[:]
+                # EMA-smoothed delivery rates: one sync window is shorter
+                # than a skewed worker's batch period, so instantaneous
+                # deltas flap between 0 and bursts — the planner would
+                # misread a mid-epoch worker as dead (and vice versa).
+                # A worker that has NEVER delivered stays at exactly 0,
+                # which the planner treats as "no rate yet".
+                for wid in owned:
+                    inst = (rows_now.get(wid, 0)
+                            - last_rows.get(wid, 0)) / dt
+                    prev = rate_ema.get(wid)
+                    rate_ema[wid] = (inst if prev is None
+                                     else 0.5 * prev + 0.5 * inst)
+                rates = {wid: rate_ema.get(wid, 0.0) for wid in owned}
+                last_t, last_rows = now, rows_now
+                try:
+                    reply = self._dispatcher_request({
+                        "type": "dynamic_sync",
+                        "client_id": self.client_id, "epoch": epoch,
+                        "done": done, "owned": owned,
+                        "stealable": stealable, "rates": rates,
+                        "failed_steals": failed}, retries=0)
+                except (ServiceError, OSError):
+                    with self._lock:
+                        failed_steals.extend(failed)  # re-report next tick
+                        self._recovery_inc("heartbeat_failures")
+                    continue
+                if reply.get("type") == "unknown_plan":
+                    post(("dreplan", None, None))
+                elif reply.get("type") == "deltas":
+                    post(("deltas", None, reply))
+
+        sync_thread = threading.Thread(
+            target=sync_loop, daemon=True,
+            name=f"service-dynsync-{self.client_id}")
+        try:
+            for wid, pairs in initial_grants.items():
+                launch(wid, pairs)
+            sync_thread.start()
+            while remaining > 0:
+                kind, sid, item = ready.get()
+                if kind == "dbatch":
+                    piece, gen, payload, bid, t_enqueued = item
+                    stream = streams.get(sid)
+                    if stream is None:
+                        continue  # stream was torn down: stale event
+                    # Ack BEFORE yielding, like static: the worker refills
+                    # its window while the trainer computes.
+                    stream.add_credit(1)
+                    st = piece_state.get(piece)
+                    if st is None or st["done"] or st["gen"] != gen:
+                        # Stale generation (a superseded grant): the dedup
+                        # that makes a stolen piece count exactly once.
+                        with self._lock:
+                            self._recovery_inc("dedup_dropped")
+                        continue
+                    st["received"] = True
+                    n = (len(next(iter(payload.values())))
+                         if payload else 0)
+                    with self._lock:
+                        self._production_count += 1
+                        self._note_consumed_locked(stream.worker_id)
+                        rows_by_wid[stream.worker_id] = (
+                            rows_by_wid.get(stream.worker_id, 0) + n)
+                    collector = tracing.COLLECTOR
+                    if collector.enabled:
+                        collector.record_span("client.queue", t_enqueued,
+                                              time.perf_counter(), bid=bid)
+                    CLIENT_READY_QUEUE_DEPTH.set(ready.qsize())
+                    self.last_bid = bid
+                    yield payload
+                elif kind == "piece_done":
+                    piece, gen, _rows = item
+                    st = piece_state.get(piece)
+                    if st is None or st["done"] or st["gen"] != gen:
+                        continue
+                    with self._lock:
+                        st["done"] = True
+                        self._completed.add(piece)
+                        self._events.append(
+                            (self._production_count, epoch, [piece]))
+                        self._note_pieces_locked(st["wid"], 1)
+                        outstanding.get(st["wid"], set()).discard(piece)
+                        drained = not outstanding.get(st["wid"])
+                        others_backlogged = any(
+                            len(ps) > 1 for w, ps in outstanding.items()
+                            if w != st["wid"])
+                    remaining -= 1
+                    if remaining and drained and others_backlogged:
+                        # This worker's deque just ran dry while a peer
+                        # still holds backlog: rebalance NOW instead of on
+                        # the next interval tick.
+                        sync_poke.set()
+                elif kind == "revoked":
+                    on_revoked(sid, item)
+                elif kind == "deltas":
+                    apply_deltas(item)
+                elif kind == "fence":
+                    # Dispatcher state moved (restart, eviction): the sync
+                    # loop's absolute-state report IS the reconciliation.
+                    sync_poke.set()
+                elif kind == "dreplan":
+                    # Dispatcher lost the plan (restart without journal):
+                    # re-seed it; live streams keep flowing and the next
+                    # syncs reconcile ownership by corrective steals.
+                    try:
+                        self._fetch_dynamic_plan(epoch)
+                        with self._lock:
+                            self._recovery_inc("resyncs")
+                    except (ServiceError, OSError):
+                        with self._lock:
+                            self._recovery_inc("resync_failures")
+                elif kind == "error":
+                    raise item
+                elif kind == "drecovered":
+                    wid, fresh = item
+                    recovering.discard(wid)
+                    old_sid = sid_by_wid.get(wid)
+                    if old_sid is not None:
+                        streams.pop(old_sid, None)
+                    new_sid = next(sid_counter)
+                    streams[new_sid] = fresh
+                    sid_by_wid[wid] = new_sid
+                    reader = _DynamicStreamReader(
+                        new_sid, fresh, ready, stop,
+                        self._note_stream_recv)
+                    readers.append(reader)
+                    reader.start()
+                    pairs = deferred_grants.pop(wid, None)
+                    if pairs:
+                        fresh.extend(pairs)
+                elif kind == "dtakeover":
+                    wid, reply = item
+                    recovering.discard(wid)
+                    if sid_by_wid.get(wid) == sid:
+                        sid_by_wid.pop(wid, None)
+                    streams.pop(sid, None)
+                    with self._lock:
+                        self._recovery_inc("takeovers")
+                        self._synced_fencing_epoch = max(
+                            self._synced_fencing_epoch,
+                            int(reply.get("fencing_epoch", 0)))
+                    for wid2, addr in (reply.get("workers") or {}).items():
+                        addresses[wid2] = tuple(addr)
+                    for piece, gen in deferred_grants.pop(wid, []):
+                        note_failed_steal(piece, gen)
+                    for wid2, pairs in reply.get("assignments",
+                                                 {}).items():
+                        pairs = [(int(p), int(g)) for p, g in pairs]
+                        fresh_pairs = []
+                        with self._lock:
+                            for piece, gen in pairs:
+                                st = piece_state.get(piece)
+                                if st is None or st["done"]:
+                                    continue
+                                outstanding.get(st["wid"],
+                                                set()).discard(piece)
+                                st["wid"], st["gen"] = wid2, gen
+                                outstanding.setdefault(wid2,
+                                                       set()).add(piece)
+                                fresh_pairs.append((piece, gen))
+                        if fresh_pairs:
+                            grant(wid2, fresh_pairs)
+                elif kind == "dgone":
+                    wid = item
+                    recovering.discard(wid)
+                    if sid_by_wid.get(wid) == sid:
+                        sid_by_wid.pop(wid, None)
+                    streams.pop(sid, None)
+                    # Steals granted while recovery was in flight: the
+                    # ownership maps already point at this worker, so
+                    # dropping them would orphan the pieces (no corrective
+                    # delta ever fires — dispatcher and client agree).
+                    # Re-grant now that the wid is out of `recovering`:
+                    # grant() opens a fresh stream, or fails the steals
+                    # back to the dispatcher if the address is unknown.
+                    deferred = deferred_grants.pop(wid, [])
+                    if deferred:
+                        with self._lock:
+                            live = [
+                                (piece, gen) for piece, gen in deferred
+                                if (st := piece_state.get(piece))
+                                is not None and not st["done"]
+                                and st["wid"] == wid]
+                        if live:
+                            grant(wid, live)
+                elif kind == "end":
+                    # Unexpected end (we have not sent finish): treat like
+                    # a broken stream if the worker still owes pieces.
+                    stream = streams.pop(sid, None)
+                    if stream is None:
+                        continue
+                    wid = stream.worker_id
+                    if sid_by_wid.get(wid) == sid:
+                        sid_by_wid.pop(wid, None)
+                    if outstanding.get(wid):
+                        fail_pending_steals_via(wid)
+                        recovering.add(wid)
+                        threading.Thread(
+                            target=recover, args=(wid, sid), daemon=True,
+                            name=f"service-dynrecover-{wid}").start()
+                elif kind == "broken":
+                    stream = streams.pop(sid, None)
+                    if stream is None:
+                        continue
+                    wid = stream.worker_id
+                    if sid_by_wid.get(wid) == sid:
+                        sid_by_wid.pop(wid, None)
+                    stream.close()
+                    fail_pending_steals_via(wid)
+                    recovering.add(wid)
+                    threading.Thread(
+                        target=recover, args=(wid, sid), daemon=True,
+                        name=f"service-dynrecover-{wid}").start()
+            # Epoch complete: close the piece queues so engines drain and
+            # streams end cleanly, then report the final state once so the
+            # dispatcher's books close too (best-effort).
+            sync_stop.set()
+            sync_poke.set()
+            for stream in streams.values():
+                stream.finish()
+            deadline = time.monotonic() + 5.0
+            waiting = set(streams)
+            while waiting and time.monotonic() < deadline:
+                try:
+                    kind, sid, item = ready.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if kind in ("end", "broken") and sid in waiting:
+                    waiting.discard(sid)
+            try:
+                self._dispatcher_request({
+                    "type": "dynamic_sync", "client_id": self.client_id,
+                    "epoch": epoch,
+                    "done": sorted(p for p, st in piece_state.items()
+                                   if st["done"]),
+                    "owned": {}, "stealable": {}, "rates": {},
+                    "failed_steals": []}, retries=0)
+            except (ServiceError, OSError):
+                pass  # the next epoch's plan supersedes this state anyway
+        finally:
+            stop.set()
+            sync_stop.set()
+            sync_poke.set()
+            for stream in streams.values():
+                stream.close()
+            with self._lock:
+                self._ready_queue = None
+                self._fence_pending = False
+            if sync_thread.is_alive():
+                sync_thread.join(timeout=5)
+            for reader in readers:
+                reader.join(timeout=5)
 
     # -- liveness / fencing -------------------------------------------------
 
@@ -977,7 +1727,10 @@ class ServiceBatchSource:
     def state_dict(self, yielded_batches=None):
         """Resumable position: the epoch in progress and the piece sets
         whose streams fully completed (pieces mid-stream are re-read on
-        resume — at-least-once). Static mode only.
+        resume — at-least-once). Static and dynamic modes (dynamic tracks
+        completion per PIECE — a steal mid-epoch changes who served a
+        piece, never whether it counts as completed); fcfs has no
+        resumable position.
 
         ``yielded_batches``: for a consumer that prefetches past this
         source — the number of batches it has actually surfaced.
@@ -1009,7 +1762,8 @@ class ServiceBatchSource:
                 for piece in pieces)
             return {
                 "version": 1,
-                "mode": "static",
+                "mode": ("dynamic" if self._mode == "dynamic"
+                         else "static"),
                 "client_index": self.client_index,
                 "num_clients": self.num_clients,
                 "epoch": epoch,
@@ -1020,8 +1774,11 @@ class ServiceBatchSource:
         if state.get("version") != 1:
             raise ValueError(
                 f"Unsupported resume_state version {state.get('version')!r}")
-        if state.get("mode") != "static":
-            raise ValueError("resume_state requires static sharding mode")
+        # static and dynamic snapshots are interchangeable: both are
+        # (epoch, completed piece set) over the same piece universe.
+        if state.get("mode") not in ("static", "dynamic"):
+            raise ValueError(
+                "resume_state requires static or dynamic sharding mode")
         for key in ("client_index", "num_clients"):
             if state.get(key) != getattr(self, key):
                 raise ValueError(
@@ -1071,7 +1828,8 @@ class ServiceBatchSource:
                 "per_worker": {
                     wid: {"batches": counters["batches"],
                           "stall_s": round(counters["stall_s"], 3),
-                          "credits_outstanding": counters["inflight"]}
+                          "credits_outstanding": counters["inflight"],
+                          "pieces": counters.get("pieces", 0)}
                     for wid, counters in self._per_worker.items()},
                 "recovery": {
                     key: (dict(value) if isinstance(value, dict)

@@ -44,6 +44,14 @@ Request vocabulary (header ``type``):
 - ``next_split`` ``{client_id}`` (fcfs mode) → ``split`` or
   ``end_of_stream`` (dispatcher-owned epoch tracking: the shared queue
   refills until ``num_epochs`` is exhausted)
+- ``dynamic_plan`` ``{client_id, client_index, num_clients, epoch}``
+  (dynamic mode) → ``plan``: this client's shard split into per-worker
+  piece deques, every piece stamped with an ownership ``generation``
+- ``dynamic_sync`` ``{client_id, epoch, done, owned, stealable, rates,
+  failed_steals}`` (dynamic mode) → ``deltas``: the work-stealing
+  rebalance loop — the client reports progress and per-worker backlog,
+  the dispatcher journals steals away from drained/straggler-bound
+  workers and replies with the moves (``docs/guides/service.md#sharding-modes``)
 - ``status`` → full control-plane snapshot (workers, clients, queue depth,
   fencing epoch, recovery counters, journal stats)
 - ``worker_diagnostics`` → one fan-out to every live worker's
@@ -66,15 +74,133 @@ from petastorm_tpu.reader_impl.framed_socket import (
 )
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
+    DISPATCHER_BACKLOG_PIECES,
     DISPATCHER_FENCING_EPOCH,
+    DISPATCHER_GENERATION,
     DISPATCHER_RECOVERY_EVENTS,
     DISPATCHER_REQUESTS,
+    DISPATCHER_STEALS,
     DISPATCHER_WORKERS,
 )
 
 logger = service_logger(__name__)
 
-MODES = ("static", "fcfs")
+MODES = ("static", "fcfs", "dynamic")
+
+#: Dynamic mode: a worker whose delivery rate falls below this fraction of
+#: the fleet median (while it still holds stealable backlog) is treated as
+#: a straggler even before any peer's deque drains.
+STRAGGLER_RATE_FACTOR = 0.5
+
+
+def plan_steals(pending, stealable, rates,
+                straggler_factor=STRAGGLER_RATE_FACTOR):
+    """Work-stealing planner (pure — unit-testable without sockets).
+
+    :param pending: ``{worker_id: not-done piece count}`` over live workers.
+    :param stealable: ``{worker_id: [pieces]}`` the client reports as not
+        yet started (queued beyond the engine's in-flight window) — the
+        only pieces a steal may touch; the revoke handshake still guards
+        the race where one starts between report and revoke.
+    :param rates: ``{worker_id: rows_per_s}`` from the client's PR 4
+        delivery counters (may be empty early in an epoch).
+    :returns: ``[(piece, from_worker, to_worker), ...]`` — steals are taken
+        from the donor's TAIL (farthest from being served).
+
+    Two triggers, in priority order:
+
+    - **drain**: a worker with zero pending pieces receives from the most
+      backlogged donor (classic work stealing);
+    - **straggler**: no deque has drained yet, but a donor's rate is below
+      ``straggler_factor`` × the fleet median — pieces move to a
+      median-or-faster worker with materially less backlog.
+
+    Move sizing: with measured rates for both sides, backlog is split
+    **proportionally to rate** — a 10× faster receiver takes ~10/11 of the
+    joint backlog in ONE sync, instead of the geometric half-then-quarter
+    convergence of midpoint splitting (each extra round leaves the
+    straggler decoding pieces it should never have kept, and a started
+    piece is no longer stealable — rounds are not free). Without rates the
+    midpoint is the only defensible split. Either way the move is bounded
+    by what is actually stealable and the donor keeps at least one piece.
+    """
+    pending = dict(pending)
+    stealable = {wid: list(ps) for wid, ps in stealable.items()}
+    moves = []
+    while True:
+        donors = [wid for wid, ps in stealable.items()
+                  if ps and pending.get(wid, 0) > 1]
+        if not donors:
+            return moves
+        donor = max(donors, key=lambda w: (pending[w], w))
+        receivers = [wid for wid in pending
+                     if wid != donor and pending[wid] == 0]
+        if not receivers:
+            working = sorted(r for wid, r in rates.items()
+                             if pending.get(wid, 0) > 0)
+            median = working[len(working) // 2] if working else None
+            donor_rate = rates.get(donor)
+            if median and donor_rate is not None \
+                    and donor_rate < straggler_factor * median:
+                receivers = [
+                    wid for wid in pending
+                    if wid != donor and rates.get(wid, 0.0) >= median
+                    # "materially less backlog" — waived while the donor
+                    # has delivered nothing at all (equal backlogs say
+                    # nothing when only one side is moving).
+                    and (pending[wid] < pending[donor] - 1
+                         or not donor_rate)]
+        if not receivers:
+            return moves
+        recv = min(receivers,
+                   key=lambda w: (pending[w], -rates.get(w, 0.0), w))
+        donor_rate, recv_rate = rates.get(donor), rates.get(recv)
+        if donor_rate and recv_rate:
+            joint = pending[donor] + pending[recv]
+            keep = max(1, round(joint * donor_rate
+                                / (donor_rate + recv_rate)))
+            count = pending[donor] - keep
+            if count < 1:
+                # The proportional share says the donor keeps everything:
+                # the "receiver" is a drained straggler near the epoch
+                # tail, and bouncing a piece back to it would serialize
+                # the wall behind its slowness. Leave it idle.
+                return moves
+            working = sorted(r for wid, r in rates.items()
+                             if pending.get(wid, 0) > 0)
+            tail_median = working[len(working) // 2] if working else None
+            if tail_median and recv_rate < straggler_factor * tail_median:
+                # The receiver is itself a straggler (it drained because
+                # it was shed, not because it is fast). Early-epoch EMAs
+                # lie in exactly the direction that over-hands work back
+                # (the donor's first window includes warmup), and every
+                # piece handed back serves at the slow rate or must be
+                # re-stolen. So: a small share (<=2) is not worth the
+                # revoke/extend round trip near the tail — leave it idle;
+                # a large share moves as a 2-piece PROBE, and only a
+                # receiver that chews it and re-drains with a matured
+                # rate graduates to full proportional hand-backs.
+                if count <= 2:
+                    return moves
+                count = 2
+        elif not donor_rate and recv_rate and pending[donor] >= 4:
+            # The donor has delivered NOTHING while the receiver is
+            # demonstrably moving — no rate to apportion by, so shed the
+            # backlog down to a 1-piece floor (the piece being served) in
+            # ONE sync; if the donor was merely slow to start, later
+            # syncs' measured rates hand work back proportionally.
+            # Halving instead costs a round per factor of 2, and every
+            # round the straggler promotes another piece past the send
+            # boundary where it stops being stealable.
+            count = pending[donor] - 1
+        else:
+            count = max(1, (pending[donor] - pending[recv]) // 2)
+        count = min(count, len(stealable[donor]))
+        for _ in range(count):
+            piece = stealable[donor].pop()
+            moves.append((piece, donor, recv))
+            pending[donor] -= 1
+            pending[recv] += 1
 
 #: Default worker-lease budget; a worker missing heartbeats this long is
 #: evicted and its splits become takeover candidates.
@@ -125,6 +251,18 @@ class Dispatcher:
         # fcfs shared queue: lazily built once the piece count is known.
         self._fcfs_queue = None
         self._fcfs_epoch = 0
+        # dynamic mode: per-client ownership state for the epoch in flight
+        # (client_id -> {"epoch", "owner": {piece: [wid, gen]}, "done",
+        # "steals": {wid: {"in", "out"}}}) and the
+        # global ownership-generation counter every grant/steal bumps —
+        # the fencing token clients dedup batches by.
+        self._dyn = {}
+        # Dirty marker for the per-worker backlog/steal gauges: the
+        # aggregation walks every client's owner map, so it runs only
+        # after a request that actually mutated dynamic state — not on
+        # every heartbeat/ping of a large fleet.
+        self._dyn_dirty = True
+        self._generation = 0
         # runtime-only liveness clocks (never persisted: wall-clock leases
         # restart from "now" after a recovery — a restored worker gets a
         # full lease to re-appear before it is declared dead).
@@ -211,6 +349,19 @@ class Dispatcher:
                            if self._fcfs_queue is not None else None),
             "fencing_epoch": self._fencing_epoch,
             "recovery": dict(self._recovery),
+            "generation": self._generation,
+            # owner maps keyed by int piece → serialized as triplet lists
+            # (JSON object keys must be strings).
+            "dyn": {
+                cid: {
+                    "epoch": state["epoch"],
+                    "owner": [[piece, wid, gen] for piece, (wid, gen)
+                              in sorted(state["owner"].items())],
+                    "done": sorted(state["done"]),
+                    "steals": {wid: dict(counts) for wid, counts
+                               in state["steals"].items()},
+                }
+                for cid, state in self._dyn.items()},
         }
 
     def _recover(self):
@@ -264,6 +415,20 @@ class Dispatcher:
         recovered = state.get("recovery", {})
         for key in self._recovery:
             self._recovery[key] = int(recovered.get(key, 0))
+        self._generation = int(state.get("generation", 0))
+        self._dyn = {}
+        self._dyn_dirty = True
+        for cid, dyn in (state.get("dyn") or {}).items():
+            self._dyn[cid] = {
+                "epoch": int(dyn["epoch"]),
+                "owner": {int(piece): [wid, int(gen)]
+                          for piece, wid, gen in dyn.get("owner", [])},
+                "done": set(int(p) for p in dyn.get("done", [])),
+                "steals": {wid: {"in": int(counts.get("in", 0)),
+                                 "out": int(counts.get("out", 0))}
+                           for wid, counts
+                           in dyn.get("steals", {}).items()},
+            }
 
     def _apply_record_locked(self, record):
         """Replay one WAL record through the same mutations the live
@@ -287,6 +452,24 @@ class Dispatcher:
         elif op == "next_split":
             self._replay_next_split_locked(int(record["piece"]),
                                            int(record["epoch"]))
+        elif op == "dynamic_plan":
+            self._install_dynamic_plan_locked(
+                record["client_id"], int(record["epoch"]),
+                {int(p): [wid, int(gen)]
+                 for p, wid, gen in record["owner"]},
+                int(record["generation"]))
+        elif op == "steal":
+            self._apply_steal_locked(
+                record["client_id"], int(record["piece"]),
+                record["from"], record["to"], int(record["generation"]))
+        elif op == "steal_failed":
+            self._apply_steal_failed_locked(
+                record["client_id"], int(record["piece"]),
+                record["worker_id"], int(record["generation"]))
+        elif op == "dynamic_done":
+            state = self._dyn.get(record["client_id"])
+            if state is not None:
+                state["done"].update(int(p) for p in record["pieces"])
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
@@ -376,6 +559,45 @@ class Dispatcher:
             time.monotonic() + (self.lease_timeout_s or 0.0))
         return known
 
+    # -- dynamic-mode mutations (shared by live handlers and WAL replay) ---
+
+    def _install_dynamic_plan_locked(self, client_id, epoch, owner,
+                                     generation):
+        self._dyn_dirty = True
+        self._dyn[client_id] = {
+            "epoch": epoch,
+            "owner": dict(owner),
+            "done": set(),
+            "steals": {},
+        }
+        self._generation = max(self._generation, generation)
+
+    def _steal_counts_locked(self, state, worker_id):
+        return state["steals"].setdefault(worker_id, {"in": 0, "out": 0})
+
+    def _apply_steal_locked(self, client_id, piece, from_wid, to_wid,
+                            generation):
+        state = self._dyn.get(client_id)
+        if state is None:
+            return
+        self._dyn_dirty = True
+        state["owner"][piece] = [to_wid, generation]
+        self._generation = max(self._generation, generation)
+        self._steal_counts_locked(state, from_wid)["out"] += 1
+        self._steal_counts_locked(state, to_wid)["in"] += 1
+
+    def _apply_steal_failed_locked(self, client_id, piece, kept_wid,
+                                   generation):
+        """A steal the client could not apply (the donor had already sent
+        a batch of the piece, or its stream was mid-takeover): ownership
+        reverts to where the piece actually stayed."""
+        state = self._dyn.get(client_id)
+        if state is None:
+            return
+        self._dyn_dirty = True
+        state["owner"][piece] = [kept_wid, generation]
+        self._generation = max(self._generation, generation)
+
     # -- serving -----------------------------------------------------------
 
     def _serve_connection(self, sock):
@@ -422,6 +644,57 @@ class Dispatcher:
         DISPATCHER_WORKERS.labels("dead").set(len(self._workers) - alive)
         for event, count in self._recovery.items():
             DISPATCHER_RECOVERY_EVENTS.labels(event).set(count)
+        if self.mode == "dynamic":
+            DISPATCHER_GENERATION.set(self._generation)
+            if not self._dyn_dirty:
+                # The aggregation below is O(clients × pieces): skip it
+                # unless this request mutated dynamic state — a scrape
+                # between mutations reads gauges that are still exact.
+                return
+            self._dyn_dirty = False
+            per_worker = self._dynamic_per_worker_locked()
+            for wid in set(self._workers) | set(per_worker):
+                entry = per_worker.get(wid)
+                DISPATCHER_BACKLOG_PIECES.labels(wid).set(
+                    entry["backlog"] if entry else 0)
+            for wid, entry in per_worker.items():
+                DISPATCHER_STEALS.labels(wid, "in").set(entry["steals_in"])
+                DISPATCHER_STEALS.labels(wid, "out").set(
+                    entry["steals_out"])
+
+    def _dynamic_per_worker_locked(self):
+        """Per-worker backlog/steal aggregation over every client's plan —
+        the ONE definition of "backlog" shared by the ``status`` reply and
+        the scrapeable gauges (they must never disagree)."""
+        per_worker = {}
+
+        def entry(wid):
+            return per_worker.setdefault(
+                wid, {"backlog": 0, "steals_in": 0, "steals_out": 0})
+
+        for state in self._dyn.values():
+            for piece, (wid, _gen) in state["owner"].items():
+                e = entry(wid)
+                if piece not in state["done"]:
+                    e["backlog"] += 1
+            for wid, counts in state["steals"].items():
+                e = entry(wid)
+                e["steals_in"] += counts["in"]
+                e["steals_out"] += counts["out"]
+        return per_worker
+
+    def _dynamic_status_locked(self):
+        """Per-worker steal/backlog aggregation for ``status`` (and the
+        ``STEALS`` column of ``status --watch``)."""
+        return {
+            "generation": self._generation,
+            "per_worker": self._dynamic_per_worker_locked(),
+            "clients": {
+                cid: {"epoch": state["epoch"],
+                      "pieces_done": len(state["done"]),
+                      "pieces_total": len(state["owner"])}
+                for cid, state in self._dyn.items()},
+        }
 
     # -- handlers ----------------------------------------------------------
 
@@ -505,7 +778,7 @@ class Dispatcher:
         if self.mode != "static":
             return {"type": "error", "error":
                     "get_assignment is a static-mode request; fcfs clients "
-                    "use next_split"}
+                    "use next_split, dynamic clients use dynamic_plan"}
         client_index = int(header["client_index"])
         num_clients = int(header["num_clients"])
         if not 0 <= client_index < num_clients:
@@ -578,6 +851,32 @@ class Dispatcher:
                 "survivors", len(pieces), len(worker_ids),
                 worker_id=worker_id, client_id=header.get("client_id"),
                 fencing_epoch=self._fencing_epoch)
+            if self.mode == "dynamic":
+                # Takeover reassignments are steals from the dead worker:
+                # journaled, generation-stamped, so a replayed dispatcher
+                # and the client's dedup agree on who serves what.
+                client_id = header.get("client_id")
+                pairs = {}
+                for wid, ws_pieces in assignments.items():
+                    pairs[wid] = []
+                    for piece in ws_pieces:
+                        self._generation += 1
+                        self._apply_steal_locked(client_id, piece,
+                                                 worker_id, wid,
+                                                 self._generation)
+                        self._journal_locked({
+                            "op": "steal", "client_id": client_id,
+                            "piece": piece, "from": worker_id, "to": wid,
+                            "generation": self._generation})
+                        pairs[wid].append([piece, self._generation])
+                return {
+                    "type": "assignment",
+                    "fencing_epoch": self._fencing_epoch,
+                    "generation": self._generation,
+                    "assignments": pairs,
+                    "workers": {wid: alive[wid]["address"]
+                                for wid in pairs},
+                }
             return {
                 "type": "assignment",
                 "fencing_epoch": self._fencing_epoch,
@@ -610,6 +909,187 @@ class Dispatcher:
                                   "epoch": self._fcfs_epoch})
             return {"type": "split", "piece": piece,
                     "epoch": self._fcfs_epoch}
+
+    # -- dynamic mode ------------------------------------------------------
+
+    def _handle_dynamic_plan(self, header):
+        """Initial per-worker piece deques for one client epoch: the
+        client's static shard round-robined across live workers, every
+        piece stamped with a fresh ownership generation. Requesting a plan
+        for a new epoch replaces the client's previous epoch state."""
+        if self.mode != "dynamic":
+            return {"type": "error", "error":
+                    "dynamic_plan is a dynamic-mode request"}
+        client_index = int(header["client_index"])
+        num_clients = int(header["num_clients"])
+        epoch = int(header.get("epoch", 0))
+        if not 0 <= client_index < num_clients:
+            return {"type": "error", "error":
+                    f"client_index {client_index} out of range "
+                    f"[0, {num_clients})"}
+        client_id = header["client_id"]
+        with self._lock:
+            if self._num_pieces is None:
+                return {"type": "error",
+                        "error": "no workers have registered yet"}
+            alive = self._alive_workers()
+            if not alive:
+                return {"type": "error", "error": "no live workers"}
+            client_pieces = list(
+                range(self._num_pieces))[client_index::num_clients]
+            worker_ids = sorted(alive)
+            assignments = self._partition(client_pieces, worker_ids)
+            self._generation += 1
+            generation = self._generation
+            owner = {piece: [wid, generation]
+                     for wid, pieces in assignments.items()
+                     for piece in pieces}
+            self._install_dynamic_plan_locked(client_id, epoch, owner,
+                                              generation)
+            self._clients[client_id] = {
+                "epoch": epoch,
+                "client_index": client_index,
+                "num_clients": num_clients,
+            }
+            self._client_heartbeats[client_id] = time.monotonic()
+            self._journal_locked({
+                "op": "client", "client_id": client_id, "epoch": epoch,
+                "client_index": client_index, "num_clients": num_clients})
+            self._journal_locked({
+                "op": "dynamic_plan", "client_id": client_id,
+                "epoch": epoch,
+                "owner": [[piece, wid, gen] for piece, (wid, gen)
+                          in sorted(owner.items())],
+                "generation": generation})
+            return {
+                "type": "plan",
+                "epoch": epoch,
+                "generation": generation,
+                "fencing_epoch": self._fencing_epoch,
+                "assignments": {
+                    wid: [[piece, generation] for piece in pieces]
+                    for wid, pieces in assignments.items()},
+                "workers": {wid: alive[wid]["address"]
+                            for wid in assignments},
+            }
+
+    def _handle_dynamic_sync(self, header):
+        """The rebalance loop's heartbeat: fold the client's progress
+        report into the ownership state, reconcile any divergence (a steal
+        journaled pre-crash that the client never saw comes back as a
+        corrective delta), and plan fresh steals away from drained or
+        straggling workers. Idempotent by construction — the client
+        reports absolute state (full done set, full ownership view), so a
+        lost reply or a replayed request converges instead of corrupting.
+        """
+        if self.mode != "dynamic":
+            return {"type": "error", "error":
+                    "dynamic_sync is a dynamic-mode request"}
+        client_id = header["client_id"]
+        epoch = int(header.get("epoch", 0))
+        done = set(int(p) for p in header.get("done", []))
+        owned = {wid: set(int(p) for p in pieces)
+                 for wid, pieces in (header.get("owned") or {}).items()}
+        stealable = {wid: [int(p) for p in pieces]
+                     for wid, pieces in
+                     (header.get("stealable") or {}).items()}
+        rates = {wid: float(r)
+                 for wid, r in (header.get("rates") or {}).items()}
+        failed = [(int(p), wid, int(gen), int(failed_gen))
+                  for p, wid, gen, failed_gen
+                  in header.get("failed_steals", [])]
+        with self._lock:
+            state = self._dyn.get(client_id)
+            if state is None or state["epoch"] != epoch:
+                # Restarted without a journal (or a plan this dispatcher
+                # never saw): the client must re-plan — its streams keep
+                # flowing meanwhile, exactly like static's resync path.
+                return {"type": "unknown_plan",
+                        "fencing_epoch": self._fencing_epoch}
+            for piece, kept_wid, kept_gen, failed_gen in failed:
+                # The revert is valid only against the exact assignment
+                # the failed steal created: a report can be retried across
+                # a sync failure and land AFTER a takeover or re-plan
+                # stamped the piece with a newer generation — applying it
+                # then would clobber the newer (journaled) owner and pin
+                # the piece on a dead worker for the rest of the epoch.
+                cur = state["owner"].get(piece)
+                if cur is None or int(cur[1]) != failed_gen:
+                    continue  # stale report: a newer grant superseded it
+                self._apply_steal_failed_locked(client_id, piece, kept_wid,
+                                                kept_gen)
+                self._journal_locked({
+                    "op": "steal_failed", "client_id": client_id,
+                    "piece": piece, "worker_id": kept_wid,
+                    "generation": kept_gen})
+            fresh_done = done - state["done"]
+            if fresh_done:
+                self._dyn_dirty = True
+                state["done"].update(fresh_done)
+                self._journal_locked({
+                    "op": "dynamic_done", "client_id": client_id,
+                    "pieces": sorted(fresh_done)})
+            alive = self._alive_workers()
+            # Reconcile: a piece the dispatcher's (journal-restored) state
+            # places on a different worker than the client's live view is
+            # re-issued as a corrective steal — the client applies it
+            # through the same revoke-then-extend handshake, so exactly-
+            # once holds across a dispatcher crash mid-steal.
+            client_owner = {piece: wid for wid, pieces in owned.items()
+                            for piece in pieces}
+            deltas = []
+            for piece, (wid, gen) in sorted(state["owner"].items()):
+                if piece in state["done"] or wid not in alive:
+                    continue
+                seen = client_owner.get(piece)
+                if seen is not None and seen != wid:
+                    deltas.append({"piece": piece, "from": seen,
+                                   "to": wid, "generation": gen})
+            # Plan fresh steals over ALL live workers — not just those the
+            # client reported grants on: a worker that registered
+            # mid-epoch has no stream yet (owned is empty for it) but is
+            # exactly the drained receiver work-stealing exists to feed;
+            # its address ships in the reply so the client can open one.
+            pending = {wid: 0 for wid in alive}
+            for piece, (wid, gen) in state["owner"].items():
+                if piece not in state["done"] and wid in pending:
+                    pending[wid] += 1
+            moves = plan_steals(pending, {
+                wid: [p for p in pieces
+                      if p not in state["done"]
+                      and state["owner"].get(p, (None,))[0] == wid]
+                for wid, pieces in stealable.items() if wid in pending},
+                rates)
+            for piece, from_wid, to_wid in moves:
+                self._generation += 1
+                self._apply_steal_locked(client_id, piece, from_wid,
+                                         to_wid, self._generation)
+                self._journal_locked({
+                    "op": "steal", "client_id": client_id, "piece": piece,
+                    "from": from_wid, "to": to_wid,
+                    "generation": self._generation})
+                deltas.append({"piece": piece, "from": from_wid,
+                               "to": to_wid,
+                               "generation": self._generation})
+            if moves:
+                logger.info(
+                    "work stealing: moved %d piece(s) (%s)", len(moves),
+                    "; ".join(f"{p}:{f}->{t}" for p, f, t in moves[:8]),
+                    client_id=client_id,
+                    fencing_epoch=self._fencing_epoch)
+            referenced = ({d["to"] for d in deltas}
+                          | {d["from"] for d in deltas})
+            return {
+                "type": "deltas",
+                "steals": deltas,
+                "generation": self._generation,
+                "fencing_epoch": self._fencing_epoch,
+                # Steal targets may be workers the client has no stream to
+                # yet (a worker that joined mid-epoch): ship addresses so
+                # the grant can open one.
+                "workers": {wid: alive[wid]["address"]
+                            for wid in referenced if wid in alive},
+            }
 
     def _handle_worker_diagnostics(self, header):
         """Diagnostics passthrough: fan the ``diagnostics`` request out to
@@ -678,4 +1158,6 @@ class Dispatcher:
                 "fcfs_epoch": self._fcfs_epoch,
                 "fcfs_remaining": (len(self._fcfs_queue)
                                    if self._fcfs_queue is not None else None),
+                "dynamic": (self._dynamic_status_locked()
+                            if self.mode == "dynamic" else None),
             }

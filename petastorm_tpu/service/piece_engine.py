@@ -1,0 +1,480 @@
+"""Streaming piece engine: one persistent reader pipeline per stream.
+
+The worker-side replacement for per-piece reader spin-up. A stream used to
+pay a full ``Reader`` construction (dataset enumeration, plan, pool thread
+start) *per piece* on the cache-armed cold path — the PR 5 documented
+limitation — and could not change its piece set at all once started. The
+engine constructs ONE reader (``dynamic_ventilation=True``: one enumeration,
+one pool) and feeds row-group pieces through it from a **mutable queue**:
+
+- :meth:`enqueue` appends a piece mid-stream (a work-stealing rebalance
+  granting this worker somebody else's backlog);
+- :meth:`revoke` removes pieces that have not produced a *sent* batch yet —
+  queued pieces, but also pieces already decoded whose batches still sit in
+  the engine's ready set, so a slow worker's decoded-but-unsent backlog is
+  stealable right up to the send boundary;
+- :meth:`finish` closes the queue; the engine ends once everything drained.
+
+Batches are **piece-aligned** (a ragged tail per piece, like the cached
+path always was): every emitted event names its piece and the ownership
+``generation`` the dispatcher stamped on it, which is what lets the client
+dedup by ``(piece, generation)`` and the dispatcher fence steals exactly
+once (``docs/guides/service.md#sharding-modes``).
+
+Cache integration mirrors the old per-piece flow with zero reader cost: a
+warm piece's pre-serialized frames are staged straight from cache memory; a
+cold piece decodes through the shared pool and its batches are serialized
+once for both send and cache fill.
+
+Threading: :meth:`next_event` is called by the stream-serving thread only;
+:meth:`enqueue` / :meth:`revoke` / :meth:`finish` may be called from a
+control thread (the dynamic stream's socket reader). Completion attribution
+rides the pool's item-done markers (FIFO with payloads), so a piece's tail
+is flushed only after every one of its outputs was consumed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from petastorm_tpu.reader_impl.framed_socket import encode_payload
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.workers_pool import (
+    EmptyResultError,
+    TimeoutWaitingForResultError,
+)
+
+logger = service_logger(__name__)
+
+#: Piece lifecycle states. "staged" = fully materialized into the ready set
+#: (cache hit, or decode finished) but nothing sent yet — still revocable.
+_QUEUED, _DECODING, _SERVING, _DONE, _REVOKED = (
+    "queued", "decoding", "serving", "done", "revoked")
+
+
+class _PieceCollator:
+    """Incremental per-piece collation into fixed-size ``{field: array}``
+    batches — the streaming analogue of ``jax_utils.batcher``'s two source
+    adapters (rows buffered to ``batch_size``; column batches sliced and
+    stitched carrying remainders), scoped to ONE piece so batch boundaries
+    align to piece boundaries."""
+
+    def __init__(self, batch_size, batched_output, ngram):
+        self._batch_size = batch_size
+        self._batched = batched_output
+        if not batched_output:
+            from petastorm_tpu.jax_utils.batcher import (
+                collate_ngram_rows,
+                collate_rows,
+            )
+
+            self._collate = collate_ngram_rows if ngram else collate_rows
+        self._rows = []          # row mode: buffered rows
+        self._pending = {}       # column mode: field -> [chunks]
+        self._pending_rows = 0
+        self._names = None
+
+    def add(self, output):
+        """Feed one reader output; return the full batches now complete."""
+        if not self._batched:
+            self._rows.append(output)
+            if len(self._rows) < self._batch_size:
+                return []
+            batch, self._rows = self._collate(self._rows), []
+            return [batch]
+        batch_dict = (output._asdict() if hasattr(output, "_asdict")
+                      else dict(output))
+        if self._names is None:
+            self._names = list(batch_dict)
+            self._pending = {name: [] for name in self._names}
+        rows_in = len(next(iter(batch_dict.values())))
+        for name in self._names:
+            self._pending[name].append(np.asarray(batch_dict[name]))
+        self._pending_rows += rows_in
+        out = []
+        while self._pending_rows >= self._batch_size:
+            out.append(self._emit(self._batch_size))
+        return out
+
+    def _emit(self, n):
+        out, rest = {}, {}
+        for name in self._names:
+            chunks = self._pending[name]
+            joined = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+            out[name] = joined[:n]
+            rest[name] = [joined[n:]] if joined.shape[0] > n else []
+        self._pending = rest
+        self._pending_rows -= n
+        return out
+
+    def flush(self):
+        """The ragged tail batch, or ``None`` when nothing is buffered."""
+        if not self._batched:
+            if not self._rows:
+                return None
+            batch, self._rows = self._collate(self._rows), []
+            return batch
+        if not self._pending_rows:
+            return None
+        return self._emit(self._pending_rows)
+
+
+class StreamingPieceEngine:
+    """Serve an edit-able queue of pieces through one reader pipeline.
+
+    :param reader: a ``dynamic_ventilation=True`` reader over the FULL piece
+        universe, or a zero-arg callable returning one. Pass the callable:
+        construction is then LAZY — deferred until the first piece actually
+        misses the cache — so a fully-warm stream costs zero reader
+        constructions (dataset enumerations, pool spinups), exactly like
+        the PR 5 per-piece warm path. The engine owns whatever it built
+        (:meth:`stop`/:meth:`join`/:meth:`close` stop and join it).
+    :param batch_size: rows per emitted batch (last batch of a piece ragged).
+    :param cache: optional decoded-batch cache
+        (:class:`~petastorm_tpu.cache_impl.BatchCache`); NOT owned — the
+        worker's lifecycle manages it.
+    :param cache_key_fn: ``piece -> key`` for cache lookups/fills.
+    :param cache_note_fn: ``hit: bool -> None`` per-piece lookup accounting.
+    :param lookahead: pieces kept in the decode pipeline beyond the one
+        being served. Small on purpose: an in-pipeline piece is committed to
+        this worker (only unsent work is stealable), so depth trades decode
+        overlap against rebalance agility.
+    """
+
+    def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
+                 cache_note_fn=None, lookahead=2):
+        if callable(reader) and not hasattr(reader, "read_next_tagged"):
+            self._reader = None
+            self._reader_factory = reader
+        else:
+            self._reader = None
+            self._reader_factory = None
+            self._install_reader(reader)
+        self._batch_size = int(batch_size)
+        self._cache = cache
+        self._cache_key_fn = cache_key_fn
+        self._cache_note_fn = cache_note_fn
+        self._lookahead = max(1, int(lookahead))
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._queue = deque()        # (piece, generation) awaiting dispatch
+        self._state = {}             # piece -> lifecycle state
+        self._gen = {}               # piece -> ownership generation
+        self._rows = {}              # piece -> rows emitted
+        self._collators = {}         # piece -> _PieceCollator (cold pieces)
+        self._builders = {}          # piece -> cache fill builder (or None)
+        self._inflight = set()       # pieces submitted, item-done not seen
+        self._out = deque()          # ready events
+        self._finish = False
+        self._finished = False
+        self._pull_s = 0.0           # decode wait attributed to next batch
+        self._served_pieces = 0
+        self._revoked_pieces = 0
+        self._rows_emitted = 0
+
+    def _install_reader(self, reader):
+        if not getattr(reader, "dynamic", False):
+            raise ValueError(
+                "StreamingPieceEngine requires a dynamic_ventilation reader")
+        reader.set_item_done_hook(self._on_item_done)
+        self._reader = reader
+
+    def _ensure_reader(self):
+        """Materialize the lazily-constructed reader (first cache miss).
+        Stream-thread only, like every other decode-path step."""
+        if self._reader is None:
+            self._install_reader(self._reader_factory())
+        return self._reader
+
+    @property
+    def reader(self):
+        """The owned reader — ``None`` while lazy construction has not
+        been triggered (no piece has missed the cache yet)."""
+        return self._reader
+
+    # -- queue edits (any thread) -----------------------------------------
+
+    def enqueue(self, piece, generation=0):
+        """Append a piece to the serve queue (initial plan or a mid-stream
+        steal grant). Re-enqueueing a revoked piece re-arms it (an aborted
+        steal handing the piece back); active/done pieces are ignored."""
+        piece = int(piece)
+        with self._lock:
+            state = self._state.get(piece)
+            if state in (_QUEUED, _DECODING, _SERVING):
+                return False
+            if state == _DONE:
+                logger.warning(
+                    "engine: ignoring enqueue of already-served piece %d",
+                    piece)
+                return False
+            self._state[piece] = _QUEUED
+            self._gen[piece] = int(generation)
+            self._queue.append(piece)
+        self._wake.set()
+        return True
+
+    def revoke(self, pieces):
+        """Remove every named piece that has not had a batch SENT yet (the
+        caller hands a popped event to the transport — "sent" here means
+        handed out via :meth:`next_event`). Returns the pieces actually
+        removed; the rest are already streaming (or done) and stay owned."""
+        removed = []
+        with self._lock:
+            for piece in pieces:
+                piece = int(piece)
+                state = self._state.get(piece)
+                if state == _QUEUED:
+                    try:
+                        self._queue.remove(piece)
+                    except ValueError:
+                        pass
+                elif state != _DECODING:
+                    # serving/done: too late; unknown/revoked: nothing to do
+                    continue
+                # _DECODING pieces stay in _inflight until their item-done
+                # marker drains; their buffered outputs are discarded below.
+                self._state[piece] = _REVOKED
+                self._collators.pop(piece, None)
+                self._builders.pop(piece, None)
+                self._revoked_pieces += 1
+                removed.append(piece)
+            if removed:
+                dropped = set(removed)
+                self._out = deque(
+                    ev for ev in self._out if ev[1] not in dropped)
+        if removed:
+            self._wake.set()
+        return removed
+
+    def finish(self):
+        """No more enqueues: the engine ends once queue + pipeline drain."""
+        with self._lock:
+            self._finish = True
+        self._wake.set()
+
+    # -- serving loop (stream thread only) ---------------------------------
+
+    @property
+    def finished(self):
+        return self._finished
+
+    def next_event(self, timeout=0.1):
+        """The next ready event, or ``None`` after ~``timeout`` idle.
+
+        Events: ``("batch", piece, generation, rows, fmt, frames,
+        decode_s)`` — frames ready for scatter-gather send — and
+        ``("piece_done", piece, generation, rows)`` after a piece's last
+        batch. Decode/ventilation errors raise. Pulls as many reader
+        outputs as it takes inside the deadline (a row reader needs
+        ``batch_size`` of them per batch)."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            self._dispatch_queued()
+            ev = self._pop_ready()
+            if ev is not None:
+                return ev
+            with self._lock:
+                pulling = bool(self._inflight)
+                drained = (not self._inflight and not self._queue
+                           and not self._out)
+                finishing = self._finish and drained
+            if finishing:
+                if not self._finished:
+                    self._finished = True
+                    if self._reader is not None:
+                        try:
+                            self._reader.finish_pieces()
+                        except Exception:  # teardown races: non-fatal here
+                            pass
+                return None
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                return None
+            if not pulling:
+                # Idle: queue empty (waiting for a steal grant or finish).
+                self._wake.wait(remaining)
+                self._wake.clear()
+                continue
+            t0 = time.perf_counter()
+            try:
+                out, piece = self._reader.read_next_tagged(
+                    timeout=max(remaining, 0.001))
+            except TimeoutWaitingForResultError:
+                return None
+            except EmptyResultError:
+                # Feed closed under us (stop/teardown): report idle; the
+                # caller checks `finished`/its own stop flags.
+                self._finished = True
+                return None
+            self._pull_s += time.perf_counter() - t0
+            self._route(out, piece)
+
+    def _pop_ready(self):
+        # Lifecycle flips at HAND-OUT time, not decode time: a piece whose
+        # batches are all materialized but unsent is still _DECODING and
+        # therefore still revocable (stealable) — the whole point of the
+        # send-boundary revocation window.
+        with self._lock:
+            if not self._out:
+                return None
+            ev = self._out.popleft()
+            if ev[0] == "batch":
+                self._state[ev[1]] = _SERVING
+            elif ev[0] == "piece_done":
+                self._state[ev[1]] = _DONE
+                self._served_pieces += 1
+            return ev
+
+    def _dispatch_queued(self):
+        """Top up the pipeline: pop queued pieces up to ``lookahead`` cold
+        pieces in flight; warm pieces are staged straight from the cache
+        without occupying a pipeline slot."""
+        while True:
+            with self._lock:
+                if not self._queue or len(self._inflight) >= self._lookahead:
+                    return
+                piece = self._queue.popleft()
+                gen = self._gen[piece]
+            entry = None
+            if self._cache is not None and self._cache_key_fn is not None:
+                entry = self._cache.get(self._cache_key_fn(piece))
+                if self._cache_note_fn is not None:
+                    self._cache_note_fn(entry is not None)
+            if entry is not None:
+                self._stage_cached(piece, gen, entry)
+                continue
+            reader = self._ensure_reader()
+            with self._lock:
+                if self._state.get(piece) != _QUEUED:
+                    continue  # revoked between pop and dispatch
+                self._state[piece] = _DECODING
+                self._inflight.add(piece)
+                self._collators[piece] = _PieceCollator(
+                    self._batch_size, reader.batched_output,
+                    getattr(reader, "ngram", None))
+                self._builders[piece] = (
+                    self._cache.begin_fill(self._cache_key_fn(piece))
+                    if self._cache is not None else None)
+            reader.submit_piece(piece)
+
+    def _stage_cached(self, piece, gen, entry):
+        """Materialize a warm piece's pre-serialized batches into the ready
+        set. Still revocable until its first batch is handed out."""
+        events, rows = [], 0
+        for cached in entry.batches():
+            events.append(("batch", piece, gen, cached.rows, cached.fmt,
+                           cached.frames, 0.0))
+            rows += cached.rows
+        events.append(("piece_done", piece, gen, rows))
+        with self._lock:
+            if self._state.get(piece) != _QUEUED:
+                return  # revoked while the cache entry was fetched
+            self._state[piece] = _DECODING  # staged; serving on first pop
+            self._rows[piece] = rows
+            self._rows_emitted += rows
+            self._out.extend(events)
+
+    def _route(self, output, piece):
+        """Attribute one reader output to its piece and collate."""
+        if piece is None:
+            raise RuntimeError(
+                "streaming engine received an untagged reader output — "
+                "per-piece attribution requires tagged payloads")
+        with self._lock:
+            collator = self._collators.get(piece)
+            builder = self._builders.get(piece)
+            gen = self._gen.get(piece, 0)
+        if collator is None:
+            return  # revoked mid-decode: discard
+        for batch in collator.add(output):
+            self._emit_batch(piece, gen, batch, builder)
+
+    def _emit_batch(self, piece, gen, batch, builder):
+        if builder is not None:
+            rows, fmt, frames = builder.add_batch(batch)
+        else:
+            fmt, frames = encode_payload(batch)
+            rows = len(next(iter(batch.values()))) if batch else 0
+        decode_s, self._pull_s = self._pull_s, 0.0
+        with self._lock:
+            if self._state.get(piece) == _REVOKED:
+                return
+            self._rows[piece] = self._rows.get(piece, 0) + rows
+            self._rows_emitted += rows
+            self._out.append(
+                ("batch", piece, gen, rows, fmt, frames, decode_s))
+
+    def _on_item_done(self, item):
+        """Pool hook (fires on the stream thread inside the results pull):
+        the named piece published everything — flush its ragged tail,
+        commit its cache fill, and emit ``piece_done``."""
+        piece = item.get("piece_index") if isinstance(item, dict) else None
+        if piece is None:
+            return
+        piece = int(piece)
+        with self._lock:
+            self._inflight.discard(piece)
+            state = self._state.get(piece)
+            collator = self._collators.pop(piece, None)
+            builder = self._builders.pop(piece, None)
+            gen = self._gen.get(piece, 0)
+        if state not in (_DECODING, _SERVING) or collator is None:
+            return  # revoked (or unknown): partial fill discarded, no tail
+        tail = collator.flush()
+        if tail is not None:
+            self._emit_batch(piece, gen, tail, builder)
+        if builder is not None:
+            try:
+                builder.commit()
+            except Exception:
+                logger.warning("cache fill commit failed for piece %d",
+                               piece, exc_info=True)
+        with self._lock:
+            if self._state.get(piece) == _REVOKED:
+                return
+            rows = self._rows.get(piece, 0)
+            # State stays _DECODING (revocable) until the piece_done event
+            # is handed out by _pop_ready.
+            self._out.append(("piece_done", piece, gen, rows))
+
+    # -- lifecycle / observability -----------------------------------------
+
+    @property
+    def diagnostics(self):
+        with self._lock:
+            return {
+                "engine_pieces_queued": len(self._queue),
+                "engine_pieces_in_flight": len(self._inflight),
+                "engine_pieces_served": self._served_pieces,
+                "engine_pieces_revoked": self._revoked_pieces,
+                "engine_rows_emitted": self._rows_emitted,
+                "engine_finished": self._finished,
+            }
+
+    def queued_pieces(self):
+        with self._lock:
+            return list(self._queue)
+
+    def stop(self):
+        """Stop the owned reader (a lazily-unconstructed one is a no-op) —
+        the Reader-shaped half of the stream-teardown contract."""
+        with self._lock:
+            self._finish = True
+        if self._reader is not None:
+            self._reader.stop()
+
+    def join(self):
+        if self._reader is not None:
+            self._reader.join()
+
+    def close(self):
+        """Stop and join the owned reader (pool threads included)."""
+        try:
+            self.stop()
+        finally:
+            self.join()

@@ -37,6 +37,7 @@ from petastorm_tpu.telemetry.metrics import (
     WORKER_BATCHES_SENT,
     WORKER_CREDIT_WAIT,
     WORKER_DECODE_SECONDS,
+    WORKER_READERS_CONSTRUCTED,
     WORKER_ROWS_SENT,
     WORKER_STREAMS,
 )
@@ -171,6 +172,7 @@ class BatchWorker:
         self._m_credit_wait = WORKER_CREDIT_WAIT.labels(self.worker_id)
         self._m_active = WORKER_ACTIVE_STREAMS.labels(self.worker_id)
         self._m_decode = WORKER_DECODE_SECONDS.labels(self.worker_id)
+        self._m_readers = WORKER_READERS_CONSTRUCTED.labels(self.worker_id)
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self._heartbeat_paused = threading.Event()  # test hook: hung worker
@@ -405,10 +407,25 @@ class BatchWorker:
         not tracing is armed.
 
         Caching: with a ``batch_cache`` armed, pieces are looked up (and
-        filled) individually — see :meth:`_stream_pieces_cached`. The
-        uncached path is byte-for-byte the pre-cache behavior (one reader
-        over the whole piece set, batches collated across pieces)."""
-        pieces = [int(p) for p in header["pieces"]]
+        filled) individually through ONE streaming piece engine per stream
+        (:meth:`_stream_pieces_engine` — a cold fill costs one reader
+        construction per stream, not per piece); pools without per-item
+        completion attribution (process) fall back to the per-piece reader
+        path. The uncached static path is byte-for-byte the pre-cache
+        behavior (one reader over the whole piece set, batches collated
+        across pieces).
+
+        Dynamic mode (``dynamic: true`` in the request): pieces arrive as
+        ``[piece, generation]`` pairs and the same engine serves them from
+        a queue the client edits mid-stream with ``extend``/``revoke``/
+        ``finish_pieces`` control frames — a work-stealing rebalance costs
+        a queue edit instead of a reader construction
+        (``docs/guides/service.md#sharding-modes``)."""
+        dynamic = bool(header.get("dynamic"))
+        if dynamic:
+            pieces = [(int(p), int(g)) for p, g in header["pieces"]]
+        else:
+            pieces = [int(p) for p in header["pieces"]]
         credits = header.get("credits")
         credits = int(credits) if credits is not None else None
         flow = {"credits_window": credits, "credits_left": credits,
@@ -425,7 +442,15 @@ class BatchWorker:
             self._active[stream_key] = state
         self._m_active.inc()
         try:
-            if self._batch_cache is not None:
+            if dynamic:
+                rows_sent = self._stream_dynamic(
+                    sock, conn_reader, state, pieces, flow, credits,
+                    stream_key, epoch=header.get("epoch"))
+            elif self._batch_cache is not None and self._engine_supported():
+                rows_sent = self._stream_pieces_engine(
+                    sock, conn_reader, state, pieces, flow, credits,
+                    stream_key, epoch=header.get("epoch"))
+            elif self._batch_cache is not None:
                 rows_sent = self._stream_pieces_cached(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"))
@@ -565,6 +590,150 @@ class BatchWorker:
                 reader.join()
         return rows_sent
 
+    # -- streaming piece engine paths --------------------------------------
+
+    def _engine_supported(self):
+        """The streaming engine needs per-item completion attribution,
+        which only the thread and dummy reader pools provide."""
+        return self._reader_kwargs.get(
+            "reader_pool_type", "thread") in ("thread", "dummy")
+
+    def _make_engine(self, epoch):
+        """ONE dynamic-ventilation reader + engine for a whole stream —
+        the piece queue is fed (and edited) afterwards, so a stream (or a
+        cold cache fill) over N pieces costs one reader construction, one
+        dataset enumeration, one pool spinup, instead of N. The reader is
+        built lazily on the first cache MISS: a fully-warm stream
+        constructs none at all (``readers_constructed_total`` stays flat)."""
+        from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+
+        def build_reader():
+            self._m_readers.inc()
+            return self._factory(self.dataset_url, dynamic_ventilation=True,
+                                 num_epochs=1, shuffle_row_groups=False,
+                                 cur_shard=0, shard_count=1,
+                                 **self._reader_kwargs)
+
+        cache = self._batch_cache
+        return StreamingPieceEngine(
+            build_reader, self._batch_size, cache=cache,
+            cache_key_fn=(self._piece_cache_key
+                          if cache is not None else None),
+            cache_note_fn=(
+                (lambda hit: self._note_cache_lookup(epoch, hit))
+                if cache is not None else None))
+
+    def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
+                              credits, stream_key, epoch=None):
+        """Cache-armed serving through the streaming engine: warm pieces
+        scatter-gather straight from cache memory, cold pieces decode
+        through the stream's ONE shared pipeline and fill the cache — the
+        PR 5 per-piece reader spinup is gone. Batch boundaries stay
+        piece-aligned, exactly like the per-piece cached path."""
+        collector = tracing.COLLECTOR
+        engine = self._make_engine(epoch)
+        with self._lock:
+            # The engine is Reader-shaped for lifecycle and snapshots
+            # (diagnostics / stop / join): the teardown block stops it,
+            # which stops whatever reader it lazily built.
+            state["reader"] = engine
+        for piece in pieces:
+            engine.enqueue(piece)
+        engine.finish()
+        rows_sent = 0
+        while True:
+            if self._server.stopped.is_set():
+                return None
+            event = engine.next_event(timeout=0.1)
+            if event is None:
+                if engine.finished:
+                    return rows_sent
+                continue
+            if event[0] != "batch":
+                continue  # piece_done: plain streams carry no such frame
+            _, piece, gen, rows, fmt, frames, decode_s = event
+            if decode_s:
+                self._m_decode.observe(decode_s)
+            bid = f"{self.worker_id}:{stream_key}:{flow['batches_sent']}"
+            if not self._send_stream_batch(sock, conn_reader, flow,
+                                           credits, bid, rows, fmt,
+                                           frames, collector):
+                return None
+            rows_sent += rows
+
+    def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
+                        credits, stream_key, epoch=None):
+        """Dynamic-mode serving: the engine's piece queue is the worker's
+        deque, edited in-band mid-stream — ``extend`` appends steal
+        grants, ``revoke`` removes not-yet-sent pieces (acked with the
+        subset actually removed, which is what makes the client's
+        revoke-then-extend steal handshake exactly-once), and
+        ``finish_pieces`` closes the queue so the stream ends once
+        everything drained. Every ``batch`` frame carries its piece and
+        ownership generation; each finished piece is announced with a
+        ``piece_done`` frame."""
+        if not self._engine_supported():
+            raise ValueError(
+                "dynamic streams need the streaming piece engine, which "
+                "requires reader_pool_type='thread' (or 'dummy') — this "
+                f"worker runs "
+                f"{self._reader_kwargs.get('reader_pool_type')!r}")
+        collector = tracing.COLLECTOR
+        engine = self._make_engine(epoch)
+        with self._lock:
+            # The engine is Reader-shaped for lifecycle and snapshots
+            # (diagnostics / stop / join): the teardown block stops it,
+            # which stops whatever reader it lazily built.
+            state["reader"] = engine
+        for piece, gen in pieces:
+            engine.enqueue(piece, gen)
+
+        def on_frame(msg):
+            kind = msg.get("type")
+            if kind == "extend":
+                for piece, gen in msg.get("pieces", []):
+                    engine.enqueue(int(piece), int(gen))
+            elif kind == "revoke":
+                removed = engine.revoke(
+                    int(p) for p in msg.get("pieces", []))
+                send_framed(sock, {"type": "revoked", "pieces": removed,
+                                   "req": msg.get("req")})
+            elif kind == "finish_pieces":
+                engine.finish()
+
+        rows_sent = 0
+        while True:
+            if self._server.stopped.is_set():
+                return None
+            while conn_reader.data_pending():
+                msg, _ = conn_reader.recv()
+                if msg.get("type") == "credit":
+                    flow["credits_left"] += int(msg.get("n", 1))
+                else:
+                    on_frame(msg)
+            event = engine.next_event(timeout=0.02)
+            if event is None:
+                if engine.finished:
+                    return rows_sent
+                continue
+            if event[0] == "batch":
+                _, piece, gen, rows, fmt, frames, decode_s = event
+                if decode_s:
+                    self._m_decode.observe(decode_s)
+                bid = (f"{self.worker_id}:{stream_key}:"
+                       f"{flow['batches_sent']}")
+                if not self._send_stream_batch(
+                        sock, conn_reader, flow, credits, bid, rows, fmt,
+                        frames, collector,
+                        extra_header={"piece": piece, "generation": gen},
+                        on_frame=on_frame):
+                    return None
+                rows_sent += rows
+            else:  # piece_done
+                _, piece, gen, rows = event
+                send_framed(sock, {"type": "piece_done", "piece": piece,
+                                   "generation": gen, "rows": rows})
+
     _CACHE_EPOCHS_KEPT = 64
 
     def _note_cache_lookup(self, epoch, hit):
@@ -587,6 +756,7 @@ class BatchWorker:
                     for epoch, bucket in self._cache_epochs.items()}
 
     def _make_stream_reader(self, pieces):
+        self._m_readers.inc()
         return self._factory(self.dataset_url, piece_indices=pieces,
                              num_epochs=1, shuffle_row_groups=False,
                              cur_shard=0, shard_count=1,
@@ -617,11 +787,16 @@ class BatchWorker:
                    "last_batch": "keep"})
 
     def _send_stream_batch(self, sock, conn_reader, flow, credits, bid,
-                           rows, fmt, frames, collector):
+                           rows, fmt, frames, collector,
+                           extra_header=None, on_frame=None):
         """The shared per-batch send step: honor stop, drain/await credits,
         apply fault-injection pacing, scatter-gather the frames, account.
         Returns ``False`` when the worker stopped (caller aborts the
-        stream without an ``end`` frame)."""
+        stream without an ``end`` frame). ``on_frame`` handles non-credit
+        control frames encountered while draining (dynamic streams carry
+        ``extend``/``revoke``/``finish_pieces`` queue edits in-band — they
+        must not be lost to a credit wait); ``extra_header`` merges into
+        the ``batch`` frame header (piece/generation tags)."""
         if self._server.stopped.is_set():
             return False
         if credits is not None:
@@ -635,6 +810,8 @@ class BatchWorker:
                 reply, _ = conn_reader.recv()
                 if reply.get("type") == "credit":
                     flow["credits_left"] += int(reply.get("n", 1))
+                elif on_frame is not None:
+                    on_frame(reply)
                 # anything else mid-stream is out of protocol; skip
             if flow["credits_left"] <= 0:
                 t0 = time.perf_counter()
@@ -644,14 +821,18 @@ class BatchWorker:
                     reply, _ = conn_reader.recv()
                     if reply.get("type") == "credit":
                         flow["credits_left"] += int(reply.get("n", 1))
+                    elif on_frame is not None:
+                        on_frame(reply)
                 waited = time.perf_counter() - t0
                 flow["credit_wait_s"] += waited
                 self._m_credit_wait.inc(waited)
         if self._batch_delay_s:
             time.sleep(self._batch_delay_s)
         t_send = time.perf_counter()
-        send_framed_frames(sock, {"type": "batch", "rows": rows,
-                                  "bid": bid}, fmt, frames)
+        header = {"type": "batch", "rows": rows, "bid": bid}
+        if extra_header:
+            header.update(extra_header)
+        send_framed_frames(sock, header, fmt, frames)
         if collector.enabled:
             collector.record_span("worker.send", t_send,
                                   time.perf_counter(), bid=bid)
@@ -692,6 +873,7 @@ class BatchWorker:
             "rows_sent_total": self._m_rows.value,
             "credit_wait_seconds_total": self._m_credit_wait.value,
             "active_streams": self._m_active.value,
+            "readers_constructed_total": self._m_readers.value,
         }
         out = {
             "worker_id": self.worker_id,

@@ -30,4 +30,16 @@ class TimeoutWaitingForResultError(Exception):
 
 
 class VentilatedItemProcessedMessage:
-    """Control marker a worker emits after finishing one ventilated item."""
+    """Control marker a worker emits after finishing one ventilated item.
+
+    ``item`` optionally carries the finished work item's kwargs (thread and
+    dummy pools fill it in) so a consumer that tracks per-item completion —
+    the service's streaming piece engine flushing a piece's ragged tail
+    batch — can observe *which* item drained. ``None`` when the pool flavor
+    cannot say (process-pool workers emit the marker from another process).
+    """
+
+    __slots__ = ("item",)
+
+    def __init__(self, item=None):
+        self.item = item

@@ -8,12 +8,19 @@ from __future__ import annotations
 import time
 from collections import deque
 
-from petastorm_tpu.workers_pool import EmptyResultError
+from petastorm_tpu.workers_pool import (
+    EmptyResultError,
+    VentilatedItemProcessedMessage,
+)
 from petastorm_tpu.workers_pool.thread_pool import WorkerException
 
 
 class DummyPool:
     """Processes each ventilated item synchronously inside :meth:`ventilate`."""
+
+    #: Completion markers are created in-process with the item's kwargs —
+    #: the capability the streaming piece engine requires.
+    supports_item_done_hook = True
 
     def __init__(self, workers_count=1, results_queue_size=None):
         self._results = deque()
@@ -23,6 +30,11 @@ class DummyPool:
         self._ventilated_items = 0
         self._completed_items = 0
         self.workers_count = workers_count
+        #: Optional ``hook(item_kwargs)`` invoked as :meth:`get_results`
+        #: drains an item's completion marker — same ordering contract as
+        #: ThreadPool: the marker rides the results deque BEHIND the item's
+        #: payloads, so the hook fires only after all of them were returned.
+        self.item_done_hook = None
 
     @property
     def diagnostics(self):
@@ -31,7 +43,9 @@ class DummyPool:
             "items_ventilated": self._ventilated_items,
             "items_processed": self._completed_items,
             "items_in_flight": self._ventilated_items - self._completed_items,
-            "results_queue_size": len(self._results),
+            # Real payloads only — completion markers are control flow,
+            # not deliverable results.
+            "results_queue_size": self.results_qsize(),
             "workers_count": self.workers_count,
         }
 
@@ -55,6 +69,11 @@ class DummyPool:
             self._completed_items += 1
             if self._ventilator is not None:
                 self._ventilator.processed_item()
+            # Deferred into the results stream (not fired here): the item's
+            # payloads are already in the deque, and the hook contract is
+            # "fires after every payload of the item was returned".
+            self._results.append(VentilatedItemProcessedMessage(
+                kwargs or None))
 
     def get_results(self, timeout=None):
         # The concurrent ventilator (if any) runs on its own thread and calls
@@ -69,6 +88,11 @@ class DummyPool:
                 raise TimeoutWaitingForResultError(f"No results for {timeout}s")
             if self._results:
                 result = self._results.popleft()
+                if isinstance(result, VentilatedItemProcessedMessage):
+                    hook = self.item_done_hook
+                    if hook is not None and result.item is not None:
+                        hook(result.item)
+                    continue
                 if isinstance(result, WorkerException):
                     raise result
                 return result
@@ -85,7 +109,10 @@ class DummyPool:
             time.sleep(0.001)
 
     def results_qsize(self):
-        return len(self._results)
+        # Real payloads only — completion markers are control flow,
+        # not deliverable results.
+        return sum(1 for r in self._results
+                   if not isinstance(r, VentilatedItemProcessedMessage))
 
     def stop(self):
         self._stopped = True

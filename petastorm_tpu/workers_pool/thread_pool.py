@@ -37,6 +37,11 @@ class WorkerException(Exception):
 
 
 class ThreadPool:
+    #: This pool can attribute completion markers to their work item (the
+    #: marker is created in-process with the item's kwargs in hand) — the
+    #: capability the streaming piece engine requires.
+    supports_item_done_hook = True
+
     def __init__(self, workers_count, results_queue_size=50, profiling_enabled=False):
         self._workers_count = workers_count
         self._results_queue = queue.Queue(maxsize=results_queue_size)
@@ -49,6 +54,11 @@ class ThreadPool:
         self._completed_items = 0
         self._results_pending = 0  # real RESULT payloads in the queue
         self._counter_lock = threading.Lock()
+        #: Optional ``hook(item_kwargs)`` invoked on the consumer thread as
+        #: :meth:`get_results` drains an item's completion marker — i.e.
+        #: strictly AFTER every payload that item published was returned
+        #: (payloads and marker ride the same FIFO queue).
+        self.item_done_hook = None
 
     @property
     def workers_count(self):
@@ -111,7 +121,10 @@ class ThreadPool:
             finally:
                 # Count failed items as processed too — otherwise the
                 # ventilator's in-flight window leaks and the pool deadlocks.
-                self._results_queue.put(VentilatedItemProcessedMessage())
+                # The marker carries the item's kwargs so a consumer-side
+                # item_done_hook can attribute the completion.
+                self._results_queue.put(
+                    VentilatedItemProcessedMessage(kwargs or None))
 
     def ventilate(self, *args, **kwargs):
         with self._counter_lock:
@@ -151,6 +164,9 @@ class ThreadPool:
                 POOL_ITEMS_PROCESSED.inc()
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                hook = self.item_done_hook
+                if hook is not None and result.item is not None:
+                    hook(result.item)
                 continue
             if isinstance(result, WorkerException):
                 raise result

@@ -200,3 +200,70 @@ class ConcurrentVentilator(Ventilator):
         with self._lock:
             self._in_flight = 0
         self.start()
+
+
+class DynamicVentilator(Ventilator):
+    """Externally-fed ventilator: items arrive one at a time via
+    :meth:`submit` instead of from a pre-planned list.
+
+    The seam behind ``Reader(dynamic_ventilation=True)`` — the service's
+    streaming piece engine feeds row-group pieces into ONE long-lived pool
+    as its mutable piece queue is consumed (and edited mid-stream by
+    work-stealing rebalances), instead of constructing a reader per piece.
+    The caller owns admission control (how many pieces it keeps in flight);
+    this class only tracks the counts and the finished flag. There is no
+    background thread: :meth:`submit` calls ``ventilate_fn`` inline, so a
+    thread pool enqueues and returns immediately while a dummy pool decodes
+    synchronously inside the call.
+    """
+
+    def __init__(self, ventilate_fn):
+        super().__init__(ventilate_fn)
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self._items_ventilated = 0
+        self._finished = False
+        #: Pools probe ``ventilator.error`` to surface ventilation-thread
+        #: failures; a thread-less ventilator never has one.
+        self.error = None
+
+    @property
+    def diagnostics(self):
+        with self._lock:
+            return {
+                "items_ventilated": self._items_ventilated,
+                "items_in_flight": self._in_flight,
+                "ventilation_completed": self._finished,
+            }
+
+    def start(self):
+        """Nothing to start — submission drives everything."""
+
+    def submit(self, item):
+        """Feed one work item (a kwargs dict) to the pool."""
+        with self._lock:
+            if self._finished:
+                raise RuntimeError(
+                    "DynamicVentilator.submit after finish(): the stream "
+                    "already declared its piece queue closed")
+            self._in_flight += 1
+            self._items_ventilated += 1
+        VENTILATOR_ITEMS.inc()
+        self._ventilate_fn(**item)
+
+    def processed_item(self):
+        with self._lock:
+            if self._in_flight > 0:
+                self._in_flight -= 1
+
+    def finish(self):
+        """No further submissions: once in-flight items drain, consumers
+        see end-of-data (``EmptyResultError``) instead of blocking."""
+        with self._lock:
+            self._finished = True
+
+    def completed(self):
+        return self._finished
+
+    def stop(self):
+        self.finish()

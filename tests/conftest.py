@@ -56,6 +56,13 @@ def _open_socket_fds():
 _LIBRARY_SINGLETON_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
                                       "orbax", "grpc")
 
+#: Reader-pool worker threads are DAEMON threads (the non-daemon check
+#: misses them), but an orphaned one means a Reader — e.g. the one owned
+#: by a service stream's streaming piece engine — was never stopped: it
+#: keeps decoding into a bounded queue nobody drains and pins its pool
+#: for the rest of the session.
+_READER_POOL_THREAD_PREFIX = "petastorm-tpu-worker"
+
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
@@ -88,10 +95,14 @@ def _resource_leak_guard(request):
             t for t in threading.enumerate()
             if t not in before_threads and t.is_alive() and not t.daemon
             and not t.name.startswith(_LIBRARY_SINGLETON_THREAD_PREFIXES)]
+        leaked_pool_threads = [
+            t for t in threading.enumerate()
+            if t not in before_threads and t.is_alive()
+            and t.name.startswith(_READER_POOL_THREAD_PREFIX)]
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
-        if not leaked_threads and not leaked_sockets \
-                and not leaked_cache_dirs:
+        if not leaked_threads and not leaked_pool_threads \
+                and not leaked_sockets and not leaked_cache_dirs:
             return
         if time.monotonic() >= deadline:
             break
@@ -99,12 +110,51 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
+        f"reader-pool threads {[t.name for t in leaked_pool_threads]} "
+        f"(an unstopped Reader — e.g. a streaming piece engine whose "
+        f"owner never stopped/joined it), "
         f"sockets {sorted(leaked_sockets)}, "
         f"cache dirs {sorted(leaked_cache_dirs)} — stop/close every "
-        f"service node, loader, and connection the test started, and "
-        f"cleanup() every cache "
+        f"service node, loader, engine, and connection the test started, "
+        f"and cleanup() every cache "
         f"(mark allow_resource_leaks only with a documented reason)",
         pytrace=False)
+
+
+#: ROADMAP's tier-1 timeout and the fraction of it the `-m 'not slow'`
+#: suite may consume before the gate fails: steal/chaos tests must not
+#: silently bloat the fast suite until the 870s timeout starts flaking.
+_TIER1_TIMEOUT_S = 870.0
+_TIER1_BUDGET_FRACTION = 0.8
+
+
+def pytest_configure(config):
+    config._tier1_budget_start = time.monotonic()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail an otherwise-green `-m 'not slow'` run that exceeds 80% of the
+    ROADMAP's 870s tier-1 timeout — a runtime regression is a gate
+    failure BEFORE it becomes a timeout flake."""
+    markexpr = getattr(session.config.option, "markexpr", "") or ""
+    if "not slow" not in markexpr:
+        return
+    start = getattr(session.config, "_tier1_budget_start", None)
+    if start is None:
+        return
+    elapsed = time.monotonic() - start
+    budget = _TIER1_TIMEOUT_S * _TIER1_BUDGET_FRACTION
+    if elapsed > budget and exitstatus == 0:
+        reporter = session.config.pluginmanager.get_plugin(
+            "terminalreporter")
+        message = (
+            f"tier-1 runtime budget exceeded: the -m 'not slow' suite took "
+            f"{elapsed:.0f}s, over {_TIER1_BUDGET_FRACTION:.0%} of the "
+            f"{_TIER1_TIMEOUT_S:.0f}s ROADMAP timeout ({budget:.0f}s). "
+            f"Move slow additions behind @pytest.mark.slow or shrink them.")
+        if reporter is not None:
+            reporter.write_sep("!", message)
+        session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,611 @@
+"""Dynamic sharding: work-stealing piece rebalancing + streaming engine.
+
+Layers under test (docs/guides/service.md#sharding-modes):
+
+- the pure work-stealing planner (``dispatcher.plan_steals``): drain and
+  straggler triggers, midpoint convergence, stealable-only moves;
+- the streaming piece engine (``service/piece_engine.py``): one reader
+  pipeline per stream fed from a mutable queue — enqueue/revoke/finish
+  semantics, lazy reader construction (a fully-warm stream builds none);
+- dynamic mode end-to-end over loopback: same multiset as a local reader,
+  steals away from a skewed worker shrink the epoch wall, multi-epoch
+  streams, per-piece ``state_dict`` resume across a mid-epoch steal;
+- the ISSUE acceptance numbers: with one of two workers skewed per batch,
+  the dynamic epoch wall lands near the no-skew wall while static stays
+  slow-worker-bound, with zero lost and zero duplicate rows;
+- chaos runs (``worker-kill``, ``dispatcher-restart``, ``conn-drop``)
+  under dynamic sharding keep the delivery invariants (slow).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.service import BatchWorker, Dispatcher, ServiceBatchSource
+from petastorm_tpu.service.dispatcher import plan_steals
+
+pytestmark = pytest.mark.service
+
+
+# ---------------------------------------------------------------------------
+# work-stealing planner (pure)
+# ---------------------------------------------------------------------------
+
+def test_plan_steals_drained_worker_receives_from_most_backlogged():
+    moves = plan_steals(
+        pending={"w0": 6, "w1": 0, "w2": 2},
+        stealable={"w0": [10, 11, 12, 13, 14], "w2": [20]},
+        rates={})
+    # w1 drained: pieces flow from w0 (most backlogged), tail first,
+    # rebalancing toward the midpoint (6 vs 0 -> 3 moves).
+    assert [(f, t) for _p, f, t in moves][:3] == [("w0", "w1")] * 3
+    assert [p for p, _f, _t in moves][:3] == [14, 13, 12]
+
+
+def test_plan_steals_straggler_rate_triggers_proactive_move():
+    # Nobody drained, but w0 crawls at < half the fleet median while
+    # holding stealable backlog: pieces move to a median-or-faster worker
+    # with materially less backlog.
+    moves = plan_steals(
+        pending={"w0": 8, "w1": 2, "w2": 2},
+        stealable={"w0": [1, 2, 3, 4, 5, 6]},
+        rates={"w0": 10.0, "w1": 100.0, "w2": 120.0})
+    assert moves, "straggler trigger planned no steals"
+    assert all(f == "w0" for _p, f, t in moves)
+    assert all(t in ("w1", "w2") for _p, _f, t in moves)
+
+
+def test_plan_steals_balanced_fleet_plans_nothing():
+    assert plan_steals(pending={"w0": 3, "w1": 3},
+                       stealable={"w0": [1, 2], "w1": [5, 6]},
+                       rates={"w0": 50.0, "w1": 55.0}) == []
+    # A donor's LAST pending piece is never stolen (it is being served).
+    assert plan_steals(pending={"w0": 1, "w1": 0},
+                       stealable={"w0": [7]}, rates={}) == []
+
+
+def test_plan_steals_rate_proportional_split_in_one_sync():
+    # With measured rates the split is proportional, not midpoint: an
+    # ~11x faster receiver takes all but one piece in a single sync
+    # (every extra round leaves the straggler starting pieces that then
+    # stop being stealable).
+    moves = plan_steals(pending={"w0": 8, "w1": 0},
+                        stealable={"w0": list(range(8))},
+                        rates={"w0": 10.0, "w1": 110.0})
+    assert len(moves) == 7
+    assert all((f, t) == ("w0", "w1") for _p, f, t in moves)
+
+
+def test_plan_steals_never_bounces_work_back_to_drained_straggler():
+    # A drained straggler near the epoch tail: the fast donor's
+    # proportional share is the whole remaining backlog, so nothing moves
+    # — handing the slow worker one last piece would serialize the epoch
+    # wall behind it.
+    assert plan_steals(pending={"slow": 0, "fast": 4},
+                       stealable={"fast": [1, 2]},
+                       rates={"slow": 10.0, "fast": 110.0}) == []
+
+
+def test_plan_steals_zero_rate_donor_sheds_to_one_piece_floor():
+    # A donor that has delivered NOTHING while a receiver is demonstrably
+    # moving sheds its backlog down to the piece it is serving in ONE
+    # sync — halving would cost a round per factor of 2, and every round
+    # the straggler starts another piece that stops being stealable.
+    moves = plan_steals(pending={"w0": 16, "w1": 2},
+                        stealable={"w0": list(range(16))},
+                        rates={"w0": 0.0, "w1": 5000.0})
+    assert len(moves) == 15
+    assert all((f, t) == ("w0", "w1") for _p, f, t in moves)
+
+
+def test_plan_steals_below_median_receiver_gets_probe_not_share():
+    # A drained receiver whose own rate is below the straggler threshold
+    # (it drained because it was shed, not because it is fast) gets a
+    # 2-piece PROBE instead of the rate-proportional share: early-epoch
+    # EMAs over-hand work back, and every piece handed back serves at the
+    # slow rate or must be re-stolen.
+    moves = plan_steals(pending={"slow": 0, "fast": 29},
+                        stealable={"fast": list(range(29))},
+                        rates={"slow": 4000.0, "fast": 10000.0})
+    assert len(moves) == 2
+    assert all((f, t) == ("fast", "slow") for _p, f, t in moves)
+
+
+def test_plan_steals_small_share_to_below_median_receiver_stays_put():
+    # Near the tail a 1-2 piece proportional share is not worth the
+    # revoke/extend round trip plus the straggler's serve rate: the
+    # healthy donor keeps it and the slow worker stays idle.
+    assert plan_steals(pending={"slow": 0, "fast": 8},
+                       stealable={"fast": list(range(8))},
+                       rates={"slow": 2000.0, "fast": 10000.0}) == []
+
+
+def test_plan_steals_moves_only_stealable_pieces():
+    moves = plan_steals(pending={"w0": 9, "w1": 0},
+                        stealable={"w0": [3]}, rates={})
+    assert moves == [(3, "w0", "w1")]  # backlog says 4, stealable caps at 1
+
+
+# ---------------------------------------------------------------------------
+# streaming piece engine
+# ---------------------------------------------------------------------------
+
+def _dynamic_reader(url, pool="dummy"):
+    from petastorm_tpu import make_batch_reader
+
+    return make_batch_reader(url, dynamic_ventilation=True, num_epochs=1,
+                             shuffle_row_groups=False, cur_shard=0,
+                             shard_count=1, reader_pool_type=pool,
+                             workers_count=2)
+
+
+def _drain_engine(engine, timeout_s=30.0):
+    """Pump the engine to completion; return (batch events, done events)."""
+    batches, done = [], []
+    deadline = time.monotonic() + timeout_s
+    while not engine.finished:
+        assert time.monotonic() < deadline, "engine did not drain"
+        event = engine.next_event(timeout=0.2)
+        if event is None:
+            continue
+        (batches if event[0] == "batch" else done).append(event)
+    return batches, done
+
+
+def _decode_rows(batches):
+    from petastorm_tpu.reader_impl.framed_socket import decode_payload
+
+    ids = []
+    for _kind, _piece, _gen, _rows, fmt, frames, _s in batches:
+        payload = decode_payload(fmt, [bytes(f) for f in frames])
+        ids.extend(int(i) for i in payload["id"])
+    return ids
+
+
+def test_engine_serves_queue_through_one_reader(scalar_dataset_12pieces):
+    from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+
+    url, rows = scalar_dataset_12pieces
+    constructed = []
+
+    def factory():
+        constructed.append(1)
+        return _dynamic_reader(url)
+
+    engine = StreamingPieceEngine(factory, batch_size=5)
+    try:
+        for piece in range(12):
+            engine.enqueue(piece, generation=7)
+        engine.finish()
+        batches, done = _drain_engine(engine)
+        assert len(constructed) == 1  # ONE reader for 12 pieces
+        assert sorted(_decode_rows(batches)) == list(range(rows))
+        # Piece-aligned: every piece announces exactly one piece_done with
+        # the generation it was granted under, after its batches.
+        assert sorted(p for _k, p, _g, _r in done) == list(range(12))
+        assert {g for _k, _p, g, _r in done} == {7}
+        assert engine.diagnostics["engine_pieces_served"] == 12
+    finally:
+        engine.close()
+
+
+def test_engine_revoke_removes_unsent_reenqueue_rearms(
+        scalar_dataset_12pieces):
+    from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+
+    url, _rows = scalar_dataset_12pieces
+    engine = StreamingPieceEngine(lambda: _dynamic_reader(url), batch_size=5)
+    try:
+        for piece in range(12):
+            engine.enqueue(piece, generation=1)
+        # Deep-queued pieces (beyond the lookahead) have not started: a
+        # revoke must drop them before anything is sent.
+        removed = engine.revoke([9, 10, 11])
+        assert sorted(removed) == [9, 10, 11]
+        # Re-granting a revoked piece re-arms it (an aborted steal).
+        assert engine.enqueue(10, generation=2)
+        engine.finish()
+        batches, done = _drain_engine(engine)
+        served = {p for _k, p, _g, _r in done}
+        assert served == set(range(9)) | {10}
+        by_piece = {p: g for _k, p, g, _r in done}
+        assert by_piece[10] == 2  # served under the re-grant's generation
+        assert sorted(_decode_rows(batches)) == sorted(
+            i for p in served for i in range(5 * p, 5 * p + 5))
+        assert engine.diagnostics["engine_pieces_revoked"] == 3
+    finally:
+        engine.close()
+
+
+def test_engine_lazy_reader_not_built_for_all_warm_stream(
+        scalar_dataset_12pieces):
+    """A fully-warm stream (every piece a cache hit) must not construct a
+    reader at all — the PR 5 warm path's zero-spinup property."""
+    from petastorm_tpu.cache_impl import BatchCache, batch_fingerprint
+    from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+
+    url, _rows = scalar_dataset_12pieces
+    cache = BatchCache(mem_budget_bytes=32 << 20)
+
+    def key(piece):
+        return batch_fingerprint(url, [int(piece)], 5)
+
+    def fill(piece):
+        builder = cache.begin_fill(key(piece))
+        builder.add_batch({"id": np.arange(5 * piece, 5 * piece + 5)})
+        builder.commit()
+
+    for piece in (0, 1, 2):
+        fill(piece)
+
+    def factory():
+        raise AssertionError("warm stream constructed a reader")
+
+    engine = StreamingPieceEngine(factory, batch_size=5, cache=cache,
+                                  cache_key_fn=key)
+    try:
+        for piece in (0, 1, 2):
+            engine.enqueue(piece)
+        engine.finish()
+        batches, done = _drain_engine(engine)
+        assert engine.reader is None
+        assert sorted(_decode_rows(batches)) == list(range(15))
+        assert len(done) == 3
+    finally:
+        engine.close()
+        cache.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# dynamic mode end-to-end (loopback fleet)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def scalar_dataset_12pieces(tmp_path_factory):
+    """60 rows in 12 five-row row-group pieces: piece p holds ids
+    [5p, 5p+5), so a batch's origin piece is identifiable from its ids."""
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    path = tmp_path_factory.mktemp("dynamic_ds")
+    url = f"file://{path}/ds"
+    create_test_scalar_dataset(url, rows_count=60, rows_per_row_group=5)
+    return url, 60
+
+
+def _dynamic_fleet(url, skew_worker_delay_s=0.0, num_epochs=1, n_workers=2,
+                   batch_size=5):
+    dispatcher = Dispatcher(port=0, mode="dynamic",
+                            num_epochs=num_epochs).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=batch_size, reader_factory="batch",
+                    worker_id=f"w{i}",
+                    batch_delay_s=(skew_worker_delay_s if i == 0 else 0.0),
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(n_workers)]
+    return dispatcher, workers
+
+
+def _stop_fleet(dispatcher, workers):
+    for worker in workers:
+        worker.stop()
+    dispatcher.stop()
+
+
+def test_dynamic_loopback_matches_local_reader(scalar_dataset_12pieces):
+    url, rows = scalar_dataset_12pieces
+    dispatcher, workers = _dynamic_fleet(url)
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == list(range(rows))
+        # The dispatcher's books closed: every piece reported done.
+        status = source.dispatcher_status()
+        dyn = status["dynamic"]
+        assert dyn["clients"][source.client_id]["pieces_done"] == 12
+    finally:
+        _stop_fleet(dispatcher, workers)
+
+
+def test_dynamic_steals_rebalance_skewed_worker_zero_dup_zero_loss(
+        scalar_dataset_12pieces):
+    """ISSUE acceptance shape: one of two workers skewed per batch — work
+    stealing moves its backlog to the fast worker, every row arrives
+    exactly once, and the straggler ends up serving fewer pieces."""
+    url, rows = scalar_dataset_12pieces
+    dispatcher, workers = _dynamic_fleet(url, skew_worker_delay_s=0.15)
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == list(range(rows))  # zero dup AND zero loss
+        recovery = source.diagnostics["recovery"]
+        assert recovery["steals_applied"] >= 1
+        assert recovery["dedup_dropped"] == 0
+        per_worker = source.diagnostics["per_worker"]
+        slow = per_worker["w0"].get("pieces", 0)
+        fast = per_worker["w1"].get("pieces", 0)
+        assert slow + fast == 12
+        assert fast > slow, (
+            f"stealing did not shift pieces to the fast worker: "
+            f"slow={slow} fast={fast}")
+        # Steal accounting is visible in dispatcher status (the STEALS
+        # column of `status --watch`).
+        dyn = source.dispatcher_status()["dynamic"]
+        assert dyn["per_worker"]["w0"]["steals_out"] >= 1
+        assert dyn["per_worker"]["w1"]["steals_in"] >= 1
+        assert dyn["generation"] >= 1
+    finally:
+        _stop_fleet(dispatcher, workers)
+
+
+def test_dynamic_stream_extend_before_connect_is_queued_not_dropped(
+        monkeypatch):
+    """A steal grant can land before the stream's reader thread dials the
+    worker (launch() registers the stream immediately; the TCP connect
+    happens on the reader thread's first pull). The control edit must
+    queue and flush right after the handshake, in order — dropping it
+    orphans a piece both ownership maps already assign to this worker."""
+    from petastorm_tpu.service import client as client_mod
+
+    sent = []
+
+    class _FakeConn:
+        def send(self, message):
+            sent.append(dict(message))
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(client_mod.FramedConnection, "connect",
+                        staticmethod(lambda *a, **kw: _FakeConn()))
+    stream = client_mod._DynamicStream(
+        "w0", ("127.0.0.1", 1), [(0, 1)], epoch=0, connect_timeout=1.0)
+    stream.extend([(7, 3)])
+    assert sent == []  # queued, not written onto a nonexistent socket
+    stream._ensure_conn()
+    assert [m["type"] for m in sent] == ["stream", "extend"]
+    assert sent[1]["pieces"] == [[7, 3]]
+    stream.extend([(8, 4)])  # post-handshake edits go straight through
+    assert sent[-1]["pieces"] == [[8, 4]]
+
+
+def test_dynamic_mid_epoch_worker_join_receives_steals(
+        scalar_dataset_12pieces):
+    """A worker that registers AFTER the epoch started is a legal steal
+    receiver: the planner sees it as drained (it is alive with zero
+    grants), ships its address with the delta, and the client opens a
+    stream to it mid-epoch — with the multiset still exact."""
+    url, rows = scalar_dataset_12pieces
+    dispatcher = Dispatcher(port=0, mode="dynamic").start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=5, reader_factory="batch", worker_id="w0",
+                    batch_delay_s=0.15,
+                    reader_kwargs={"workers_count": 2}).start()]
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        got = []
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if len(workers) == 1:
+                workers.append(
+                    BatchWorker(url, dispatcher_address=dispatcher.address,
+                                batch_size=5, reader_factory="batch",
+                                worker_id="w1",
+                                reader_kwargs={"workers_count": 2}).start())
+        assert sorted(got) == list(range(rows))
+        per_worker = source.diagnostics["per_worker"]
+        joined = per_worker.get("w1", {}).get("pieces", 0)
+        assert joined >= 1, (
+            f"mid-epoch joiner served nothing: {per_worker}")
+        assert source.diagnostics["recovery"]["steals_applied"] >= 1
+    finally:
+        _stop_fleet(dispatcher, workers)
+
+
+def test_dynamic_multi_epoch_delivers_every_epoch(scalar_dataset_12pieces):
+    """The fcfs single-epoch restriction does not apply to dynamic mode:
+    num_epochs=2 delivers the full multiset twice."""
+    url, rows = scalar_dataset_12pieces
+    dispatcher, workers = _dynamic_fleet(url, num_epochs=2)
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == sorted(list(range(rows)) * 2)
+    finally:
+        _stop_fleet(dispatcher, workers)
+
+
+def test_dynamic_steal_mid_epoch_preserves_state_dict_resume(
+        scalar_dataset_12pieces):
+    """Tier-1 ISSUE satellite: snapshot mid-epoch AFTER steals have moved
+    pieces, resume from it — completed pieces are never re-served and the
+    union covers the dataset exactly at piece granularity."""
+    url, rows = scalar_dataset_12pieces
+    dispatcher, workers = _dynamic_fleet(url, skew_worker_delay_s=0.15)
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        first, state = [], None
+        iterator = source()
+        for batch in iterator:
+            first.extend(int(i) for i in batch["id"])
+            state = source.state_dict()
+            if (len(first) >= rows // 2 and state["completed_pieces"]
+                    and source.diagnostics["recovery"]["steals_applied"]):
+                break
+        else:
+            pytest.fail("stream ended before a steal + snapshot landed")
+        iterator.close()
+        completed = set(state["completed_pieces"])
+        # The snapshot's contract: every completed piece was fully
+        # delivered in part one (a steal moves WHO serves a piece, never
+        # whether it counts as completed).
+        for piece in completed:
+            for row in range(5 * piece, 5 * piece + 5):
+                assert row in first, (
+                    f"piece {piece} marked completed but row {row} was "
+                    f"never delivered")
+        resumed = ServiceBatchSource(dispatcher.address, resume_state=state,
+                                     dynamic_sync_interval_s=0.1)
+        second = [int(i) for batch in resumed() for i in batch["id"]]
+        # Completed pieces are skipped; incomplete ones re-stream whole.
+        expected = sorted(i for p in range(12) if p not in completed
+                          for i in range(5 * p, 5 * p + 5))
+        assert sorted(second) == expected
+        assert sorted(set(first) | set(second)) == list(range(rows))
+    finally:
+        _stop_fleet(dispatcher, workers)
+
+
+def test_dynamic_cold_cache_fill_constructs_one_reader_per_stream(
+        scalar_dataset_12pieces):
+    """ISSUE acceptance: a cold cache-fill epoch over many small pieces
+    shows reader constructions == streams, not pieces — and a warm epoch
+    constructs none."""
+    from petastorm_tpu.cache_impl import BatchCache
+
+    url, rows = scalar_dataset_12pieces
+    dispatcher = Dispatcher(port=0, mode="dynamic", num_epochs=2).start()
+    worker = BatchWorker(url, dispatcher_address=dispatcher.address,
+                         batch_size=5, reader_factory="batch",
+                         worker_id="w0",
+                         batch_cache=BatchCache(mem_budget_bytes=32 << 20),
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        baseline = worker._m_readers.value
+        source = ServiceBatchSource(dispatcher.address,
+                                    dynamic_sync_interval_s=0.1)
+        got = [int(i) for batch in source() for i in batch["id"]]
+        assert sorted(got) == sorted(list(range(rows)) * 2)
+        constructed = worker._m_readers.value - baseline
+        # 2 epochs = 2 streams over 12 pieces each: the cold epoch builds
+        # ONE engine reader, the warm epoch builds none.
+        assert constructed == 1, (
+            f"expected 1 reader construction (cold stream), got "
+            f"{constructed}")
+        stats = worker.cache_stats()
+        assert stats["misses"] == 12 and stats["hits"] >= 12
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# scenario wiring (the bench A/B leg's substrate)
+# ---------------------------------------------------------------------------
+
+def test_scenario_rejects_multi_epoch_fcfs_pointing_at_dynamic():
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    with pytest.raises(ValueError, match="dynamic"):
+        service_loopback_scenario(rows=100, epochs=2, sharding="fcfs")
+
+
+def test_scenario_dynamic_multi_epoch_with_skew_reports_steals(tmp_path):
+    """The `--sharding dynamic --skew-ms` A/B leg end-to-end: multi-epoch
+    run under a straggler reports steals and per-worker piece counts, and
+    the per-epoch breakdown stays intact."""
+    import json
+
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    json_out = tmp_path / "dyn.json"
+    result = service_loopback_scenario(rows=2000, days=4, workers=2,
+                                       batch_size=64, sharding="dynamic",
+                                       skew_ms=30.0, epochs=2,
+                                       json_out=str(json_out))
+    assert result["mode"] == "dynamic"
+    assert result["epochs"] == 2
+    assert len(result["epochs_detail"]) == 2
+    assert result["steals_applied"] >= 1
+    assert result["dedup_dropped"] == 0
+    assert sum(result["per_worker_pieces"].values()) > 0
+    assert result["time_to_half_rows_s"] > 0
+    assert json.loads(json_out.read_text().strip()) == result
+
+
+def test_status_watch_renders_steals_and_backlog_columns():
+    from petastorm_tpu.service.cli import render_fleet_status
+
+    def sample(rows):
+        return {
+            "t": 10.0 + (2.0 if rows else 0.0),
+            "status": {
+                "mode": "dynamic", "fencing_epoch": 0,
+                "workers": {"w0": {"alive": True}},
+                "clients": {"c": {}},
+                "recovery": {},
+                "dynamic": {
+                    "generation": 5,
+                    "per_worker": {"w0": {"backlog": 3, "steals_in": 2,
+                                          "steals_out": 1}},
+                    "clients": {},
+                },
+            },
+            "workers": {"w0": {"metrics": {
+                "rows_sent_total": rows, "batches_sent_total": rows / 10,
+                "credit_wait_seconds_total": 0.0, "active_streams": 1,
+            }}},
+        }
+
+    text = render_fleet_status(sample(0), sample(500))
+    assert "STEALS" in text and "BACKLOG" in text
+    assert "generation=5" in text
+    assert "2/1" in text  # steals in/out
+    row = next(line for line in text.splitlines()
+               if line.startswith("w0"))
+    assert row.rstrip().endswith("3")  # backlog column
+
+
+# ---------------------------------------------------------------------------
+# chaos under dynamic sharding (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_dynamic_dispatcher_restart_zero_dup_zero_loss():
+    """Control-plane-only fault under dynamic sharding, multi-epoch: the
+    journaled steals replay, and the multiset invariant must hold exactly
+    (zero lost AND zero duplicate rows across both epochs)."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=2,
+                                       batch_size=32, sharding="dynamic",
+                                       epochs=2, skew_ms=10.0,
+                                       chaos="dispatcher-restart",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0
+    assert result["duplicate_rows"] == 0
+    assert result["dispatcher_recovery"]["journal_replays"] >= 1
+    assert result["chaos_events"], "no chaos event landed inside the run"
+
+
+@pytest.mark.slow
+def test_chaos_dynamic_worker_kill_no_loss():
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=3,
+                                       batch_size=32, sharding="dynamic",
+                                       chaos="worker-kill",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0  # duplicates allowed (at-least-once)
+    assert result["chaos_events"]
+
+
+@pytest.mark.slow
+def test_chaos_dynamic_conn_drop_no_loss():
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    result = service_loopback_scenario(rows=4000, days=4, workers=2,
+                                       batch_size=32, sharding="dynamic",
+                                       epochs=2, chaos="conn-drop",
+                                       chaos_interval_s=5.0)
+    assert result["lost_rows"] == 0
+    assert result["chaos_events"]
