@@ -136,6 +136,18 @@ def _scenario_main(argv):
                         help="shared disk-tier directory for "
                              "--cache mem+disk (default: a scenario-owned "
                              "tempdir)")
+    parser.add_argument("--shuffle-seed", type=int, default=None,
+                        dest="shuffle_seed",
+                        help="service scenario: dispatcher-side seed-tree "
+                             "deterministic shuffle — piece order derives "
+                             "from fold_in(seed, epoch, piece), invariant "
+                             "to worker count and steal/failure history "
+                             "(docs/guides/service.md#deterministic-order)")
+    parser.add_argument("--ordered", action="store_true", default=None,
+                        help="service scenario: re-sequence delivery into "
+                             "the canonical seed-tree order so the "
+                             "delivered stream (and its stream_digest) is "
+                             "byte-identical across runs and fleet shapes")
     parser.add_argument("--device-stage", default=None,
                         choices=["on", "off"], dest="device_stage",
                         help="image scenario: run the accelerator-side "
@@ -175,6 +187,8 @@ def _scenario_main(argv):
             ("cache", "--cache", args.cache),
             ("cache_mem_mb", "--cache-mem-mb", args.cache_mem_mb),
             ("cache_dir", "--cache-dir", args.cache_dir),
+            ("shuffle_seed", "--shuffle-seed", args.shuffle_seed),
+            ("ordered", "--ordered", args.ordered),
             ("device_stage", "--device-stage", args.device_stage),
             ("device_prefetch", "--device-prefetch",
              args.device_prefetch)):

@@ -586,7 +586,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               journal_dir=None, metrics_port=None,
                               trace_out=None, epochs=1, cache="off",
                               cache_mem_mb=256.0, cache_dir=None,
-                              sharding=None):
+                              sharding=None, shuffle_seed=None,
+                              ordered=False):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -643,7 +644,25 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     ``cache_mem_mb`` of host RAM per worker; under ``mem+disk`` every
     worker shares ``cache_dir`` (default: a scenario-owned tempdir), so a
     takeover after ``--chaos worker-kill`` re-serves the victim's pieces
-    from the disk tier instead of re-decoding them.
+    from the disk tier instead of re-decoding them. The ``cache-corrupt``
+    chaos kind (requires ``mem+disk`` and ``epochs >= 2``; clamps the
+    memory tier to ~0 so warm loads actually read the damaged disk files)
+    truncates / bit-flips disk-tier entry files mid-run and asserts the
+    fleet counted at least one ``cache_corrupt_entries`` while delivery
+    stayed intact — corrupt entries degrade to fresh decode, never to bad
+    bytes.
+
+    ``shuffle_seed`` arms the dispatcher's seed-tree deterministic
+    shuffle; ``ordered`` re-sequences client delivery into the canonical
+    piece order. The result always carries ``stream_digest`` — an
+    order-sensitive blake2b of every delivered batch's bytes — so two
+    ``--json-out`` lines assert run-to-run determinism by string
+    equality (byte-identity needs ``ordered``; without it the digest
+    still certifies WHAT arrived, not the interleaving). Chaos delivery
+    invariants are exactly-once on every path: zero lost rows AND zero
+    duplicates under dispatcher restarts, worker kills, and connection
+    drops alike (per-piece watermarks re-grant at the delivery cursor;
+    ``docs/guides/service.md#delivery-semantics``).
     """
     from petastorm_tpu.jax_utils.batcher import batch_iterator
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
@@ -651,6 +670,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     from petastorm_tpu.service import (BatchWorker, Dispatcher,
                                        ServiceBatchSource)
     from petastorm_tpu.service.chaos import (CHAOS_KINDS, ChaosInjector,
+                                             StreamDigest,
+                                             cache_corrupt_action,
                                              connection_drop_action,
                                              delivery_invariants,
                                              dispatcher_restart_action,
@@ -677,6 +698,30 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "chaos delivery invariants are checked against the scenario's "
             "own synthesized dataset (unique sample_index per row, known "
             "row count) — omit --dataset-url when --chaos is armed")
+    if "cache-corrupt" in chaos_kinds:
+        if cache != "mem+disk":
+            raise ValueError(
+                "--chaos cache-corrupt damages disk-tier entry files: it "
+                "needs --cache mem+disk")
+        if epochs < 2:
+            raise ValueError(
+                "--chaos cache-corrupt needs --epochs >= 2: entries fill "
+                "during epoch 1 and only a warm epoch LOADS them, which "
+                "is where corruption detection (and the degrade-to-fresh-"
+                "decode path) runs")
+        if cache_mem_mb > 1.0:
+            # A roomy memory tier answers every warm lookup from RAM, so
+            # the damaged disk files are never loaded and the run fails
+            # its own >=1-corrupt-entry-detected assertion despite
+            # nothing being wrong. This leg exists to exercise the disk
+            # load path — force it.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "cache-corrupt: clamping cache_mem_mb %s -> 0.001 so "
+                "warm loads hit the disk tier (memory hits would never "
+                "read the damaged files)", cache_mem_mb)
+            cache_mem_mb = 0.001
 
     from petastorm_tpu.cache_impl import CacheConfig
 
@@ -718,9 +763,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     lease_timeout_s = 2.0 if chaos_kinds else 30.0
 
     def make_dispatcher(host="127.0.0.1", port=0):
+        # The restart factory passes the SAME shuffle_seed: the journal
+        # guard refuses a seed change mid-run (it would silently shift
+        # the piece order and break the determinism contract).
         return Dispatcher(host=host, port=port, mode=mode,
                           num_epochs=epochs, journal_dir=journal_dir,
-                          lease_timeout_s=lease_timeout_s)
+                          lease_timeout_s=lease_timeout_s,
+                          shuffle_seed=shuffle_seed)
 
     # Telemetry arming and every node start happen INSIDE the try: a
     # failing dispatcher/worker start must still stop the HTTP server +
@@ -757,7 +806,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 batch_cache=cache_config.build(),
                 reader_kwargs={"workers_count": 2}).start())
         source = ServiceBatchSource(
-            dispatcher_holder[0].address, credits=credits,
+            dispatcher_holder[0].address, credits=credits, ordered=ordered,
             heartbeat_interval_s=0.3 if chaos_kinds else 2.0,
             # Snappy rebalance loop: steal latency is what the dynamic
             # skew leg measures, and the sync RPC is a tiny control
@@ -775,6 +824,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                         dispatcher_holder, make_dispatcher)))
                 elif kind == "worker-kill":
                     actions.append((kind, worker_kill_action(fleet)))
+                elif kind == "cache-corrupt":
+                    actions.append((kind, cache_corrupt_action(cache_dir)))
                 else:
                     actions.append((kind, connection_drop_action(
                         lambda: [dispatcher_holder[0]] + fleet)))
@@ -798,11 +849,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         served_rows = batches = 0
         got_ids = []
         arrivals = []  # (elapsed_s, cumulative rows) per batch
+        digest = StreamDigest()
         t0 = time.perf_counter()
         with loader:
             for batch in loader:
                 batches += 1
                 served_rows += len(next(iter(batch.values())))
+                digest.update(batch)
                 if chaos_kinds and "sample_index" in batch:
                     got_ids.extend(int(i) for i in batch["sample_index"])
                 arrivals.append((time.perf_counter() - t0, served_rows))
@@ -890,6 +943,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "skew_ms": skew_ms,
             "credits": credits,
             "epochs": epochs,
+            # Determinism surface: equal digests (same seed, ordered) =
+            # byte-identical delivered streams — the cheap A/B assert.
+            "shuffle_seed": shuffle_seed,
+            "ordered": ordered,
+            "stream_digest": digest.hexdigest(),
+            "duplicates_dropped":
+                source_diag["recovery"]["duplicates_dropped"],
             "epochs_detail": epochs_detail,
             "rows": served_rows,
             "batches": batches,
@@ -931,6 +991,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                      for s in per_worker_stats if s),
                 "evictions_disk": sum(s["evictions_disk"]
                                       for s in per_worker_stats if s),
+                "corrupt_entries": sum(s.get("corrupt_entries", 0)
+                                       for s in per_worker_stats if s),
             }
         # Final registry snapshot + per-stage latency quantiles: BENCH
         # artifacts capture distributions (p50/p99), not just means.
@@ -945,14 +1007,16 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         if trace_out:
             result["trace_out"] = trace_out
         if chaos_kinds:
-            # Control-plane-only faults must not repeat a single row; any
-            # fault that kills or drops the data plane re-delivers pieces
-            # (at-least-once — duplicates are the contract, loss never is).
-            allow_duplicates = any(k != "dispatcher-restart"
-                                   for k in chaos_kinds)
+            # Exactly-once on EVERY path: per-piece watermarks re-grant a
+            # re-served piece at the delivery cursor (worker-kill
+            # takeover, conn-drop retry) and journal replay restores the
+            # control plane (dispatcher restart), so zero lost rows AND
+            # zero duplicates is the contract under all chaos kinds — the
+            # pre-watermark harness only promised at-least-once off the
+            # steal path.
+            allow_duplicates = False
             # Every epoch delivers the full id set once: the expected
-            # multiset scales with the epoch count (zero-dup under
-            # control-plane-only faults still holds per epoch).
+            # multiset scales with the epoch count.
             invariants = delivery_invariants(
                 list(range(rows)) * epochs, got_ids, allow_duplicates)
             status = source.dispatcher_status()
@@ -981,6 +1045,14 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 raise RuntimeError(
                     f"dispatcher-restart chaos recorded no recovery: "
                     f"{recovery} (events: {injector.events})")
+            if "cache-corrupt" in chaos_kinds and (
+                    result["cache"]["corrupt_entries"] < 1):
+                raise RuntimeError(
+                    "cache-corrupt chaos ran but no worker counted a "
+                    "corrupt entry: either no injection landed on an "
+                    "entry a warm epoch later loaded, or — the bug this "
+                    "guard exists for — a damaged entry was served "
+                    f"without detection (events: {injector.events})")
         if json_out:
             import json
 

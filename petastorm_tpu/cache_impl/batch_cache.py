@@ -41,8 +41,10 @@ import threading
 import time
 from collections import OrderedDict
 
+from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
     CACHE_BYTES,
+    CACHE_CORRUPT,
     CACHE_ENTRIES,
     CACHE_EVICTIONS,
     CACHE_FILL_SECONDS,
@@ -51,8 +53,16 @@ from petastorm_tpu.telemetry.metrics import (
     CACHE_SERVE_SECONDS,
 )
 
-_MAGIC = b"PTBCACHE1\n"
+# Version 2 adds a payload crc32 to the meta header: a truncated file was
+# already caught by the frame-length sum, but a BIT-FLIPPED payload byte
+# passed it and would have been served — the checksum closes that hole
+# (chaos mode ``cache-corrupt`` exercises exactly this). v1 files fail the
+# magic check and take the corrupt-entry path: deleted, refilled on the
+# next decode — cheap, and the tiers never mix formats.
+_MAGIC = b"PTBCACHE2\n"
 _LEN = struct.Struct("!Q")
+
+logger = service_logger(__name__)
 
 #: Disk-tier entry suffix (the shared eviction policy scopes to it).
 ENTRY_SUFFIX = ".ptbc"
@@ -248,6 +258,7 @@ class BatchCache:
         self.misses = 0
         self.evictions_mem = 0
         self.evictions_disk = 0
+        self.corrupt_entries = 0
         self._m_hits_mem = CACHE_HITS.labels("mem")
         self._m_hits_disk = CACHE_HITS.labels("disk")
         self._m_bytes_mem = CACHE_BYTES.labels("mem")
@@ -362,9 +373,13 @@ class BatchCache:
 
     def _store_disk(self, key, entry):
         import json
+        import zlib
 
-        meta = json.dumps([{"rows": rows, "fmt": fmt, "frame_lens": lens}
-                           for rows, fmt, lens in entry.meta]).encode("utf-8")
+        meta = json.dumps({
+            "crc32": zlib.crc32(entry.buf) & 0xFFFFFFFF,
+            "batches": [{"rows": rows, "fmt": fmt, "frame_lens": lens}
+                        for rows, fmt, lens in entry.meta],
+        }).encode("utf-8")
         path = self._entry_path(key)
         tmp_path = None
         try:
@@ -416,6 +431,7 @@ class BatchCache:
 
     def _load_disk(self, key):
         import json
+        import zlib
 
         path = self._entry_path(key)
         try:
@@ -431,16 +447,28 @@ class BatchCache:
             payload_off = meta_off + _LEN.size + meta_len
             meta = json.loads(blob[meta_off + _LEN.size:payload_off]
                               .decode("utf-8"))
+            payload = blob[payload_off:]
             entry = CachedEntry(
-                [(m["rows"], m["fmt"], list(m["frame_lens"])) for m in meta],
-                blob[payload_off:])
+                [(m["rows"], m["fmt"], list(m["frame_lens"]))
+                 for m in meta["batches"]],
+                payload)
             expected = sum(length for _, _, lens in entry.meta
                            for length in lens)
             if expected != entry.nbytes:
                 raise ValueError("truncated payload")
-        except (ValueError, KeyError, TypeError):
-            # Corrupt/torn/old-format entry: a miss, and remove the file so
-            # it cannot keep failing every epoch.
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != int(meta["crc32"]):
+                raise ValueError("payload checksum mismatch")
+        except (ValueError, KeyError, TypeError, struct.error):
+            # Corrupt/torn/old-format entry: counted, removed so it cannot
+            # keep failing every epoch, and reported as a MISS — the
+            # caller degrades to a fresh decode (which re-fills the entry)
+            # instead of serving bad bytes or erroring the stream.
+            with self._lock:
+                self.corrupt_entries += 1
+            CACHE_CORRUPT.inc()
+            logger.warning(
+                "disk-tier cache entry %s failed validation — deleting "
+                "and degrading to fresh decode", path)
             try:
                 os.unlink(path)
             except OSError:
@@ -473,6 +501,7 @@ class BatchCache:
                 "mem_budget_bytes": self._mem_budget,
                 "evictions_mem": self.evictions_mem,
                 "evictions_disk": self.evictions_disk,
+                "corrupt_entries": self.corrupt_entries,
                 "cache_dir": self._dir,
             }
 

@@ -12,7 +12,13 @@ consistently — this module is that glue:
 - the loader/reader input state (a small JSON-serializable dict) rides in
   the same checkpoint directory as a JSON file, captured BETWEEN steps from
   the training thread — the consistency point the resume machinery is
-  specified against (at-least-once delivery on restore).
+  specified against. Local-reader states resume at-least-once (buffered
+  rows re-read); a ``ServiceBatchSource`` state is a v2 watermark snapshot,
+  so a service-fed job resumes **exactly-once** — each mid-piece piece
+  continues at its next batch — and with the dispatcher's ``shuffle_seed``
+  plus ``ordered=True`` delivery the restored stream is bit-identical to
+  the uninterrupted run from the checkpoint batch onward
+  (``docs/guides/service.md#delivery-semantics``).
 
 Crash safety is pointer-file based: each save writes a COMPLETE checkpoint
 (arrays + input state + per-host commit markers) into a fresh versioned
@@ -207,8 +213,11 @@ def restore_training_state(directory, abstract_arrays=None):
         concrete arrays) guiding orbax's typed/sharded restore; ``None``
         restores as saved.
     :return: ``(arrays, input_state_or_None)`` — pass the input state as
-        ``resume_state=`` to the reader factory feeding a fresh loader
-        (buffered-but-unyielded rows are re-read: at-least-once).
+        ``resume_state=`` to the reader factory (or ``ServiceBatchSource``)
+        feeding a fresh loader. Local readers re-read buffered-but-
+        unyielded rows (at-least-once); a service source resumes at its
+        per-piece watermarks (exactly-once — nothing re-delivered, nothing
+        lost).
     :raises RuntimeError: if no published checkpoint exists, this host's
         commit marker is absent (torn save), or the checkpoint was saved by
         a different number of hosts than are restoring (the other hosts'

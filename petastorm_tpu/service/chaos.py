@@ -15,6 +15,8 @@ invariant can be correlated with what the harness did when.
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
 import time
 
@@ -22,7 +24,8 @@ from petastorm_tpu.telemetry.log import service_logger
 
 logger = service_logger(__name__)
 
-CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop")
+CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop",
+               "cache-corrupt")
 
 
 class ChaosInjector:
@@ -150,6 +153,91 @@ def connection_drop_action(nodes_fn):
         for node in nodes_fn():
             node.drop_connections()
     return action
+
+
+def cache_corrupt_action(cache_dir):
+    """Corrupt one disk-tier decoded-batch cache entry per injection —
+    alternately truncating the file to half its length and bit-flipping a
+    byte in its payload region (the two damage signatures a real disk /
+    torn write produces). The worker's load path must detect either
+    (magic / frame-length sum / payload crc32), count it in
+    ``cache_corrupt_entries``, delete the entry, and degrade to a fresh
+    decode — never serve bad bytes, never error the stream. Victim choice
+    cycles a sorted listing with a counter (no RNG: the harness obeys the
+    same determinism lint as the service)."""
+    state = {"count": 0}
+
+    def action():
+        from petastorm_tpu.cache_impl.batch_cache import ENTRY_SUFFIX
+
+        entries = sorted(
+            os.path.join(cache_dir, name)
+            for name in os.listdir(cache_dir)
+            if name.endswith(ENTRY_SUFFIX))
+        if not entries:
+            logger.warning("chaos: no disk-tier entries under %s yet — "
+                           "nothing to corrupt", cache_dir)
+            return
+        victim = entries[state["count"] % len(entries)]
+        truncate = state["count"] % 2 == 0
+        state["count"] += 1
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            if truncate or size < 2:
+                f.truncate(size // 2)
+                logger.warning("chaos: truncated cache entry %s (%d -> %d "
+                               "bytes)", victim, size, size // 2)
+            else:
+                f.seek(size // 2)
+                original = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([original[0] ^ 0x40]))
+                logger.warning("chaos: bit-flipped cache entry %s at "
+                               "offset %d", victim, size // 2)
+    return action
+
+
+class StreamDigest:
+    """Order-sensitive hash of a delivered batch stream.
+
+    Byte-identity is the determinism contract's check: two runs (or a
+    perturbed run vs a clean one, or a killed-and-resumed run's two
+    halves) must produce the SAME digest, which multiset equality cannot
+    certify. Each batch folds in every field's name, dtype, shape, and
+    raw bytes, in sorted field order — any reordering, dropped row,
+    duplicate, or flipped bit changes the digest.
+    """
+
+    def __init__(self):
+        self._hash = hashlib.blake2b(digest_size=16)
+        self.batches = 0
+
+    def update(self, batch):
+        import numpy as np
+
+        for name in sorted(batch):
+            arr = np.asarray(batch[name])
+            self._hash.update(name.encode("utf-8"))
+            self._hash.update(str(arr.dtype).encode("utf-8"))
+            self._hash.update(repr(arr.shape).encode("utf-8"))
+            if arr.dtype == object:
+                # Ragged/string fields have no flat buffer: hash per
+                # element (bytes stay bytes; everything else reprs),
+                # length-prefixed — bare concatenation would let
+                # boundary-shifted values ([b"ab", b"c"] vs [b"a", b"bc"])
+                # collide, and this digest is the byte-identity check.
+                for item in arr.ravel():
+                    data = (item if isinstance(item, bytes)
+                            else repr(item).encode("utf-8"))
+                    self._hash.update(len(data).to_bytes(8, "big"))
+                    self._hash.update(data)
+            else:
+                self._hash.update(np.ascontiguousarray(arr).tobytes())
+        self.batches += 1
+        return self
+
+    def hexdigest(self):
+        return self._hash.hexdigest()
 
 
 def delivery_invariants(expected_ids, got_ids, allow_duplicates):

@@ -72,6 +72,12 @@ def _build_parser():
     disp.add_argument("--journal-fsync", action="store_true",
                       help="fsync the WAL per record (durable against OS "
                            "crash; default survives process crashes)")
+    disp.add_argument("--shuffle-seed", type=int, default=None,
+                      help="seed-tree deterministic shuffle: piece order "
+                           "derives from fold_in(seed, epoch, piece) — "
+                           "invariant to worker count, steals, and "
+                           "restarts. Omit for ascending piece order "
+                           "(docs/guides/service.md#deterministic-order)")
 
     work = sub.add_parser("worker", help="run a batch worker")
     work.add_argument("--dispatcher", default=None,
@@ -139,7 +145,8 @@ def build_service_node(args):
                           num_epochs=args.num_epochs or None,
                           journal_dir=args.journal_dir,
                           lease_timeout_s=args.lease_timeout or None,
-                          journal_fsync=args.journal_fsync)
+                          journal_fsync=args.journal_fsync,
+                          shuffle_seed=args.shuffle_seed)
     from petastorm_tpu.cache_impl import CacheConfig
     from petastorm_tpu.service.worker import BatchWorker
 

@@ -47,11 +47,14 @@ from petastorm_tpu.reader_impl.framed_socket import (
 )
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.metrics import (
     CLIENT_BATCHES,
+    CLIENT_DEDUP_DROPPED,
     CLIENT_READY_QUEUE_DEPTH,
     CLIENT_RECOVERY_EVENTS,
     CLIENT_RECV_STALL,
+    CLIENT_WATERMARK_LAG,
 )
 from petastorm_tpu.utils import retry_with_backoff
 
@@ -65,7 +68,7 @@ class ServiceError(RuntimeError):
 
 class _WorkerStream:
     """One ``stream`` request against one worker; connects lazily so every
-    connection failure funnels through ``next_batch`` (one recovery path).
+    connection failure funnels through ``next_event`` (one recovery path).
 
     ``credits`` arms flow control: the ``stream`` request carries the
     window, the worker keeps at most that many un-acknowledged batches in
@@ -74,26 +77,44 @@ class _WorkerStream:
     the sequential consumption paths (fcfs splits, reconnect probes) where
     receive and consume are the same event; the multiplexed drain uses
     ``False`` and acks from the consumer side of its ready-queue, so the
-    window bounds worker-sent-but-unconsumed batches end to end."""
+    window bounds worker-sent-but-unconsumed batches end to end.
+
+    ``tagged=True`` (the static drain's default) requests the exactly-once
+    protocol: piece-aligned batches tagged ``(piece, ordinal)`` plus
+    ``piece_done`` frames, with ``starts`` naming the per-piece delivery
+    watermark the worker must resume each piece at — a re-serve then
+    duplicates nothing. A worker whose pool cannot attribute per-piece
+    completion ignores the flag and streams untagged batches; the consumer
+    detects that per batch (``last_piece is None``) and keeps the legacy
+    at-least-once bookkeeping for that stream."""
 
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
-                 credits=None, auto_replenish=False):
+                 credits=None, auto_replenish=False, tagged=False,
+                 starts=None):
         self.worker_id = worker_id
         self.address = tuple(address)
         self.pieces = list(pieces)
         self.epoch = epoch
         self.credits = credits
+        self.tagged = tagged
+        self.starts = dict(starts or {})
         #: Batch id (minted worker-side at decode) of the batch the last
-        #: ``next_batch`` returned — the tracing key correlating this
+        #: ``next_event`` returned — the tracing key correlating this
         #: stream's receive with the worker's decode/send spans.
         self.last_bid = None
+        #: Piece/ordinal tags of the last batch (``None`` on untagged
+        #: streams — the legacy protocol).
+        self.last_piece = None
+        self.last_ordinal = None
         self._auto_replenish = auto_replenish
         self._connect_timeout = connect_timeout
         self._conn = None
         self._closed = False
 
-    def next_batch(self):
-        """Next batch dict, or ``None`` when the stream ended cleanly."""
+    def next_event(self):
+        """``(kind, payload)`` — ``("batch", payload_dict)`` (tags exposed
+        via ``last_piece``/``last_ordinal``/``last_bid``), ``("piece_done",
+        piece)``, or ``("end", None)`` when the stream ended cleanly."""
         if self._closed:
             # Terminal: a teardown close() must not be mistaken for the
             # lazy not-yet-connected state — reconnecting here would send
@@ -118,6 +139,13 @@ class _WorkerStream:
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "pieces": self.pieces,
                        "epoch": self.epoch}
+            if self.tagged:
+                request["tagged"] = True
+                if self.starts:
+                    # JSON object keys are strings on the wire.
+                    request["starts"] = {str(p): int(s)
+                                         for p, s in self.starts.items()
+                                         if s}
             if self.credits is not None:
                 request["credits"] = self.credits
             self._conn.send(request)
@@ -125,17 +153,35 @@ class _WorkerStream:
         kind = header.get("type")
         if kind == "batch":
             self.last_bid = header.get("bid")
+            piece = header.get("piece")
+            self.last_piece = int(piece) if piece is not None else None
+            ordinal = header.get("ordinal")
+            self.last_ordinal = int(ordinal) if ordinal is not None else None
             if self._auto_replenish:
                 self.add_credit(1)
-            return payload
+            return ("batch", payload)
+        if kind == "piece_done":
+            return ("piece_done", int(header["piece"]))
         if kind == "end":
             self.close()
-            return None
+            return ("end", None)
         if kind == "error":
             raise ServiceError(
                 f"worker {self.worker_id} failed streaming pieces "
                 f"{self.pieces}: {header.get('error')}")
         raise ServiceError(f"unexpected stream message {kind!r}")
+
+    def next_batch(self):
+        """Next batch dict, or ``None`` when the stream ended cleanly —
+        the sequential-consumption convenience over :meth:`next_event`
+        (fcfs splits and reconnect probes; ``piece_done`` markers are
+        consumed silently)."""
+        while True:
+            kind, payload = self.next_event()
+            if kind == "batch":
+                return payload
+            if kind == "end":
+                return None
 
     def add_credit(self, n=1):
         """Replenish ``n`` credits of the worker's flow-control window.
@@ -183,11 +229,145 @@ class _SourceIterator:
         self._gen.close()
 
 
+class _OrderedSequencer:
+    """Reorder buffer enforcing the deterministic delivery order.
+
+    Workers race batches into the shared ready-queue in whatever order the
+    fleet produces them; byte-identical streams need one canonical order
+    — the seed-tree piece order, batches within a piece by ordinal. The
+    drain pushes every received batch (and every ``piece_done``) in here
+    and yields only what :meth:`push`/:meth:`finish_piece` release: the
+    current piece's batches immediately, later pieces' buffered until
+    their turn. Per-piece arrival is already ordinal-ordered (FIFO per
+    stream; watermark re-serves continue where delivery stopped), so
+    buffering is append-only.
+
+    Buffer depth (exported as the ``client_watermark_lag`` gauge) is
+    ~(streams × credits) in the common case, but it is NOT a hard bound:
+    credits must be acked at dequeue, not at release — the engine's
+    decode lookahead (and wholesale warm-cache staging, and dynamic-mode
+    steals re-queueing a canonically-early piece behind later ones) can
+    legally fill a stream's window with batches of a canonically-later
+    piece while an earlier one is still pending, and a release-parked
+    window would deadlock the epoch. Under a persistent head-of-line
+    stall (one dead-slow worker owning the current piece) the buffer can
+    therefore grow toward the stalled-behind remainder of the epoch —
+    watch the gauge; ordered mode trades memory and head-of-line waiting
+    for byte-identical delivery.
+    """
+
+    def __init__(self, order):
+        self._order = [int(p) for p in order]
+        self._pos = 0
+        self._buffered = {}    # piece -> [items]
+        self._done = {}        # piece -> worker_id (piece_done arrived)
+        self.lag = 0           # buffered batches (watermark-lag gauge)
+
+    def push(self, piece, item):
+        """Buffer one received batch; return the ``("batch", piece, item)``
+        / ``("piece_done", piece, wid)`` events now releasable in order."""
+        self._buffered.setdefault(piece, []).append(item)
+        self.lag += 1
+        return self._release()
+
+    def finish_piece(self, piece, worker_id):
+        self._done[piece] = worker_id
+        return self._release()
+
+    def _release(self):
+        out = []
+        while self._pos < len(self._order):
+            piece = self._order[self._pos]
+            buffered = self._buffered.get(piece)
+            if buffered:
+                for item in buffered:
+                    out.append(("batch", piece, item))
+                self.lag -= len(buffered)
+                buffered.clear()
+            if piece in self._done:
+                self._buffered.pop(piece, None)
+                out.append(("piece_done", piece, self._done.pop(piece)))
+                self._pos += 1
+                continue
+            break
+        return out
+
+    def drain(self):
+        """Flush everything still buffered, in order (epoch teardown
+        safety net — empty when every piece announced ``piece_done``)."""
+        out = []
+        for piece in self._order[self._pos:] + sorted(
+                set(self._buffered) - set(self._order[self._pos:])):
+            for item in self._buffered.pop(piece, []):
+                out.append(("batch", piece, item))
+                self.lag -= 1
+            if piece in self._done:
+                out.append(("piece_done", piece, self._done.pop(piece)))
+        self._pos = len(self._order)
+        return out
+
+
+class _DeliveryBook:
+    """Consumer-side delivery bookkeeping shared by the static and dynamic
+    drains: production counts, per-worker attribution, tagged batch events
+    (the provenance ``state_dict`` computes watermarks from), piece
+    completion, and the ordered-mode release loop. One implementation so
+    the two drains' snapshots cannot silently diverge.
+    """
+
+    def __init__(self, source, epoch):
+        self._source = source
+        self._epoch = epoch
+
+    def account_yielded(self, piece, ordinal, wid, bid):
+        """One batch is about to be yielded to the consumer."""
+        source = self._source
+        with source._lock:
+            source._production_count += 1
+            source._note_consumed_locked(wid)
+            if piece is not None and ordinal is not None:
+                source._batch_events.append(
+                    (source._production_count, self._epoch, piece, ordinal))
+        source.last_bid = bid
+
+    def complete_piece(self, piece, wid):
+        """One piece fully yielded to the consumer (its ``piece_done``
+        cleared the drain — in ordered mode, cleared the sequencer)."""
+        source = self._source
+        with source._lock:
+            if piece in source._completed:
+                return
+            source._completed.add(piece)
+            source._events.append(
+                (source._production_count, self._epoch, [piece]))
+            source._note_pieces_locked(wid, 1)
+
+    def emit(self, released):
+        """Yield a sequencer's released events in order (generator — the
+        drain ``yield from``s it). Buffered batch items are
+        ``(ordinal, payload, stream, bid, t_enqueued)``."""
+        collector = tracing.COLLECTOR
+        for ev in released:
+            if ev[0] == "batch":
+                _, rpiece, (rordinal, rpayload, rstream, rbid, rt) = ev
+                self.account_yielded(rpiece, rordinal, rstream.worker_id,
+                                     rbid)
+                if collector.enabled:
+                    collector.record_span("client.queue", rt,
+                                          time.perf_counter(), bid=rbid)
+                yield rpayload
+            else:
+                _, rpiece, rwid = ev
+                self.complete_piece(rpiece, rwid)
+
+
 class _StreamReader(threading.Thread):
-    """One worker stream's receive loop: pulls batches and feeds the shared
-    ready-queue as ``(kind, sid, item)`` events — ``batch`` per payload,
-    then one terminal ``end`` (clean), ``broken`` (connection-type failure
-    → consumer retry/takeover), or ``error`` (``ServiceError`` → consumer
+    """One worker stream's receive loop: pulls events and feeds the shared
+    ready-queue as ``(kind, sid, item)`` events — ``batch`` per payload
+    (piece/ordinal tags riding along on the exactly-once protocol),
+    ``piece_done`` per finished piece (tagged streams only), then one
+    terminal ``end`` (clean), ``broken`` (connection-type failure →
+    consumer retry/takeover), or ``error`` (``ServiceError`` → consumer
     re-raises). Bookkeeping stays on the consumer side of the queue; this
     thread only reports its receive-stall seconds via ``note_recv``."""
 
@@ -207,7 +387,7 @@ class _StreamReader(threading.Thread):
             while not self._stopped.is_set():
                 t0 = time.perf_counter()
                 try:
-                    batch = self._stream.next_batch()
+                    kind, payload = self._stream.next_event()
                 except (ConnectionClosedError, ConnectionError,
                         OSError) as exc:
                     # A close() from the consumer's teardown also lands here
@@ -217,16 +397,21 @@ class _StreamReader(threading.Thread):
                     return
                 t1 = time.perf_counter()
                 self._note_recv(self._stream.worker_id, t1 - t0,
-                                batch is not None)
-                if batch is None:
+                                kind == "batch")
+                if kind == "end":
                     self._put(("end", self._sid, None))
                     return
+                if kind == "piece_done":
+                    self._put(("piece_done", self._sid, payload))
+                    continue
                 bid = self._stream.last_bid
                 if collector.enabled:
                     collector.record_span("client.recv", t0, t1, bid=bid)
                 # The enqueue timestamp travels with the batch so the
                 # consumer can record the ready-queue residency span.
-                self._put(("batch", self._sid, (batch, bid, t1)))
+                self._put(("batch", self._sid,
+                           (payload, self._stream.last_piece,
+                            self._stream.last_ordinal, bid, t1)))
         except BaseException as exc:
             # ServiceError and anything unexpected: forward as a terminal
             # event for the consumer to re-raise — a reader dying silently
@@ -262,7 +447,9 @@ class _DynamicStream:
                  credits=None):
         self.worker_id = worker_id
         self.address = tuple(address)
-        self.pairs = list(pairs)          # initial [(piece, generation)]
+        # initial [(piece, generation, start)] — start = the client's
+        # delivery watermark, so a (re)opened stream never repeats batches
+        self.pairs = [self._triple(t) for t in pairs]
         self.epoch = epoch
         self.credits = credits
         self._connect_timeout = connect_timeout
@@ -284,7 +471,7 @@ class _DynamicStream:
                 conn.close()
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "dynamic": True,
-                       "pieces": [[int(p), int(g)] for p, g in self.pairs],
+                       "pieces": [list(t) for t in self.pairs],
                        "epoch": self.epoch}
             if self.credits is not None:
                 request["credits"] = self.credits
@@ -302,16 +489,23 @@ class _DynamicStream:
             self._conn = conn
             return self._conn
 
+    @staticmethod
+    def _triple(t):
+        t = list(t)
+        return (int(t[0]), int(t[1]), int(t[2]) if len(t) > 2 else 0)
+
     def next_event(self):
-        """``(kind, payload)`` — ``("batch", (piece, gen, payload, bid))``,
-        ``("piece_done", (piece, gen, rows))``, ``("revoked", (req,
-        pieces))``, or ``("end", None)``."""
+        """``(kind, payload)`` — ``("batch", (piece, gen, ordinal,
+        payload, bid))``, ``("piece_done", (piece, gen, rows))``,
+        ``("revoked", (req, pieces))``, or ``("end", None)``."""
         conn = self._ensure_conn()
         header, payload = conn.recv()
         kind = header.get("type")
         if kind == "batch":
+            ordinal = header.get("ordinal")
             return ("batch", (int(header.get("piece", -1)),
                               int(header.get("generation", 0)),
+                              int(ordinal) if ordinal is not None else None,
                               payload, header.get("bid")))
         if kind == "piece_done":
             return ("piece_done", (int(header["piece"]),
@@ -346,7 +540,7 @@ class _DynamicStream:
 
     def extend(self, pairs):
         self._send({"type": "extend",
-                    "pieces": [[int(p), int(g)] for p, g in pairs]})
+                    "pieces": [list(self._triple(t)) for t in pairs]})
 
     def revoke(self, pieces, req):
         self._send({"type": "revoke", "pieces": [int(p) for p in pieces],
@@ -401,12 +595,12 @@ class _DynamicStreamReader(threading.Thread):
                     self._put(("end", self._sid, None))
                     return
                 if kind == "batch":
-                    piece, gen, payload, bid = item
+                    piece, gen, ordinal, payload, bid = item
                     if collector.enabled:
                         collector.record_span("client.recv", t0, t1,
                                               bid=bid)
                     self._put(("dbatch", self._sid,
-                               (piece, gen, payload, bid, t1)))
+                               (piece, gen, ordinal, payload, bid, t1)))
                 else:  # piece_done / revoked
                     self._put((kind, self._sid, item))
         except BaseException as exc:
@@ -463,6 +657,18 @@ class ServiceBatchSource:
         pokes the loop immediately, so steal latency is not bounded by
         this interval; it mostly caps how stale the dispatcher's
         backlog/rate view may get.
+    :param ordered: deterministic delivery order. The multiplexed drain
+        normally yields whichever worker's batch is ready (fast, but the
+        interleaving varies run to run); ``ordered=True`` re-sequences
+        delivery into the canonical order — pieces in the seed-tree order
+        of the dispatcher's ``shuffle_seed`` (ascending without one),
+        batches within a piece by ordinal — so two runs (any fleet shape,
+        any steal/failure history) yield byte-identical streams. Costs a
+        reorder buffer (~streams × credits batches in the common case,
+        exported live as ``client_watermark_lag``; a persistent
+        head-of-line stall can grow it past that — see
+        ``_OrderedSequencer``) and re-introduces head-of-line waiting
+        on the piece whose turn it is. Static and dynamic modes only.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
@@ -470,7 +676,7 @@ class ServiceBatchSource:
                  backoff_base=0.05, backoff_max=2.0, resume_state=None,
                  credits=8, ready_queue_depth=None, heartbeat_interval_s=2.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
-                 dynamic_sync_interval_s=0.25):
+                 dynamic_sync_interval_s=0.25, ordered=False):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if ready_queue_depth is not None and ready_queue_depth < 1:
@@ -491,6 +697,8 @@ class ServiceBatchSource:
         self._rpc_deadline_s = rpc_deadline_s
         self._max_frame_bytes = max_frame_bytes
         self._dynamic_sync_interval_s = dynamic_sync_interval_s
+        self._ordered = bool(ordered)
+        self._shuffle_seed = None     # dispatcher config, read at __call__
         self._ready_queue = None      # live queue while a drain is active
         self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
@@ -519,14 +727,32 @@ class ServiceBatchSource:
             "steals_applied": 0,      # dynamic: revoke-ack'd piece moves
             "steals_failed": 0,       # dynamic: steals the donor beat
             "dedup_dropped": 0,       # dynamic: stale-generation batches
+            "duplicates_dropped": 0,  # sub-watermark batches a re-serve
+            #                           repeated (the exactly-once safety
+            #                           net — 0 when the worker-side
+            #                           watermark skip did its job)
             "fencing_epoch": 0,       # last fencing epoch observed
             "dispatcher": {},         # dispatcher recovery counters (last
         }                             # heartbeat reply)
+        # Per-piece delivery watermarks for the epoch in flight: the next
+        # batch ordinal expected from the network (batches below it were
+        # already received — yielded or sitting in the ordered-mode reorder
+        # buffer). Every re-serve path (retry, takeover, resync relaunch)
+        # reads these as the `starts` it re-grants pieces at; sub-watermark
+        # arrivals are dropped as duplicates. Guarded by ``_lock`` (the
+        # recovery threads and the heartbeat read them concurrently).
+        self._recv_watermarks = {}
+        self._resume_watermarks = {}
         if resume_state is not None:
             self._validate_resume_state(resume_state)
             self._epoch = int(resume_state["epoch"])
             self._completed = set(int(p)
                                   for p in resume_state["completed_pieces"])
+            self._resume_watermarks = {
+                int(p): int(n)
+                for p, n in (resume_state.get("watermarks") or {}).items()}
+            self._resume_seed = resume_state.get("shuffle_seed")
+            self._resume_has_seed = "shuffle_seed" in resume_state
         self._resumed = resume_state is not None
         # Production-order bookkeeping for state_dict(): the n-th produced
         # batch is the n-th batch the consumer yields (FIFO through the
@@ -535,7 +761,14 @@ class ServiceBatchSource:
         # what this source has produced into the loader's prefetch queue.
         self._production_count = 0
         self._events = []        # (production_count, epoch, [pieces])
-        self._epoch_starts = [(0, self._epoch, set(self._completed))]
+        # Per-batch provenance in production order: (production_count,
+        # epoch, piece, ordinal) for every TAGGED batch yielded — what a
+        # state_dict() computes mid-piece watermarks from, at any consumer
+        # position (untagged legacy batches record nothing and fall back
+        # to per-piece-set completion granularity).
+        self._batch_events = []
+        self._epoch_starts = [(0, self._epoch, set(self._completed),
+                               dict(self._resume_watermarks))]
 
     def _recovery_inc(self, event, n=1):
         """Bump a client recovery counter in BOTH surfaces at once: the
@@ -583,13 +816,29 @@ class ServiceBatchSource:
         info = self._dispatcher_request({"type": "list_workers"})
         with self._lock:
             self._mode = info["mode"]
+            self._shuffle_seed = info.get("shuffle_seed")
             # Fresh iteration: the consumer's batch counter restarts, so
             # production bookkeeping (and delivery diagnostics) restart
             # with it.
             self._production_count = 0
             self._events = []
-            self._epoch_starts = [(0, self._epoch, set(self._completed))]
+            self._batch_events = []
+            self._epoch_starts = [(0, self._epoch, set(self._completed),
+                               dict(self._resume_watermarks))]
             self._per_worker = {}
+        if self._resumed and getattr(self, "_resume_has_seed", False) \
+                and self._resume_seed != self._shuffle_seed:
+            self._log.warning(
+                "resume_state was saved under shuffle_seed=%r but the "
+                "dispatcher runs %r — delivery stays exactly-once, but "
+                "the resumed stream's ORDER will not be bit-identical to "
+                "the original run's", self._resume_seed,
+                self._shuffle_seed)
+        if self._ordered and info["mode"] == "fcfs":
+            raise ValueError(
+                "ordered delivery requires static or dynamic sharding: "
+                "fcfs hands splits out first-come-first-served, so no "
+                "canonical piece order exists to sequence against")
         if info["mode"] == "static":
             # The multiplexed drain prefetches into its ready-queue behind
             # reader threads — consumers may pull it directly.
@@ -652,6 +901,7 @@ class ServiceBatchSource:
         return reply
 
     def _iter_static_epochs(self, num_epochs, epoch):
+        first = True
         while num_epochs is None or epoch < num_epochs:
             reply = self._fetch_assignment(epoch)
             if not reply["assignments"] and num_epochs is None:
@@ -668,22 +918,32 @@ class ServiceBatchSource:
                 return
             with self._lock:
                 skip = set(self._completed)
+                # A resumed first epoch starts mid-piece at the snapshot's
+                # watermarks; later epochs start clean.
+                self._recv_watermarks = (
+                    dict(self._resume_watermarks) if first else {})
+                starts = dict(self._recv_watermarks)
+            first = False
             streams = {}
+            pending_all = []
             for wid, pieces in reply["assignments"].items():
                 pending = [p for p in pieces if p not in skip]
                 if pending:
+                    pending_all.extend(pending)
                     streams[len(streams)] = _WorkerStream(
                         wid, reply["workers"][wid], pending, epoch,
-                        self._connect_timeout, credits=self._credits)
-            yield from self._drain_streams(streams, epoch)
+                        self._connect_timeout, credits=self._credits,
+                        tagged=True,
+                        starts={p: starts.get(p, 0) for p in pending})
+            sequencer = (_OrderedSequencer(
+                piece_order(self._shuffle_seed, epoch, pending_all))
+                if self._ordered else None)
+            yield from self._drain_streams(streams, epoch, sequencer)
             epoch += 1
             with self._lock:
-                self._completed = set()
-                self._epoch = epoch
-                self._epoch_starts.append(
-                    (self._production_count, epoch, set()))
+                self._roll_epoch_locked(epoch)
 
-    def _drain_streams(self, streams, epoch):
+    def _drain_streams(self, streams, epoch, sequencer=None):
         """Multiplexed drain: one reader thread per worker stream, all
         feeding a single bounded ready-queue this generator yields from —
         whichever worker is ready is consumed, so a stalled worker never
@@ -691,14 +951,24 @@ class ServiceBatchSource:
         round-robin ``next_batch`` loop this replaces blocked on one slow
         stream while the others' batches sat in socket buffers).
 
+        Delivery is **exactly-once** on the tagged protocol: every batch
+        carries ``(piece, ordinal)``, the consumer tracks a per-piece
+        receive watermark, every re-serve path (same-worker retry,
+        takeover, resync relaunch) re-grants pieces AT their watermarks so
+        the worker's engine skips already-delivered batches at the source,
+        and a sub-watermark arrival that slips through anyway is dropped
+        here (counted as ``duplicates_dropped`` — the safety net, 0 in a
+        healthy run). Untagged streams (a worker whose pool cannot
+        attribute per-piece completion) keep the legacy at-least-once
+        re-serve.
+
         Semantics preserved from the blocking drain:
 
         - a broken stream is retried against the same worker, then reported
-          and re-assigned (at-least-once takeover) — recovery runs on a
-          helper thread, so a dead worker's connect timeouts and backoff
-          never block this consumer from yielding the survivors' batches
-          (recovery completing posts a ``recovered`` event and the new
-          streams' readers are launched here);
+          and re-assigned — recovery runs on a helper thread, so a dead
+          worker's connect timeouts and backoff never block this consumer
+          from yielding the survivors' batches (recovery completing posts
+          a ``recovered`` event and the new streams' readers launch here);
         - production-count accounting happens HERE, on the consumer side of
           the queue: events flow per-stream FIFO, so a stream's ``end`` is
           dequeued only after all its batches were yielded and completion
@@ -711,7 +981,14 @@ class ServiceBatchSource:
           streams whose piece→worker mapping is unchanged keep flowing
           untouched (a journal-backed dispatcher restart is a no-op — zero
           duplicates); only streams whose mapping changed are retired and
-          their pending pieces relaunched per the fresh plan.
+          their pending pieces relaunched per the fresh plan, at their
+          watermarks.
+
+        ``sequencer`` (ordered mode) re-sequences yields into the
+        canonical seed-tree order: received batches are pushed through it
+        and only what it releases is yielded — checkpoint bookkeeping
+        happens at RELEASE, so ``state_dict`` snapshots stay consistent
+        with what the consumer actually saw.
         """
         if not streams:
             return
@@ -758,6 +1035,8 @@ class ServiceBatchSource:
                 for stream in fresh:  # drain torn down mid-recovery
                     stream.close()
 
+        book = _DeliveryBook(self, epoch)
+
         def resync(active):
             """Re-fetch the assignment under the current fencing epoch and
             reconcile the live streams against it (consumer thread). A
@@ -788,16 +1067,21 @@ class ServiceBatchSource:
                                           tuple(reply["workers"][wid]))
             for sid in list(active):
                 stream = streams[sid]
+                # Judge the stream by its PENDING pieces only: piece-level
+                # completion (tagged protocol) means some of stream.pieces
+                # may already be done — absent from `desired` by design,
+                # which must not read as "mapping moved".
+                pending = [p for p in stream.pieces if p not in completed]
                 if all(desired.get(p, (None,))[0] == stream.worker_id
-                       for p in stream.pieces):
+                       for p in pending):
                     # Mapping unchanged: the stream keeps flowing — its
                     # pieces are accounted for.
-                    for piece in stream.pieces:
+                    for piece in pending:
                         desired.pop(piece, None)
                 else:
                     # Mapping moved (its worker was evicted/re-planned):
-                    # retire the stream; its pieces relaunch below, from
-                    # their beginning (at-least-once).
+                    # retire the stream; its pending pieces relaunch
+                    # below, AT their watermarks (exactly-once).
                     streams.pop(sid)
                     active.discard(sid)
                     retired.add(sid)
@@ -806,17 +1090,27 @@ class ServiceBatchSource:
                         self._recovery_inc("streams_retired")
                     self._log.warning(
                         "resync: retiring stream (pieces %s moved)",
-                        stream.pieces, worker_id=stream.worker_id,
+                        pending, worker_id=stream.worker_id,
                         fencing_epoch=reply.get("fencing_epoch"))
             regroup = {}
             for piece, (wid, address) in sorted(desired.items()):
                 regroup.setdefault((wid, address), []).append(piece)
+            with self._lock:
+                marks = dict(self._recv_watermarks)
             for (wid, address), pieces in regroup.items():
                 new_sid = next(sid_counter)
                 active.add(new_sid)
+                # Relaunch in CANONICAL order, not numeric: serving a
+                # relaunched stream's pieces in seed-tree relative order
+                # keeps ordered mode's reorder buffer small (a
+                # canonically-late piece served first just sits buffered
+                # until its turn).
                 launch(new_sid, _WorkerStream(
-                    wid, address, pieces, epoch, self._connect_timeout,
-                    credits=self._credits))
+                    wid, address,
+                    piece_order(self._shuffle_seed, epoch, pieces),
+                    epoch, self._connect_timeout,
+                    credits=self._credits, tagged=True,
+                    starts={p: marks.get(p, 0) for p in pieces}))
 
         try:
             for sid, stream in list(streams.items()):
@@ -835,34 +1129,95 @@ class ServiceBatchSource:
                         retired.discard(sid)
                     continue
                 if kind == "batch":
-                    batch, bid, t_enqueued = item
+                    batch, piece, ordinal, bid, t_enqueued = item
                     stream = streams[sid]
                     # Ack BEFORE yielding: the worker refills its window
-                    # while the trainer computes on this batch.
+                    # while the trainer computes on this batch — also in
+                    # ordered mode, where the batch may only be buffered:
+                    # deferring the ack to sequencer release deadlocks,
+                    # because the engine's decode lookahead (and warm
+                    # cache staging) can legally fill a window with a
+                    # canonically-later piece's batches while an earlier
+                    # piece is still decoding on the same stream.
                     stream.add_credit(1)
-                    with self._lock:
-                        self._production_count += 1
-                        self._note_consumed_locked(stream.worker_id)
-                    collector = tracing.COLLECTOR
-                    if collector.enabled:
-                        collector.record_span("client.queue", t_enqueued,
-                                              time.perf_counter(), bid=bid)
+                    if piece is not None and ordinal is not None:
+                        with self._lock:
+                            duplicate = (
+                                ordinal < self._recv_watermarks.get(piece,
+                                                                    0))
+                            if duplicate:
+                                # A re-serve repeated a delivered batch —
+                                # the watermark skip should have prevented
+                                # it at the source; drop it here so the
+                                # consumer still sees it exactly once.
+                                self._recovery_inc("duplicates_dropped")
+                            else:
+                                self._recv_watermarks[piece] = ordinal + 1
+                        if duplicate:
+                            CLIENT_DEDUP_DROPPED.labels("takeover").inc()
+                            continue
+                    elif sequencer is not None:
+                        raise ServiceError(
+                            "ordered delivery needs the tagged stream "
+                            f"protocol, but worker {stream.worker_id} "
+                            "sent an untagged batch (its reader pool has "
+                            "no per-piece completion attribution — use "
+                            "reader_pool_type='thread')")
                     # Sampled on dequeue: what a scraper sees is the depth
                     # the consumer actually experienced.
                     CLIENT_READY_QUEUE_DEPTH.set(ready.qsize())
-                    self.last_bid = bid
-                    yield batch
+                    if sequencer is not None:
+                        released = sequencer.push(
+                            piece, (ordinal, batch, stream, bid,
+                                    t_enqueued))
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.account_yielded(piece, ordinal,
+                                             stream.worker_id, bid)
+                        collector = tracing.COLLECTOR
+                        if collector.enabled:
+                            collector.record_span(
+                                "client.queue", t_enqueued,
+                                time.perf_counter(), bid=bid)
+                        yield batch
+                elif kind == "piece_done":
+                    piece = int(item)
+                    stream = streams.get(sid)
+                    if stream is None:
+                        continue
+                    if sequencer is not None:
+                        released = sequencer.finish_piece(
+                            piece, stream.worker_id)
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.complete_piece(piece, stream.worker_id)
                 elif kind == "end":
                     stream = streams.pop(sid)
                     with self._lock:
-                        self._completed.update(stream.pieces)
-                        # The stream's batches are all among the first
-                        # _production_count produced: once the consumer has
-                        # yielded that many, these pieces are truly done.
-                        self._events.append((self._production_count, epoch,
-                                             sorted(stream.pieces)))
-                        self._note_pieces_locked(stream.worker_id,
-                                                 len(stream.pieces))
+                        # Tagged streams completed their pieces one by one
+                        # via piece_done; anything still pending here is a
+                        # legacy untagged stream (or a lost marker) and
+                        # completes at stream granularity, exactly like
+                        # the pre-watermark drain. NOT in ordered mode:
+                        # there the markers are parked in the sequencer
+                        # (a fast stream's end outruns its pieces' turns)
+                        # and complete when released — completing them
+                        # here would stamp a production count that
+                        # predates their own batches, which a v2 snapshot
+                        # reads as "already delivered" (sample loss on
+                        # resume).
+                        pending = ([] if sequencer is not None
+                                   else [p for p in stream.pieces
+                                         if p not in self._completed])
+                        if pending:
+                            self._completed.update(pending)
+                            self._events.append(
+                                (self._production_count, epoch,
+                                 sorted(pending)))
+                            self._note_pieces_locked(stream.worker_id,
+                                                     len(pending))
                     active.discard(sid)
                 elif kind == "error":
                     raise item
@@ -890,8 +1245,14 @@ class ServiceBatchSource:
                     threading.Thread(
                         target=recover, args=(stream,), daemon=True,
                         name=f"service-recover-{stream.worker_id}").start()
+            if sequencer is not None:
+                # Defensive: every piece_done should have cleared the
+                # sequencer by now; flush anything a lost marker stranded
+                # so the epoch never ends with batches held back.
+                yield from book.emit(sequencer.drain())
         finally:
             stop.set()
+            CLIENT_WATERMARK_LAG.set(0)
             # Closing the sockets unblocks readers parked in recv; the stop
             # flag unblocks readers (and recovery threads) parked on a full
             # queue. A recovery thread still mid-dial is a daemon bounded
@@ -915,6 +1276,30 @@ class ServiceBatchSource:
             counters["stall_s"] += stall_s
             if got_batch:
                 counters["inflight"] += 1
+
+    def _roll_epoch_locked(self, epoch):
+        """Per-epoch delivery state reset at an epoch boundary (callers
+        hold ``_lock``): completion and watermarks start clean (a resumed
+        first epoch's carry-over is over), the new epoch start is
+        recorded, and per-batch snapshot events from epochs a
+        ``state_dict`` can no longer target are pruned —
+        ``_batch_events`` holds one tuple per tagged batch, so without
+        pruning a ``num_epochs=None`` run grows it forever. The
+        just-finished epoch is retained because a consumer's
+        ``yielded_batches`` cursor may lag production by its (bounded)
+        prefetch depth; lagging a FULL epoch behind is not a supported
+        snapshot position. ``_events`` (one tuple per piece per epoch,
+        inspected by diagnostics and tests as completion history) is two
+        orders of magnitude smaller and stays unpruned."""
+        self._completed = set()
+        self._recv_watermarks = {}
+        self._resume_watermarks = {}
+        self._epoch = epoch
+        self._epoch_starts.append(
+            (self._production_count, epoch, set(), {}))
+        keep_from = epoch - 1
+        self._batch_events = [e for e in self._batch_events
+                              if e[1] >= keep_from]
 
     def _note_consumed_locked(self, worker_id):
         """One batch consumed (and its credit acked) — callers hold _lock."""
@@ -956,6 +1341,7 @@ class ServiceBatchSource:
                 target=self._heartbeat_loop, args=(heartbeat_stop,),
                 daemon=True, name=f"service-heartbeat-{self.client_id}")
             heartbeat.start()
+        first = True
         try:
             while num_epochs is None or epoch < num_epochs:
                 plan = self._fetch_dynamic_plan(epoch)
@@ -966,13 +1352,14 @@ class ServiceBatchSource:
                         client_index=self.client_index,
                         num_clients=self.num_clients)
                     return
+                with self._lock:
+                    self._recv_watermarks = (
+                        dict(self._resume_watermarks) if first else {})
+                first = False
                 yield from self._drain_dynamic(plan, epoch)
                 epoch += 1
                 with self._lock:
-                    self._completed = set()
-                    self._epoch = epoch
-                    self._epoch_starts.append(
-                        (self._production_count, epoch, set()))
+                    self._roll_epoch_locked(epoch)
         finally:
             heartbeat_stop.set()
             if heartbeat is not None:
@@ -995,9 +1382,18 @@ class ServiceBatchSource:
         Delivery bookkeeping matches static mode (production-order FIFO
         through one ready-queue; ``piece_done`` dequeues strictly after
         the piece's batches), so ``state_dict`` resume works per piece —
-        finer grained than static's per-stream completion."""
+        finer grained than static's per-stream completion.
+
+        Exactly-once now also covers the TAKEOVER path: every grant (the
+        initial plan, steals, dead-worker reassignments, deferred grants)
+        carries the piece's delivery watermark as its ``start``, so the
+        receiving engine resumes the piece where delivery stopped, and a
+        sub-watermark ordinal arriving anyway is dropped (counted in
+        ``duplicates_dropped``). ``sequencer`` re-orders yields into the
+        canonical seed-tree order (ordered mode)."""
         with self._lock:
             skip = set(self._completed)
+            marks = dict(self._recv_watermarks)
         piece_state = {}   # piece -> {"wid", "gen", "done", "received"}
         outstanding = {}   # wid -> set of not-done pieces granted to it
         addresses = {wid: tuple(addr)
@@ -1005,17 +1401,22 @@ class ServiceBatchSource:
         initial_grants = {}
         for wid, pairs in plan["assignments"].items():
             outstanding.setdefault(wid, set())
-            for piece, gen in pairs:
-                piece, gen = int(piece), int(gen)
+            for entry in pairs:
+                piece, gen = int(entry[0]), int(entry[1])
                 done = piece in skip
                 piece_state[piece] = {"wid": wid, "gen": gen,
                                       "done": done, "received": False}
                 if not done:
                     outstanding[wid].add(piece)
-                    initial_grants.setdefault(wid, []).append((piece, gen))
+                    initial_grants.setdefault(wid, []).append(
+                        (piece, gen, marks.get(piece, 0)))
         remaining = sum(len(ps) for ps in outstanding.values())
         if remaining == 0:
             return
+        sequencer = (_OrderedSequencer(piece_order(
+            self._shuffle_seed, epoch,
+            [p for p, st in piece_state.items() if not st["done"]]))
+            if self._ordered else None)
         depth = (self._ready_queue_depth
                  if self._ready_queue_depth is not None
                  else max(4, 2 * max(1, len(initial_grants))))
@@ -1072,7 +1473,10 @@ class ServiceBatchSource:
                 self._recovery_inc("steals_failed")
 
         def grant(wid, pairs):
-            """Hand pieces to a worker's live stream (or open one)."""
+            """Hand ``(piece, gen, start)`` grants to a worker's live
+            stream (or open one) — ``start`` is the piece's delivery
+            watermark at grant time, so the engine never repeats what the
+            consumer already has."""
             if wid in recovering:
                 deferred_grants.setdefault(wid, []).extend(pairs)
                 return
@@ -1082,7 +1486,7 @@ class ServiceBatchSource:
             elif wid in addresses:
                 launch(wid, pairs)
             else:  # no address for this worker: give the pieces back
-                for piece, gen in pairs:
+                for piece, gen, _start in pairs:
                     note_failed_steal(piece, gen)
 
         def apply_deltas(reply):
@@ -1134,7 +1538,9 @@ class ServiceBatchSource:
                         st["wid"], st["gen"] = to_wid, gen
                         outstanding.setdefault(to_wid, set()).add(piece)
                         self._recovery_inc("steals_applied")
-                    regroup.setdefault(to_wid, []).append((piece, gen))
+                        start = self._recv_watermarks.get(piece, 0)
+                    regroup.setdefault(to_wid, []).append(
+                        (piece, gen, start))
                 else:
                     # The donor had already sent (or is sending) it: the
                     # steal loses, the piece stays where it is.
@@ -1154,10 +1560,12 @@ class ServiceBatchSource:
 
         def recover(wid, sid):
             """Retry-then-takeover off the consumer thread (same shape as
-            static's recovery)."""
+            static's recovery). Pieces reconnect AT their watermarks —
+            the retry, like every other re-serve, is idempotent."""
             with self._lock:
                 pairs = sorted(
-                    (piece, piece_state[piece]["gen"])
+                    (piece, piece_state[piece]["gen"],
+                     self._recv_watermarks.get(piece, 0))
                     for piece in outstanding.get(wid, set()))
             if not pairs:
                 post(("dgone", sid, wid))
@@ -1191,7 +1599,7 @@ class ServiceBatchSource:
                 reply = self._dispatcher_request({
                     "type": "report_failure", "client_id": self.client_id,
                     "worker_id": wid,
-                    "pieces": [piece for piece, _ in pairs],
+                    "pieces": [t[0] for t in pairs],
                     "fencing_epoch": token})
                 if reply.get("type") == "stale_fencing":
                     with self._lock:
@@ -1199,7 +1607,7 @@ class ServiceBatchSource:
                     reply = self._dispatcher_request({
                         "type": "report_failure",
                         "client_id": self.client_id, "worker_id": wid,
-                        "pieces": [piece for piece, _ in pairs],
+                        "pieces": [t[0] for t in pairs],
                         "fencing_epoch": int(reply["fencing_epoch"])})
                 post(("dtakeover", sid, (wid, reply)))
             except BaseException as exc:
@@ -1259,6 +1667,8 @@ class ServiceBatchSource:
                 elif reply.get("type") == "deltas":
                     post(("deltas", None, reply))
 
+        book = _DeliveryBook(self, epoch)
+
         sync_thread = threading.Thread(
             target=sync_loop, daemon=True,
             name=f"service-dynsync-{self.client_id}")
@@ -1269,7 +1679,7 @@ class ServiceBatchSource:
             while remaining > 0:
                 kind, sid, item = ready.get()
                 if kind == "dbatch":
-                    piece, gen, payload, bid, t_enqueued = item
+                    piece, gen, ordinal, payload, bid, t_enqueued = item
                     stream = streams.get(sid)
                     if stream is None:
                         continue  # stream was torn down: stale event
@@ -1280,24 +1690,55 @@ class ServiceBatchSource:
                     if st is None or st["done"] or st["gen"] != gen:
                         # Stale generation (a superseded grant): the dedup
                         # that makes a stolen piece count exactly once.
+                        # Deliberately does NOT advance the watermark —
+                        # the current owner re-serves this ordinal under
+                        # its own generation.
                         with self._lock:
                             self._recovery_inc("dedup_dropped")
+                        CLIENT_DEDUP_DROPPED.labels("steal").inc()
                         continue
+                    if ordinal is not None:
+                        with self._lock:
+                            duplicate = (
+                                ordinal
+                                < self._recv_watermarks.get(piece, 0))
+                            if duplicate:
+                                self._recovery_inc("duplicates_dropped")
+                            else:
+                                self._recv_watermarks[piece] = ordinal + 1
+                        if duplicate:
+                            CLIENT_DEDUP_DROPPED.labels("takeover").inc()
+                            continue
+                    elif sequencer is not None:
+                        raise ServiceError(
+                            "ordered delivery needs ordinal-tagged "
+                            f"batches, but worker {stream.worker_id} "
+                            "sent one untagged")
                     st["received"] = True
                     n = (len(next(iter(payload.values())))
                          if payload else 0)
                     with self._lock:
-                        self._production_count += 1
-                        self._note_consumed_locked(stream.worker_id)
+                        # Rates credit the DELIVERING worker at receipt —
+                        # the steal planner balances worker throughput,
+                        # not the consumer's (possibly re-ordered) yields.
                         rows_by_wid[stream.worker_id] = (
                             rows_by_wid.get(stream.worker_id, 0) + n)
-                    collector = tracing.COLLECTOR
-                    if collector.enabled:
-                        collector.record_span("client.queue", t_enqueued,
-                                              time.perf_counter(), bid=bid)
                     CLIENT_READY_QUEUE_DEPTH.set(ready.qsize())
-                    self.last_bid = bid
-                    yield payload
+                    if sequencer is not None:
+                        released = sequencer.push(
+                            piece, (ordinal, payload, stream,
+                                    bid, t_enqueued))
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.account_yielded(piece, ordinal,
+                                             stream.worker_id, bid)
+                        collector = tracing.COLLECTOR
+                        if collector.enabled:
+                            collector.record_span(
+                                "client.queue", t_enqueued,
+                                time.perf_counter(), bid=bid)
+                        yield payload
                 elif kind == "piece_done":
                     piece, gen, _rows = item
                     st = piece_state.get(piece)
@@ -1305,15 +1746,17 @@ class ServiceBatchSource:
                         continue
                     with self._lock:
                         st["done"] = True
-                        self._completed.add(piece)
-                        self._events.append(
-                            (self._production_count, epoch, [piece]))
-                        self._note_pieces_locked(st["wid"], 1)
                         outstanding.get(st["wid"], set()).discard(piece)
                         drained = not outstanding.get(st["wid"])
                         others_backlogged = any(
                             len(ps) > 1 for w, ps in outstanding.items()
                             if w != st["wid"])
+                    if sequencer is not None:
+                        released = sequencer.finish_piece(piece, st["wid"])
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.complete_piece(piece, st["wid"])
                     remaining -= 1
                     if remaining and drained and others_backlogged:
                         # This worker's deque just ran dry while a peer
@@ -1371,11 +1814,11 @@ class ServiceBatchSource:
                             int(reply.get("fencing_epoch", 0)))
                     for wid2, addr in (reply.get("workers") or {}).items():
                         addresses[wid2] = tuple(addr)
-                    for piece, gen in deferred_grants.pop(wid, []):
+                    for piece, gen, _start in deferred_grants.pop(wid, []):
                         note_failed_steal(piece, gen)
                     for wid2, pairs in reply.get("assignments",
                                                  {}).items():
-                        pairs = [(int(p), int(g)) for p, g in pairs]
+                        pairs = [(int(t[0]), int(t[1])) for t in pairs]
                         fresh_pairs = []
                         with self._lock:
                             for piece, gen in pairs:
@@ -1387,7 +1830,9 @@ class ServiceBatchSource:
                                 st["wid"], st["gen"] = wid2, gen
                                 outstanding.setdefault(wid2,
                                                        set()).add(piece)
-                                fresh_pairs.append((piece, gen))
+                                fresh_pairs.append(
+                                    (piece, gen,
+                                     self._recv_watermarks.get(piece, 0)))
                         if fresh_pairs:
                             grant(wid2, fresh_pairs)
                 elif kind == "dgone":
@@ -1407,7 +1852,9 @@ class ServiceBatchSource:
                     if deferred:
                         with self._lock:
                             live = [
-                                (piece, gen) for piece, gen in deferred
+                                (piece, gen,
+                                 self._recv_watermarks.get(piece, 0))
+                                for piece, gen, _start in deferred
                                 if (st := piece_state.get(piece))
                                 is not None and not st["done"]
                                 and st["wid"] == wid]
@@ -1441,6 +1888,10 @@ class ServiceBatchSource:
                     threading.Thread(
                         target=recover, args=(wid, sid), daemon=True,
                         name=f"service-dynrecover-{wid}").start()
+            if sequencer is not None:
+                # Defensive: every piece_done cleared the sequencer by
+                # now; flush anything a lost marker stranded.
+                yield from book.emit(sequencer.drain())
             # Epoch complete: close the piece queues so engines drain and
             # streams end cleanly, then report the final state once so the
             # dispatcher's books close too (best-effort).
@@ -1471,6 +1922,7 @@ class ServiceBatchSource:
             stop.set()
             sync_stop.set()
             sync_poke.set()
+            CLIENT_WATERMARK_LAG.set(0)
             for stream in streams.values():
                 stream.close()
             with self._lock:
@@ -1492,9 +1944,26 @@ class ServiceBatchSource:
         drain. A dispatcher outage is a counted, retried tick, never an
         error: the data plane keeps flowing without the control plane."""
         while not stop_event.wait(self._heartbeat_interval_s):
+            with self._lock:
+                # Delivery watermarks ride every heartbeat: the dispatcher
+                # journals them through the WAL, so `status` (and a
+                # post-restart dispatcher) knows how far each piece got —
+                # the observability half of exactly-once recovery. The
+                # client's own copy stays authoritative for re-grants (it
+                # is never behind). Mid-flight pieces only: a completed
+                # piece's watermark is never used for a re-grant
+                # (_pending_and_starts filters on completion), and
+                # shipping the whole map would grow the heartbeat — and
+                # the dispatcher's piece-granularity WAL appends of it —
+                # to O(pieces) by late epoch (O(pieces^2) journal bytes).
+                marks = {str(p): n
+                         for p, n in self._recv_watermarks.items()
+                         if n and p not in self._completed}
+                epoch_now = self._epoch
             try:
                 reply = self._dispatcher_request(
-                    {"type": "client_heartbeat", "client_id": self.client_id},
+                    {"type": "client_heartbeat", "client_id": self.client_id,
+                     "epoch": epoch_now, "watermarks": marks},
                     retries=0)
             except (ServiceError, OSError):
                 with self._lock:
@@ -1529,34 +1998,55 @@ class ServiceBatchSource:
         with self._lock:
             self._fence_pending = False  # next heartbeat re-detects
 
+    def _pending_and_starts(self, pieces):
+        """The not-yet-completed subset of ``pieces`` and their delivery
+        watermarks — what every re-serve (same-worker retry, takeover,
+        resync relaunch) re-grants, so nothing completed is re-read and
+        nothing delivered is repeated."""
+        with self._lock:
+            pending = [p for p in pieces if p not in self._completed]
+            starts = {p: self._recv_watermarks.get(p, 0) for p in pending}
+        return pending, starts
+
     def _retry_stream(self, stream):
-        """Reconnect to the same worker and restart its piece set (the whole
-        set — at-least-once). ``None`` when the worker stays unreachable."""
+        """Reconnect to the same worker and resume its pending pieces at
+        their watermarks (exactly-once; an untagged legacy worker replays
+        from the piece start and the drain's dedup cannot help it — that
+        path stays at-least-once). ``None`` when the worker stays
+        unreachable."""
         stream.close()
+        pending, starts = self._pending_and_starts(stream.pieces)
+        if not pending:
+            # Everything this stream owed was already delivered and
+            # completed (its break raced the tail piece_done): nothing to
+            # re-serve — hand back an immediately-ended stream so the
+            # drain just closes the sid's bookkeeping.
+            return _EndedStream(stream)
 
         def attempt():
             fresh = _WorkerStream(stream.worker_id, stream.address,
-                                  stream.pieces, stream.epoch,
+                                  pending, stream.epoch,
                                   self._connect_timeout,
-                                  credits=self._credits)
-            batch = fresh.next_batch()  # forces connect + first reply
-            return fresh, batch
+                                  credits=self._credits, tagged=True,
+                                  starts=starts)
+            event = fresh.next_event()  # forces connect + first reply
+            return fresh, event
 
         try:
-            fresh, batch = retry_with_backoff(
+            fresh, event = retry_with_backoff(
                 attempt, retries=self._max_retries,
                 base_delay=self._backoff_base, max_delay=self._backoff_max,
                 retry_on=(OSError,), no_retry_on=(ServiceError,),
                 description=f"reconnect to worker {stream.worker_id}")
         except OSError:
             return None
-        # The first batch was consumed by the probe; hand it back by
+        # The first event was consumed by the probe; hand it back by
         # buffering it on the stream object.
-        if batch is None:
+        if event[0] == "end":
             # The restarted stream ended immediately; _drain_streams's
             # end-of-stream branch records the completion bookkeeping.
             return _EndedStream(fresh)
-        return _BufferedStream(fresh, batch)
+        return _BufferedStream(fresh, event)
 
     def _reassign(self, stream):
         """Report ``stream``'s worker dead; return fresh streams for its
@@ -1568,16 +2058,20 @@ class ServiceBatchSource:
         — instead of acting on the superseded takeover, re-fetch the
         assignment under the current epoch and route the broken pieces
         per the fresh plan (never double-delivering a piece another
-        mapping now owns, never skipping one)."""
+        mapping now owns, never skipping one).
+
+        Survivors re-serve each granted piece AT its watermark: zero
+        duplicates on the takeover path, not just zero loss."""
+        pending, starts = self._pending_and_starts(stream.pieces)
         self._log.warning(
             "worker unreachable after %d retries; requesting "
             "re-assignment of %d pieces", self._max_retries + 1,
-            len(stream.pieces), worker_id=stream.worker_id)
+            len(pending), worker_id=stream.worker_id)
         with self._lock:
             token = self._synced_fencing_epoch
         reply = self._dispatcher_request({
             "type": "report_failure", "client_id": self.client_id,
-            "worker_id": stream.worker_id, "pieces": stream.pieces,
+            "worker_id": stream.worker_id, "pieces": pending,
             "fencing_epoch": token})
         if reply.get("type") == "stale_fencing":
             with self._lock:
@@ -1588,7 +2082,7 @@ class ServiceBatchSource:
             # (moved by the same bump, e.g. a hung worker's eviction)
             # still depend on.
             fresh = self._request_assignment(stream.epoch)
-            broken = set(stream.pieces)
+            broken = set(pending)
             reply = {
                 "assignments": {
                     wid: [p for p in pieces if p in broken]
@@ -1604,8 +2098,16 @@ class ServiceBatchSource:
         with self._lock:
             self._recovery_inc("takeovers")
         return [
-            _WorkerStream(wid, reply["workers"][wid], pieces, stream.epoch,
-                          self._connect_timeout, credits=self._credits)
+            # piece_order re-asserts the canonical serve order that keeps
+            # ordered mode's reorder buffer small (the dispatcher already
+            # replies in it; this keeps the property local).
+            _WorkerStream(wid, reply["workers"][wid],
+                          piece_order(self._shuffle_seed, stream.epoch,
+                                      pieces),
+                          stream.epoch,
+                          self._connect_timeout, credits=self._credits,
+                          tagged=True,
+                          starts={p: starts.get(p, 0) for p in pieces})
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -1725,18 +2227,25 @@ class ServiceBatchSource:
     # -- checkpoint / diagnostics -----------------------------------------
 
     def state_dict(self, yielded_batches=None):
-        """Resumable position: the epoch in progress and the piece sets
-        whose streams fully completed (pieces mid-stream are re-read on
-        resume — at-least-once). Static and dynamic modes (dynamic tracks
-        completion per PIECE — a steal mid-epoch changes who served a
-        piece, never whether it counts as completed); fcfs has no
-        resumable position.
+        """Resumable position: the epoch in progress, the pieces fully
+        yielded, and — on the tagged exactly-once protocol — per-piece
+        batch **watermarks** for pieces mid-delivery, so a resume
+        continues each piece at the next batch instead of re-reading it
+        (exactly-once resume; untagged legacy streams still fall back to
+        piece-set granularity, at-least-once). With ``ordered=True`` and
+        the same dispatcher ``shuffle_seed``, the resumed stream is
+        bit-identical to the uninterrupted run from the snapshot batch
+        onward — the seed-tree cursor is implied by (epoch, completed,
+        watermarks). Static and dynamic modes (dynamic tracks completion
+        per piece — a steal mid-epoch changes who served a piece, never
+        whether it counts as completed); fcfs has no resumable position.
 
         ``yielded_batches``: for a consumer that prefetches past this
         source — the number of batches it has actually surfaced.
-        Completion is then computed as of that batch (batches still sitting
-        in a prefetch queue keep their pieces un-completed, so they are
-        re-read on resume: at-least-once, never sample loss).
+        Completion AND watermarks are computed as of that batch (batches
+        still sitting in a prefetch queue stay un-snapshotted, so they
+        are re-served on resume: never sample loss, and never a duplicate
+        either, because the re-serve starts exactly at the watermark).
         ``JaxDataLoader.state_dict()`` passes this for you; a consumer
         iterating the source directly has no prefetch gap and the default
         (everything produced) is exact.
@@ -1750,28 +2259,48 @@ class ServiceBatchSource:
                     "for resumable training")
             count = (self._production_count if yielded_batches is None
                      else min(int(yielded_batches), self._production_count))
-            epoch, base = self._epoch_starts[0][1], self._epoch_starts[0][2]
-            for start_count, start_epoch, start_base in self._epoch_starts:
+            epoch, base, base_marks = (self._epoch_starts[0][1],
+                                       self._epoch_starts[0][2],
+                                       self._epoch_starts[0][3])
+            for start_count, start_epoch, start_base, start_marks \
+                    in self._epoch_starts:
                 if start_count <= count:
-                    epoch, base = start_epoch, start_base
+                    epoch, base, base_marks = (start_epoch, start_base,
+                                               start_marks)
             completed = set(base)
             completed.update(
                 piece
                 for event_count, event_epoch, pieces in self._events
                 if event_epoch == epoch and event_count <= count
                 for piece in pieces)
+            watermarks = dict(base_marks)
+            for event_count, event_epoch, piece, ordinal \
+                    in self._batch_events:
+                if event_epoch == epoch and event_count <= count \
+                        and ordinal is not None:
+                    if ordinal + 1 > watermarks.get(piece, 0):
+                        watermarks[piece] = ordinal + 1
             return {
-                "version": 1,
+                "version": 2,
                 "mode": ("dynamic" if self._mode == "dynamic"
                          else "static"),
                 "client_index": self.client_index,
                 "num_clients": self.num_clients,
                 "epoch": epoch,
                 "completed_pieces": sorted(completed),
+                # Mid-piece positions (completed pieces need none); JSON
+                # object keys are strings for wire/file round-trips.
+                "watermarks": {str(p): n for p, n in sorted(
+                    watermarks.items()) if n and p not in completed},
+                # The order the snapshot was taken under: a resume under a
+                # different dispatcher seed stays exactly-once but warns
+                # that bit-identical order is off the table.
+                "shuffle_seed": self._shuffle_seed,
+                "ordered": self._ordered,
             }
 
     def _validate_resume_state(self, state):
-        if state.get("version") != 1:
+        if state.get("version") not in (1, 2):
             raise ValueError(
                 f"Unsupported resume_state version {state.get('version')!r}")
         # static and dynamic snapshots are interchangeable: both are
@@ -1803,9 +2332,13 @@ class ServiceBatchSource:
         - ``recovery``: control-plane recovery events this client observed
           — ``resyncs`` (fence-triggered assignment refreshes),
           ``streams_retired``, ``takeovers``, ``stale_fencing_retries``,
-          ``heartbeat_failures``, the last ``fencing_epoch`` seen, and
-          ``dispatcher`` (the dispatcher's own recovery counters — journal
-          replays, evictions, fencing bumps — from the last heartbeat).
+          ``heartbeat_failures``, ``dedup_dropped`` (stale-generation
+          batches of superseded dynamic grants), ``duplicates_dropped``
+          (sub-watermark batches a re-serve repeated — the exactly-once
+          safety net, 0 when the worker-side watermark skip worked), the
+          last ``fencing_epoch`` seen, and ``dispatcher`` (the
+          dispatcher's own recovery counters — journal replays, evictions,
+          fencing bumps — from the last heartbeat).
 
         ``JaxDataLoader`` snapshots this into its own ``diagnostics`` under
         ``"source"`` when the source is plugged in.
@@ -1823,7 +2356,7 @@ class ServiceBatchSource:
                 # consumer correlating its own per-batch timeline (the
                 # `service` scenario's per-epoch rows/s breakdown) reads
                 # the boundary without private state.
-                "epoch_starts": [[count, epoch] for count, epoch, _
+                "epoch_starts": [[count, epoch] for count, epoch, *_
                                  in self._epoch_starts],
                 "per_worker": {
                     wid: {"batches": counters["batches"],
@@ -1859,25 +2392,40 @@ class ServiceBatchSource:
 
 
 class _BufferedStream:
-    """A stream whose first batch was already pulled by the reconnect probe."""
+    """A stream whose first event was already pulled by the reconnect
+    probe — hands it back first, then proxies, mirroring the tag
+    attributes the drain's reader thread snapshots per event."""
 
-    def __init__(self, stream, first_batch):
+    def __init__(self, stream, first_event):
         self._stream = stream
-        self._first = first_batch
+        self._first = first_event
         self.worker_id = stream.worker_id
         self.address = stream.address
         self.pieces = stream.pieces
         self.epoch = stream.epoch
         self.credits = stream.credits
-        self.last_bid = stream.last_bid  # bid of the buffered probe batch
+        # Tags of the buffered probe event.
+        self.last_bid = stream.last_bid
+        self.last_piece = stream.last_piece
+        self.last_ordinal = stream.last_ordinal
+
+    def next_event(self):
+        if self._first is not None:
+            event, self._first = self._first, None
+            return event
+        event = self._stream.next_event()
+        self.last_bid = self._stream.last_bid
+        self.last_piece = self._stream.last_piece
+        self.last_ordinal = self._stream.last_ordinal
+        return event
 
     def next_batch(self):
-        if self._first is not None:
-            batch, self._first = self._first, None
-            return batch
-        batch = self._stream.next_batch()
-        self.last_bid = self._stream.last_bid
-        return batch
+        while True:
+            kind, payload = self.next_event()
+            if kind == "batch":
+                return payload
+            if kind == "end":
+                return None
 
     def add_credit(self, n=1):
         self._stream.add_credit(n)
@@ -1887,7 +2435,8 @@ class _BufferedStream:
 
 
 class _EndedStream:
-    """A stream that already ended cleanly during the reconnect probe."""
+    """A stream that already ended cleanly during the reconnect probe (or
+    had nothing pending left to re-serve)."""
 
     def __init__(self, stream):
         self.worker_id = stream.worker_id
@@ -1896,6 +2445,11 @@ class _EndedStream:
         self.epoch = stream.epoch
         self.credits = stream.credits
         self.last_bid = None
+        self.last_piece = None
+        self.last_ordinal = None
+
+    def next_event(self):
+        return ("end", None)
 
     def next_batch(self):
         return None

@@ -72,6 +72,7 @@ from petastorm_tpu.reader_impl.framed_socket import (
     FramedServer,
     send_framed,
 )
+from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
     DISPATCHER_BACKLOG_PIECES,
@@ -226,18 +227,28 @@ class Dispatcher:
         crash; the default survives process crashes).
     :param max_frame_bytes: per-connection receive frame cap (control
         messages are tiny; the default module cap is data-plane-sized).
+    :param shuffle_seed: seed-tree deterministic shuffling
+        (:mod:`petastorm_tpu.service.seedtree`). Every client-epoch's
+        piece order derives from ``fold_in(fold_in(seed, epoch), piece)``
+        — a pure function of the seed, the epoch, and the piece identity,
+        so the order is invariant to worker count, steal history, join
+        timing, and kill/resume. ``None`` = no shuffling (ascending piece
+        order, equally deterministic). Static and dynamic modes; fcfs
+        ignores it (its queue is inherently racy).
     """
 
     def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1,
                  journal_dir=None, lease_timeout_s=DEFAULT_LEASE_TIMEOUT_S,
                  journal_compact_every=256, journal_fsync=False,
-                 max_frame_bytes=None):
+                 max_frame_bytes=None, shuffle_seed=None):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if num_epochs is not None and num_epochs <= 0:
             raise ValueError("num_epochs must be a positive integer or None")
         self.mode = mode
         self.num_epochs = num_epochs
+        self.shuffle_seed = (int(shuffle_seed)
+                             if shuffle_seed is not None else None)
         self.journal_dir = journal_dir
         # 0 and None both disable lease expiry (the CLI's documented
         # contract); a literal 0 would otherwise expire every lease the
@@ -247,6 +258,12 @@ class Dispatcher:
         self._lock = threading.Lock()
         self._workers = {}   # worker_id -> {address, num_pieces, alive}
         self._clients = {}   # client_id -> {epoch, client_index, num_clients}
+        # client_id -> {"epoch", "watermarks": {piece: next ordinal}} —
+        # delivery watermarks riding client heartbeats, journaled so a
+        # restarted dispatcher (and `status`) knows how far each piece
+        # got. Observability + recovery audit; the client's own copy is
+        # what re-grants actually use (it is never behind this one).
+        self._client_watermarks = {}
         self._num_pieces = None
         # fcfs shared queue: lazily built once the piece count is known.
         self._fcfs_queue = None
@@ -341,9 +358,15 @@ class Dispatcher:
         return {
             "mode": self.mode,
             "num_epochs": self.num_epochs,
+            "shuffle_seed": self.shuffle_seed,
             "num_pieces": self._num_pieces,
             "workers": {wid: dict(w) for wid, w in self._workers.items()},
             "clients": {cid: dict(c) for cid, c in self._clients.items()},
+            "client_watermarks": {
+                cid: {"epoch": entry["epoch"],
+                      "watermarks": {str(p): n for p, n
+                                     in entry["watermarks"].items()}}
+                for cid, entry in self._client_watermarks.items()},
             "fcfs_epoch": self._fcfs_epoch,
             "fcfs_queue": (list(self._fcfs_queue)
                            if self._fcfs_queue is not None else None),
@@ -403,7 +426,21 @@ class Dispatcher:
                 f"journal at {self.journal_dir!r} was written by a "
                 f"{state.get('mode')!r}-mode dispatcher; this one runs "
                 f"{self.mode!r} — refusing to mix split-plan semantics")
+        if state.get("shuffle_seed") != self.shuffle_seed:
+            raise ValueError(
+                f"journal at {self.journal_dir!r} was written under "
+                f"shuffle_seed={state.get('shuffle_seed')!r}; this "
+                f"dispatcher runs {self.shuffle_seed!r} — restarting with "
+                f"a different seed would silently change the piece order "
+                f"mid-run and break the determinism contract")
         self._num_pieces = state.get("num_pieces")
+        self._client_watermarks = {
+            cid: {"epoch": int(entry.get("epoch", 0)),
+                  "watermarks": {int(p): int(n) for p, n
+                                 in (entry.get("watermarks")
+                                     or {}).items()}}
+            for cid, entry in (state.get("client_watermarks")
+                               or {}).items()}
         self._workers = {wid: dict(w)
                          for wid, w in state.get("workers", {}).items()}
         self._clients = {cid: dict(c)
@@ -470,6 +507,13 @@ class Dispatcher:
             state = self._dyn.get(record["client_id"])
             if state is not None:
                 state["done"].update(int(p) for p in record["pieces"])
+        elif op == "watermarks":
+            self._client_watermarks[record["client_id"]] = {
+                "epoch": int(record.get("epoch", 0)),
+                "watermarks": {int(p): int(n) for p, n
+                               in (record.get("watermarks")
+                                   or {}).items()},
+            }
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
@@ -745,6 +789,36 @@ class Dispatcher:
         with self._lock:
             known = client_id in self._clients
             self._client_heartbeats[client_id] = time.monotonic()
+            if "watermarks" in header:
+                # Delivery watermarks ride the heartbeat into the live
+                # `status` view on every change, but they are JOURNALED
+                # only at piece granularity (epoch moved, or the set of
+                # mid-flight pieces changed): ordinals tick per batch, so
+                # journaling every change would put a WAL append (plus an
+                # fsync under --journal-fsync) on virtually every
+                # heartbeat under the global lock — the exact per-tick
+                # hot-path cost PR 7's dirty-flag work removed. The
+                # journaled view is informational (status after a
+                # restart); re-grant `starts` always come from the
+                # client's own watermarks, so coarseness costs nothing.
+                entry = {
+                    "epoch": int(header.get("epoch", 0)),
+                    "watermarks": {int(p): int(n) for p, n
+                                   in (header.get("watermarks")
+                                       or {}).items()},
+                }
+                prev = self._client_watermarks.get(client_id)
+                if prev != entry:
+                    self._client_watermarks[client_id] = entry
+                    if (prev is None
+                            or prev["epoch"] != entry["epoch"]
+                            or set(prev["watermarks"])
+                            != set(entry["watermarks"])):
+                        self._journal_locked({
+                            "op": "watermarks", "client_id": client_id,
+                            "epoch": entry["epoch"],
+                            "watermarks": {str(p): n for p, n
+                                           in entry["watermarks"].items()}})
             return {
                 "type": "ok",
                 "known": known,
@@ -764,6 +838,7 @@ class Dispatcher:
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
                 "num_pieces": self._num_pieces,
+                "shuffle_seed": self.shuffle_seed,
                 "fencing_epoch": self._fencing_epoch,
             }
 
@@ -792,8 +867,13 @@ class Dispatcher:
             alive = self._alive_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
-            client_pieces = list(
-                range(self._num_pieces))[client_index::num_clients]
+            # Seed-tree order BEFORE partitioning: the round-robin split
+            # then spreads consecutive pieces of the epoch's canonical
+            # order across workers, so an ordered client's reorder buffer
+            # stays shallow (the next piece is always on some live stream).
+            client_pieces = piece_order(
+                self.shuffle_seed, int(header.get("epoch", 0)),
+                list(range(self._num_pieces))[client_index::num_clients])
             worker_ids = sorted(alive)
             assignments = self._partition(client_pieces, worker_ids)
             self._clients[header["client_id"]] = {
@@ -935,8 +1015,9 @@ class Dispatcher:
             alive = self._alive_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
-            client_pieces = list(
-                range(self._num_pieces))[client_index::num_clients]
+            client_pieces = piece_order(
+                self.shuffle_seed, epoch,
+                list(range(self._num_pieces))[client_index::num_clients])
             worker_ids = sorted(alive)
             assignments = self._partition(client_pieces, worker_ids)
             self._generation += 1
@@ -1143,7 +1224,13 @@ class Dispatcher:
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
                 "num_pieces": self._num_pieces,
+                "shuffle_seed": self.shuffle_seed,
                 "fencing_epoch": self._fencing_epoch,
+                "client_watermarks": {
+                    cid: {"epoch": entry["epoch"],
+                          "watermarks": {str(p): n for p, n
+                                         in entry["watermarks"].items()}}
+                    for cid, entry in self._client_watermarks.items()},
                 "recovery": dict(self._recovery),
                 "journal": (self._journal.stats
                             if self._journal is not None else None),

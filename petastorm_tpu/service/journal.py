@@ -16,8 +16,12 @@ Crash-safety invariants:
 - Every record carries a monotonically increasing ``seq`` and the snapshot
   records the ``seq`` watermark it folded in, so a crash *between* the
   snapshot replace and the WAL truncation replays nothing twice.
-- A torn final line (crash mid-append) is detected by its failed JSON parse
-  and dropped; everything before it replays normally.
+- A torn final line (crash mid-append) is detected and truncated off,
+  whether it is missing its newline OR newline-terminated but unparseable
+  (buffered writes flush at page boundaries, not record boundaries, so a
+  crash can persist a mangled record complete with its "\n"); everything
+  before it replays normally. Mid-file corruption still refuses recovery —
+  that is damage, not a crash signature.
 
 The layout inside ``path`` is two files: ``snapshot.json`` and
 ``wal.jsonl``. :meth:`load` returns the snapshot state (or ``None``) plus
@@ -108,17 +112,41 @@ class Journal:
             with open(self._wal_path, "r+b") as f:
                 f.truncate(len(data) - len(torn))
         lines = complete.split(b"\n") if complete else []
+        parsed = []  # (line_index, record)
+        bad_final = None
         for i, line in enumerate(lines):
-            line = line.strip()
-            if not line:
+            stripped = line.strip()
+            if not stripped:
                 continue
             try:
-                record = json.loads(line.decode("utf-8"))
+                record = json.loads(stripped.decode("utf-8"))
+                if not isinstance(record, dict):
+                    raise ValueError("WAL record is not a JSON object")
             except ValueError:
+                if i == len(lines) - 1:
+                    # Garbage FINAL line: the other face of a crash
+                    # mid-append — the buffered write flushed a partial or
+                    # mangled record WITH its trailing newline (page-sized
+                    # flush boundaries don't respect record boundaries).
+                    # Same remedy as the torn tail: truncate it off and
+                    # restore the pre-append state.
+                    bad_final = (i, line)
+                    break
                 raise ValueError(
                     f"journal {self.path}: corrupt WAL record at line "
-                    f"{i + 1} (not the torn-tail case — refusing to "
-                    f"recover from ambiguous state)")
+                    f"{i + 1} (mid-file, not the crash-mid-append case — "
+                    f"refusing to recover from ambiguous state)")
+            parsed.append((i, record))
+        if bad_final is not None:
+            i, line = bad_final
+            keep = sum(len(ln) + 1 for ln in lines[:i])
+            logger.warning(
+                "journal %s: dropping unparseable final WAL line %d "
+                "(%d bytes — crash mid-append)", self.path, i + 1,
+                len(line))
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(keep)
+        for _, record in parsed:
             seq = int(record.get("seq", 0))
             if seq <= watermark:
                 continue  # already folded into the snapshot

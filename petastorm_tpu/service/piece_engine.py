@@ -164,6 +164,8 @@ class StreamingPieceEngine:
         self._queue = deque()        # (piece, generation) awaiting dispatch
         self._state = {}             # piece -> lifecycle state
         self._gen = {}               # piece -> ownership generation
+        self._start = {}             # piece -> first batch ordinal to emit
+        self._ordinal = {}           # piece -> next batch ordinal (cold path)
         self._rows = {}              # piece -> rows emitted
         self._collators = {}         # piece -> _PieceCollator (cold pieces)
         self._builders = {}          # piece -> cache fill builder (or None)
@@ -198,10 +200,19 @@ class StreamingPieceEngine:
 
     # -- queue edits (any thread) -----------------------------------------
 
-    def enqueue(self, piece, generation=0):
+    def enqueue(self, piece, generation=0, start=0):
         """Append a piece to the serve queue (initial plan or a mid-stream
         steal grant). Re-enqueueing a revoked piece re-arms it (an aborted
-        steal handing the piece back); active/done pieces are ignored."""
+        steal handing the piece back); active/done pieces are ignored.
+
+        ``start`` is the first batch ordinal to EMIT — the client's
+        watermark for the piece. The cold path still decodes the piece
+        from its beginning (a skip-scan: row groups have no intra-piece
+        index) and the cache fill still receives every batch (entries must
+        stay complete), but events below ``start`` are suppressed, so a
+        takeover/retry re-serve is idempotent instead of at-least-once.
+        The warm path seeks straight to the ``start``-th cached batch's
+        frames — no decode, no skipped bytes staged."""
         piece = int(piece)
         with self._lock:
             state = self._state.get(piece)
@@ -214,6 +225,7 @@ class StreamingPieceEngine:
                 return False
             self._state[piece] = _QUEUED
             self._gen[piece] = int(generation)
+            self._start[piece] = max(0, int(start))
             self._queue.append(piece)
         self._wake.set()
         return True
@@ -266,12 +278,14 @@ class StreamingPieceEngine:
     def next_event(self, timeout=0.1):
         """The next ready event, or ``None`` after ~``timeout`` idle.
 
-        Events: ``("batch", piece, generation, rows, fmt, frames,
-        decode_s)`` — frames ready for scatter-gather send — and
-        ``("piece_done", piece, generation, rows)`` after a piece's last
-        batch. Decode/ventilation errors raise. Pulls as many reader
-        outputs as it takes inside the deadline (a row reader needs
-        ``batch_size`` of them per batch)."""
+        Events: ``("batch", piece, generation, ordinal, rows, fmt, frames,
+        decode_s)`` — frames ready for scatter-gather send, ``ordinal``
+        the batch's absolute index within its piece (deterministic for a
+        fixed batch size, which is what makes watermark re-serves line up
+        across workers and restarts) — and ``("piece_done", piece,
+        generation, rows)`` after a piece's last batch. Decode/ventilation
+        errors raise. Pulls as many reader outputs as it takes inside the
+        deadline (a row reader needs ``batch_size`` of them per batch)."""
         deadline = time.perf_counter() + timeout
         while True:
             self._dispatch_queued()
@@ -354,6 +368,7 @@ class StreamingPieceEngine:
                     continue  # revoked between pop and dispatch
                 self._state[piece] = _DECODING
                 self._inflight.add(piece)
+                self._ordinal[piece] = 0  # fresh decode restarts ordinals
                 self._collators[piece] = _PieceCollator(
                     self._batch_size, reader.batched_output,
                     getattr(reader, "ngram", None))
@@ -364,11 +379,17 @@ class StreamingPieceEngine:
 
     def _stage_cached(self, piece, gen, entry):
         """Materialize a warm piece's pre-serialized batches into the ready
-        set. Still revocable until its first batch is handed out."""
+        set. Still revocable until its first batch is handed out. A
+        nonzero ``start`` watermark seeks past the first ``start`` cached
+        batches — a frame-offset walk over the entry header, no payload
+        bytes touched for the skipped prefix."""
+        start = self._start.get(piece, 0)
         events, rows = [], 0
-        for cached in entry.batches():
-            events.append(("batch", piece, gen, cached.rows, cached.fmt,
-                           cached.frames, 0.0))
+        for ordinal, cached in enumerate(entry.batches()):
+            if ordinal < start:
+                continue
+            events.append(("batch", piece, gen, ordinal, cached.rows,
+                           cached.fmt, cached.frames, 0.0))
             rows += cached.rows
         events.append(("piece_done", piece, gen, rows))
         with self._lock:
@@ -395,19 +416,34 @@ class StreamingPieceEngine:
             self._emit_batch(piece, gen, batch, builder)
 
     def _emit_batch(self, piece, gen, batch, builder):
-        if builder is not None:
+        with self._lock:
+            ordinal = self._ordinal.get(piece, 0)
+            self._ordinal[piece] = ordinal + 1
+            start = self._start.get(piece, 0)
+            revoked = self._state.get(piece) == _REVOKED
+        # The cache fill gets EVERY batch (a watermark must never publish
+        # a truncated entry); only the emission below honors `start`.
+        if builder is not None and not revoked:
             rows, fmt, frames = builder.add_batch(batch)
+            decode_s, self._pull_s = self._pull_s, 0.0
+            if ordinal < start:
+                return  # skip-scan: below the re-serve watermark, not sent
         else:
+            decode_s, self._pull_s = self._pull_s, 0.0
+            if revoked or ordinal < start:
+                # Skip-scan (below the re-serve watermark) or a piece
+                # revoked mid-decode: either way the batch will never be
+                # sent — drop it before paying the serialization.
+                return
             fmt, frames = encode_payload(batch)
             rows = len(next(iter(batch.values()))) if batch else 0
-        decode_s, self._pull_s = self._pull_s, 0.0
         with self._lock:
             if self._state.get(piece) == _REVOKED:
                 return
             self._rows[piece] = self._rows.get(piece, 0) + rows
             self._rows_emitted += rows
             self._out.append(
-                ("batch", piece, gen, rows, fmt, frames, decode_s))
+                ("batch", piece, gen, ordinal, rows, fmt, frames, decode_s))
 
     def _on_item_done(self, item):
         """Pool hook (fires on the stream thread inside the results pull):
@@ -446,15 +482,21 @@ class StreamingPieceEngine:
 
     @property
     def diagnostics(self):
+        # Merged with the owned reader's diagnostics (when one was built):
+        # remote snapshots keep surfacing the reader-layer counters
+        # (rowgroups_total, pool depths) the engine would otherwise hide.
+        reader = self._reader
+        out = dict(reader.diagnostics) if reader is not None else {}
         with self._lock:
-            return {
+            out.update({
                 "engine_pieces_queued": len(self._queue),
                 "engine_pieces_in_flight": len(self._inflight),
                 "engine_pieces_served": self._served_pieces,
                 "engine_pieces_revoked": self._revoked_pieces,
                 "engine_rows_emitted": self._rows_emitted,
                 "engine_finished": self._finished,
-            }
+            })
+        return out
 
     def queued_pieces(self):
         with self._lock:

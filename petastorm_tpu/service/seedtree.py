@@ -1,0 +1,69 @@
+"""Seed-tree deterministic shuffling for the data service.
+
+The reproducibility contract (ROADMAP: end-to-end deterministic pipelines;
+PAPERS.md 2604.21275) demands a shuffle order that is a pure function of
+``(seed, epoch, piece identity)`` and of NOTHING else — not the worker
+count, not steal history, not which worker joined when, not whether the run
+was killed and resumed. The classic ``rng.shuffle(pieces)`` fails that the
+moment the piece list is sharded differently (a permutation of N elements
+says nothing about a permutation of a subset), so the service derives order
+the way ``jax.random.fold_in`` derives keys: every piece gets its own key by
+folding the piece identity into an ``(seed, epoch)`` node of a seed tree,
+and the epoch's order is simply the pieces sorted by their keys. Any subset
+of pieces — a client shard, a worker deque, the survivors of a takeover —
+sorts into the same RELATIVE order, which is what makes the delivered
+stream byte-identical across fleet shapes and failures.
+
+Pure stdlib (blake2b), no RNG state, no global seeding — every function is
+referentially transparent, so two processes (dispatcher and client) agree
+without coordination.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_KEY_BYTES = 8
+_KEY_MASK = (1 << (8 * _KEY_BYTES)) - 1
+
+
+def fold_in(key, data):
+    """Derive a child key from ``key`` and ``data`` — the seed-tree split.
+
+    Deterministic across processes and Python versions (no ``hash()``):
+    the child is the first 8 bytes of ``blake2b(key_bytes || repr(data))``.
+    ``data`` may be any object with a stable ``repr`` (ints, strings,
+    tuples of those). ``key`` is reduced mod 2**64 first — the function
+    must be total: a negative or oversized ``--shuffle-seed`` reaching a
+    request handler must derive an order, not crash the control plane.
+    """
+    h = hashlib.blake2b(digest_size=_KEY_BYTES)
+    h.update((int(key) & _KEY_MASK).to_bytes(_KEY_BYTES, "big",
+                                             signed=False))
+    h.update(repr(data).encode("utf-8"))
+    return int.from_bytes(h.digest(), "big")
+
+
+def piece_key(seed, epoch, piece):
+    """The sort key of one piece in one epoch: ``fold_in(fold_in(seed,
+    ("epoch", epoch)), ("piece", piece))`` — a per-piece leaf of the seed
+    tree. Ties (astronomically unlikely) break by the piece identity
+    itself, see :func:`piece_order`."""
+    return fold_in(fold_in(int(seed), ("epoch", int(epoch))),
+                   ("piece", int(piece)))
+
+
+def piece_order(seed, epoch, pieces):
+    """Deterministic epoch order of ``pieces``.
+
+    ``seed=None`` means shuffling is off: the natural ascending order
+    (itself deterministic). Otherwise pieces sort by their seed-tree keys.
+    Subset-stable by construction: ``piece_order(s, e, subset)`` is the
+    restriction of ``piece_order(s, e, universe)`` to ``subset`` — the
+    property that makes the order invariant to sharding, steals, and
+    takeovers.
+    """
+    pieces = [int(p) for p in pieces]
+    if seed is None:
+        return sorted(pieces)
+    return sorted(pieces, key=lambda p: (piece_key(seed, epoch, p), p))

@@ -416,14 +416,30 @@ class BatchWorker:
         across pieces).
 
         Dynamic mode (``dynamic: true`` in the request): pieces arrive as
-        ``[piece, generation]`` pairs and the same engine serves them from
-        a queue the client edits mid-stream with ``extend``/``revoke``/
-        ``finish_pieces`` control frames — a work-stealing rebalance costs
-        a queue edit instead of a reader construction
-        (``docs/guides/service.md#sharding-modes``)."""
+        ``[piece, generation]`` (or ``[piece, generation, start]``) tuples
+        and the same engine serves them from a queue the client edits
+        mid-stream with ``extend``/``revoke``/``finish_pieces`` control
+        frames — a work-stealing rebalance costs a queue edit instead of a
+        reader construction (``docs/guides/service.md#sharding-modes``).
+
+        Tagged static mode (``tagged: true``): the engine serves the named
+        pieces piece-aligned, every ``batch`` frame carrying its piece and
+        absolute batch ``ordinal``, each finished piece announced with a
+        ``piece_done`` frame; a ``starts`` map (piece → first ordinal to
+        send, the client's delivery watermark) makes re-serves idempotent
+        — this is the exactly-once static path
+        (``docs/guides/service.md#delivery-semantics``). Pool types
+        without per-item completion attribution fall back to the legacy
+        untagged serving; the client detects the untagged batches and
+        keeps at-least-once bookkeeping for that worker."""
         dynamic = bool(header.get("dynamic"))
+        tagged = bool(header.get("tagged"))
+        starts = {int(p): int(s)
+                  for p, s in (header.get("starts") or {}).items()}
         if dynamic:
-            pieces = [(int(p), int(g)) for p, g in header["pieces"]]
+            pieces = [(int(t[0]), int(t[1]),
+                       int(t[2]) if len(t) > 2 else 0)
+                      for t in header["pieces"]]
         else:
             pieces = [int(p) for p in header["pieces"]]
         credits = header.get("credits")
@@ -446,6 +462,10 @@ class BatchWorker:
                 rows_sent = self._stream_dynamic(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"))
+            elif tagged and self._engine_supported():
+                rows_sent = self._stream_pieces_tagged(
+                    sock, conn_reader, state, pieces, flow, credits,
+                    stream_key, starts, epoch=header.get("epoch"))
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
@@ -623,13 +643,46 @@ class BatchWorker:
                 (lambda hit: self._note_cache_lookup(epoch, hit))
                 if cache is not None else None))
 
+    def _note_engine_decode(self, collector, decode_s, bid):
+        """Engine events carry decode DURATION, not absolute span times
+        (the pull happened inside ``next_event``); anchor the trace span
+        to end at the dequeue so the per-bid chain stays completion-
+        ordered (decode ends before this batch's send starts)."""
+        if not decode_s:
+            return
+        self._m_decode.observe(decode_s)
+        if collector.enabled:
+            t_now = time.perf_counter()
+            collector.record_span("worker.decode", t_now - decode_s, t_now,
+                                  bid=bid)
+
     def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None):
         """Cache-armed serving through the streaming engine: warm pieces
         scatter-gather straight from cache memory, cold pieces decode
         through the stream's ONE shared pipeline and fill the cache — the
         PR 5 per-piece reader spinup is gone. Batch boundaries stay
-        piece-aligned, exactly like the per-piece cached path."""
+        piece-aligned, exactly like the per-piece cached path. Same serve
+        loop as :meth:`_stream_pieces_tagged`, minus the tags (a legacy
+        plain stream carries no piece/ordinal headers and no
+        ``piece_done`` frames)."""
+        return self._stream_pieces_tagged(sock, conn_reader, state, pieces,
+                                          flow, credits, stream_key, {},
+                                          epoch=epoch, tagged=False)
+
+    def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
+                              credits, stream_key, starts, epoch=None,
+                              tagged=True):
+        """Exactly-once static serving: piece-aligned batches through the
+        streaming engine, every ``batch`` frame tagged with its piece and
+        absolute ``ordinal``, every finished piece announced with a
+        ``piece_done`` frame — the static analogue of the dynamic stream's
+        event vocabulary, minus the queue edits. ``starts`` holds the
+        client's per-piece delivery watermarks: the engine skip-scans (or
+        frame-seeks, warm) past already-delivered batches, so a takeover
+        or reconnect re-serve duplicates nothing. ``tagged=False`` serves
+        the same loop as the legacy untagged engine stream (no tags, no
+        markers)."""
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch)
         with self._lock:
@@ -638,7 +691,7 @@ class BatchWorker:
             # which stops whatever reader it lazily built.
             state["reader"] = engine
         for piece in pieces:
-            engine.enqueue(piece)
+            engine.enqueue(piece, 0, start=starts.get(int(piece), 0))
         engine.finish()
         rows_sent = 0
         while True:
@@ -649,17 +702,22 @@ class BatchWorker:
                 if engine.finished:
                     return rows_sent
                 continue
-            if event[0] != "batch":
-                continue  # piece_done: plain streams carry no such frame
-            _, piece, gen, rows, fmt, frames, decode_s = event
-            if decode_s:
-                self._m_decode.observe(decode_s)
-            bid = f"{self.worker_id}:{stream_key}:{flow['batches_sent']}"
-            if not self._send_stream_batch(sock, conn_reader, flow,
-                                           credits, bid, rows, fmt,
-                                           frames, collector):
-                return None
-            rows_sent += rows
+            if event[0] == "batch":
+                _, piece, _gen, ordinal, rows, fmt, frames, decode_s = event
+                bid = (f"{self.worker_id}:{stream_key}:"
+                       f"{flow['batches_sent']}")
+                self._note_engine_decode(collector, decode_s, bid)
+                if not self._send_stream_batch(
+                        sock, conn_reader, flow, credits, bid, rows, fmt,
+                        frames, collector,
+                        extra_header=({"piece": piece, "ordinal": ordinal}
+                                      if tagged else None)):
+                    return None
+                rows_sent += rows
+            elif tagged:  # piece_done: plain streams carry no such frame
+                _, piece, _gen, rows = event
+                send_framed(sock, {"type": "piece_done", "piece": piece,
+                                   "rows": rows})
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
                         credits, stream_key, epoch=None):
@@ -685,14 +743,16 @@ class BatchWorker:
             # (diagnostics / stop / join): the teardown block stops it,
             # which stops whatever reader it lazily built.
             state["reader"] = engine
-        for piece, gen in pieces:
-            engine.enqueue(piece, gen)
+        for piece, gen, start in pieces:
+            engine.enqueue(piece, gen, start=start)
 
         def on_frame(msg):
             kind = msg.get("type")
             if kind == "extend":
-                for piece, gen in msg.get("pieces", []):
-                    engine.enqueue(int(piece), int(gen))
+                for entry in msg.get("pieces", []):
+                    engine.enqueue(int(entry[0]), int(entry[1]),
+                                   start=(int(entry[2])
+                                          if len(entry) > 2 else 0))
             elif kind == "revoke":
                 removed = engine.revoke(
                     int(p) for p in msg.get("pieces", []))
@@ -717,15 +777,15 @@ class BatchWorker:
                     return rows_sent
                 continue
             if event[0] == "batch":
-                _, piece, gen, rows, fmt, frames, decode_s = event
-                if decode_s:
-                    self._m_decode.observe(decode_s)
+                _, piece, gen, ordinal, rows, fmt, frames, decode_s = event
                 bid = (f"{self.worker_id}:{stream_key}:"
                        f"{flow['batches_sent']}")
+                self._note_engine_decode(collector, decode_s, bid)
                 if not self._send_stream_batch(
                         sock, conn_reader, flow, credits, bid, rows, fmt,
                         frames, collector,
-                        extra_header={"piece": piece, "generation": gen},
+                        extra_header={"piece": piece, "generation": gen,
+                                      "ordinal": ordinal},
                         on_frame=on_frame):
                     return None
                 rows_sent += rows

@@ -136,6 +136,23 @@ CLIENT_RECOVERY_EVENTS = REGISTRY.counter(
     "streams_retired, takeovers, stale_fencing_retries, "
     "heartbeat_failures)",
     labels=("event",))
+CLIENT_DEDUP_DROPPED = REGISTRY.counter(
+    "petastorm_service_client_dedup_dropped_total",
+    "Batches the client received but refused to yield because delivery "
+    "bookkeeping proved them duplicates, by path: steal = a stale "
+    "ownership generation (a superseded dynamic-mode grant), takeover = a "
+    "sub-watermark ordinal (a re-served piece repeating batches already "
+    "handed to the consumer). Zero on healthy exactly-once paths — the "
+    "worker-side watermark skip means re-serves start past what was "
+    "delivered; a nonzero takeover count is the safety net firing",
+    labels=("path",))
+CLIENT_WATERMARK_LAG = REGISTRY.gauge(
+    "petastorm_service_client_watermark_lag",
+    "Batches received from workers but not yet yielded past the "
+    "deterministic delivery cursor (the ordered-mode reorder buffer depth; "
+    "0 when ordered delivery is off). Persistent growth = the next piece "
+    "in the seed-tree order is stuck behind a slow or recovering worker "
+    "while its peers run ahead")
 
 # -- JAX loader (jax_utils/loader.py) ----------------------------------------
 
@@ -202,6 +219,13 @@ CACHE_SERVE_SECONDS = REGISTRY.histogram(
     "petastorm_cache_serve_seconds",
     "Per-hit time to fetch a decoded-batch cache entry (memory hits are "
     "~free; disk hits pay one contiguous file read)")
+CACHE_CORRUPT = REGISTRY.counter(
+    "petastorm_cache_corrupt_entries_total",
+    "Disk-tier entry files that failed validation on load (bad magic, "
+    "torn length, or checksum mismatch from a truncated/bit-flipped "
+    "file). Each one is deleted and treated as a miss — the worker "
+    "degrades to a fresh decode, never serves corrupt bytes, never "
+    "errors the stream")
 
 # -- reader / worker pools / ventilator --------------------------------------
 

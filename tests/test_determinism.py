@@ -1,0 +1,304 @@
+"""Deterministic, exactly-once delivery: seed-tree order, ordered-mode
+byte-identity, v2 watermark resume, and takeover dedup counters.
+
+The determinism contract (docs/guides/service.md#deterministic-order): the
+delivered stream is a pure function of ``(seed, epoch, dataset)`` —
+invariant to worker count, steal/failure history, and kill/resume. These
+are the fast tier-1 checks; the slow chaos-matrix digests live in
+``test_service_recovery.py``.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.service import (
+    BatchWorker,
+    Dispatcher,
+    ServiceBatchSource,
+)
+from petastorm_tpu.service.chaos import StreamDigest
+from petastorm_tpu.service.seedtree import fold_in, piece_key, piece_order
+
+pytestmark = pytest.mark.service
+
+
+# ---------------------------------------------------------------------------
+# seed tree (pure functions)
+# ---------------------------------------------------------------------------
+
+def test_fold_in_deterministic_and_collision_free_on_inputs():
+    assert fold_in(7, ("epoch", 0)) == fold_in(7, ("epoch", 0))
+    assert fold_in(7, ("epoch", 0)) != fold_in(7, ("epoch", 1))
+    assert fold_in(7, ("epoch", 0)) != fold_in(8, ("epoch", 0))
+    # Namespacing matters: an epoch node and a piece node of the same
+    # integer must not alias.
+    assert fold_in(7, ("epoch", 3)) != fold_in(7, ("piece", 3))
+
+
+def test_seed_tree_order_is_pinned_across_versions():
+    """The exact permutation is part of the on-disk/resume contract: a
+    checkpoint taken by one build must replay the same order in the next.
+    Pin golden values so an accidental change to the derivation (digest
+    size, byte order, repr scheme) fails loudly instead of silently
+    re-shuffling every resumed run."""
+    assert fold_in(7, ("epoch", 0)) == 7973815963285622585
+    assert piece_order(7, 0, range(8)) == [2, 4, 7, 1, 6, 3, 5, 0]
+    assert piece_order(7, 1, range(8)) == [7, 6, 0, 3, 4, 1, 2, 5]
+    assert piece_order(8, 0, range(8)) == [7, 0, 5, 6, 4, 3, 1, 2]
+
+
+def test_piece_order_none_seed_is_ascending():
+    assert piece_order(None, 3, [5, 1, 4]) == [1, 4, 5]
+
+
+def test_fold_in_is_total_over_any_int_seed():
+    """A negative or oversized ``--shuffle-seed`` reaches the request
+    handlers unvalidated — it must derive an order, not crash the
+    control plane (keys reduce mod 2**64)."""
+    assert piece_order(-1, 0, range(4)) == piece_order(-1, 0, range(4))
+    assert piece_order(2 ** 80 + 3, 0, range(4)) == piece_order(
+        (2 ** 80 + 3) % 2 ** 64, 0, range(4))
+    assert sorted(piece_order(-7, 1, range(8))) == list(range(8))
+
+
+def test_piece_order_subset_stable():
+    """The load-bearing property: ANY subset (a client shard, one worker's
+    deque, a takeover's survivors) sorts into the same relative order as
+    its restriction of the universe order — piece keys are independent, so
+    sharding cannot perturb the stream."""
+    universe = list(range(50))
+    for seed, epoch in ((7, 0), (7, 5), (123456789, 2)):
+        full = piece_order(seed, epoch, universe)
+        for subset in (universe[::2], universe[10:20], [41, 3, 17, 29, 8]):
+            expect = [p for p in full if p in set(subset)]
+            assert piece_order(seed, epoch, subset) == expect
+
+
+def test_piece_key_epoch_reshuffles():
+    """Distinct epochs draw distinct key sets — epoch 2 is a fresh
+    shuffle, not a replay of epoch 1."""
+    keys0 = [piece_key(7, 0, p) for p in range(16)]
+    keys1 = [piece_key(7, 1, p) for p in range(16)]
+    assert keys0 != keys1
+    assert len(set(keys0)) == 16  # no collisions on a small universe
+
+
+# ---------------------------------------------------------------------------
+# StreamDigest (the byte-identity certificate)
+# ---------------------------------------------------------------------------
+
+def _batch(seed):
+    rng = np.random.RandomState(seed)
+    return {"id": np.arange(4) + seed,
+            "x": rng.rand(4, 3).astype(np.float32),
+            "s": np.array([b"a", b"bb", "ccc", 4], dtype=object)}
+
+
+def test_stream_digest_equal_for_equal_streams():
+    a, b = StreamDigest(), StreamDigest()
+    for seed in (1, 2, 3):
+        a.update(_batch(seed))
+        b.update(_batch(seed))
+    assert a.hexdigest() == b.hexdigest()
+    assert a.batches == 3
+
+
+def test_stream_digest_is_order_sensitive():
+    a, b = StreamDigest(), StreamDigest()
+    a.update(_batch(1)).update(_batch(2))
+    b.update(_batch(2)).update(_batch(1))
+    assert a.hexdigest() != b.hexdigest()
+
+
+def test_stream_digest_sees_a_single_flipped_bit():
+    tampered = _batch(1)
+    shape = tampered["x"].shape
+    raw = tampered["x"].view(np.uint8).ravel().copy()
+    raw[5] ^= 0x01
+    tampered["x"] = raw.view(np.float32).reshape(shape)
+    assert (StreamDigest().update(_batch(1)).hexdigest()
+            != StreamDigest().update(tampered).hexdigest())
+
+
+def test_stream_digest_sees_ragged_boundary_shifts():
+    """Object-dtype elements are length-prefixed: the same bytes split
+    differently across elements must NOT collide."""
+    a = {"s": np.array([b"ab", b"c"], dtype=object)}
+    b = {"s": np.array([b"a", b"bc"], dtype=object)}
+    assert (StreamDigest().update(a).hexdigest()
+            != StreamDigest().update(b).hexdigest())
+
+
+def test_stream_digest_sees_dropped_and_duplicated_batches():
+    base = StreamDigest().update(_batch(1)).update(_batch(2))
+    dropped = StreamDigest().update(_batch(1))
+    duplicated = (StreamDigest().update(_batch(1)).update(_batch(2))
+                  .update(_batch(2)))
+    assert len({base.hexdigest(), dropped.hexdigest(),
+                duplicated.hexdigest()}) == 3
+
+
+# ---------------------------------------------------------------------------
+# ordered delivery: byte-identity across fleet shapes (loopback)
+# ---------------------------------------------------------------------------
+
+def _fleet(url, n_workers, shuffle_seed=7, num_epochs=1, batch_delay_s=0.0):
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=num_epochs,
+                            shuffle_seed=shuffle_seed).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=7, reader_factory="row", worker_id=f"w{i}",
+                    batch_delay_s=batch_delay_s,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(n_workers)]
+    return dispatcher, workers
+
+
+def _stream_ids(source):
+    """Per-batch id lists, in yield order — the sequence the trainer saw."""
+    return [[int(i) for i in batch["id"]] for batch in source()]
+
+
+def test_ordered_delivery_byte_identical_across_fleet_shapes(
+        petastorm_dataset):
+    """One worker vs two workers, same seed, ordered=True: the yielded
+    sequence (not just the multiset) is identical — the contract that
+    lets a training run resize its input fleet without changing what the
+    model trains on."""
+    sequences, digests = [], []
+    for n_workers in (1, 2):
+        dispatcher, workers = _fleet(petastorm_dataset.url, n_workers)
+        try:
+            source = ServiceBatchSource(dispatcher.address, ordered=True)
+            digest = StreamDigest()
+            seq = []
+            for batch in source():
+                seq.append([int(i) for i in batch["id"]])
+                digest.update(batch)
+            sequences.append(seq)
+            digests.append(digest.hexdigest())
+        finally:
+            for w in workers:
+                w.stop()
+            dispatcher.stop()
+    assert sequences[0] == sequences[1]
+    assert digests[0] == digests[1]
+    # And the order is genuinely shuffled, not the ascending fallback.
+    flat = [i for ids in sequences[0] for i in ids]
+    assert flat != sorted(flat)
+    assert sorted(flat) == sorted(int(r["id"]) for r in
+                                  petastorm_dataset.rows)
+
+
+def test_ordered_delivery_reshuffles_per_epoch(petastorm_dataset):
+    """Each epoch folds its number into the seed tree: two epochs of one
+    run yield different orders, and a second run repeats both exactly."""
+    runs = []
+    for _ in range(2):
+        dispatcher, workers = _fleet(petastorm_dataset.url, 2, num_epochs=2)
+        try:
+            source = ServiceBatchSource(dispatcher.address, ordered=True)
+            runs.append(_stream_ids(source))
+        finally:
+            for w in workers:
+                w.stop()
+            dispatcher.stop()
+    assert runs[0] == runs[1]
+    n_rows = len(petastorm_dataset.rows)
+    flat = [i for ids in runs[0] for i in ids]
+    epoch1, epoch2 = flat[:n_rows], flat[n_rows:]
+    assert sorted(epoch1) == sorted(epoch2)
+    assert epoch1 != epoch2  # epoch 2 is a fresh shuffle
+
+
+# ---------------------------------------------------------------------------
+# v2 state_dict: mid-piece watermark resume, exactly-once and bit-exact
+# ---------------------------------------------------------------------------
+
+def test_v2_resume_is_bit_identical_from_snapshot_batch(petastorm_dataset):
+    """Snapshot mid-piece, resume: the resumed stream must equal the
+    uninterrupted run's tail EXACTLY — nothing re-delivered (the pre-v2
+    at-least-once shape re-streamed mid-pieces whole), nothing lost."""
+    dispatcher, workers = _fleet(petastorm_dataset.url, 2)
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True)
+        full = _stream_ids(source)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+    # Snapshot after 2 batches: with batch_size=7 over 10-row pieces, the
+    # first piece is mid-delivery — the watermark path, not the
+    # completed-piece path, carries the resume.
+    cut = 2
+    dispatcher, workers = _fleet(petastorm_dataset.url, 2)
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True)
+        iterator = source()
+        first = [[int(i) for i in next(iterator)["id"]] for _ in range(cut)]
+        state = source.state_dict()
+        iterator.close()
+        assert state["version"] == 2
+        assert state["watermarks"], "snapshot landed on a piece boundary"
+
+        resumed = ServiceBatchSource(dispatcher.address, ordered=True,
+                                     resume_state=state)
+        rest = _stream_ids(resumed)
+        assert first == full[:cut]
+        assert rest == full[cut:]
+        assert resumed.diagnostics["recovery"]["duplicates_dropped"] == 0
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# takeover recovery counters (ISSUE satellite): exactly-once, not
+# at-least-once, when a worker dies mid-epoch
+# ---------------------------------------------------------------------------
+
+def test_takeover_is_exactly_once_and_reports_zero_duplicates(tmp_path):
+    """Kill one of two workers mid-epoch: survivors re-serve its pieces
+    at their watermarks, so the epoch completes with every sample
+    delivered exactly once and ``duplicates_dropped == 0`` (the safety
+    net never had to fire), with the dedup/watermark telemetry families
+    live."""
+    from petastorm_tpu.telemetry.registry import REGISTRY
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_scalar_dataset,
+    )
+
+    url = f"file://{tmp_path}/ds"
+    rows = create_test_scalar_dataset(url, rows_count=60,
+                                      rows_per_row_group=5)  # 12 pieces
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    workers = [
+        BatchWorker(url, dispatcher_address=dispatcher.address,
+                    batch_size=4, reader_factory="batch", worker_id=f"w{i}",
+                    batch_delay_s=0.02,
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        source = ServiceBatchSource(dispatcher.address, max_retries=2,
+                                    backoff_base=0.02, backoff_max=0.1)
+        got, killed = [], False
+        for batch in source():
+            got.extend(int(i) for i in batch["id"])
+            if not killed and len(got) >= 8:
+                workers[1].kill()
+                killed = True
+        assert killed, "dataset too small to kill mid-epoch"
+        expected = sorted(int(r["id"]) for r in rows)
+        assert sorted(got) == expected  # exactly once: no loss AND no dup
+        recovery = source.diagnostics["recovery"]
+        assert recovery["takeovers"] >= 1
+        assert recovery["duplicates_dropped"] == 0
+        families = REGISTRY.families()
+        assert "petastorm_service_client_dedup_dropped_total" in families
+        assert "petastorm_service_client_watermark_lag" in families
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()

@@ -87,6 +87,48 @@ def test_no_wall_clock_duration_math():
         f"{offenders}")
 
 
+#: Unseeded RNG calls silently break run-to-run reproducibility — the
+#: determinism contract (docs/guides/service.md#deterministic-order) says
+#: every random draw in the data path derives from an explicit seed
+#: (seed-tree fold_in, random.Random(seed), jax.random keys). Module-level
+#: `random.x()` / `np.random.x()` draw from hidden global state, so they
+#: are banned in the directories that feed training. `random.Random(...)`
+#: and `jax.random.*` (explicit-key API) stay allowed; seeding discipline
+#: for those is the constructor caller's contract.
+_UNSEEDED_RNG_RE = re.compile(
+    r"(?<![.\w])random\.(?!Random\b|SystemRandom\b)\w+\s*\("
+    r"|\b(?:np|numpy)\.random\.(?!Generator\b|default_rng\b)\w+\s*\(")
+
+#: Directories whose code feeds the training stream: nondeterminism here
+#: changes what the model trains on.
+_DETERMINISM_DIRS = ("petastorm_tpu/service", "petastorm_tpu/reader",
+                     "petastorm_tpu/reader_impl", "petastorm_tpu/jax_utils")
+
+#: Explicitly-documented nondeterministic spots (file → why). Empty today;
+#: an entry here must cite where the nondeterminism is documented.
+_UNSEEDED_RNG_ALLOWED = {}
+
+
+def test_no_unseeded_rng_in_data_path():
+    """Determinism lint: no unseeded ``random.``/``np.random.`` calls in
+    the service/reader/jax_utils trees — a future PR cannot silently
+    reintroduce run-to-run nondeterminism into the delivered stream."""
+    offenders = []
+    for root in _DETERMINISM_DIRS:
+        for py in sorted((REPO / root).rglob("*.py")):
+            rel = str(py.relative_to(REPO))
+            if rel in _UNSEEDED_RNG_ALLOWED:
+                continue
+            for lineno, line in enumerate(py.read_text().splitlines(), 1):
+                code = line.split("#", 1)[0]
+                if _UNSEEDED_RNG_RE.search(code):
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "unseeded RNG calls in the data path (derive from an explicit "
+        "seed — seedtree.fold_in, random.Random(seed), jax.random keys — "
+        "or add a documented allowlist entry): " + "; ".join(offenders))
+
+
 def test_documented_apis_exist():
     """Spot-check that names the docs teach are importable."""
     from petastorm_tpu import (  # noqa: F401

@@ -157,7 +157,7 @@ def _decode_rows(batches):
     from petastorm_tpu.reader_impl.framed_socket import decode_payload
 
     ids = []
-    for _kind, _piece, _gen, _rows, fmt, frames, _s in batches:
+    for _kind, _piece, _gen, _ordinal, _rows, fmt, frames, _s in batches:
         payload = decode_payload(fmt, [bytes(f) for f in frames])
         ids.extend(int(i) for i in payload["id"])
     return ids
@@ -369,9 +369,9 @@ def test_dynamic_stream_extend_before_connect_is_queued_not_dropped(
     assert sent == []  # queued, not written onto a nonexistent socket
     stream._ensure_conn()
     assert [m["type"] for m in sent] == ["stream", "extend"]
-    assert sent[1]["pieces"] == [[7, 3]]
+    assert sent[1]["pieces"] == [[7, 3, 0]]
     stream.extend([(8, 4)])  # post-handshake edits go straight through
-    assert sent[-1]["pieces"] == [[8, 4]]
+    assert sent[-1]["pieces"] == [[8, 4, 0]]
 
 
 def test_dynamic_mid_epoch_worker_join_receives_steals(
@@ -426,8 +426,9 @@ def test_dynamic_multi_epoch_delivers_every_epoch(scalar_dataset_12pieces):
 def test_dynamic_steal_mid_epoch_preserves_state_dict_resume(
         scalar_dataset_12pieces):
     """Tier-1 ISSUE satellite: snapshot mid-epoch AFTER steals have moved
-    pieces, resume from it — completed pieces are never re-served and the
-    union covers the dataset exactly at piece granularity."""
+    pieces, resume from it — completed pieces are never re-served, v2
+    watermarks resume mid-piece pieces at their next batch (not from the
+    piece start), so first + resumed cover the dataset EXACTLY once."""
     url, rows = scalar_dataset_12pieces
     dispatcher, workers = _dynamic_fleet(url, skew_worker_delay_s=0.15)
     try:
@@ -444,6 +445,7 @@ def test_dynamic_steal_mid_epoch_preserves_state_dict_resume(
         else:
             pytest.fail("stream ended before a steal + snapshot landed")
         iterator.close()
+        assert state["version"] == 2
         completed = set(state["completed_pieces"])
         # The snapshot's contract: every completed piece was fully
         # delivered in part one (a steal moves WHO serves a piece, never
@@ -456,11 +458,11 @@ def test_dynamic_steal_mid_epoch_preserves_state_dict_resume(
         resumed = ServiceBatchSource(dispatcher.address, resume_state=state,
                                      dynamic_sync_interval_s=0.1)
         second = [int(i) for batch in resumed() for i in batch["id"]]
-        # Completed pieces are skipped; incomplete ones re-stream whole.
-        expected = sorted(i for p in range(12) if p not in completed
-                          for i in range(5 * p, 5 * p + 5))
-        assert sorted(second) == expected
-        assert sorted(set(first) | set(second)) == list(range(rows))
+        # Exactly-once resume: the two halves tile the dataset with zero
+        # duplicates — mid-piece pieces continue at their watermark
+        # instead of re-streaming whole (the pre-v2 at-least-once shape).
+        assert sorted(first + second) == list(range(rows))
+        assert resumed.diagnostics["recovery"]["duplicates_dropped"] == 0
     finally:
         _stop_fleet(dispatcher, workers)
 
