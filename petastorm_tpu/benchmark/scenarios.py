@@ -993,6 +993,14 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                       for s in per_worker_stats if s),
                 "corrupt_entries": sum(s.get("corrupt_entries", 0)
                                        for s in per_worker_stats if s),
+                # Shuffle-compatible serving: entries that went out
+                # through a seed-tree serve-time permutation (nonzero iff
+                # --shuffle-seed and a warm tier met), and old-format
+                # entries evicted by the version check.
+                "permuted_serves": sum(s.get("permuted_serves", 0)
+                                       for s in per_worker_stats if s),
+                "version_evicted": sum(s.get("version_evicted", 0)
+                                       for s in per_worker_stats if s),
             }
         # Final registry snapshot + per-stage latency quantiles: BENCH
         # artifacts capture distributions (p50/p99), not just means.

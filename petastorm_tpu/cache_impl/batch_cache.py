@@ -50,16 +50,23 @@ from petastorm_tpu.telemetry.metrics import (
     CACHE_FILL_SECONDS,
     CACHE_HITS,
     CACHE_MISSES,
+    CACHE_PERMUTED_SERVES,
     CACHE_SERVE_SECONDS,
+    CACHE_VERSION_EVICTED,
 )
 
-# Version 2 adds a payload crc32 to the meta header: a truncated file was
-# already caught by the frame-length sum, but a BIT-FLIPPED payload byte
-# passed it and would have been served — the checksum closes that hole
-# (chaos mode ``cache-corrupt`` exercises exactly this). v1 files fail the
-# magic check and take the corrupt-entry path: deleted, refilled on the
-# next decode — cheap, and the tiers never mix formats.
-_MAGIC = b"PTBCACHE2\n"
+#: On-disk entry format version, stamped in the magic line AND the meta
+#: header. Version 3 adds the per-batch frame index (absolute payload
+#: offsets) that serve-time permutation seeks on. Version 2 added a
+#: payload crc32 (a truncated file was already caught by the frame-length
+#: sum, but a bit-flipped payload byte passed it — chaos mode
+#: ``cache-corrupt`` exercises exactly this).
+ENTRY_FORMAT_VERSION = 3
+_MAGIC = b"PTBCACHE3\n"
+#: Magics of formats this build used to write: recognized so an old entry
+#: is counted/evicted as a VERSION mismatch (expected after an upgrade —
+#: deleted, refilled by the next decode) rather than as corruption.
+_OLD_MAGICS = (b"PTBCACHE1\n", b"PTBCACHE2\n")
 _LEN = struct.Struct("!Q")
 
 logger = service_logger(__name__)
@@ -127,28 +134,50 @@ class CachedBatch:
 
 
 class CachedEntry:
-    """One key's batch sequence: per-batch meta + one contiguous buffer."""
+    """One key's batch sequence: per-batch meta + one contiguous buffer.
 
-    __slots__ = ("meta", "buf", "nbytes")
+    The **frame index** (``_offsets``) records each batch's absolute
+    payload offset, so :meth:`batch_at` is an O(frames-per-batch) seek —
+    the primitive serve-time permutation scatter-gathers on: any batch's
+    frames slice out of the shared buffer without touching (or copying)
+    the skipped prefix."""
+
+    __slots__ = ("meta", "buf", "nbytes", "_offsets")
 
     def __init__(self, meta, buf):
         self.meta = meta          # [(rows, fmt, [frame_len, ...]), ...]
         self.buf = buf            # bytes: every batch's frames back to back
         self.nbytes = len(buf)
+        offsets, offset = [], 0
+        for _, _, frame_lens in meta:
+            offsets.append(offset)
+            offset += sum(frame_lens)
+        self._offsets = offsets   # frame index: batch -> payload offset
 
     @property
     def rows(self):
         return sum(rows for rows, _, _ in self.meta)
 
-    def batches(self):
+    @property
+    def num_batches(self):
+        return len(self.meta)
+
+    def batch_at(self, index):
+        """The ``index``-th batch as zero-copy views into the buffer —
+        random access via the frame index (serve-time permutation's seek
+        path; ``batches()`` below is the sequential walk)."""
+        rows, fmt, frame_lens = self.meta[index]
         view = memoryview(self.buf)
-        offset = 0
-        for rows, fmt, frame_lens in self.meta:
-            frames = []
-            for length in frame_lens:
-                frames.append(view[offset:offset + length])
-                offset += length
-            yield CachedBatch(rows, fmt, frames)
+        offset = self._offsets[index]
+        frames = []
+        for length in frame_lens:
+            frames.append(view[offset:offset + length])
+            offset += length
+        return CachedBatch(rows, fmt, frames)
+
+    def batches(self):
+        for index in range(len(self.meta)):
+            yield self.batch_at(index)
 
     def to_dicts(self):
         return [batch.to_dict() for batch in self.batches()]
@@ -259,6 +288,8 @@ class BatchCache:
         self.evictions_mem = 0
         self.evictions_disk = 0
         self.corrupt_entries = 0
+        self.version_evicted = 0
+        self.permuted_serves = 0
         self._m_hits_mem = CACHE_HITS.labels("mem")
         self._m_hits_disk = CACHE_HITS.labels("disk")
         self._m_bytes_mem = CACHE_BYTES.labels("mem")
@@ -285,6 +316,13 @@ class BatchCache:
         """The :class:`CachedEntry` for ``key`` or ``None`` (a miss).
         Checks memory, then disk; a disk hit is promoted into the memory
         tier (it is about to be hot)."""
+        return self.get_tiered(key)[0]
+
+    def get_tiered(self, key):
+        """``(entry, tier)`` — the entry plus which tier answered
+        (``"mem"``/``"disk"``), or ``(None, None)`` on a miss. Serve-time
+        permutation callers use the tier to attribute their
+        ``cache_permuted_serves_total`` bumps."""
         t0 = time.perf_counter()
         with self._lock:
             entry = self._entries.get(key)
@@ -294,7 +332,7 @@ class BatchCache:
         if entry is not None:
             self._m_hits_mem.inc()
             CACHE_SERVE_SECONDS.observe(time.perf_counter() - t0)
-            return entry
+            return entry, "mem"
         if self._disk:
             entry = self._load_disk(key)
             if entry is not None:
@@ -303,11 +341,20 @@ class BatchCache:
                     self._insert_locked(key, entry)
                 self._m_hits_disk.inc()
                 CACHE_SERVE_SECONDS.observe(time.perf_counter() - t0)
-                return entry
+                return entry, "disk"
         with self._lock:
             self.misses += 1
         CACHE_MISSES.inc()
-        return None
+        return None, None
+
+    def note_permuted_serve(self, tier):
+        """One entry was served through a serve-time permutation (shuffle-
+        compatible serving). Called by the serve sites (the worker's piece
+        engine, the loader's replay) — the cache itself never knows the
+        order its bytes go out in."""
+        with self._lock:
+            self.permuted_serves += 1
+        CACHE_PERMUTED_SERVES.labels(tier or "mem").inc()
 
     def get_batches(self, key):
         """The decoded ``[{field: ndarray}, ...]`` sequence, or ``None``."""
@@ -376,9 +423,16 @@ class BatchCache:
         import zlib
 
         meta = json.dumps({
+            "format": ENTRY_FORMAT_VERSION,
             "crc32": zlib.crc32(entry.buf) & 0xFFFFFFFF,
-            "batches": [{"rows": rows, "fmt": fmt, "frame_lens": lens}
-                        for rows, fmt, lens in entry.meta],
+            # The frame index rides along explicitly (offset per batch):
+            # redundant with the cumulative frame_lens, which doubles as a
+            # consistency check on load — an offset that disagrees with
+            # the running sum marks the file bad.
+            "batches": [{"rows": rows, "fmt": fmt, "frame_lens": lens,
+                         "offset": offset}
+                        for (rows, fmt, lens), offset
+                        in zip(entry.meta, entry._offsets)],
         }).encode("utf-8")
         path = self._entry_path(key)
         tmp_path = None
@@ -439,6 +493,22 @@ class BatchCache:
                 blob = f.read()
         except OSError:
             return None
+        if any(blob.startswith(magic) for magic in _OLD_MAGICS):
+            # A previous format version's entry (expected after an
+            # upgrade, not damage): counted separately from corruption,
+            # deleted, reported as a miss — the next decode refills it in
+            # the current format. Never a stream error.
+            with self._lock:
+                self.version_evicted += 1
+            CACHE_VERSION_EVICTED.inc()
+            logger.warning(
+                "disk-tier cache entry %s was written by an older format "
+                "version — deleting; the next decode refills it", path)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
         try:
             if not blob.startswith(_MAGIC):
                 raise ValueError("bad magic")
@@ -447,6 +517,8 @@ class BatchCache:
             payload_off = meta_off + _LEN.size + meta_len
             meta = json.loads(blob[meta_off + _LEN.size:payload_off]
                               .decode("utf-8"))
+            if int(meta.get("format", 0)) != ENTRY_FORMAT_VERSION:
+                raise ValueError("meta format/magic version disagree")
             payload = blob[payload_off:]
             entry = CachedEntry(
                 [(m["rows"], m["fmt"], list(m["frame_lens"]))
@@ -456,6 +528,8 @@ class BatchCache:
                            for length in lens)
             if expected != entry.nbytes:
                 raise ValueError("truncated payload")
+            if [m["offset"] for m in meta["batches"]] != entry._offsets:
+                raise ValueError("frame index disagrees with frame lengths")
             if (zlib.crc32(payload) & 0xFFFFFFFF) != int(meta["crc32"]):
                 raise ValueError("payload checksum mismatch")
         except (ValueError, KeyError, TypeError, struct.error):
@@ -502,6 +576,8 @@ class BatchCache:
                 "evictions_mem": self.evictions_mem,
                 "evictions_disk": self.evictions_disk,
                 "corrupt_entries": self.corrupt_entries,
+                "version_evicted": self.version_evicted,
+                "permuted_serves": self.permuted_serves,
                 "cache_dir": self._dir,
             }
 

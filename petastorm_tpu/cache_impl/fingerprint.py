@@ -7,6 +7,18 @@ fingerprint canonicalizes all of that into one hex digest; changing any
 ingredient changes the key, so a stale entry is simply never found (miss →
 re-decode → refill) rather than ever being served wrong.
 
+Keys are **order-independent by contract**: what is cached (decoded bytes
+in canonical piece order) is separated from how it is served (a seed-tree
+permutation composed at serve time — ``service/seedtree.py``), so nothing
+that only shapes *serve order* may reach a key. Shuffle seeds, epoch
+numbers, and shuffle flags are banned ingredients — epoch 1's fill must
+hit on every later epoch, and N jobs running the same dataset under
+different seeds must share one disk-tier fill ("decode once"). The ban is
+enforced, not advisory: :func:`batch_fingerprint` rejects ``extra`` keys
+that smell order-dependent (see ``_ORDER_DEPENDENT_KEYS``), and the tier-1
+golden test pins that the shipped keys are invariant to seed/epoch/shuffle
+configuration.
+
 Two keying granularities share this function:
 
 - the service worker keys **per piece** (``pieces=[piece_index]``), so an
@@ -24,6 +36,37 @@ import json
 #: Bump when the on-wire/cached entry layout changes: old entries must
 #: become misses, not deserialization errors.
 FINGERPRINT_VERSION = 1
+
+#: ``extra`` key names (exact, case-insensitive) that name an
+#: order-dependent ingredient. Serve order is composed at serve time from
+#: the seed tree; letting any of these into a key would silently split
+#: the cache per seed/epoch and forfeit both the warm-epoch hit rate
+#: under shuffle and the cross-job "decode once" disk-tier share. Exact
+#: names, not substrings: content-shaping ingredients that merely contain
+#: one of these words (``num_epochs`` — how many passes an entry holds —
+#: or a hypothetical ``sort_order_version``) must stay usable.
+_ORDER_DEPENDENT_KEYS = frozenset((
+    "seed", "shuffle_seed", "shard_seed", "random_seed",
+    "shuffle", "shuffle_row_groups", "shuffle_buffer_size",
+    "epoch", "cache_epoch", "fill_epoch",
+    "order", "item_order", "row_order", "piece_order", "serve_order",
+))
+
+
+def _reject_order_dependent(value, path="extra"):
+    if isinstance(value, dict):
+        for key, child in value.items():
+            if str(key).lower() in _ORDER_DEPENDENT_KEYS:
+                raise ValueError(
+                    f"batch_fingerprint ingredient {path}[{key!r}] is "
+                    f"order-dependent: cache keys must exclude "
+                    f"serve-order inputs (seed, epoch, shuffle flags) — "
+                    f"serve order is composed at serve time "
+                    f"(docs/guides/caching.md#shuffle-compatible-serving)")
+            _reject_order_dependent(child, f"{path}[{key!r}]")
+    elif isinstance(value, (list, tuple)):
+        for index, child in enumerate(value):
+            _reject_order_dependent(child, f"{path}[{index}]")
 
 
 def _canonical(value):
@@ -55,8 +98,11 @@ def batch_fingerprint(dataset_url, pieces, batch_size, fields=None,
         ``"batch"`` / ``"columnar"`` or a callable's qualname) — the three
         families emit different collation layouts for codec columns.
     :param extra: any further invalidation inputs (filters, predicate,
-        last-batch policy, ...).
+        last-batch policy, ...). Keys naming order-dependent ingredients
+        (seed/epoch/shuffle/order) are rejected — see the module
+        docstring.
     """
+    _reject_order_dependent(extra)
     payload = json.dumps({
         "v": FINGERPRINT_VERSION,
         "url": str(dataset_url),

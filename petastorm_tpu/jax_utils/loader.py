@@ -122,7 +122,8 @@ def make_jax_dataloader(reader, batch_size,
                         stage_in_producer=False,
                         trace_path=None,
                         batch_cache=None,
-                        device_stage=None):
+                        device_stage=None,
+                        cache_resume=None):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -180,9 +181,18 @@ def make_jax_dataloader(reader, batch_size,
         not touched, so iterating the loader again replays the epoch even
         though the underlying ``num_epochs=1`` reader is exhausted); on a
         miss the decoded sequence is written through as it streams.
-        Requires deterministic order: ``shuffle_buffer_size=0`` and a
-        reader constructed with ``shuffle_row_groups=False``
-        (``docs/guides/caching.md``).
+        Shuffle-compatible: with shuffling requested (``shuffle_seed``, a
+        shuffle buffer, or a ``shuffle_row_groups`` reader) the entry
+        stays canonical and each pass is served through a fresh seed-tree
+        batch permutation — order changes per epoch, bytes don't; note
+        the row-level shuffle buffer is superseded by batch-granularity
+        permutation while the cache is armed, and the shuffled fill pass
+        buffers the epoch before its first yield
+        (``docs/guides/caching.md#shuffle-compatible-serving``).
+    :param cache_resume: a prior ``state_dict()`` of kind
+        ``"cache_replay"`` — resumes a shuffled cached pass at its exact
+        permuted batch position (requires ``batch_cache`` and the same
+        reader construction).
     :param device_stage: a :class:`~petastorm_tpu.jax_utils.DeviceStage`
         (or ``None``). When armed, the loader stages each batch's raw
         uint8 image fields AS BYTES (4x fewer H2D bytes than float32
@@ -204,7 +214,8 @@ def make_jax_dataloader(reader, batch_size,
                          stage_in_producer=stage_in_producer,
                          trace_path=trace_path,
                          batch_cache=batch_cache,
-                         device_stage=device_stage)
+                         device_stage=device_stage,
+                         cache_resume=cache_resume)
 
 
 class JaxDataLoader:
@@ -216,7 +227,7 @@ class JaxDataLoader:
                  stage_to_device=True, shuffle_buffer_size=0,
                  shuffle_seed=None, stage_in_producer=False,
                  batch_source=None, trace_path=None, batch_cache=None,
-                 device_stage=None):
+                 device_stage=None, cache_resume=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
         if device_stage is not None and not stage_to_device:
@@ -249,20 +260,33 @@ class JaxDataLoader:
                     "dependent per host, so without an agreed step count "
                     "pjit deadlocks the pod (agree via "
                     "jax_utils.sharding.agree_max_batches)")
-        if batch_cache is not None:
-            if batch_source is not None:
+        if batch_cache is not None and batch_source is not None:
+            raise ValueError(
+                "batch_cache is the local-reader decode bypass; the "
+                "data service's workers own caching on the remote path "
+                "(BatchWorker(batch_cache=...)) — arming both here "
+                "would cache an opaque stream under a key that cannot "
+                "see the remote plan")
+        if cache_resume is not None:
+            if batch_cache is None:
                 raise ValueError(
-                    "batch_cache is the local-reader decode bypass; the "
-                    "data service's workers own caching on the remote path "
-                    "(BatchWorker(batch_cache=...)) — arming both here "
-                    "would cache an opaque stream under a key that cannot "
-                    "see the remote plan")
-            if shuffle_buffer_size:
+                    "cache_resume is a batch_cache replay position; it "
+                    "needs batch_cache armed (and the same cache "
+                    "key ingredients the snapshot was taken under)")
+            if cache_resume.get("kind") != "cache_replay":
                 raise ValueError(
-                    "batch_cache requires a deterministic batch sequence; "
-                    "a shuffle buffer reorders rows per epoch, so a cached "
-                    "replay would silently freeze epoch 1's order — "
-                    "shuffle at materialization time or disable caching")
+                    f"cache_resume must be a state_dict() of kind "
+                    f"'cache_replay', got {cache_resume.get('kind')!r}")
+            ventilator = getattr(reader, "_ventilator", None)
+            if getattr(ventilator, "_randomize_item_order", False) \
+                    and getattr(reader, "_shard_seed", None) is None:
+                raise ValueError(
+                    "cache_resume with a shuffle_row_groups reader "
+                    "requires shard_seed: without one the fill order is "
+                    "not reproducible, so a cold-cache resume would "
+                    "refill the entry in a different canonical order and "
+                    "then seek the resume position into the WRONG "
+                    "sequence (silent duplicate and lost samples)")
         self.reader = reader
         self._batch_size = batch_size
         self._last_batch = last_batch
@@ -303,6 +327,25 @@ class JaxDataLoader:
         # sequence under the full-epoch key. Once set, misses stream
         # uncached (correct, just not accelerated).
         self._cache_fill_attempted = False
+        # Shuffle-compatible replay: each iteration of a cache-armed
+        # loader is one "cache epoch"; shuffled serves permute the
+        # canonical entry by fold_in(seed, cache-epoch) so the order
+        # changes per pass while the cached bytes don't. cache_resume
+        # re-enters a permuted pass at a batch position.
+        self._cache_epoch = 0
+        self._cache_skip = 0
+        self._cache_pass = None   # live pass info state_dict() snapshots
+        self._cache_resume_seed = None
+        self._cache_resume_has_seed = False
+        if cache_resume is not None:
+            self._cache_epoch = int(cache_resume["cache_epoch"])
+            self._cache_skip = max(0, int(
+                cache_resume.get("batches_yielded", 0)))
+            # Checked against the effective permutation seed at serve
+            # time: resuming under a different seed would skip a prefix
+            # of the WRONG permutation (silent duplicate/lost samples).
+            self._cache_resume_seed = cache_resume.get("shuffle_seed")
+            self._cache_resume_has_seed = "shuffle_seed" in cache_resume
         if sharding is not None and max_batches is None \
                 and batch_source is None:
             # (With a custom batch_source the reader-metadata derivation
@@ -520,7 +563,19 @@ class JaxDataLoader:
         though the exhausted ``num_epochs=1`` reader would yield nothing);
         a miss streams batches through while writing them into an entry
         that is published only on clean exhaustion (an abandoned iteration
-        can never be served as a complete epoch)."""
+        can never be served as a complete epoch).
+
+        Shuffle-compatible serving: when shuffling is requested (a
+        shuffle buffer, an explicit ``shuffle_seed``, or a
+        ``shuffle_row_groups`` reader), the entry stays canonical (the
+        fill pass's decode order, read WITHOUT the shuffle buffer) and
+        each pass serves it through a fresh seed-tree permutation at
+        batch granularity — order changes per epoch, bytes don't, and
+        the cache key is seed/epoch-invariant
+        (``docs/guides/caching.md#shuffle-compatible-serving``). The
+        shuffled fill pass buffers the epoch before serving (the entry
+        IS the buffer), so its first batch arrives after the decode
+        completes; warm passes stream immediately."""
         if self._batch_cache is None:
             yield from batch_iterator(
                 self.reader, self._batch_size,
@@ -530,16 +585,39 @@ class JaxDataLoader:
                 shuffle_seed=self._shuffle_seed)
             return
         key = self._reader_cache_key()
-        entry = self._batch_cache.get(key)
+        permute_seed = self._cache_permute_seed()
+        if self._cache_resume_has_seed \
+                and self._cache_resume_seed != permute_seed:
+            raise ValueError(
+                f"cache_resume was snapshotted under shuffle_seed="
+                f"{self._cache_resume_seed!r} but this loader's effective "
+                f"permutation seed is {permute_seed!r}: the resume "
+                f"position indexes that seed's permutation, so resuming "
+                f"here would silently re-serve some batches and skip "
+                f"others — reconstruct the loader (and reader) with the "
+                f"snapshot's shuffle configuration")
+        cache_epoch = self._cache_epoch
+        self._cache_epoch += 1
+        skip, self._cache_skip = self._cache_skip, 0
+        if permute_seed is not None:
+            # Snapshot the pass BEFORE any yield: a state_dict() taken
+            # mid-fill resumes at `skip` (nothing yielded yet). ``n`` is
+            # filled in once the entry exists — state_dict uses it to
+            # roll a COMPLETED pass forward to the next pass's start.
+            self._cache_pass = {"cache_epoch": cache_epoch, "base": skip,
+                                "seed": permute_seed, "n": None}
+        entry, tier = self._batch_cache.get_tiered(key)
         if entry is not None:
-            for cached in entry.batches():
-                yield cached.to_dict()
+            yield from self._serve_entry(entry, tier, permute_seed,
+                                         cache_epoch, skip)
             return
         if self._cache_fill_attempted:
             # The reader's start position was already consumed (by a
             # complete OR abandoned earlier pass): what it yields now is a
             # tail of the stream, not an epoch — serve it uncached and
-            # never commit it under the epoch key.
+            # never commit it under the epoch key. Not a permuted cache
+            # pass either: a state_dict() here has no replayable position.
+            self._cache_pass = None
             produced = 0
             for batch in batch_iterator(self.reader, self._batch_size,
                                         last_batch=self._last_batch,
@@ -563,6 +641,32 @@ class JaxDataLoader:
             return
         self._cache_fill_attempted = True
         builder = self._batch_cache.begin_fill(key)
+        if permute_seed is not None:
+            # Shuffled fill: buffer the canonical epoch into the entry
+            # (no yields — the builder already holds every frame), then
+            # serve it through this pass's permutation so epoch 1 is
+            # shuffled too. The fill reads WITHOUT the shuffle buffer:
+            # the entry must be canonical or two jobs with different
+            # seeds could not share it.
+            for batch in batch_iterator(self.reader, self._batch_size,
+                                        last_batch=self._last_batch,
+                                        max_batches=self._max_batches):
+                if self._stop.is_set():
+                    return  # abandoned fill: the builder never commits
+                builder.add_batch(batch)
+            entry = builder.commit()
+            if not self._batch_cache.retained(key):
+                import warnings
+
+                warnings.warn(
+                    "batch_cache could not retain this epoch's entry "
+                    "(larger than the memory budget and no disk tier kept "
+                    "it); re-iterating this exhausted reader will yield "
+                    "no batches — raise mem_budget_bytes or enable the "
+                    "disk tier", RuntimeWarning, stacklevel=2)
+            yield from self._serve_entry(entry, None, permute_seed,
+                                         cache_epoch, skip)
+            return
         for batch in batch_iterator(self.reader, self._batch_size,
                                     last_batch=self._last_batch,
                                     max_batches=self._max_batches):
@@ -585,25 +689,64 @@ class JaxDataLoader:
                 "— raise mem_budget_bytes or enable the disk tier",
                 RuntimeWarning, stacklevel=2)
 
+    def _cache_permute_seed(self):
+        """The serve-time permutation seed, or ``None`` when replays must
+        be byte-exact (no shuffling requested — the pre-shuffle replay
+        contract). Shuffling is requested by any of the loader's shuffle
+        knobs or a ``shuffle_row_groups`` reader; the seed prefers the
+        explicit ``shuffle_seed``, then the reader's ``shard_seed``, then
+        0 (a fixed default — the determinism lint bans unseeded draws)."""
+        ventilator = getattr(self.reader, "_ventilator", None)
+        reader_shuffled = bool(getattr(ventilator, "_randomize_item_order",
+                                       False))
+        if not (self._shuffle_buffer_size or self._shuffle_seed is not None
+                or reader_shuffled):
+            return None
+        if self._shuffle_seed is not None:
+            return int(self._shuffle_seed)
+        shard_seed = getattr(self.reader, "_shard_seed", None)
+        return int(shard_seed) if shard_seed is not None else 0
+
+    def _serve_entry(self, entry, tier, permute_seed, cache_epoch, skip):
+        """Serve a whole-epoch cache entry, permuted when shuffling is
+        requested: position ``i`` of the pass is the entry's
+        ``order[i]``-th canonical batch, where ``order`` derives only
+        from ``fold_in(seed, cache-epoch)`` — each pass reshuffles, every
+        process replays the same orders, and ``skip`` (a resume position)
+        indexes the PERMUTED stream so a restore continues mid-pass
+        bit-exactly."""
+        from petastorm_tpu.service.seedtree import fold_in, permutation
+
+        if permute_seed is None:
+            order = range(entry.num_batches)
+        else:
+            order = permutation(
+                fold_in(int(permute_seed), ("cache-epoch", cache_epoch)),
+                entry.num_batches)
+            self._batch_cache.note_permuted_serve(tier or "mem")
+            if self._cache_pass is not None:
+                self._cache_pass["n"] = entry.num_batches
+        for position, source in enumerate(order):
+            if position < skip:
+                continue
+            yield entry.batch_at(source).to_dict()
+
     def _reader_cache_key(self):
         """Content fingerprint of everything that shapes this loader's
         batch sequence: the reader's resolved piece plan (path + row-group
         identity, so a re-materialized dataset misses), its schema view,
-        transform, predicate, epoch count and resume position, plus this
-        loader's batching knobs. Refuses row-group shuffling — a shuffled
-        reader's order differs per epoch, so a cached replay would
-        silently train on a frozen order."""
+        transform, predicate, pass count and resume position, plus this
+        loader's batching knobs. Deliberately EXCLUDES every shuffle
+        ingredient (seed, flags, buffer size) — order is composed at
+        serve time from the seed tree, so one canonical fill serves any
+        seed and every epoch (``batch_fingerprint`` enforces the
+        exclusion). Under ``shuffle_row_groups`` the canonical order is
+        the fill pass's decode order: set ``shard_seed`` for a
+        reproducible fill, or construct the reader unshuffled and let
+        serve-time permutation do the shuffling."""
         from petastorm_tpu.cache_impl import batch_fingerprint
 
         reader = self.reader
-        ventilator = getattr(reader, "_ventilator", None)
-        if ventilator is not None \
-                and getattr(ventilator, "_randomize_item_order", False):
-            raise ValueError(
-                "batch_cache requires shuffle_row_groups=False on the "
-                "reader: row-group shuffling changes the batch sequence "
-                "every epoch, so serving a cached epoch would silently "
-                "freeze the first epoch's order")
         pieces = [(piece.path, piece.row_group)
                   for piece in getattr(reader, "_pieces", [])]
         return batch_fingerprint(
@@ -614,6 +757,11 @@ class JaxDataLoader:
             + type(reader._results_queue_reader).__name__,
             extra={"last_batch": self._last_batch,
                    "max_batches": self._max_batches,
+                   # num_epochs is CONTENT-shaping (how many passes of
+                   # batches one entry holds), not serve order — it stays
+                   # in the key, and keeping the PR 5 spelling means old
+                   # disk entries are found and version-evicted instead
+                   # of lingering as orphaned files.
                    "num_epochs": reader.num_epochs,
                    "predicate": repr(getattr(reader, "_predicate", None)),
                    "resume": repr(getattr(reader, "_resume_state", None))})
@@ -1039,6 +1187,35 @@ class JaxDataLoader:
                 "batches to reader deliveries. Checkpoint at an epoch "
                 "boundary with the reader's state_dict(), or give the "
                 "source a state_dict()")
+        if self._batch_cache is not None and self._cache_pass is not None:
+            # A shuffled cache pass (fill or replay): the resumable
+            # position is a batch index into the pass's PERMUTED stream —
+            # yielded batches only, so anything still in the prefetch
+            # queues is re-served on resume (and nothing twice: the
+            # resume skips exactly the yielded prefix of the same
+            # deterministic permutation). Pass the dict back as
+            # ``JaxDataLoader(cache_resume=...)`` with the same reader
+            # construction and cache; a cold cache on resume re-fills
+            # canonically and then seeks, so the restore works from a
+            # fresh process too.
+            pass_info = self._cache_pass
+            yielded = pass_info["base"] + int(
+                self._m_batches.value - self._base["batches"])
+            cache_epoch = pass_info["cache_epoch"]
+            n = pass_info.get("n")
+            if n is not None and yielded >= n:
+                # The pass is fully consumed: snapshot the NEXT pass's
+                # start, not position n of this one — resuming "at the
+                # end of pass k" must serve pass k+1, not an empty (or,
+                # cold, a re-decoded-for-nothing) remainder of pass k.
+                cache_epoch, yielded = cache_epoch + 1, 0
+            return {
+                "version": 1,
+                "kind": "cache_replay",
+                "cache_epoch": cache_epoch,
+                "batches_yielded": yielded,
+                "shuffle_seed": pass_info["seed"],
+            }
         tracker = getattr(self.reader, "_delivery_tracker", None)
         if tracker is None or not hasattr(self.reader, "state_dict"):
             raise TypeError(

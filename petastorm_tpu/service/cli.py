@@ -206,7 +206,8 @@ def _worker_totals(sample, wid):
             # None (not 0) when the worker has no batch cache armed, so
             # the render shows "--" instead of a fake 0% hit rate.
             metrics.get("cache_hits_total"),
-            metrics.get("cache_misses_total"))
+            metrics.get("cache_misses_total"),
+            metrics.get("cache_permuted_serves_total"))
 
 
 def render_fleet_status(prev, cur):
@@ -230,7 +231,7 @@ def render_fleet_status(prev, cur):
         header,
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
         f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} {'CACHEHIT%':>10} "
-        f"{'STEALS':>9} {'BACKLOG':>8}",
+        f"{'PERM/S':>7} {'STEALS':>9} {'BACKLOG':>8}",
     ]
 
     def steal_cols(wid):
@@ -247,17 +248,17 @@ def render_fleet_status(prev, cur):
         if now is None:
             lines.append(f"{wid:<20} {'unreachable':>10}")
             continue
-        rows1, batches1, wait1, active, hits1, misses1 = now
+        rows1, batches1, wait1, active, hits1, misses1, perm1 = now
         before = _worker_totals(prev, wid)
         if before is None:
             # No prior baseline (worker just appeared or was unreachable
             # last poll): totals are real, rates are unknowable.
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
-                f"{'--':>13} {int(rows1):>12} {'--':>10} "
+                f"{'--':>13} {int(rows1):>12} {'--':>10} {'--':>7} "
                 f"{steal_cols(wid)}")
             continue
-        rows0, batches0, wait0, _, hits0, misses0 = before
+        rows0, batches0, wait0, _, hits0, misses0, perm0 = before
         rows_rate = max(0.0, rows1 - rows0) / dt
         batch_rate = max(0.0, batches1 - batches0) / dt
         wait_rate = max(0.0, wait1 - wait0) / dt
@@ -272,10 +273,16 @@ def render_fleet_status(prev, cur):
             lookups = hit_delta + max(0.0, misses1 - (misses0 or 0.0))
             if lookups > 0:
                 hit_pct = f"{100.0 * hit_delta / lookups:.1f}"
+        # Permuted serves over the window: the shuffle-compatible serving
+        # signal — nonzero means warm entries go out through a seed-tree
+        # serve-time permutation (cached shuffled epochs are live).
+        perm_rate = "--"
+        if perm1 is not None:
+            perm_rate = f"{max(0.0, perm1 - (perm0 or 0.0)) / dt:.2f}"
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
             f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12} "
-            f"{hit_pct:>10} {steal_cols(wid)}")
+            f"{hit_pct:>10} {perm_rate:>7} {steal_cols(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
     recovery = status.get("recovery") or {}

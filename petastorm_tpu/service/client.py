@@ -90,7 +90,7 @@ class _WorkerStream:
 
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
-                 starts=None):
+                 starts=None, shuffle_seed=None):
         self.worker_id = worker_id
         self.address = tuple(address)
         self.pieces = list(pieces)
@@ -98,6 +98,11 @@ class _WorkerStream:
         self.credits = credits
         self.tagged = tagged
         self.starts = dict(starts or {})
+        #: The dispatcher's shuffle seed, forwarded on the stream request
+        #: so the worker serves each piece's batches through the epoch's
+        #: seed-tree permutation (shuffle-compatible caching: order is
+        #: composed at serve time, cached bytes stay canonical).
+        self.shuffle_seed = shuffle_seed
         #: Batch id (minted worker-side at decode) of the batch the last
         #: ``next_event`` returned — the tracing key correlating this
         #: stream's receive with the worker's decode/send spans.
@@ -139,6 +144,8 @@ class _WorkerStream:
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "pieces": self.pieces,
                        "epoch": self.epoch}
+            if self.shuffle_seed is not None:
+                request["shuffle_seed"] = int(self.shuffle_seed)
             if self.tagged:
                 request["tagged"] = True
                 if self.starts:
@@ -444,7 +451,7 @@ class _DynamicStream:
     takeover path when the stream reports broken."""
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
-                 credits=None):
+                 credits=None, shuffle_seed=None):
         self.worker_id = worker_id
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
@@ -452,6 +459,7 @@ class _DynamicStream:
         self.pairs = [self._triple(t) for t in pairs]
         self.epoch = epoch
         self.credits = credits
+        self.shuffle_seed = shuffle_seed  # see _WorkerStream.shuffle_seed
         self._connect_timeout = connect_timeout
         self._conn = None
         self._closed = False
@@ -473,6 +481,8 @@ class _DynamicStream:
             request = {"type": "stream", "dynamic": True,
                        "pieces": [list(t) for t in self.pairs],
                        "epoch": self.epoch}
+            if self.shuffle_seed is not None:
+                request["shuffle_seed"] = int(self.shuffle_seed)
             if self.credits is not None:
                 request["credits"] = self.credits
             try:
@@ -934,7 +944,8 @@ class ServiceBatchSource:
                         wid, reply["workers"][wid], pending, epoch,
                         self._connect_timeout, credits=self._credits,
                         tagged=True,
-                        starts={p: starts.get(p, 0) for p in pending})
+                        starts={p: starts.get(p, 0) for p in pending},
+                        shuffle_seed=self._shuffle_seed)
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1110,7 +1121,8 @@ class ServiceBatchSource:
                     piece_order(self._shuffle_seed, epoch, pieces),
                     epoch, self._connect_timeout,
                     credits=self._credits, tagged=True,
-                    starts={p: marks.get(p, 0) for p in pieces}))
+                    starts={p: marks.get(p, 0) for p in pieces},
+                    shuffle_seed=self._shuffle_seed))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1441,7 +1453,8 @@ class ServiceBatchSource:
             sid = next(sid_counter)
             stream = _DynamicStream(wid, addresses[wid], pairs, epoch,
                                     self._connect_timeout,
-                                    credits=self._credits)
+                                    credits=self._credits,
+                                    shuffle_seed=self._shuffle_seed)
             streams[sid] = stream
             sid_by_wid[wid] = sid
             reader = _DynamicStreamReader(sid, stream, ready, stop,
@@ -1574,7 +1587,8 @@ class ServiceBatchSource:
                 def attempt():
                     fresh = _DynamicStream(wid, addresses[wid], pairs,
                                            epoch, self._connect_timeout,
-                                           credits=self._credits)
+                                           credits=self._credits,
+                                           shuffle_seed=self._shuffle_seed)
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -2028,7 +2042,8 @@ class ServiceBatchSource:
                                   pending, stream.epoch,
                                   self._connect_timeout,
                                   credits=self._credits, tagged=True,
-                                  starts=starts)
+                                  starts=starts,
+                                  shuffle_seed=self._shuffle_seed)
             event = fresh.next_event()  # forces connect + first reply
             return fresh, event
 
@@ -2107,7 +2122,8 @@ class ServiceBatchSource:
                           stream.epoch,
                           self._connect_timeout, credits=self._credits,
                           tagged=True,
-                          starts={p: starts.get(p, 0) for p in pieces})
+                          starts={p: starts.get(p, 0) for p in pieces},
+                          shuffle_seed=self._shuffle_seed)
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2188,7 +2204,8 @@ class ServiceBatchSource:
             stream = _WorkerStream(wid, address, [piece], epoch,
                                    self._connect_timeout,
                                    credits=self._credits,
-                                   auto_replenish=True)
+                                   auto_replenish=True,
+                                   shuffle_seed=self._shuffle_seed)
             try:
                 yield from self._drain_one(stream)
                 return True

@@ -867,15 +867,22 @@ class Dispatcher:
             alive = self._alive_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
-            # Seed-tree order BEFORE partitioning: the round-robin split
-            # then spreads consecutive pieces of the epoch's canonical
-            # order across workers, so an ordered client's reorder buffer
-            # stays shallow (the next piece is always on some live stream).
-            client_pieces = piece_order(
-                self.shuffle_seed, int(header.get("epoch", 0)),
-                list(range(self._num_pieces))[client_index::num_clients])
+            # Partition the ASCENDING piece list (epoch-invariant), then
+            # order each worker's share by the epoch's seed-tree keys.
+            # Sticky piece→worker assignment is what keeps the workers'
+            # decoded-batch caches warm across shuffled epochs (epoch 1's
+            # fill lives in the worker that serves the piece forever
+            # after); per-share canonical ordering keeps an ordered
+            # client's reorder buffer shallow — the canonical next piece
+            # is always at the head of some live stream's remaining work.
+            epoch_number = int(header.get("epoch", 0))
+            client_pieces = list(
+                range(self._num_pieces))[client_index::num_clients]
             worker_ids = sorted(alive)
-            assignments = self._partition(client_pieces, worker_ids)
+            assignments = {
+                wid: piece_order(self.shuffle_seed, epoch_number, pieces)
+                for wid, pieces in self._partition(client_pieces,
+                                                   worker_ids).items()}
             self._clients[header["client_id"]] = {
                 "epoch": int(header.get("epoch", 0)),
                 "client_index": client_index,
@@ -1015,11 +1022,16 @@ class Dispatcher:
             alive = self._alive_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
-            client_pieces = piece_order(
-                self.shuffle_seed, epoch,
-                list(range(self._num_pieces))[client_index::num_clients])
+            # Sticky initial deques + per-deque canonical order, like the
+            # static path: cache warmth survives shuffled epochs (steals
+            # may still move pieces — the shared disk tier covers those).
+            client_pieces = list(
+                range(self._num_pieces))[client_index::num_clients]
             worker_ids = sorted(alive)
-            assignments = self._partition(client_pieces, worker_ids)
+            assignments = {
+                wid: piece_order(self.shuffle_seed, epoch, pieces)
+                for wid, pieces in self._partition(client_pieces,
+                                                   worker_ids).items()}
             self._generation += 1
             generation = self._generation
             owner = {piece: [wid, generation]

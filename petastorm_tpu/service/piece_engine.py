@@ -143,10 +143,24 @@ class StreamingPieceEngine:
         being served. Small on purpose: an in-pipeline piece is committed to
         this worker (only unsent work is stealable), so depth trades decode
         overlap against rebalance agility.
+    :param permute_fn: ``(piece, n_batches) -> [ordinal, ...]`` — the
+        serve-time batch permutation (shuffle-compatible serving). When
+        set, a piece's ``n`` batches are emitted in the permuted order
+        with event ordinals numbering the PERMUTED stream positions, so
+        delivery watermarks, dedup, and ``starts`` re-grants index a
+        stable shuffled order. Warm pieces frame-seek the cached entry in
+        permuted order (zero decode, zero copy of skipped batches); cold
+        pieces buffer until the piece finishes decoding (the permutation
+        needs the batch count), then flush permuted — the cache fill still
+        receives every batch in canonical order, so entries stay
+        order-independent. Must be a pure function of the piece and count
+        (the worker derives it from ``seedtree.batch_permutation(seed,
+        epoch, piece, n)``): every re-serve replays the same order.
+        ``None`` (default) emits in canonical decode order.
     """
 
     def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
-                 cache_note_fn=None, lookahead=2):
+                 cache_note_fn=None, lookahead=2, permute_fn=None):
         if callable(reader) and not hasattr(reader, "read_next_tagged"):
             self._reader = None
             self._reader_factory = reader
@@ -158,6 +172,7 @@ class StreamingPieceEngine:
         self._cache = cache
         self._cache_key_fn = cache_key_fn
         self._cache_note_fn = cache_note_fn
+        self._permute = permute_fn
         self._lookahead = max(1, int(lookahead))
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -169,6 +184,9 @@ class StreamingPieceEngine:
         self._rows = {}              # piece -> rows emitted
         self._collators = {}         # piece -> _PieceCollator (cold pieces)
         self._builders = {}          # piece -> cache fill builder (or None)
+        self._pending = {}           # piece -> buffered cold batches
+        #                              (permuted serving: flushed in
+        #                              permuted order at piece completion)
         self._inflight = set()       # pieces submitted, item-done not seen
         self._out = deque()          # ready events
         self._finish = False
@@ -253,6 +271,7 @@ class StreamingPieceEngine:
                 self._state[piece] = _REVOKED
                 self._collators.pop(piece, None)
                 self._builders.pop(piece, None)
+                self._pending.pop(piece, None)
                 self._revoked_pieces += 1
                 removed.append(piece)
             if removed:
@@ -354,13 +373,14 @@ class StreamingPieceEngine:
                     return
                 piece = self._queue.popleft()
                 gen = self._gen[piece]
-            entry = None
+            entry = tier = None
             if self._cache is not None and self._cache_key_fn is not None:
-                entry = self._cache.get(self._cache_key_fn(piece))
+                entry, tier = self._cache.get_tiered(
+                    self._cache_key_fn(piece))
                 if self._cache_note_fn is not None:
                     self._cache_note_fn(entry is not None)
             if entry is not None:
-                self._stage_cached(piece, gen, entry)
+                self._stage_cached(piece, gen, entry, tier)
                 continue
             reader = self._ensure_reader()
             with self._lock:
@@ -377,17 +397,26 @@ class StreamingPieceEngine:
                     if self._cache is not None else None)
             reader.submit_piece(piece)
 
-    def _stage_cached(self, piece, gen, entry):
+    def _stage_cached(self, piece, gen, entry, tier=None):
         """Materialize a warm piece's pre-serialized batches into the ready
         set. Still revocable until its first batch is handed out. A
         nonzero ``start`` watermark seeks past the first ``start`` cached
-        batches — a frame-offset walk over the entry header, no payload
-        bytes touched for the skipped prefix."""
+        batches — a frame-index seek over the entry header, no payload
+        bytes touched for the skipped prefix. With ``permute_fn`` armed,
+        the frame index is walked in the permuted order (event ordinals
+        number permuted stream positions, so ``start`` still means "the
+        first position to send"): zero decode AND zero re-serialization
+        on a warm *shuffled* epoch — the scatter-gather just reads the
+        buffer in a different order."""
         start = self._start.get(piece, 0)
+        n = entry.num_batches
+        order = (self._permute(piece, n) if self._permute is not None
+                 else range(n))
         events, rows = [], 0
-        for ordinal, cached in enumerate(entry.batches()):
+        for ordinal, source in enumerate(order):
             if ordinal < start:
                 continue
+            cached = entry.batch_at(source)
             events.append(("batch", piece, gen, ordinal, cached.rows,
                            cached.fmt, cached.frames, 0.0))
             rows += cached.rows
@@ -399,6 +428,8 @@ class StreamingPieceEngine:
             self._rows[piece] = rows
             self._rows_emitted += rows
             self._out.extend(events)
+        if self._permute is not None and self._cache is not None:
+            self._cache.note_permuted_serve(tier)
 
     def _route(self, output, piece):
         """Attribute one reader output to its piece and collate."""
@@ -416,10 +447,15 @@ class StreamingPieceEngine:
             self._emit_batch(piece, gen, batch, builder)
 
     def _emit_batch(self, piece, gen, batch, builder):
+        permuting = self._permute is not None
         with self._lock:
             ordinal = self._ordinal.get(piece, 0)
             self._ordinal[piece] = ordinal + 1
-            start = self._start.get(piece, 0)
+            # Permuted serving cannot skip-scan at decode time: `start`
+            # indexes the PERMUTED stream, and a canonical batch's
+            # permuted position is unknown until the piece's batch count
+            # is — the flush (_flush_permuted) applies it instead.
+            start = 0 if permuting else self._start.get(piece, 0)
             revoked = self._state.get(piece) == _REVOKED
         # The cache fill gets EVERY batch (a watermark must never publish
         # a truncated entry); only the emission below honors `start`.
@@ -437,6 +473,18 @@ class StreamingPieceEngine:
                 return
             fmt, frames = encode_payload(batch)
             rows = len(next(iter(batch.values()))) if batch else 0
+        if permuting:
+            # Buffer in canonical decode order; flushed permuted once the
+            # piece's count is known (piece completion). Frames of a cold
+            # batch alias the decoded arrays — holding them pins at most
+            # one piece's decoded batches, the same bound the cache fill
+            # already has.
+            with self._lock:
+                if self._state.get(piece) == _REVOKED:
+                    return
+                self._pending.setdefault(piece, []).append(
+                    (rows, fmt, frames, decode_s))
+            return
         with self._lock:
             if self._state.get(piece) == _REVOKED:
                 return
@@ -444,6 +492,35 @@ class StreamingPieceEngine:
             self._rows_emitted += rows
             self._out.append(
                 ("batch", piece, gen, ordinal, rows, fmt, frames, decode_s))
+
+    def _flush_permuted(self, piece, gen):
+        """Emit a cold piece's buffered batches in the permuted order,
+        honoring the piece's ``start`` watermark against PERMUTED stream
+        positions — the cold-path mirror of :meth:`_stage_cached`'s warm
+        frame-index walk, so a re-serve replays identically whether the
+        entry was warm or the piece re-decoded."""
+        with self._lock:
+            pending = self._pending.pop(piece, None) or []
+            start = self._start.get(piece, 0)
+        order = self._permute(piece, len(pending))
+        events, rows = [], 0
+        decode_s = sum(item[3] for item in pending)
+        for ordinal, source in enumerate(order):
+            if ordinal < start:
+                continue
+            batch_rows, fmt, frames, _ = pending[source]
+            # Total decode time rides the first emitted batch (the pull
+            # happened piece-wide; per-batch attribution has no meaning
+            # after reordering).
+            events.append(("batch", piece, gen, ordinal, batch_rows, fmt,
+                           frames, decode_s if not events else 0.0))
+            rows += batch_rows
+        with self._lock:
+            if self._state.get(piece) == _REVOKED:
+                return
+            self._rows[piece] = self._rows.get(piece, 0) + rows
+            self._rows_emitted += rows
+            self._out.extend(events)
 
     def _on_item_done(self, item):
         """Pool hook (fires on the stream thread inside the results pull):
@@ -470,6 +547,8 @@ class StreamingPieceEngine:
             except Exception:
                 logger.warning("cache fill commit failed for piece %d",
                                piece, exc_info=True)
+        if self._permute is not None:
+            self._flush_permuted(piece, gen)
         with self._lock:
             if self._state.get(piece) == _REVOKED:
                 return

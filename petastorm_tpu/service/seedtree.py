@@ -67,3 +67,29 @@ def piece_order(seed, epoch, pieces):
     if seed is None:
         return sorted(pieces)
     return sorted(pieces, key=lambda p: (piece_key(seed, epoch, p), p))
+
+
+def permutation(key, n):
+    """Deterministic permutation of ``range(n)`` derived from the seed-tree
+    node ``key``: ordinal ``i`` sorts by ``fold_in(key, ("ordinal", i))``.
+    Like :func:`piece_order` this is a pure function — every process that
+    holds the same key replays the same permutation, which is what lets a
+    cache serve one canonical batch sequence through a per-epoch order
+    without storing the order anywhere."""
+    return sorted(range(int(n)),
+                  key=lambda i: (fold_in(key, ("ordinal", i)), i))
+
+
+def batch_permutation(seed, epoch, piece, n):
+    """Serve-time order of one piece's ``n`` cached/decoded batches in one
+    epoch — the intra-piece analogue of :func:`piece_order`, keyed off the
+    piece's own seed-tree leaf so the batch order reshuffles per epoch and
+    per seed while the cached bytes stay canonical
+    (``docs/guides/caching.md#shuffle-compatible-serving``). ``seed=None``
+    is the identity (shuffling off). Deterministic in ``(seed, epoch,
+    piece, n)`` and NOTHING else: a takeover, kill-resume, or warm-vs-cold
+    re-serve of the same piece replays the same order, so per-piece batch
+    watermarks index a stable permuted stream."""
+    if seed is None:
+        return list(range(int(n)))
+    return permutation(piece_key(seed, epoch, piece), n)

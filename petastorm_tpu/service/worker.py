@@ -434,6 +434,12 @@ class BatchWorker:
         keeps at-least-once bookkeeping for that worker."""
         dynamic = bool(header.get("dynamic"))
         tagged = bool(header.get("tagged"))
+        # Serve-time shuffle: the client forwards the dispatcher's
+        # shuffle_seed so the engine can compose the per-epoch intra-piece
+        # batch permutation at serve time (cached bytes stay canonical and
+        # seed-invariant — docs/guides/caching.md#shuffle-compatible-serving).
+        shuffle_seed = header.get("shuffle_seed")
+        shuffle_seed = int(shuffle_seed) if shuffle_seed is not None else None
         starts = {int(p): int(s)
                   for p, s in (header.get("starts") or {}).items()}
         if dynamic:
@@ -461,23 +467,47 @@ class BatchWorker:
             if dynamic:
                 rows_sent = self._stream_dynamic(
                     sock, conn_reader, state, pieces, flow, credits,
-                    stream_key, epoch=header.get("epoch"))
+                    stream_key, epoch=header.get("epoch"),
+                    shuffle_seed=shuffle_seed)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
                     sock, conn_reader, state, pieces, flow, credits,
-                    stream_key, starts, epoch=header.get("epoch"))
+                    stream_key, starts, epoch=header.get("epoch"),
+                    shuffle_seed=shuffle_seed)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
-                    stream_key, epoch=header.get("epoch"))
-            elif self._batch_cache is not None:
-                rows_sent = self._stream_pieces_cached(
-                    sock, conn_reader, state, pieces, flow, credits,
-                    stream_key, epoch=header.get("epoch"))
+                    stream_key, epoch=header.get("epoch"),
+                    shuffle_seed=shuffle_seed)
             else:
-                rows_sent = self._stream_pieces_direct(
-                    sock, conn_reader, state, pieces, flow, credits,
-                    stream_key)
+                if shuffle_seed is not None:
+                    # This serving path cannot compose the serve-time
+                    # batch permutation: say why instead of silently
+                    # serving canonical order every epoch. Two distinct
+                    # causes land here — diagnose the right one.
+                    if not self._engine_supported():
+                        reason = (
+                            f"reader pool "
+                            f"{self._reader_kwargs.get('reader_pool_type')!r}"
+                            f" has no per-item completion attribution — "
+                            f"use reader_pool_type='thread'")
+                    else:
+                        reason = ("the stream is untagged and no batch "
+                                  "cache is armed, so it serves through "
+                                  "the plain whole-set reader, not the "
+                                  "streaming engine")
+                    self._log.warning(
+                        "stream requested shuffle_seed=%s but intra-piece "
+                        "batches will serve in canonical order: %s",
+                        shuffle_seed, reason)
+                if self._batch_cache is not None:
+                    rows_sent = self._stream_pieces_cached(
+                        sock, conn_reader, state, pieces, flow, credits,
+                        stream_key, epoch=header.get("epoch"))
+                else:
+                    rows_sent = self._stream_pieces_direct(
+                        sock, conn_reader, state, pieces, flow, credits,
+                        stream_key)
             if rows_sent is None:
                 return  # worker stopped mid-stream
             send_framed(sock, {"type": "end", "rows": rows_sent,
@@ -618,14 +648,21 @@ class BatchWorker:
         return self._reader_kwargs.get(
             "reader_pool_type", "thread") in ("thread", "dummy")
 
-    def _make_engine(self, epoch):
+    def _make_engine(self, epoch, shuffle_seed=None):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
         dataset enumeration, one pool spinup, instead of N. The reader is
         built lazily on the first cache MISS: a fully-warm stream
-        constructs none at all (``readers_constructed_total`` stays flat)."""
+        constructs none at all (``readers_constructed_total`` stays flat).
+
+        ``shuffle_seed`` arms serve-time intra-piece batch shuffling: the
+        permutation derives ONLY from ``seedtree.batch_permutation(seed,
+        epoch, piece, n)`` — pure, so any re-serve (takeover, retry,
+        kill-resume) replays the same permuted order against the same
+        watermarks, warm or cold."""
         from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+        from petastorm_tpu.service.seedtree import batch_permutation
 
         def build_reader():
             self._m_readers.inc()
@@ -634,6 +671,13 @@ class BatchWorker:
                                  cur_shard=0, shard_count=1,
                                  **self._reader_kwargs)
 
+        permute_fn = None
+        if shuffle_seed is not None:
+            seed, epoch_number = int(shuffle_seed), int(epoch or 0)
+
+            def permute_fn(piece, n):
+                return batch_permutation(seed, epoch_number, piece, n)
+
         cache = self._batch_cache
         return StreamingPieceEngine(
             build_reader, self._batch_size, cache=cache,
@@ -641,7 +685,8 @@ class BatchWorker:
                           if cache is not None else None),
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit))
-                if cache is not None else None))
+                if cache is not None else None),
+            permute_fn=permute_fn)
 
     def _note_engine_decode(self, collector, decode_s, bid):
         """Engine events carry decode DURATION, not absolute span times
@@ -657,7 +702,8 @@ class BatchWorker:
                                   bid=bid)
 
     def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
-                              credits, stream_key, epoch=None):
+                              credits, stream_key, epoch=None,
+                              shuffle_seed=None):
         """Cache-armed serving through the streaming engine: warm pieces
         scatter-gather straight from cache memory, cold pieces decode
         through the stream's ONE shared pipeline and fill the cache — the
@@ -668,11 +714,12 @@ class BatchWorker:
         ``piece_done`` frames)."""
         return self._stream_pieces_tagged(sock, conn_reader, state, pieces,
                                           flow, credits, stream_key, {},
-                                          epoch=epoch, tagged=False)
+                                          epoch=epoch, tagged=False,
+                                          shuffle_seed=shuffle_seed)
 
     def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
-                              tagged=True):
+                              tagged=True, shuffle_seed=None):
         """Exactly-once static serving: piece-aligned batches through the
         streaming engine, every ``batch`` frame tagged with its piece and
         absolute ``ordinal``, every finished piece announced with a
@@ -684,7 +731,7 @@ class BatchWorker:
         the same loop as the legacy untagged engine stream (no tags, no
         markers)."""
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch)
+        engine = self._make_engine(epoch, shuffle_seed)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -720,7 +767,7 @@ class BatchWorker:
                                    "rows": rows})
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
-                        credits, stream_key, epoch=None):
+                        credits, stream_key, epoch=None, shuffle_seed=None):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -737,7 +784,7 @@ class BatchWorker:
                 f"worker runs "
                 f"{self._reader_kwargs.get('reader_pool_type')!r}")
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch)
+        engine = self._make_engine(epoch, shuffle_seed)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -946,6 +993,7 @@ class BatchWorker:
             stats = self._batch_cache.stats()
             metrics["cache_hits_total"] = stats["hits"]
             metrics["cache_misses_total"] = stats["misses"]
+            metrics["cache_permuted_serves_total"] = stats["permuted_serves"]
             out["cache"] = stats
         return out
 

@@ -226,6 +226,18 @@ CACHE_CORRUPT = REGISTRY.counter(
     "file). Each one is deleted and treated as a miss — the worker "
     "degrades to a fresh decode, never serves corrupt bytes, never "
     "errors the stream")
+CACHE_PERMUTED_SERVES = REGISTRY.counter(
+    "petastorm_cache_permuted_serves_total",
+    "Cache entries served through a seed-tree serve-time permutation "
+    "(shuffle-compatible serving: canonical cached bytes, per-epoch "
+    "order), by the tier the entry was fetched from (mem/disk)",
+    labels=("tier",))
+CACHE_VERSION_EVICTED = REGISTRY.counter(
+    "petastorm_cache_version_evicted_total",
+    "Disk-tier entry files written by an older cache format version, "
+    "detected on load, deleted, and treated as a miss (fresh decode "
+    "refills them in the current format — a format bump never errors a "
+    "stream)")
 
 # -- reader / worker pools / ventilator --------------------------------------
 

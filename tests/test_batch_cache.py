@@ -223,16 +223,20 @@ def test_cache_config_builds_modes(tmp_path):
 # service worker integration
 # ---------------------------------------------------------------------------
 
-def _stream_worker(worker, pieces):
+def _stream_worker(worker, pieces, **request_extra):
     """Stream ``pieces`` from a directly-addressed worker; returns the
-    batch dicts in arrival order."""
+    batch dicts in arrival order. ``request_extra`` merges into the
+    stream request header (epoch, shuffle_seed, tagged, starts...)."""
     batches = []
     with FramedConnection.connect(worker.address, timeout=5) as conn:
-        conn.send({"type": "stream", "pieces": pieces, "epoch": 0})
+        conn.send({"type": "stream", "pieces": pieces, "epoch": 0,
+                   **request_extra})
         while True:
             header, payload = conn.recv()
             if header["type"] == "end":
                 return batches
+            if header["type"] == "piece_done":
+                continue
             assert header["type"] == "batch", header
             batches.append(payload)
 
@@ -380,6 +384,215 @@ def test_worker_diagnostics_carry_cache_stats(petastorm_dataset):
 
 
 # ---------------------------------------------------------------------------
+# shuffle-compatible serving (worker tier)
+# ---------------------------------------------------------------------------
+
+def test_worker_shuffled_warm_epoch_multiset_and_reshuffle(
+        petastorm_dataset):
+    """The shuffle-compatible serving contract at the worker: a warm
+    shuffled epoch delivers the byte-identical batch MULTISET of an
+    unshuffled run, per-epoch orders differ across epochs and seeds, and
+    the same (seed, epoch) replays identically — all at 100% hit rate
+    after the fill."""
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"reader_pool_type": "dummy"},
+                         batch_cache=cache).start()
+    plain_worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                               reader_kwargs={"reader_pool_type": "dummy"}
+                               ).start()
+    try:
+        # Piece-by-piece streams share the cached paths' piece-aligned
+        # batch boundaries (the whole-set uncached stream collates across
+        # pieces — a different batching, not a different multiset).
+        plain = _batch_digests([b for piece in (0, 1, 2)
+                                for b in _stream_worker(plain_worker,
+                                                        [piece])])
+        # Cold shuffled epoch 0: fills canonically, serves permuted.
+        epoch0 = _batch_digests(
+            _stream_worker(worker, [0, 1, 2], shuffle_seed=7))
+        assert cache.stats()["misses"] == 3
+        # Warm epochs: 100% hit rate, fresh permutation per epoch.
+        epoch1 = _batch_digests(
+            _stream_worker(worker, [0, 1, 2], epoch=1, shuffle_seed=7))
+        epoch1_again = _batch_digests(
+            _stream_worker(worker, [0, 1, 2], epoch=1, shuffle_seed=7))
+        epoch1_seed9 = _batch_digests(
+            _stream_worker(worker, [0, 1, 2], epoch=1, shuffle_seed=9))
+        stats = cache.stats()
+        assert stats["misses"] == 3 and stats["hits"] == 9
+        # Every WARM piece serve went out permuted (cold fills decode —
+        # they are misses, not cache serves).
+        assert stats["permuted_serves"] == 9
+        # Multiset identity vs the unshuffled run: bytes are canonical,
+        # only the serve order moved.
+        for shuffled in (epoch0, epoch1, epoch1_seed9):
+            assert sorted(shuffled) == sorted(plain)
+        # Orders: differ across epochs and seeds, replay per (seed, epoch).
+        assert epoch0 != epoch1
+        assert epoch1 != epoch1_seed9
+        assert epoch1 == epoch1_again
+    finally:
+        worker.stop()
+        plain_worker.stop()
+
+
+def test_worker_shuffled_cold_warm_same_order_and_watermark_seek(
+        petastorm_dataset):
+    """The permutation is a pure function of (seed, epoch, piece, n) —
+    NOT of cache state: the cold fill epoch and a warm re-serve of the
+    same (seed, epoch) emit the identical permuted order, and a
+    ``starts`` re-grant (the watermark re-serve path) resumes that order
+    at the exact permuted position, warm or cold."""
+    seed = 11
+
+    def fresh_worker():
+        return BatchWorker(petastorm_dataset.url, batch_size=4,
+                           reader_kwargs={"reader_pool_type": "dummy"},
+                           batch_cache=BatchCache(mem_budget_bytes=64 << 20)
+                           ).start()
+
+    worker = fresh_worker()
+    try:
+        cold = _batch_digests(_stream_worker(worker, [0], tagged=True,
+                                             shuffle_seed=seed))
+        warm = _batch_digests(_stream_worker(worker, [0], tagged=True,
+                                             shuffle_seed=seed))
+        assert cold == warm  # warm-vs-cold order identity (same epoch)
+        # Warm watermark seek: re-grant at start=2 serves the tail.
+        tail = _batch_digests(_stream_worker(worker, [0], tagged=True,
+                                             shuffle_seed=seed,
+                                             starts={"0": 2}))
+        assert tail == warm[2:]
+    finally:
+        worker.stop()
+    # Cold watermark seek: a FRESH worker (empty cache) re-granted at
+    # start=2 re-decodes the piece and resumes the same permuted order.
+    worker = fresh_worker()
+    try:
+        cold_tail = _batch_digests(_stream_worker(worker, [0], tagged=True,
+                                                  shuffle_seed=seed,
+                                                  starts={"0": 2}))
+        assert cold_tail == warm[2:]
+    finally:
+        worker.stop()
+
+
+def test_worker_cache_key_invariant_to_shuffle_and_epoch(petastorm_dataset):
+    """Golden invariance (the CI satellite): the worker's per-piece cache
+    key has no seed/epoch/shuffle ingredient at all — its inputs are the
+    piece's content identity and the decode-shaping config, so epoch 1's
+    fill hits on every later epoch and any other seed by construction.
+    The fingerprint API enforces the exclusion for future ingredients."""
+    worker = BatchWorker(petastorm_dataset.url, batch_size=4,
+                         reader_kwargs={"reader_pool_type": "dummy"},
+                         batch_cache=BatchCache(mem_budget_bytes=1 << 20))
+    worker.num_pieces = worker._count_pieces()
+    key = worker._piece_cache_key(0)
+    assert key == worker._piece_cache_key(0)
+    worker._batch_cache.cleanup()
+    # Enforcement: an order-dependent ingredient cannot reach a key.
+    for bad in ({"shuffle_seed": 7}, {"epoch": 1}, {"Shuffle": True},
+                {"nested": {"row_order": [1, 2]}}):
+        with pytest.raises(ValueError, match="order-dependent"):
+            batch_fingerprint("file:///ds", [0], 64, extra=bad)
+    # Golden pin: the key derivation itself (sha256 over the canonical
+    # payload) must not drift — a silent change would cold every
+    # persistent disk tier (or worse, alias old entries).
+    assert batch_fingerprint(
+        "file:///ds", [("part-0.parquet", 3)], 64, fields=["a", "b"],
+        factory="batch", extra={"filters": None, "last_batch": "keep"},
+    ) == ("03de5f50d5cd1bc291b1b1947230d38777bde51218accf0197b5380e8b41"
+          "9adc")
+
+
+# ---------------------------------------------------------------------------
+# entry format versioning
+# ---------------------------------------------------------------------------
+
+def test_old_format_entries_evicted_as_version_mismatch(tmp_path):
+    """An entry written by an older format (PR 5/8 magics) is detected,
+    counted as a VERSION eviction (not corruption), deleted, and reported
+    as a miss — the degrade path of the frame-index format change."""
+    cache = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=str(tmp_path / "tier"), spill_to_disk=True)
+    cache.put_batches("k", [_make_batch(1)])
+    path = cache._entry_path("k")
+    blob = open(path, "rb").read()
+    for old_magic in (b"PTBCACHE1\n", b"PTBCACHE2\n"):
+        with open(path, "wb") as f:
+            f.write(old_magic + blob[len(old_magic):])
+        fresh = BatchCache(mem_budget_bytes=8 << 20,
+                           cache_dir=cache.cache_dir, spill_to_disk=True)
+        assert fresh.get("k") is None
+        stats = fresh.stats()
+        assert stats["version_evicted"] == 1
+        assert stats["corrupt_entries"] == 0  # NOT the corrupt path
+        assert not os.path.exists(path)
+        fresh.cleanup()
+        # Refill for the next magic round.
+        cache.put_batches("k", [_make_batch(1)])
+    cache.cleanup()
+
+
+def test_damaged_headers_fuzz_never_error(tmp_path):
+    """Fuzz-style sweep over damaged entry files — truncations at every
+    region boundary, garbage magics, a meta format field that disagrees
+    with the magic, flipped payload bits: every case is a clean miss
+    (counted corrupt or version-evicted), never an exception, and the
+    bad file is gone afterwards."""
+    import json as json_mod
+    import struct as struct_mod
+
+    from petastorm_tpu.cache_impl.batch_cache import _LEN, _MAGIC
+
+    cache = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=str(tmp_path / "tier"), spill_to_disk=True)
+    cache.put_batches("k", [_make_batch(1), _make_batch(2)])
+    path = cache._entry_path("k")
+    good = open(path, "rb").read()
+    meta_len = _LEN.unpack_from(good, len(_MAGIC))[0]
+    payload_off = len(_MAGIC) + _LEN.size + meta_len
+
+    def mutate_meta(**overrides):
+        meta = json_mod.loads(
+            good[len(_MAGIC) + _LEN.size:payload_off].decode())
+        meta.update(overrides)
+        raw = json_mod.dumps(meta).encode()
+        return (_MAGIC + struct_mod.pack("!Q", len(raw)) + raw
+                + good[payload_off:])
+
+    cases = [
+        good[:5],                            # torn inside the magic
+        good[:len(_MAGIC) + 3],              # torn inside the length
+        good[:payload_off - 4],              # torn inside the meta json
+        good[:payload_off + 7],              # torn inside the payload
+        b"",                                 # empty file
+        b"GARBAGE!!\n" + good[10:],          # unknown magic
+        good[:-3] + b"\xff\xff\xff",         # flipped payload tail
+        mutate_meta(format=999),             # meta/magic version disagree
+        mutate_meta(crc32=12345),            # checksum mismatch
+    ]
+    for blob in cases:
+        with open(path, "wb") as f:
+            f.write(blob)
+        fresh = BatchCache(mem_budget_bytes=8 << 20,
+                           cache_dir=cache.cache_dir, spill_to_disk=True)
+        assert fresh.get("k") is None, blob[:16]
+        stats = fresh.stats()
+        assert (stats["corrupt_entries"] + stats["version_evicted"]) == 1
+        assert not os.path.exists(path), blob[:16]
+        fresh.cleanup()
+        cache.put_batches("k", [_make_batch(1), _make_batch(2)])
+    # And the pristine file still loads (the fuzz loop's refill is valid).
+    fresh = BatchCache(mem_budget_bytes=8 << 20,
+                       cache_dir=cache.cache_dir, spill_to_disk=True)
+    assert fresh.get("k") is not None
+    fresh.cleanup()
+    cache.cleanup()
+
+
+# ---------------------------------------------------------------------------
 # JAX loader integration
 # ---------------------------------------------------------------------------
 
@@ -436,26 +649,241 @@ def test_loader_partial_iteration_never_commits(petastorm_dataset):
     cache.cleanup()
 
 
-def test_loader_cache_rejects_shuffling(petastorm_dataset):
+def test_loader_cache_rejects_batch_source(petastorm_dataset):
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
-    from petastorm_tpu.reader.reader import make_reader
 
     cache = BatchCache(mem_budget_bytes=1 << 20)
     with pytest.raises(ValueError, match="decode bypass"):
         JaxDataLoader(None, 4, batch_source=lambda: iter([]),
                       stage_to_device=False, batch_cache=cache)
-    with pytest.raises(ValueError, match="shuffle"):
-        JaxDataLoader(object(), 4, shuffle_buffer_size=8,
-                      stage_to_device=False, batch_cache=cache)
+    with pytest.raises(ValueError, match="cache_resume"):
+        JaxDataLoader(object(), 4, stage_to_device=False,
+                      cache_resume={"kind": "cache_replay",
+                                    "cache_epoch": 0})
+    cache.cleanup()
+
+
+def _batch_digests(batches):
+    """Order-sensitive per-batch content digests (sorted → the multiset)."""
+    import hashlib
+
+    out = []
+    for batch in batches:
+        h = hashlib.blake2b(digest_size=16)
+        for name in sorted(batch):
+            col = np.asarray(batch[name])
+            h.update(name.encode())
+            if col.dtype == object:
+                for item in col:
+                    item = np.asarray(item)
+                    h.update(item.tobytes() if item.dtype != object
+                             else repr(item.tolist()).encode())
+            else:
+                h.update(col.tobytes())
+        out.append(h.hexdigest())
+    return out
+
+
+def test_loader_cache_shuffled_replay_permutes_per_epoch(petastorm_dataset):
+    """Shuffle-compatible loader caching: every pass serves the SAME batch
+    multiset (canonical cached bytes) in a DIFFERENT order (serve-time
+    permutation), deterministically — a loader re-built with the same
+    seed replays the same orders, a different seed orders differently."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    def run(seed, passes=3):
+        cache = BatchCache(mem_budget_bytes=64 << 20)
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy", num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, 7, last_batch="keep",
+                               stage_to_device=False, batch_cache=cache,
+                               shuffle_seed=seed)
+        with loader:
+            epochs = [_batch_digests(list(loader)) for _ in range(passes)]
+        stats = cache.stats()
+        cache.cleanup()
+        return epochs, stats
+
+    epochs_a, stats = run(7)
+    assert stats["misses"] == 1 and stats["hits"] == 2
+    assert stats["permuted_serves"] == 3  # fill pass serves permuted too
+    # Same multiset every pass, different order each pass.
+    assert all(sorted(e) == sorted(epochs_a[0]) for e in epochs_a)
+    assert len({tuple(e) for e in epochs_a}) == 3
+    # Deterministic across runs; a different seed draws different orders.
+    epochs_b, _ = run(7)
+    assert epochs_a == epochs_b
+    epochs_c, _ = run(8)
+    assert sorted(epochs_c[0]) == sorted(epochs_a[0])
+    assert epochs_c != epochs_a
+
+
+def test_loader_cache_shuffled_multiset_matches_unshuffled(
+        petastorm_dataset):
+    """The shuffled cache serves the byte-identical batch MULTISET of an
+    unshuffled run — what proves the bytes are canonical and only the
+    serve order moved (the fill ignores the shuffle knobs)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    def epoch(shuffle_seed):
+        cache = BatchCache(mem_budget_bytes=64 << 20)
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy", num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, 7, last_batch="keep",
+                               stage_to_device=False, batch_cache=cache,
+                               shuffle_seed=shuffle_seed)
+        with loader:
+            digests = _batch_digests(list(loader))
+        cache.cleanup()
+        return digests
+
+    plain, shuffled = epoch(None), epoch(7)
+    assert shuffled != plain            # order moved
+    assert sorted(shuffled) == sorted(plain)  # bytes did not
+
+
+def test_loader_cache_key_invariant_to_shuffle_config(petastorm_dataset):
+    """The loader's cache key excludes every shuffle ingredient: seed,
+    buffer, and row-group flag — epoch 1's fill hits on any other seed
+    (the cross-job "decode once" contract)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    def key(**loader_kwargs):
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy", num_epochs=1,
+                             shuffle_row_groups=False)
+        loader = JaxDataLoader(reader, 7, last_batch="keep",
+                               stage_to_device=False,
+                               batch_cache=BatchCache(
+                                   mem_budget_bytes=1 << 20),
+                               **loader_kwargs)
+        out = loader._reader_cache_key()
+        loader._batch_cache.cleanup()
+        reader.stop()
+        reader.join()
+        return out
+
+    base = key()
+    assert key(shuffle_seed=7) == base
+    assert key(shuffle_seed=8) == base
+    assert key(shuffle_buffer_size=16, shuffle_seed=3) == base
+
+
+def test_loader_cache_resume_mid_permuted_epoch(petastorm_dataset):
+    """state_dict() mid-shuffled-replay + cache_resume= resumes the pass
+    at the exact permuted position: the resumed tail equals the
+    uninterrupted pass's tail, and later passes line up too."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    def make_loader(cache, resume=None):
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy", num_epochs=1,
+                             shuffle_row_groups=False)
+        return JaxDataLoader(reader, 7, last_batch="keep",
+                             stage_to_device=False, batch_cache=cache,
+                             shuffle_seed=7, cache_resume=resume)
+
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    with make_loader(cache) as loader:
+        full = [_batch_digests(list(loader)) for _ in range(2)]
+
+    cache2 = BatchCache(mem_budget_bytes=64 << 20)
+    with make_loader(cache2) as loader:
+        iterator = iter(loader)
+        first = _batch_digests([next(iterator) for _ in range(2)])
+        state = loader.state_dict()
+        assert state["kind"] == "cache_replay"
+        assert state["batches_yielded"] == 2
+    # "Restore": a fresh loader over a fresh reader (same construction)
+    # resumes the permuted pass mid-epoch; the next pass continues the
+    # epoch sequence.
+    with make_loader(cache2, resume=state) as loader:
+        rest = _batch_digests(list(loader))
+        nxt = _batch_digests(list(loader))
+    assert first == full[0][:2]
+    assert rest == full[0][2:]
+    assert nxt == full[1]
+    cache.cleanup()
+    cache2.cleanup()
+
+
+def test_loader_cache_resume_at_pass_boundary_and_seed_mismatch(
+        petastorm_dataset):
+    """Two resume edge cases: a state_dict() taken AFTER a pass completes
+    snapshots the NEXT pass's start (resuming must not serve an empty
+    epoch or replay the finished one), and resuming under a different
+    shuffle seed raises instead of silently skipping a prefix of the
+    wrong permutation."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    def make_loader(cache, seed=7, resume=None):
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy", num_epochs=1,
+                             shuffle_row_groups=False)
+        return JaxDataLoader(reader, 7, last_batch="keep",
+                             stage_to_device=False, batch_cache=cache,
+                             shuffle_seed=seed, cache_resume=resume)
+
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    with make_loader(cache) as loader:
+        full = [_batch_digests(list(loader)) for _ in range(2)]
+
+    cache2 = BatchCache(mem_budget_bytes=64 << 20)
+    with make_loader(cache2) as loader:
+        first = _batch_digests(list(loader))  # pass 0, fully consumed
+        state = loader.state_dict()
+    assert first == full[0]
+    # The completed pass rolled forward: resume serves pass 1 in full.
+    assert state["cache_epoch"] == 1 and state["batches_yielded"] == 0
+    with make_loader(cache2, resume=state) as loader:
+        assert _batch_digests(list(loader)) == full[1]
+    # Seed mismatch: the resume position indexes seed 7's permutation.
+    with make_loader(cache2, seed=8, resume=state) as loader:
+        with pytest.raises(ValueError, match="shuffle_seed"):
+            list(loader)
+    # Unseeded shuffled reader: the fill order is not reproducible, so a
+    # cold-cache resume could seek into the wrong sequence — refused.
     reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
                          num_epochs=1, shuffle_row_groups=True)
-    loader = JaxDataLoader(reader, 7, last_batch="keep",
-                           stage_to_device=False, batch_cache=cache)
-    with pytest.raises(ValueError, match="shuffle_row_groups"):
-        with loader:
-            list(loader)
+    with pytest.raises(ValueError, match="shard_seed"):
+        JaxDataLoader(reader, 7, last_batch="keep", stage_to_device=False,
+                      batch_cache=cache2, cache_resume=state)
     reader.stop()
     reader.join()
+    cache.cleanup()
+    cache2.cleanup()
+
+
+def test_loader_cache_accepts_shuffled_reader(petastorm_dataset):
+    """A shuffle_row_groups reader is accepted: the fill order is the
+    reader's first-pass order (canonical for this cache), replays permute
+    per pass, and the row multiset always matches the dataset."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.reader.reader import make_reader
+
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=1, shuffle_row_groups=True,
+                         shard_seed=3)
+    loader = JaxDataLoader(reader, 7, last_batch="keep",
+                           stage_to_device=False, batch_cache=cache)
+    with loader:
+        epoch1 = list(loader)
+        epoch2 = list(loader)
+    ids1 = sorted(int(i) for b in epoch1 for i in np.asarray(b["id"]))
+    ids2 = sorted(int(i) for b in epoch2 for i in np.asarray(b["id"]))
+    want = sorted(int(r["id"]) for r in petastorm_dataset.rows)
+    assert ids1 == want and ids2 == want
+    assert sorted(_batch_digests(epoch1)) == sorted(_batch_digests(epoch2))
+    assert _batch_digests(epoch1) != _batch_digests(epoch2)
+    assert cache.stats()["hits"] == 1
     cache.cleanup()
 
 
@@ -485,6 +913,37 @@ def test_service_scenario_epoch_breakdown_and_warm_hit_rate(tmp_path):
     assert result["cache"]["hits"] == result["cache"]["misses"] == 4
     line = json.loads(json_out.read_text().splitlines()[0])
     assert line["epochs_detail"] == detail
+
+
+def test_service_scenario_shuffled_cache_hits_and_digest_purity(tmp_path):
+    """Tier-1 scale of the ISSUE 9 acceptance: shuffle + cache compose —
+    a 2-epoch shuffled run with the worker cache armed hits 100% on the
+    warm epoch AND delivers the byte-identical ordered stream of an
+    uncached run (the serve-time permutation is pure: cache state never
+    changes the bytes or the order), with permuted serves counted."""
+    import json
+
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    def run(cache):
+        return service_loopback_scenario(
+            rows=2000, days=4, workers=2, batch_size=128, epochs=2,
+            cache=cache, shuffle_seed=7, ordered=True,
+            json_out=str(tmp_path / f"bench-{cache}.jsonl"))
+
+    cached = run("mem")
+    detail = cached["epochs_detail"]
+    assert all(d["rows"] == 2000 for d in detail)
+    assert detail[1]["cache_hit_rate"] == 1.0
+    assert detail[1]["cache_misses"] == 0
+    assert cached["cache"]["permuted_serves"] > 0
+    assert cached["duplicates_dropped"] == 0
+    uncached = run("off")
+    assert cached["stream_digest"] == uncached["stream_digest"]
+    line = json.loads(
+        (tmp_path / "bench-mem.jsonl").read_text().splitlines()[0])
+    assert line["cache"]["permuted_serves"] == \
+        cached["cache"]["permuted_serves"]
 
 
 @pytest.mark.slow

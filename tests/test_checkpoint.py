@@ -271,3 +271,101 @@ def test_kill_then_restore_is_bit_identical_from_checkpoint_batch(
         got.update(batch)
     assert got.batches == expected.batches
     assert got.hexdigest() == expected.hexdigest()
+
+
+def test_kill_then_restore_mid_warm_shuffled_epoch_is_bit_identical(
+        tmp_path, petastorm_dataset):
+    """ISSUE 9 acceptance: the same kill-then-restore contract while the
+    stream is being served from WARM SHUFFLED cache entries — epoch 1
+    fills the workers' caches, the checkpoint lands mid-epoch-2 (100%
+    warm, serve-time permuted), and the restore reproduces the
+    uninterrupted run's tail bit-exactly: the permutation derives only
+    from (seed, epoch, piece), so the re-grant at the watermarks replays
+    the identical permuted order."""
+    import jax.numpy as jnp
+
+    from petastorm_tpu.cache_impl import BatchCache
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+
+    def fleet():
+        dispatcher = Dispatcher(port=0, mode="static", num_epochs=2,
+                                shuffle_seed=7).start()
+        workers = [
+            BatchWorker(petastorm_dataset.url,
+                        dispatcher_address=dispatcher.address,
+                        batch_size=7, reader_factory="row",
+                        worker_id=f"w{i}",
+                        batch_cache=BatchCache(mem_budget_bytes=64 << 20),
+                        reader_kwargs={"reader_pool_type": "dummy"}).start()
+            for i in range(2)]
+        return dispatcher, workers
+
+    # Uninterrupted reference run (2 epochs: fill, then warm shuffled).
+    dispatcher, workers = fleet()
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        full = []
+        with loader:
+            for batch in loader:
+                full.append({k: np.asarray(v) for k, v in batch.items()})
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+    epoch_batches = len(full) // 2
+
+    # Interrupted run: save mid-epoch-2 — by then every serve is a warm
+    # permuted cache hit — then "die" with post-save progress unsaved.
+    cut = epoch_batches + 2
+    params = {"w": jnp.arange(4.0)}
+    dispatcher, workers = fleet()
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True)
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        seen = 0
+        ckpt = None
+        with loader:
+            for batch in loader:
+                seen += 1
+                if seen == cut:
+                    ckpt = save_training_state(tmp_path / "ckpt", params,
+                                               loader=loader)
+                elif seen == cut + 1:
+                    break  # preemption
+        # The snapshot is mid-epoch-2: the warm epoch, mid-piece.
+        arrays, input_state = restore_training_state(ckpt)
+        assert input_state["epoch"] == 1
+        for worker in workers:
+            stats = worker.cache_stats()
+            assert stats["permuted_serves"] > 0
+        resumed_source = ServiceBatchSource(dispatcher.address,
+                                            ordered=True,
+                                            resume_state=input_state)
+        resumed_loader = JaxDataLoader(None, 7,
+                                       batch_source=resumed_source,
+                                       stage_to_device=False)
+        resumed = []
+        with resumed_loader:
+            for batch in resumed_loader:
+                resumed.append({k: np.asarray(v)
+                                for k, v in batch.items()})
+        assert (resumed_source.diagnostics["recovery"]
+                ["duplicates_dropped"]) == 0
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+    expected, got = StreamDigest(), StreamDigest()
+    for batch in full[cut:]:
+        expected.update(batch)
+    for batch in resumed:
+        got.update(batch)
+    assert got.batches == expected.batches
+    assert got.hexdigest() == expected.hexdigest()

@@ -17,7 +17,13 @@ from petastorm_tpu.service import (
     ServiceBatchSource,
 )
 from petastorm_tpu.service.chaos import StreamDigest
-from petastorm_tpu.service.seedtree import fold_in, piece_key, piece_order
+from petastorm_tpu.service.seedtree import (
+    batch_permutation,
+    fold_in,
+    permutation,
+    piece_key,
+    piece_order,
+)
 
 pytestmark = pytest.mark.service
 
@@ -49,6 +55,23 @@ def test_seed_tree_order_is_pinned_across_versions():
 
 def test_piece_order_none_seed_is_ascending():
     assert piece_order(None, 3, [5, 1, 4]) == [1, 4, 5]
+
+
+def test_batch_permutation_pinned_identity_and_valid():
+    """The serve-time intra-piece batch permutation is part of the
+    watermark/resume contract (ordinals number the permuted stream): pin
+    golden orders so a derivation change fails loudly, and check the
+    algebra — identity without a seed, a true permutation with one,
+    sensitive to seed/epoch/piece."""
+    assert batch_permutation(None, 0, 3, 4) == [0, 1, 2, 3]
+    assert batch_permutation(7, 0, 3, 6) == [0, 5, 3, 2, 4, 1]
+    assert batch_permutation(7, 1, 3, 6) == [2, 0, 5, 4, 1, 3]
+    assert batch_permutation(8, 0, 3, 6) == [3, 0, 1, 4, 2, 5]
+    assert batch_permutation(7, 0, 4, 6) != batch_permutation(7, 0, 3, 6)
+    for n in (0, 1, 2, 17):
+        assert sorted(batch_permutation(7, 2, 0, n)) == list(range(n))
+    # The generic node-keyed permutation (the loader's whole-epoch serve).
+    assert permutation(fold_in(7, ("cache-epoch", 1)), 5) == [4, 2, 0, 3, 1]
 
 
 def test_fold_in_is_total_over_any_int_seed():

@@ -100,9 +100,13 @@ _UNSEEDED_RNG_RE = re.compile(
     r"|\b(?:np|numpy)\.random\.(?!Generator\b|default_rng\b)\w+\s*\(")
 
 #: Directories whose code feeds the training stream: nondeterminism here
-#: changes what the model trains on.
+#: changes what the model trains on. ``cache_impl`` is included for the
+#: cache SERVE path: serve-time permutations must derive only from
+#: ``seedtree.fold_in`` — an unseeded draw there would silently decouple
+#: re-serves from their watermarks (duplicates/loss under recovery).
 _DETERMINISM_DIRS = ("petastorm_tpu/service", "petastorm_tpu/reader",
-                     "petastorm_tpu/reader_impl", "petastorm_tpu/jax_utils")
+                     "petastorm_tpu/reader_impl", "petastorm_tpu/jax_utils",
+                     "petastorm_tpu/cache_impl")
 
 #: Explicitly-documented nondeterministic spots (file → why). Empty today;
 #: an entry here must cite where the nondeterminism is documented.

@@ -831,6 +831,33 @@ def test_chaos_stream_is_byte_identical_to_unperturbed_run(kind, sharding):
 
 
 @pytest.mark.slow
+def test_chaos_worker_kill_shuffled_warm_cache_byte_deterministic():
+    """ISSUE 9 acceptance: chaos worker-kill while WARM SHUFFLED cache
+    entries are being served (shared disk tier, seed-tree shuffle,
+    ordered delivery) stays zero-loss/zero-dup AND byte-deterministic —
+    the takeover re-serves the victim's pieces from the shared tier at
+    their watermarks, replaying the identical serve-time permutation."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    def run(chaos=None):
+        return service_loopback_scenario(
+            rows=3000, days=3, workers=3, batch_size=32, sharding="static",
+            epochs=2, shuffle_seed=7, ordered=True, cache="mem+disk",
+            chaos=chaos, chaos_interval_s=4.0, chaos_max_events=2)
+
+    baseline = run()
+    assert baseline["cache"]["hits"] > 0
+    assert baseline["cache"]["permuted_serves"] > 0
+    perturbed = run(chaos="worker-kill")
+    assert perturbed["chaos_events"], "no fault landed inside the run"
+    assert perturbed["lost_rows"] == 0
+    assert perturbed["duplicate_rows"] == 0
+    assert perturbed["stream_digest"] == baseline["stream_digest"], (
+        "worker-kill under shuffled warm cache serving diverged from the "
+        "unperturbed run")
+
+
+@pytest.mark.slow
 def test_chaos_cache_corrupt_degrades_to_fresh_decode():
     """ISSUE satellite: truncated/bit-flipped disk-tier entries mid-run
     are detected on load (counted in ``cache_corrupt_entries``), deleted,
