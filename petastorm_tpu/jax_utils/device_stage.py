@@ -259,6 +259,21 @@ class DeviceStage:
                                     self._field_key(step, i), np)
                 for i, name in enumerate(sorted(raw))}
 
+    def describe(self):
+        """Static stage configuration as pure data — what the pipeline
+        graph embeds in its ``device_decode`` node (and an autotune
+        decision trail records once), so a profile snapshot names the
+        kernel it measured (``docs/guides/pipeline.md``)."""
+        return {
+            "image_fields": (list(self._image_fields)
+                             if self._image_fields is not None else None),
+            "output_dtype": self._dtype.name,
+            "normalize": self._mean is not None,
+            "crop": self._crop,
+            "flip": self._flip,
+            "seed": self._seed,
+        }
+
     def __repr__(self):
         return (f"DeviceStage(image_fields={self._image_fields}, "
                 f"output_dtype={self._dtype.name}, "

@@ -39,6 +39,7 @@ import time
 import numpy as np
 
 from petastorm_tpu.jax_utils.batcher import PAD_MASK_KEY, batch_iterator
+from petastorm_tpu.utils import resize_bounded_queue
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.metrics import (
     LOADER_BATCHES,
@@ -123,7 +124,8 @@ def make_jax_dataloader(reader, batch_size,
                         trace_path=None,
                         batch_cache=None,
                         device_stage=None,
-                        cache_resume=None):
+                        cache_resume=None,
+                        autotune=None):
     """Create a :class:`JaxDataLoader` over ``reader``.
 
     :param reader: a ``make_reader``/``make_batch_reader`` Reader (row, NGram,
@@ -202,6 +204,18 @@ def make_jax_dataloader(reader, batch_size,
         batch is delivered shard-by-shard directly onto each target device
         and decoded as one global array (``docs/guides/device_decode.md``).
         Requires ``stage_to_device=True``.
+    :param autotune: arm the profile-driven online autotuner
+        (``docs/guides/pipeline.md``): the loader's pipeline is described
+        as an explicit stage graph and a controller thread periodically
+        re-plans the runtime knobs — reader-pool ``workers_count``,
+        ``host_prefetch``/``device_prefetch``, and (with a
+        ``ServiceBatchSource``) ``credits``/``ready_queue_depth``/
+        ``transform_placement`` — within declared bounds, from measured
+        per-stage profiles. ``True`` uses defaults; a dict may set
+        ``interval_s``, ``bounds`` (``{knob: (lo, hi)}``),
+        ``hysteresis``, ``placement_hysteresis``, ``tolerance``. The
+        default ``None`` builds no graph and starts no thread — static
+        behavior is bit-for-bit unchanged.
     """
     return JaxDataLoader(reader, batch_size, last_batch=last_batch,
                          max_batches=max_batches, device=device,
@@ -215,7 +229,8 @@ def make_jax_dataloader(reader, batch_size,
                          trace_path=trace_path,
                          batch_cache=batch_cache,
                          device_stage=device_stage,
-                         cache_resume=cache_resume)
+                         cache_resume=cache_resume,
+                         autotune=autotune)
 
 
 class JaxDataLoader:
@@ -227,7 +242,7 @@ class JaxDataLoader:
                  stage_to_device=True, shuffle_buffer_size=0,
                  shuffle_seed=None, stage_in_producer=False,
                  batch_source=None, trace_path=None, batch_cache=None,
-                 device_stage=None, cache_resume=None):
+                 device_stage=None, cache_resume=None, autotune=None):
         if device is not None and sharding is not None:
             raise ValueError("device and sharding are mutually exclusive")
         if device_stage is not None and not stage_to_device:
@@ -401,6 +416,32 @@ class JaxDataLoader:
         self._iter_end = None     # set when the iteration finishes
         self._source_diag = None  # batch_source diagnostics snapshot
         self._base = self._metric_baseline()
+        # Online autotuner (docs/guides/pipeline.md): the stage graph and
+        # controller are built lazily at the first __iter__ so they bind
+        # the source/reader objects as iterated. The default (None) builds
+        # nothing — static behavior is bit-for-bit today's.
+        if autotune is None or autotune is False:
+            self._autotune_config = None
+        elif autotune is True:
+            self._autotune_config = {}
+        elif isinstance(autotune, dict):
+            allowed = {"interval_s", "bounds", "hysteresis",
+                       "placement_hysteresis", "tolerance", "probe_defer",
+                       "classify_kwargs"}
+            unknown = set(autotune) - allowed
+            if unknown:
+                # A misspelled key would otherwise silently fall back to
+                # the default — the user believes they tuned something.
+                raise ValueError(
+                    f"unknown autotune config key(s) {sorted(unknown)}; "
+                    f"allowed: {sorted(allowed)}")
+            self._autotune_config = dict(autotune)
+        else:
+            raise ValueError(
+                "autotune must be None, True, or a config dict "
+                "(interval_s/bounds/hysteresis/placement_hysteresis/"
+                "tolerance/probe_defer/classify_kwargs)")
+        self.autotune = None  # the AutotuneController once armed
 
     # -- diagnostics (derived from the metrics registry) -------------------
 
@@ -504,6 +545,70 @@ class JaxDataLoader:
                     for q in quantiles}
             for stage, child in self._m_stage.items()
         }
+
+    # -- runtime knobs (live-resizable: the autotuner's bindings) ----------
+
+    @property
+    def host_prefetch(self):
+        """Bounded host-queue depth. Settable live: the bound applies to
+        the running iteration's queue immediately (a producer blocked on
+        the old, smaller bound is woken)."""
+        return self._host_prefetch
+
+    @host_prefetch.setter
+    def host_prefetch(self, value):
+        value = int(value)
+        if value < 1:
+            raise ValueError("host_prefetch must be >= 1")
+        self._host_prefetch = value
+        queue_ = (self._host_queue if self._stage_in_producer
+                  else self._queue)
+        if queue_ is not None:
+            resize_bounded_queue(queue_, value)
+
+    @property
+    def device_prefetch(self):
+        """In-flight device batches kept ahead. Settable live: the
+        consumer's fill loop reads it per batch, so a raise deepens the
+        window on the next fill and a shrink drains down naturally."""
+        return self._device_prefetch
+
+    @device_prefetch.setter
+    def device_prefetch(self, value):
+        value = int(value)
+        if value < 1:
+            raise ValueError("device_prefetch must be >= 1")
+        self._device_prefetch = value
+        if self._stage_in_producer and self._queue is not None:
+            # In producer-staging mode the device queue's bound IS
+            # device_prefetch (HBM budget) — resize it live too.
+            resize_bounded_queue(self._queue, max(1, value))
+
+    def _ensure_autotune(self):
+        """Build (once) and start the autotune controller when armed."""
+        if self._autotune_config is None:
+            return
+        if self.autotune is None:
+            from petastorm_tpu.pipeline import (
+                AutotuneController,
+                Planner,
+                build_loader_graph,
+            )
+
+            cfg = self._autotune_config
+            graph = build_loader_graph(self, bounds=cfg.get("bounds"))
+            planner = Planner(
+                {name: knob.descriptor()
+                 for name, knob in graph.knobs.items()},
+                hysteresis=cfg.get("hysteresis", 2),
+                placement_hysteresis=cfg.get("placement_hysteresis", 4),
+                tolerance=cfg.get("tolerance", 0.05),
+                probe_defer=cfg.get("probe_defer", 3),
+                classify_kwargs=cfg.get("classify_kwargs"))
+            self.autotune = AutotuneController(
+                graph, interval_s=cfg.get("interval_s", 0.5),
+                planner=planner)
+        self.autotune.start()
 
     # -- producer ---------------------------------------------------------
 
@@ -921,6 +1026,7 @@ class JaxDataLoader:
         else:
             self._producer = None
             self._stager = None
+        self._ensure_autotune()
         return self._iterate()
 
     def _iterate(self):
@@ -1240,6 +1346,8 @@ class JaxDataLoader:
         discarded batches are gone (resume accounting stays correct: the
         at-least-once contract re-reads buffered-but-unyielded rows)."""
         self._stop.set()
+        if self.autotune is not None:
+            self.autotune.stop()
         for q in (self._queue, self._host_queue):
             if q is not None:
                 try:  # unblock a producer/stager waiting on a full queue
@@ -1252,6 +1360,8 @@ class JaxDataLoader:
             self._producer.join(timeout=30)
         if self._stager is not None:
             self._stager.join(timeout=30)
+        if self.autotune is not None:
+            self.autotune.join()
 
     def __enter__(self):
         return self

@@ -1,0 +1,20 @@
+"""Explicit pipeline stage graph + profile-driven online autotuner.
+
+``graph.py`` models the reader/worker/loader stack as stage nodes with
+placement attributes and measured costs; ``autotune.py`` plans knob
+deltas from windowed profiles (pure, unit-testable) and applies them
+live. Entry points: ``build_loader_graph(loader)`` and
+``JaxDataLoader(autotune=...)``. See ``docs/guides/pipeline.md``.
+"""
+
+from petastorm_tpu.pipeline.autotune import (  # noqa: F401
+    AutotuneController,
+    Planner,
+    classify,
+)
+from petastorm_tpu.pipeline.graph import (  # noqa: F401
+    Knob,
+    PipelineGraph,
+    StageNode,
+    build_loader_graph,
+)

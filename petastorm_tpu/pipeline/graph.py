@@ -1,0 +1,456 @@
+"""Explicit pipeline stage graph (docs/guides/pipeline.md).
+
+The reader/worker/loader stack is described as a chain of
+:class:`StageNode` s — worker side ``read → decode → transform → collate
+→ serialize → send``, client/loader side ``recv → queue →
+raw_stage/device_decode → device_put → consume`` — instead of the
+hard-wired layout the code used to imply. Each node carries:
+
+- its **measured cost**: a callable returning the cumulative
+  ``(count, seconds)`` of the stage, fed from the per-stage histograms
+  the telemetry registry already collects (``telemetry/metrics.py``) —
+  nodes whose stage has no process-local series (a remote worker's
+  stages seen from the trainer) carry ``None`` and are profiled through
+  the graph's *signals* instead (recv-stall, credit-wait);
+- a **placement** attribute — ``trainer`` (runs on the trainer host),
+  ``worker`` (runs on a service worker), or ``device`` (runs on the
+  accelerator). The batch-transform stage is the placement-FLIPPABLE
+  one: :class:`~petastorm_tpu.service.client.ServiceBatchSource` can
+  move it between trainer and worker per iteration, and the autotuner
+  does so from measured profiles.
+
+On top of the nodes, the graph binds :class:`Knob` s — the runtime
+handles the online autotuner (``pipeline/autotune.py``) adjusts within
+declared bounds: reader-pool ``workers_count``
+(:meth:`ThreadPool.resize`), loader ``host_prefetch`` /
+``device_prefetch`` (live queue/window resizes), client ``credits`` /
+``ready_queue_depth``, and ``transform_placement``.
+
+``build_loader_graph`` is the one constructor call sites use: it
+inspects a :class:`JaxDataLoader` (and its reader or
+``ServiceBatchSource``) and wires nodes, signals, and knobs to the live
+objects. ``PipelineGraph.snapshot()`` reads everything once —
+cumulative, monotonic; the autotune controller windows consecutive
+snapshots into the profiles the pure planner consumes.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Placement vocabulary: where a stage's work executes.
+PLACEMENTS = ("trainer", "worker", "device")
+
+
+class StageNode:
+    """One pipeline stage: a name, where it runs, and how it is measured.
+
+    :param name: stage name (unique within a graph side).
+    :param side: ``"worker"`` (produces batches) or ``"client"``
+        (consumes them) — the two chains of the stage graph.
+    :param placement: one of :data:`PLACEMENTS`.
+    :param metric: zero-arg callable returning cumulative
+        ``(count, seconds)`` for the stage, or ``None`` when the stage
+        has no process-local series (its cost is then inferred from
+        graph signals).
+    :param flippable: True for the stage whose placement the autotuner
+        may move (the batch transform).
+    :param description: one line for rendering/docs.
+    """
+
+    def __init__(self, name, side, placement, metric=None, flippable=False,
+                 description="", placement_fn=None):
+        if side not in ("worker", "client"):
+            raise ValueError(f"side must be worker|client, got {side!r}")
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement must be one of {PLACEMENTS}, got {placement!r}")
+        self.name = name
+        self.side = side
+        self._placement = placement
+        self.metric = metric
+        self.flippable = flippable
+        self.description = description
+        #: Flippable stages read their placement live (a
+        #: transform_placement flip must show in the next snapshot, not
+        #: the build-time value forever).
+        self._placement_fn = placement_fn
+
+    @property
+    def placement(self):
+        if self._placement_fn is not None:
+            value = self._placement_fn()
+            if value in PLACEMENTS:
+                return value
+        return self._placement
+
+    def measure(self):
+        """Cumulative ``(count, seconds)`` — ``(0, 0.0)`` when unmeasured."""
+        if self.metric is None:
+            return (0, 0.0)
+        return self.metric()
+
+    def __repr__(self):
+        return (f"StageNode({self.name!r}, side={self.side!r}, "
+                f"placement={self.placement!r})")
+
+
+class Knob:
+    """A runtime-adjustable pipeline parameter with declared bounds.
+
+    :param name: knob name (the telemetry label value).
+    :param get/set: live accessors against the owning object. ``set``
+        receives an already-clamped value.
+    :param lo/hi: inclusive bounds — the autotuner NEVER sets a value
+        outside them (clamped at apply time as well as plan time).
+    :param kind: ``"int"`` (geometric hill-climb steps) or ``"choice"``
+        (discrete flip between ``choices``).
+    :param choices: for ``kind="choice"``: the allowed values.
+    :param applies: ``"live"`` (takes effect immediately),
+        ``"next-stream"`` (new worker streams only), or
+        ``"next-iteration"`` (sampled at the next epoch/iteration
+        boundary) — surfaced in the decision trail so an audit knows
+        when a change could have mattered.
+    """
+
+    def __init__(self, name, get, set, lo=None, hi=None, kind="int",
+                 choices=None, applies="live"):
+        if kind not in ("int", "choice"):
+            raise ValueError(f"kind must be int|choice, got {kind!r}")
+        if kind == "choice" and not choices:
+            raise ValueError("choice knobs need choices")
+        if kind == "int" and (lo is None or hi is None or lo > hi):
+            raise ValueError(f"int knob {name!r} needs lo <= hi bounds")
+        self.name = name
+        self.get = get
+        self.set = set
+        self.lo = lo
+        self.hi = hi
+        self.kind = kind
+        self.choices = tuple(choices) if choices else None
+        self.applies = applies
+
+    def clamp(self, value):
+        if self.kind == "choice":
+            return value if value in self.choices else self.get()
+        return max(self.lo, min(self.hi, int(value)))
+
+    def descriptor(self):
+        """The planner-facing bound/kind description (pure data)."""
+        out = {"kind": self.kind, "applies": self.applies}
+        if self.kind == "choice":
+            out["choices"] = list(self.choices)
+        else:
+            out["lo"] = self.lo
+            out["hi"] = self.hi
+        return out
+
+
+class PipelineGraph:
+    """A pipeline described as stage nodes + edges + knobs + signals.
+
+    ``signals`` are graph-level cumulative measurements that are not a
+    single stage's histogram — wall-adjacent quantities the planner
+    classifies bottlenecks from: ``rows`` delivered, ``stall_s`` (the
+    consumer blocked on input), ``queue_wait_s`` (the producer blocked
+    on a full queue), ``recv_stall_s`` (client reader threads blocked on
+    workers), ``credit_wait_s`` (workers blocked on the client's credit
+    window — only visible when worker and trainer share a process, e.g.
+    the loopback scenario; ``None`` otherwise).
+    """
+
+    def __init__(self, nodes, edges, knobs=(), signals=None):
+        self.nodes = {}
+        for node in nodes:
+            key = (node.side, node.name)
+            if key in self.nodes:
+                raise ValueError(f"duplicate stage {key}")
+            self.nodes[key] = node
+        names = {key[1] for key in self.nodes}
+        for src, dst in edges:
+            if src not in names or dst not in names:
+                raise ValueError(f"edge ({src!r}, {dst!r}) names an "
+                                 f"unknown stage")
+        self.edges = list(edges)
+        self.knobs = {}
+        for knob in knobs:
+            if knob.name in self.knobs:
+                raise ValueError(f"duplicate knob {knob.name!r}")
+            self.knobs[knob.name] = knob
+        self._signals = dict(signals or {})
+
+    def node(self, name, side=None):
+        for (node_side, node_name), node in self.nodes.items():
+            if node_name == name and (side is None or node_side == side):
+                return node
+        raise KeyError(name)
+
+    def snapshot(self):
+        """One cumulative reading of every stage, signal, and knob.
+
+        Monotonic where the underlying series are; the autotune
+        controller subtracts consecutive snapshots to window a profile.
+        Pure data — safe to can into planner tests.
+        """
+        stages = {}
+        for (side, name), node in self.nodes.items():
+            count, seconds = node.measure()
+            stages[name] = {"side": side, "placement": node.placement,
+                            "count": int(count),
+                            "seconds": float(seconds)}
+        signals = {}
+        for name, fn in self._signals.items():
+            try:
+                signals[name] = fn()
+            except Exception:
+                signals[name] = None
+        return {
+            "stages": stages,
+            "signals": signals,
+            "knobs": {name: knob.get() for name, knob in self.knobs.items()},
+        }
+
+    def describe(self):
+        """Static structure (no measurements) — what ``pipeline.md``
+        documents and the decision trail embeds once."""
+        return {
+            "stages": [{"name": node.name, "side": node.side,
+                        "placement": node.placement,
+                        "flippable": node.flippable,
+                        "description": node.description}
+                       for node in self.nodes.values()],
+            "edges": list(self.edges),
+            "knobs": {name: knob.descriptor()
+                      for name, knob in self.knobs.items()},
+        }
+
+
+def _histogram_metric(child):
+    """Adapt a registry histogram child to the node metric contract."""
+    return lambda: (child.count, child.sum)
+
+
+def _default_workers_hi():
+    return max(4, 2 * (os.cpu_count() or 1))
+
+
+def build_loader_graph(loader, bounds=None):
+    """Describe a live :class:`JaxDataLoader`'s pipeline as a graph.
+
+    Wires the client-side chain to the loader's own stage histograms,
+    adds the worker-side chain (measured when a local reader runs
+    in-process; declared-but-unmeasured for remote service workers,
+    whose cost the planner reads through recv-stall/credit-wait
+    signals), and binds every runtime-resizable knob the attached
+    objects support:
+
+    - ``workers_count`` — when ``loader.reader`` has a resizable pool
+      (thread pools; process pools are not runtime-resizable);
+    - ``host_prefetch`` / ``device_prefetch`` — always;
+    - ``credits`` / ``ready_queue_depth`` / ``transform_placement`` —
+      when the batch source is a ``ServiceBatchSource`` (placement only
+      when a transform callable is armed).
+
+    ``bounds`` overrides per-knob ``(lo, hi)`` tuples.
+    """
+    bounds = dict(bounds or {})
+
+    def bound(name, lo, hi):
+        return bounds.get(name, (lo, hi))
+
+    stage = loader._m_stage
+    source = loader._batch_source
+    reader = loader.reader
+    nodes = []
+    edges = []
+    remote = source is not None
+
+    # -- worker side: read → decode → transform → collate → serialize → send
+    worker_placement = "worker" if remote else "trainer"
+    # On the local path, read+decode+transform+collate are all inside the
+    # producer's reader pull — one measured stage ("decode" histogram); the
+    # finer-grained split exists on the graph (the model is the contract)
+    # with the measured series attached to the stage that times the whole
+    # pull. Worker-side series for the service path are per-worker and
+    # remote; they stay unmeasured here and profile through signals.
+    nodes.append(StageNode(
+        "read", "worker", worker_placement,
+        description="Parquet row-group read"))
+    nodes.append(StageNode(
+        "decode", "worker", worker_placement,
+        metric=(_histogram_metric(stage["decode"]) if not remote else None),
+        description=("reader pull: codec decode (+read/transform/collate "
+                     "on the local path — one measured stage)")))
+    nodes.append(StageNode(
+        "transform", "worker",
+        worker_placement if _transform_remote(source) else "trainer",
+        flippable=_has_transform(source),
+        metric=(_transform_metric if _has_transform(source) else None),
+        placement_fn=(
+            (lambda: "trainer"
+             if not _transform_remote(source) else worker_placement)
+            if _has_transform(source) else None),
+        description="placement-flippable collated-batch transform"))
+    nodes.append(StageNode(
+        "collate", "worker", worker_placement,
+        description="rows → fixed-size numpy batch"))
+    nodes.append(StageNode(
+        "serialize", "worker", worker_placement,
+        description="batch → wire frames (service path only)"))
+    nodes.append(StageNode(
+        "send", "worker", worker_placement,
+        description="framed socket send (service path only)"))
+    edges += [("read", "decode"), ("decode", "transform"),
+              ("transform", "collate"), ("collate", "serialize"),
+              ("serialize", "send")]
+
+    # -- client side: recv → queue → raw_stage/device_decode → device_put
+    #    → consume
+    nodes.append(StageNode(
+        "recv", "client", "trainer",
+        metric=_histogram_metric(stage["wait"]),
+        description="consumer blocked on the next host batch (the stall)"))
+    nodes.append(StageNode(
+        "queue", "client", "trainer",
+        metric=_histogram_metric(stage["queue_wait"]),
+        description="producer blocked on a full host queue"))
+    nodes.append(StageNode(
+        "raw_stage", "client", "trainer",
+        metric=_histogram_metric(stage["raw_stage"]),
+        description="raw uint8 bytes batch staged to device"))
+    device_stage = getattr(loader, "_device_stage", None)
+    nodes.append(StageNode(
+        "device_decode", "client", "device",
+        metric=_histogram_metric(stage["device_decode"]),
+        description=("fused on-device decode/augment kernel dispatch"
+                     + (f" {device_stage.describe()}"
+                        if device_stage is not None else ""))))
+    nodes.append(StageNode(
+        "device_put", "client", "trainer",
+        metric=_histogram_metric(stage["device_put"]),
+        description="H2D dispatch of ordinary tensors"))
+    nodes.append(StageNode(
+        "consume", "client", "device",
+        metric=_histogram_metric(stage["consumer"]),
+        description="training step between yields"))
+    edges += [("send", "recv"), ("recv", "queue"), ("queue", "raw_stage"),
+              ("queue", "device_put"), ("raw_stage", "device_decode"),
+              ("device_decode", "consume"), ("device_put", "consume")]
+
+    knobs = []
+    pool = getattr(reader, "_workers_pool", None) if reader is not None \
+        else None
+    if pool is not None and hasattr(pool, "resize") \
+            and hasattr(reader, "resize_workers"):
+        lo, hi = bound("workers_count", 1, _default_workers_hi())
+        knobs.append(Knob(
+            "workers_count",
+            get=lambda: pool.workers_count,
+            set=reader.resize_workers, lo=lo, hi=hi))
+    if not remote or loader._stage_in_producer:
+        # A prefetched batch_source is consumed DIRECTLY (no producer
+        # thread, no host queue — the source's ready-queue/credits are
+        # the buffering): binding host_prefetch there would hand the
+        # planner a dead knob that burns probe rounds and journals
+        # fictitious decisions.
+        lo, hi = bound("host_prefetch", 1, 64)
+        knobs.append(Knob(
+            "host_prefetch",
+            get=lambda: loader.host_prefetch,
+            set=lambda v: setattr(loader, "host_prefetch", v),
+            lo=lo, hi=hi))
+    lo, hi = bound("device_prefetch", 1, 16)
+    knobs.append(Knob(
+        "device_prefetch",
+        get=lambda: loader.device_prefetch,
+        set=lambda v: setattr(loader, "device_prefetch", v), lo=lo, hi=hi))
+    if remote and hasattr(source, "set_credits") \
+            and getattr(source, "credits", None) is not None:
+        lo, hi = bound("credits", 1, 64)
+        knobs.append(Knob(
+            "credits", get=lambda: source.credits,
+            set=source.set_credits, lo=lo, hi=hi, applies="next-stream"))
+    if remote and hasattr(source, "set_ready_queue_depth") \
+            and source._ready_queue_depth is not None:
+        # Bound only when the user PINNED an explicit depth. A derived
+        # depth (the default) already tracks the credits knob —
+        # set_credits re-derives the live queue bound — and an autotuner
+        # probe here would silently pin it, disabling derived sizing
+        # forever (a revert restores the pre-probe NUMBER, not
+        # derived-ness).
+        lo, hi = bound("ready_queue_depth", 2, 256)
+        knobs.append(Knob(
+            "ready_queue_depth",
+            get=lambda: source.ready_queue_depth,
+            set=source.set_ready_queue_depth, lo=lo, hi=hi))
+    if _has_transform(source):
+        knobs.append(Knob(
+            "transform_placement",
+            get=lambda: source.transform_placement,
+            set=source.set_transform_placement,
+            kind="choice", choices=("remote", "local"),
+            applies="next-iteration"))
+
+    signals = {
+        "rows": lambda: loader._m_rows.value,
+        "stall_s": lambda: stage["wait"].sum,
+        "queue_wait_s": lambda: stage["queue_wait"].sum,
+        "decode_s": lambda: stage["decode"].sum,
+        "dispatch_s": lambda: (stage["raw_stage"].sum
+                               + stage["device_decode"].sum
+                               + stage["device_put"].sum),
+        "consumer_s": lambda: stage["consumer"].sum,
+    }
+    if remote:
+        signals["recv_stall_s"] = lambda: _source_recv_stall(source)
+        signals["credit_wait_s"] = _process_credit_wait
+    return PipelineGraph(nodes, edges, knobs=knobs, signals=signals)
+
+
+def _has_transform(source):
+    return (source is not None
+            and getattr(source, "transform", None) is not None)
+
+
+def _transform_remote(source):
+    return (getattr(source, "transform_placement", "remote") == "remote"
+            if source is not None else True)
+
+
+def _source_recv_stall(source):
+    """Total seconds the client's stream-reader threads spent blocked
+    waiting on their workers (per-worker stall summed)."""
+    diag = getattr(source, "diagnostics", None)
+    if not isinstance(diag, dict):
+        return 0.0
+    return float(sum(w.get("stall_s", 0.0)
+                     for w in diag.get("per_worker", {}).values()))
+
+
+def _transform_metric():
+    """Cumulative (count, seconds) of the batch-transform stage across
+    BOTH placements: the client-side histogram always lives in this
+    process; worker-side series join in-process deployments (loopback),
+    so the node's cost follows the stage wherever it runs."""
+    from petastorm_tpu.telemetry.metrics import (
+        CLIENT_TRANSFORM_SECONDS,
+        WORKER_TRANSFORM_SECONDS,
+    )
+
+    client = CLIENT_TRANSFORM_SECONDS.labels()
+    count, total = client.count, client.sum
+    for child in WORKER_TRANSFORM_SECONDS.children().values():
+        count += child.count
+        total += child.sum
+    return count, total
+
+
+def _process_credit_wait():
+    """Cumulative worker credit-wait seconds visible in THIS process's
+    registry — populated in loopback/in-process deployments (the bench
+    scenario, tests); a remote fleet's credit waits are not visible here
+    and the planner falls back to client-side signals alone."""
+    from petastorm_tpu.telemetry.metrics import WORKER_CREDIT_WAIT
+
+    return float(sum(child.value
+                     for child in WORKER_CREDIT_WAIT.children().values()))

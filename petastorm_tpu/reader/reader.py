@@ -687,6 +687,19 @@ class Reader:
             snapshot.update(ventilator.diagnostics)
         return snapshot
 
+    def resize_workers(self, workers_count):
+        """Live-resize the decode pool's parallelism (thread pools only —
+        the pipeline autotuner's ``workers_count`` knob,
+        ``docs/guides/pipeline.md``). Raises for pools without runtime
+        resize (process pools fork at start)."""
+        pool = self._workers_pool
+        resize = getattr(pool, "resize", None)
+        if resize is None:
+            raise NotImplementedError(
+                f"{type(pool).__name__} cannot resize at runtime — use "
+                f"reader_pool_type='thread'")
+        resize(workers_count)
+
     # --- iterator protocol ----------------------------------------------
 
     @property

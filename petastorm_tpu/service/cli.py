@@ -117,6 +117,14 @@ def _build_parser():
     work.add_argument("--cache-disk-mb", type=float, default=None,
                       help="optional disk-tier budget (LRU eviction of "
                            "spill files beyond it); default unlimited")
+    work.add_argument("--batch-transform", default=None,
+                      help="module:attr of the placement-flippable "
+                           "collated-batch transform ({field: ndarray} -> "
+                           "{field: ndarray}), applied before "
+                           "serialization unless the stream asks for "
+                           "local placement — arm the SAME function on "
+                           "ServiceBatchSource(transform=...) "
+                           "(docs/guides/pipeline.md#transform-placement)")
     for role in (disp, work):
         role.add_argument("--metrics-port", type=int, default=None,
                           help="serve this process's metrics registry in "
@@ -133,6 +141,11 @@ def _build_parser():
                       help="refresh continuously until interrupted")
     stat.add_argument("--interval", type=float, default=2.0,
                       help="seconds between polls (the rate window)")
+    stat.add_argument("--trainer-metrics", default=None,
+                      help="a trainer's --metrics-port endpoint "
+                           "(host:port): renders the pipeline autotuner's "
+                           "knob gauges and decision counters under the "
+                           "fleet table (docs/guides/pipeline.md)")
     return parser
 
 
@@ -162,8 +175,30 @@ def build_service_node(args):
                                 cache_dir=getattr(args, "cache_dir", None),
                                 disk_mb=getattr(args, "cache_disk_mb",
                                                 None)).build(),
+        batch_transform=resolve_batch_transform(
+            getattr(args, "batch_transform", None)),
         reader_kwargs={"workers_count": args.workers_count,
                        "reader_pool_type": args.reader_pool_type})
+
+
+def resolve_batch_transform(spec):
+    """``module:attr`` → the callable (dotted attrs allowed). The worker
+    CLI's way to arm the placement-flippable batch transform — the
+    trainer arms the same function object on its ``ServiceBatchSource``."""
+    if spec is None:
+        return None
+    module_name, sep, attr = str(spec).partition(":")
+    if not sep or not attr:
+        raise ValueError(
+            f"--batch-transform must be module:attr, got {spec!r}")
+    import importlib
+
+    target = importlib.import_module(module_name)
+    for part in attr.split("."):
+        target = getattr(target, part)
+    if not callable(target):
+        raise ValueError(f"--batch-transform {spec!r} is not callable")
+    return target
 
 
 # -- fleet status -----------------------------------------------------------
@@ -293,13 +328,73 @@ def render_fleet_status(prev, cur):
     return "\n".join(lines)
 
 
+def collect_autotune_sample(metrics_address, timeout=3.0):
+    """One ``/metrics.json`` poll of a trainer's metrics endpoint, reduced
+    to the autotuner families: knob value gauges and cumulative decision
+    counts. ``None`` when the endpoint is unreachable (the trainer may
+    simply not be up yet — the watch keeps rendering the fleet)."""
+    import urllib.error
+    import urllib.request
+
+    host, port = metrics_address
+    url = f"http://{host}:{port}/metrics.json"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as response:
+            snapshot = json.loads(response.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    knobs = {}
+    for series in snapshot.get("petastorm_autotune_knob_value",
+                               {}).get("series", []):
+        labels = series["labels"]
+        knobs[(labels.get("controller", "0"),
+               labels.get("knob", "?"))] = series.get("value")
+    decisions = {}
+    for series in snapshot.get("petastorm_autotune_decisions_total",
+                               {}).get("series", []):
+        labels = series["labels"]
+        key = (labels.get("knob", "?"), labels.get("direction", "?"))
+        decisions[key] = series.get("value", 0.0)
+    return {"knobs": knobs, "decisions": decisions}
+
+
+def render_autotune_status(prev, cur):
+    """The autotuner line(s) under the fleet table: knob values in force
+    plus decisions applied in this window (cumulative in parentheses).
+    Pure — testable without sockets."""
+    if cur is None:
+        return "autotune: trainer metrics unreachable"
+    if not cur["knobs"]:
+        return "autotune: no autotuned loader registered"
+    controllers = {controller for controller, _ in cur["knobs"]}
+    knobs = " ".join(
+        (f"{name}={value:g}" if len(controllers) == 1
+         else f"{controller}/{name}={value:g}")
+        for (controller, name), value in sorted(cur["knobs"].items()))
+    moved = []
+    prev_decisions = (prev or {}).get("decisions", {})
+    for (knob, direction), total in sorted(cur["decisions"].items()):
+        delta = total - prev_decisions.get((knob, direction), 0.0)
+        if delta > 0 or total > 0:
+            mark = f"{knob}:{direction}={int(delta)}({int(total)})"
+            moved.append(mark)
+    lines = [f"autotune knobs: {knobs}"]
+    if moved:
+        lines.append("autotune decisions (window(total)): "
+                     + " ".join(moved))
+    return "\n".join(lines)
+
+
 def run_status(address, watch=False, interval_s=2.0, out=None,
-               max_refreshes=None, stop_event=None):
+               max_refreshes=None, stop_event=None, trainer_metrics=None):
     """The ``status`` subcommand: poll, render, and (with ``watch``)
     refresh until interrupted. ``max_refreshes``/``stop_event`` bound the
-    loop for tests."""
+    loop for tests; ``trainer_metrics`` adds the autotuner section from a
+    trainer's metrics endpoint."""
     out = out if out is not None else sys.stdout
     prev = collect_fleet_sample(address)
+    prev_tune = (collect_autotune_sample(trainer_metrics)
+                 if trainer_metrics is not None else None)
     refreshes = 0
     while True:
         if stop_event is not None and stop_event.is_set():
@@ -320,6 +415,10 @@ def run_status(address, watch=False, interval_s=2.0, out=None,
         if watch:
             out.write("\x1b[2J\x1b[H")  # clear + home, top-style refresh
         out.write(render_fleet_status(prev, cur) + "\n")
+        if trainer_metrics is not None:
+            cur_tune = collect_autotune_sample(trainer_metrics)
+            out.write(render_autotune_status(prev_tune, cur_tune) + "\n")
+            prev_tune = cur_tune
         out.flush()
         prev = cur
         refreshes += 1
@@ -339,7 +438,10 @@ def main(argv=None, run_seconds=None, stop_event=None):
         try:
             return run_status(parse_address(args.dispatcher),
                               watch=args.watch, interval_s=args.interval,
-                              stop_event=stop_event)
+                              stop_event=stop_event,
+                              trainer_metrics=(
+                                  parse_address(args.trainer_metrics)
+                                  if args.trainer_metrics else None))
         except KeyboardInterrupt:
             return 0
     node = build_service_node(args)

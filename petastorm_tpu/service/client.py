@@ -54,9 +54,10 @@ from petastorm_tpu.telemetry.metrics import (
     CLIENT_READY_QUEUE_DEPTH,
     CLIENT_RECOVERY_EVENTS,
     CLIENT_RECV_STALL,
+    CLIENT_TRANSFORM_SECONDS,
     CLIENT_WATERMARK_LAG,
 )
-from petastorm_tpu.utils import retry_with_backoff
+from petastorm_tpu.utils import resize_bounded_queue, retry_with_backoff
 
 logger = service_logger(__name__)
 
@@ -90,7 +91,7 @@ class _WorkerStream:
 
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
-                 starts=None, shuffle_seed=None):
+                 starts=None, shuffle_seed=None, transform_placement=None):
         self.worker_id = worker_id
         self.address = tuple(address)
         self.pieces = list(pieces)
@@ -98,6 +99,11 @@ class _WorkerStream:
         self.credits = credits
         self.tagged = tagged
         self.starts = dict(starts or {})
+        #: Where the placement-flippable batch transform runs for THIS
+        #: stream ("remote"/"local"; None = no transform armed). Carried
+        #: on the stream request: "local" tells the worker to skip its
+        #: batch_transform — the client applies it instead.
+        self.transform_placement = transform_placement
         #: The dispatcher's shuffle seed, forwarded on the stream request
         #: so the worker serves each piece's batches through the epoch's
         #: seed-tree permutation (shuffle-compatible caching: order is
@@ -146,6 +152,8 @@ class _WorkerStream:
                        "epoch": self.epoch}
             if self.shuffle_seed is not None:
                 request["shuffle_seed"] = int(self.shuffle_seed)
+            if self.transform_placement is not None:
+                request["transform_placement"] = self.transform_placement
             if self.tagged:
                 request["tagged"] = True
                 if self.starts:
@@ -451,7 +459,7 @@ class _DynamicStream:
     takeover path when the stream reports broken."""
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
-                 credits=None, shuffle_seed=None):
+                 credits=None, shuffle_seed=None, transform_placement=None):
         self.worker_id = worker_id
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
@@ -460,6 +468,7 @@ class _DynamicStream:
         self.epoch = epoch
         self.credits = credits
         self.shuffle_seed = shuffle_seed  # see _WorkerStream.shuffle_seed
+        self.transform_placement = transform_placement  # see _WorkerStream
         self._connect_timeout = connect_timeout
         self._conn = None
         self._closed = False
@@ -483,6 +492,8 @@ class _DynamicStream:
                        "epoch": self.epoch}
             if self.shuffle_seed is not None:
                 request["shuffle_seed"] = int(self.shuffle_seed)
+            if self.transform_placement is not None:
+                request["transform_placement"] = self.transform_placement
             if self.credits is not None:
                 request["credits"] = self.credits
             try:
@@ -643,9 +654,16 @@ class ServiceBatchSource:
         consume-ack round trip, shallow enough that a pause stops pulling
         within ~`credits` batches per worker.
     :param ready_queue_depth: bound of the shared ready-queue the
-        multiplexed drain yields from (static mode). ``None`` sizes it to
-        ``max(4, 2 * active streams)`` — enough that every stream can have
-        a batch ready plus one in the consumer's hand.
+        multiplexed drain yields from. ``None`` derives it from the
+        flow-control window: ``max(4, min(streams × credits, 256))`` —
+        the queue can absorb every un-acked batch the credit windows
+        allow in flight, so a full window never wedges reader threads
+        mid-handoff (overrun) and the consumer never drains the queue dry
+        while credits still permit deliveries (starvation). Without
+        credits (``credits=None``, unbounded push) the legacy
+        ``max(4, 2 × streams)`` sizing applies
+        (``docs/guides/service.md#flow-control``). Settable live via
+        :meth:`set_ready_queue_depth` (the autotuner's binding).
     :param heartbeat_interval_s: poll the dispatcher's ``client_heartbeat``
         this often while a static drain is live. The heartbeat carries the
         dispatcher's fencing epoch: when it moves past the epoch this
@@ -679,6 +697,21 @@ class ServiceBatchSource:
         head-of-line stall can grow it past that — see
         ``_OrderedSequencer``) and re-introduces head-of-line waiting
         on the piece whose turn it is. Static and dynamic modes only.
+    :param transform: the placement-flippable collated-batch transform —
+        a ``{field: ndarray} -> {field: ndarray}`` callable, the SAME
+        computation the service's workers were configured with
+        (``BatchWorker(batch_transform=...)``). Where it runs is decided
+        by ``transform_placement``; the callable must be armed on both
+        sides for the flip to be meaningful
+        (``docs/guides/pipeline.md#transform-placement``).
+    :param transform_placement: ``"remote"`` (default — workers apply
+        their ``batch_transform`` before serializing, today's layout) or
+        ``"local"`` (stream requests tell workers to skip it and this
+        client applies ``transform`` to each received batch on the
+        trainer host). Sampled once per iteration: a
+        :meth:`set_transform_placement` flip (the autotuner's binding)
+        takes effect at the next epoch/iteration boundary, never
+        mid-stream.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
@@ -686,12 +719,21 @@ class ServiceBatchSource:
                  backoff_base=0.05, backoff_max=2.0, resume_state=None,
                  credits=8, ready_queue_depth=None, heartbeat_interval_s=2.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
-                 dynamic_sync_interval_s=0.25, ordered=False):
+                 dynamic_sync_interval_s=0.25, ordered=False,
+                 transform=None, transform_placement="remote"):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if ready_queue_depth is not None and ready_queue_depth < 1:
             raise ValueError(
                 "ready_queue_depth must be a positive integer or None")
+        if transform_placement not in ("remote", "local"):
+            raise ValueError(
+                "transform_placement must be 'remote' or 'local'")
+        if transform is None and transform_placement == "local":
+            raise ValueError(
+                "transform_placement='local' needs the transform callable: "
+                "workers are told to skip their batch_transform, so "
+                "without one here the stage would silently not run at all")
         self._dispatcher_address = tuple(dispatcher_address)
         self.client_index = client_index
         self.num_clients = num_clients
@@ -703,6 +745,15 @@ class ServiceBatchSource:
         self._backoff_max = backoff_max
         self._credits = credits
         self._ready_queue_depth = ready_queue_depth
+        self.transform = transform
+        self._transform_placement = transform_placement
+        # Placement in force for the CURRENT iteration (frozen at
+        # __call__): all of an iteration's streams — including takeover /
+        # resync relaunches — carry the same placement, so the client-side
+        # applier can wrap the whole iterator instead of tracking
+        # placement per batch.
+        self._iter_transform_placement = (transform_placement
+                                          if transform is not None else None)
         self._heartbeat_interval_s = heartbeat_interval_s
         self._rpc_deadline_s = rpc_deadline_s
         self._max_frame_bytes = max_frame_bytes
@@ -710,6 +761,7 @@ class ServiceBatchSource:
         self._ordered = bool(ordered)
         self._shuffle_seed = None     # dispatcher config, read at __call__
         self._ready_queue = None      # live queue while a drain is active
+        self._live_stream_count = 1   # streams feeding the live queue
         self._per_worker = {}         # worker_id -> delivery counters
         self._lock = threading.Lock()
         self._log = logger.bind(client_id=self.client_id)
@@ -820,6 +872,99 @@ class ServiceBatchSource:
                     int(reply["fencing_epoch"]))
         return reply
 
+    # -- runtime knobs (live-adjustable: the autotuner's bindings) ---------
+
+    @property
+    def credits(self):
+        """The per-worker flow-control window in force."""
+        return self._credits
+
+    def set_credits(self, credits):
+        """Adjust the credit window. Applies to streams opened AFTER the
+        call (epoch starts, takeover/resync relaunches) — a live stream's
+        window was negotiated on its request and keeps its size. When
+        ``ready_queue_depth`` was left derived (``None``), the live
+        ready-queue's bound is re-derived from the new window too."""
+        if credits is not None and credits < 1:
+            raise ValueError("credits must be a positive integer or None")
+        with self._lock:
+            self._credits = credits
+            ready = self._ready_queue
+            streams = self._live_stream_count
+        if self._ready_queue_depth is None and ready is not None:
+            resize_bounded_queue(ready, self._derived_ready_depth(streams))
+
+    @property
+    def ready_queue_depth(self):
+        """The configured ready-queue bound (``None`` = derived)."""
+        if self._ready_queue_depth is not None:
+            return self._ready_queue_depth
+        with self._lock:
+            ready = self._ready_queue
+        return (ready.maxsize if ready is not None
+                else self._derived_ready_depth(1))
+
+    def set_ready_queue_depth(self, depth):
+        """Pin (and live-resize) the shared ready-queue bound: a raise
+        wakes reader threads blocked on the old bound immediately; a
+        shrink lets the queue drain down to the new bound."""
+        if depth is not None and depth < 1:
+            raise ValueError(
+                "ready_queue_depth must be a positive integer or None")
+        with self._lock:
+            self._ready_queue_depth = depth
+            ready = self._ready_queue
+            streams = self._live_stream_count
+        if ready is not None:
+            resize_bounded_queue(ready, depth if depth is not None
+                          else self._derived_ready_depth(streams))
+
+    @property
+    def transform_placement(self):
+        """Where the batch transform will run from the NEXT iteration on."""
+        return self._transform_placement
+
+    def set_transform_placement(self, placement):
+        """Flip the batch-transform stage between the workers ("remote")
+        and this trainer host ("local"). Takes effect at the next
+        iteration/epoch boundary — the placement each iteration runs
+        under is frozen when it starts, so every one of its streams (and
+        the client-side applier) agree."""
+        if placement not in ("remote", "local"):
+            raise ValueError(
+                "transform_placement must be 'remote' or 'local'")
+        if self.transform is None:
+            raise ValueError(
+                "no transform callable armed — construct the source with "
+                "transform= to make placement meaningful")
+        self._transform_placement = placement
+
+    def _derived_ready_depth(self, streams):
+        """The default ready-queue bound when none was pinned: wide
+        enough for every credit the flow-control windows can have in
+        flight (capped — a huge fleet should pin explicitly), falling
+        back to the legacy 2-per-stream sizing when credits are off."""
+        streams = max(1, int(streams))
+        if self._credits is not None:
+            return max(4, min(streams * self._credits, 256))
+        return max(4, 2 * streams)
+
+    def _apply_transform_local(self, inner):
+        """Trainer-local execution of the batch-transform stage: applied
+        to each batch as it leaves the drain, timed into
+        ``petastorm_service_client_transform_seconds``."""
+        transform = self.transform
+        try:
+            for batch in inner:
+                t0 = time.perf_counter()
+                batch = transform(batch)
+                CLIENT_TRANSFORM_SECONDS.observe(time.perf_counter() - t0)
+                yield batch
+        finally:
+            close = getattr(inner, "close", None)
+            if callable(close):
+                close()
+
     # -- the batch_source contract ----------------------------------------
 
     def __call__(self):
@@ -849,13 +994,26 @@ class ServiceBatchSource:
                 "ordered delivery requires static or dynamic sharding: "
                 "fcfs hands splits out first-come-first-served, so no "
                 "canonical piece order exists to sequence against")
+        # Freeze the transform placement for this whole iteration: every
+        # stream it opens (takeover/resync relaunches included) carries
+        # the same placement, and the local applier wraps the iterator
+        # exactly when the workers were told to skip the stage.
+        self._iter_transform_placement = (self._transform_placement
+                                          if self.transform is not None
+                                          else None)
+        local = self._iter_transform_placement == "local"
         if info["mode"] == "static":
             # The multiplexed drain prefetches into its ready-queue behind
             # reader threads — consumers may pull it directly.
-            return _SourceIterator(self._iter_static(info), prefetched=True)
+            it = self._iter_static(info)
+            if local:
+                it = self._apply_transform_local(it)
+            return _SourceIterator(it, prefetched=True)
         if info["mode"] == "dynamic":
-            return _SourceIterator(self._iter_dynamic(info),
-                                   prefetched=True)
+            it = self._iter_dynamic(info)
+            if local:
+                it = self._apply_transform_local(it)
+            return _SourceIterator(it, prefetched=True)
         if self._resumed:
             raise ValueError(
                 "resume_state was supplied but the dispatcher is in fcfs "
@@ -865,7 +1023,10 @@ class ServiceBatchSource:
                 "Run the dispatcher in static or dynamic mode to resume")
         # fcfs consumes streams sequentially (no reader threads): a
         # prefetching consumer should keep its own producer thread.
-        return _SourceIterator(self._iter_fcfs(info), prefetched=False)
+        it = self._iter_fcfs(info)
+        if local:
+            it = self._apply_transform_local(it)
+        return _SourceIterator(it, prefetched=False)
 
     # -- static mode -------------------------------------------------------
 
@@ -945,7 +1106,8 @@ class ServiceBatchSource:
                         self._connect_timeout, credits=self._credits,
                         tagged=True,
                         starts={p: starts.get(p, 0) for p in pending},
-                        shuffle_seed=self._shuffle_seed)
+                        shuffle_seed=self._shuffle_seed,
+                        transform_placement=self._iter_transform_placement)
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1005,7 +1167,7 @@ class ServiceBatchSource:
             return
         depth = (self._ready_queue_depth
                  if self._ready_queue_depth is not None
-                 else max(4, 2 * len(streams)))
+                 else self._derived_ready_depth(len(streams)))
         ready = queue.Queue(maxsize=depth)
         stop = threading.Event()
         readers = []
@@ -1013,9 +1175,14 @@ class ServiceBatchSource:
         sid_counter = itertools.count(max(streams) + 1)
         with self._lock:
             self._ready_queue = ready
+            self._live_stream_count = len(streams)
 
         def launch(sid, stream):
             streams[sid] = stream
+            with self._lock:
+                # Keep the live count honest across resync relaunches:
+                # set_credits re-derives the queue bound from it.
+                self._live_stream_count = len(streams)
             reader = _StreamReader(sid, stream, ready, stop,
                                    self._note_stream_recv)
             readers.append(reader)
@@ -1122,7 +1289,8 @@ class ServiceBatchSource:
                     epoch, self._connect_timeout,
                     credits=self._credits, tagged=True,
                     starts={p: marks.get(p, 0) for p in pieces},
-                    shuffle_seed=self._shuffle_seed))
+                    shuffle_seed=self._shuffle_seed,
+                    transform_placement=self._iter_transform_placement))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1431,7 +1599,7 @@ class ServiceBatchSource:
             if self._ordered else None)
         depth = (self._ready_queue_depth
                  if self._ready_queue_depth is not None
-                 else max(4, 2 * max(1, len(initial_grants))))
+                 else self._derived_ready_depth(len(initial_grants)))
         ready = queue.Queue(maxsize=depth)
         stop = threading.Event()
         sync_stop = threading.Event()
@@ -1448,15 +1616,20 @@ class ServiceBatchSource:
         req_counter = itertools.count()
         with self._lock:
             self._ready_queue = ready
+            self._live_stream_count = max(1, len(initial_grants))
 
         def launch(wid, pairs):
             sid = next(sid_counter)
-            stream = _DynamicStream(wid, addresses[wid], pairs, epoch,
-                                    self._connect_timeout,
-                                    credits=self._credits,
-                                    shuffle_seed=self._shuffle_seed)
+            stream = _DynamicStream(
+                wid, addresses[wid], pairs, epoch, self._connect_timeout,
+                credits=self._credits, shuffle_seed=self._shuffle_seed,
+                transform_placement=self._iter_transform_placement)
             streams[sid] = stream
             sid_by_wid[wid] = sid
+            with self._lock:
+                # Mid-epoch joiners/takeovers grow the fleet: keep the
+                # live count honest (set_credits re-derives from it).
+                self._live_stream_count = max(1, len(streams))
             reader = _DynamicStreamReader(sid, stream, ready, stop,
                                           self._note_stream_recv)
             readers.append(reader)
@@ -1585,10 +1758,11 @@ class ServiceBatchSource:
                 return
             try:
                 def attempt():
-                    fresh = _DynamicStream(wid, addresses[wid], pairs,
-                                           epoch, self._connect_timeout,
-                                           credits=self._credits,
-                                           shuffle_seed=self._shuffle_seed)
+                    fresh = _DynamicStream(
+                        wid, addresses[wid], pairs, epoch,
+                        self._connect_timeout, credits=self._credits,
+                        shuffle_seed=self._shuffle_seed,
+                        transform_placement=self._iter_transform_placement)
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -2038,12 +2212,11 @@ class ServiceBatchSource:
             return _EndedStream(stream)
 
         def attempt():
-            fresh = _WorkerStream(stream.worker_id, stream.address,
-                                  pending, stream.epoch,
-                                  self._connect_timeout,
-                                  credits=self._credits, tagged=True,
-                                  starts=starts,
-                                  shuffle_seed=self._shuffle_seed)
+            fresh = _WorkerStream(
+                stream.worker_id, stream.address, pending, stream.epoch,
+                self._connect_timeout, credits=self._credits, tagged=True,
+                starts=starts, shuffle_seed=self._shuffle_seed,
+                transform_placement=self._iter_transform_placement)
             event = fresh.next_event()  # forces connect + first reply
             return fresh, event
 
@@ -2123,7 +2296,8 @@ class ServiceBatchSource:
                           self._connect_timeout, credits=self._credits,
                           tagged=True,
                           starts={p: starts.get(p, 0) for p in pieces},
-                          shuffle_seed=self._shuffle_seed)
+                          shuffle_seed=self._shuffle_seed,
+                          transform_placement=self._iter_transform_placement)
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2201,11 +2375,11 @@ class ServiceBatchSource:
             # Sequential consumption: receive == consume, so each batch is
             # acked on arrival (auto_replenish) and the credit window still
             # bounds the worker's read-ahead past this client.
-            stream = _WorkerStream(wid, address, [piece], epoch,
-                                   self._connect_timeout,
-                                   credits=self._credits,
-                                   auto_replenish=True,
-                                   shuffle_seed=self._shuffle_seed)
+            stream = _WorkerStream(
+                wid, address, [piece], epoch, self._connect_timeout,
+                credits=self._credits, auto_replenish=True,
+                shuffle_seed=self._shuffle_seed,
+                transform_placement=self._iter_transform_placement)
             try:
                 yield from self._drain_one(stream)
                 return True
@@ -2368,6 +2542,9 @@ class ServiceBatchSource:
                 "ready_queue_capacity": ready.maxsize if ready is not None
                 else 0,
                 "credits_window": self._credits,
+                # Placement of the batch-transform stage in force for the
+                # current iteration (None = no transform armed).
+                "transform_placement": self._iter_transform_placement,
                 # Epoch boundaries in production order: the n-th entry says
                 # "epoch `epoch` began at produced-batch `count`" — a
                 # consumer correlating its own per-batch timeline (the

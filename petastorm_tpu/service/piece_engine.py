@@ -157,10 +157,18 @@ class StreamingPieceEngine:
         (the worker derives it from ``seedtree.batch_permutation(seed,
         epoch, piece, n)``): every re-serve replays the same order.
         ``None`` (default) emits in canonical decode order.
+    :param transform_fn: optional collated-batch transform applied to
+        every cold-decoded batch BEFORE serialization (and before the
+        cache fill, so warm entries hold post-transform bytes under
+        their transform-aware key). The worker passes its timed
+        ``batch_transform`` wrapper here when the stream's placement is
+        remote; ``None`` (local placement or no transform) leaves
+        batches untouched.
     """
 
     def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
-                 cache_note_fn=None, lookahead=2, permute_fn=None):
+                 cache_note_fn=None, lookahead=2, permute_fn=None,
+                 transform_fn=None):
         if callable(reader) and not hasattr(reader, "read_next_tagged"):
             self._reader = None
             self._reader_factory = reader
@@ -173,6 +181,7 @@ class StreamingPieceEngine:
         self._cache_key_fn = cache_key_fn
         self._cache_note_fn = cache_note_fn
         self._permute = permute_fn
+        self._transform = transform_fn
         self._lookahead = max(1, int(lookahead))
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -447,6 +456,11 @@ class StreamingPieceEngine:
             self._emit_batch(piece, gen, batch, builder)
 
     def _emit_batch(self, piece, gen, batch, builder):
+        if self._transform is not None:
+            # Placement-flippable transform stage (remote placement): runs
+            # before serialization AND before the cache fill — entries
+            # under the transform-aware key hold post-transform bytes.
+            batch = self._transform(batch)
         permuting = self._permute is not None
         with self._lock:
             ordinal = self._ordinal.get(piece, 0)

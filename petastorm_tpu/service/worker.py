@@ -40,6 +40,7 @@ from petastorm_tpu.telemetry.metrics import (
     WORKER_READERS_CONSTRUCTED,
     WORKER_ROWS_SENT,
     WORKER_STREAMS,
+    WORKER_TRANSFORM_SECONDS,
 )
 
 logger = service_logger(__name__)
@@ -66,6 +67,43 @@ def _resolve_factory(reader_factory):
             f"reader_factory must be a callable or one of {_FACTORIES}, "
             f"got {reader_factory!r}")
     return factories[reader_factory]
+
+
+def _digest_code(digest, code):
+    """Feed a code object's behavior-shaping parts into ``digest``,
+    recursing into nested code objects (lambdas, inner defs,
+    comprehensions). Deliberately NOT ``repr(co_consts)``: a nested code
+    object's repr embeds its memory address and absolute file path, which
+    change every process — the key must be stable across restarts (warm
+    disk tier) yet change when the code is edited."""
+    digest.update(code.co_code)
+    digest.update(" ".join(code.co_names).encode())
+    for const in code.co_consts:
+        if hasattr(const, "co_code"):
+            _digest_code(digest, const)
+        else:
+            digest.update(repr(const).encode())
+
+
+def _transform_identity(fn):
+    """Cache-key ingredient naming a batch transform: module:qualname
+    PLUS a digest of the function's compiled body and constants — a
+    restarted worker whose transform code was edited must MISS the
+    persistent disk tier, not serve bytes transformed by the old code
+    (and two same-named lambdas with different bodies must not share
+    entries). Closure-captured *values* are not hashable here and stay
+    invisible — parameterize through constants or name the version in
+    the qualname if a closure variable shapes the output."""
+    identity = (f"{getattr(fn, '__module__', '')}:"
+                f"{getattr(fn, '__qualname__', repr(fn))}")
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        import hashlib
+
+        digest = hashlib.blake2b(digest_size=8)
+        _digest_code(digest, code)
+        identity += f"#{digest.hexdigest()}"
+    return identity
 
 
 class BatchWorker:
@@ -112,6 +150,18 @@ class BatchWorker:
         piece boundaries (a ragged batch per piece tail, not just per
         stream). The worker owns the instance: ``stop()`` calls its
         ``cleanup()``.
+    :param batch_transform: the placement-flippable collated-batch
+        transform — ``{field: ndarray} -> {field: ndarray}``, applied to
+        each batch after collation and before serialization (timed into
+        ``petastorm_service_worker_transform_seconds``). A stream request
+        carrying ``transform_placement="local"`` skips it (the client
+        runs the identical callable trainer-side — arm
+        ``ServiceBatchSource(transform=...)`` with the same function);
+        the pipeline autotuner flips that placement from measured
+        profiles (``docs/guides/pipeline.md#transform-placement``).
+        Distinct from the reader-level ``transform_spec`` (row/DataFrame
+        granularity, fixed at reader construction), which stays where it
+        is.
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
@@ -120,13 +170,21 @@ class BatchWorker:
                  register_retries=5, register_backoff=0.2,
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
-                 batch_cache=None):
+                 batch_cache=None, batch_transform=None):
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
                                     if dispatcher_address else None)
         self._batch_size = batch_size
         self._batch_cache = batch_cache
+        # The placement-flippable collated-batch transform
+        # (docs/guides/pipeline.md#transform-placement): applied to every
+        # batch before serialization UNLESS the stream request carries
+        # transform_placement="local" (the client then runs the identical
+        # callable trainer-side). Cache entries are keyed by whether the
+        # transform was applied, so a placement flip re-fills instead of
+        # serving bytes from the other placement.
+        self._batch_transform = batch_transform
         # The cache fingerprint's factory tag: the three reader families
         # collate codec columns differently, so entries must not cross them.
         self._factory_name = (reader_factory if isinstance(reader_factory,
@@ -173,6 +231,7 @@ class BatchWorker:
         self._m_active = WORKER_ACTIVE_STREAMS.labels(self.worker_id)
         self._m_decode = WORKER_DECODE_SECONDS.labels(self.worker_id)
         self._m_readers = WORKER_READERS_CONSTRUCTED.labels(self.worker_id)
+        self._m_transform = WORKER_TRANSFORM_SECONDS.labels(self.worker_id)
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self._heartbeat_paused = threading.Event()  # test hook: hung worker
@@ -434,6 +493,35 @@ class BatchWorker:
         keeps at-least-once bookkeeping for that worker."""
         dynamic = bool(header.get("dynamic"))
         tagged = bool(header.get("tagged"))
+        # Placement-flippable batch transform: "local" tells this worker
+        # to SKIP its configured batch_transform — the client applies the
+        # identical callable trainer-side (docs/guides/pipeline.md).
+        transform_local = header.get("transform_placement") == "local"
+        if header.get("transform_placement") == "remote" \
+                and self._batch_transform is None:
+            # The client armed a transform and expects THIS side to run
+            # it; silently serving untransformed batches would train on
+            # wrong data with no error anywhere — refuse the stream and
+            # name the misconfiguration instead.
+            send_framed(sock, {
+                "type": "error",
+                "error": "stream requested transform_placement='remote' "
+                         "but this worker has no batch_transform armed — "
+                         "start it with --batch-transform module:attr "
+                         "(the same callable the client's transform= "
+                         "uses), or run the client with "
+                         "transform_placement='local'"})
+            return
+        transform_fn = None
+        if self._batch_transform is not None and not transform_local:
+            batch_transform = self._batch_transform
+            observe = self._m_transform.observe
+
+            def transform_fn(batch):
+                t0 = time.perf_counter()
+                out = batch_transform(batch)
+                observe(time.perf_counter() - t0)
+                return out
         # Serve-time shuffle: the client forwards the dispatcher's
         # shuffle_seed so the engine can compose the per-epoch intra-piece
         # batch permutation at serve time (cached bytes stay canonical and
@@ -468,17 +556,17 @@ class BatchWorker:
                 rows_sent = self._stream_dynamic(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, starts, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
             else:
                 if shuffle_seed is not None:
                     # This serving path cannot compose the serve-time
@@ -503,11 +591,12 @@ class BatchWorker:
                 if self._batch_cache is not None:
                     rows_sent = self._stream_pieces_cached(
                         sock, conn_reader, state, pieces, flow, credits,
-                        stream_key, epoch=header.get("epoch"))
+                        stream_key, epoch=header.get("epoch"),
+                        transform_fn=transform_fn)
                 else:
                     rows_sent = self._stream_pieces_direct(
                         sock, conn_reader, state, pieces, flow, credits,
-                        stream_key)
+                        stream_key, transform_fn=transform_fn)
             if rows_sent is None:
                 return  # worker stopped mid-stream
             send_framed(sock, {"type": "end", "rows": rows_sent,
@@ -537,7 +626,7 @@ class BatchWorker:
                 reader.join()
 
     def _stream_pieces_direct(self, sock, conn_reader, state, pieces, flow,
-                              credits, stream_key):
+                              credits, stream_key, transform_fn=None):
         """Uncached serving: one reader over the whole piece set, batches
         collated across piece boundaries. Returns rows sent, or ``None``
         when the worker stopped mid-stream."""
@@ -568,6 +657,8 @@ class BatchWorker:
             if collector.enabled:
                 collector.record_span("worker.decode", t_decode,
                                       t_decoded, bid=bid)
+            if transform_fn is not None:
+                batch = transform_fn(batch)
             n = self._batch_rows(batch)
             fmt, frames = encode_payload(batch)
             if not self._send_stream_batch(sock, conn_reader, flow, credits,
@@ -576,7 +667,8 @@ class BatchWorker:
             rows_sent += n
 
     def _stream_pieces_cached(self, sock, conn_reader, state, pieces, flow,
-                              credits, stream_key, epoch=None):
+                              credits, stream_key, epoch=None,
+                              transform_fn=None):
         """Cache-armed serving, piece by piece: a warm piece's batches are
         scatter-gathered straight out of cache memory (zero decode, zero
         re-serialization — ``send_framed_frames``); a cold piece is decoded
@@ -593,7 +685,8 @@ class BatchWorker:
         collector = tracing.COLLECTOR
         rows_sent = 0
         for piece in pieces:
-            key = self._piece_cache_key(piece)
+            key = self._piece_cache_key(
+                piece, transformed=transform_fn is not None)
             entry = cache.get(key)
             self._note_cache_lookup(epoch, hit=entry is not None)
             if entry is not None:
@@ -626,6 +719,8 @@ class BatchWorker:
                     if collector.enabled:
                         collector.record_span("worker.decode", t_decode,
                                               t_decoded, bid=bid)
+                    if transform_fn is not None:
+                        batch = transform_fn(batch)
                     n, fmt, frames = builder.add_batch(batch)
                     if not self._send_stream_batch(
                             sock, conn_reader, flow, credits, bid, n, fmt,
@@ -648,7 +743,7 @@ class BatchWorker:
         return self._reader_kwargs.get(
             "reader_pool_type", "thread") in ("thread", "dummy")
 
-    def _make_engine(self, epoch, shuffle_seed=None):
+    def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
@@ -679,14 +774,17 @@ class BatchWorker:
                 return batch_permutation(seed, epoch_number, piece, n)
 
         cache = self._batch_cache
+        transformed = transform_fn is not None
         return StreamingPieceEngine(
             build_reader, self._batch_size, cache=cache,
-            cache_key_fn=(self._piece_cache_key
-                          if cache is not None else None),
+            cache_key_fn=(
+                (lambda piece: self._piece_cache_key(
+                    piece, transformed=transformed))
+                if cache is not None else None),
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit))
                 if cache is not None else None),
-            permute_fn=permute_fn)
+            permute_fn=permute_fn, transform_fn=transform_fn)
 
     def _note_engine_decode(self, collector, decode_s, bid):
         """Engine events carry decode DURATION, not absolute span times
@@ -703,7 +801,7 @@ class BatchWorker:
 
     def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
-                              shuffle_seed=None):
+                              shuffle_seed=None, transform_fn=None):
         """Cache-armed serving through the streaming engine: warm pieces
         scatter-gather straight from cache memory, cold pieces decode
         through the stream's ONE shared pipeline and fill the cache — the
@@ -715,11 +813,13 @@ class BatchWorker:
         return self._stream_pieces_tagged(sock, conn_reader, state, pieces,
                                           flow, credits, stream_key, {},
                                           epoch=epoch, tagged=False,
-                                          shuffle_seed=shuffle_seed)
+                                          shuffle_seed=shuffle_seed,
+                                          transform_fn=transform_fn)
 
     def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
-                              tagged=True, shuffle_seed=None):
+                              tagged=True, shuffle_seed=None,
+                              transform_fn=None):
         """Exactly-once static serving: piece-aligned batches through the
         streaming engine, every ``batch`` frame tagged with its piece and
         absolute ``ordinal``, every finished piece announced with a
@@ -731,7 +831,7 @@ class BatchWorker:
         the same loop as the legacy untagged engine stream (no tags, no
         markers)."""
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch, shuffle_seed)
+        engine = self._make_engine(epoch, shuffle_seed, transform_fn)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -767,7 +867,8 @@ class BatchWorker:
                                    "rows": rows})
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
-                        credits, stream_key, epoch=None, shuffle_seed=None):
+                        credits, stream_key, epoch=None, shuffle_seed=None,
+                        transform_fn=None):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -784,7 +885,7 @@ class BatchWorker:
                 f"worker runs "
                 f"{self._reader_kwargs.get('reader_pool_type')!r}")
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch, shuffle_seed)
+        engine = self._make_engine(epoch, shuffle_seed, transform_fn)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -869,7 +970,7 @@ class BatchWorker:
                              cur_shard=0, shard_count=1,
                              **self._reader_kwargs)
 
-    def _piece_cache_key(self, piece):
+    def _piece_cache_key(self, piece, transformed=False):
         from petastorm_tpu.cache_impl import batch_fingerprint
 
         kwargs = self._reader_kwargs
@@ -882,16 +983,26 @@ class BatchWorker:
                      if self._piece_signatures is not None
                      and int(piece) < len(self._piece_signatures)
                      else int(piece))
+        extra = {"filters": kwargs.get("filters"),
+                 "predicate": repr(kwargs.get("predicate")),
+                 "piece_index": int(piece),
+                 "num_pieces": self.num_pieces,
+                 "last_batch": "keep"}
+        if self._batch_transform is not None:
+            # Placement-aware keying: entries hold POST-transform bytes
+            # when the stage ran here, pre-transform bytes when the client
+            # runs it — the two must never serve each other. Workers
+            # without a batch_transform keep the legacy key (old disk
+            # entries stay warm).
+            extra["batch_transform"] = (
+                _transform_identity(self._batch_transform)
+                if transformed else None)
         return batch_fingerprint(
             self.dataset_url, [signature], self._batch_size,
             fields=kwargs.get("schema_fields"),
             transform=kwargs.get("transform_spec"),
             factory=self._factory_name,
-            extra={"filters": kwargs.get("filters"),
-                   "predicate": repr(kwargs.get("predicate")),
-                   "piece_index": int(piece),
-                   "num_pieces": self.num_pieces,
-                   "last_batch": "keep"})
+            extra=extra)
 
     def _send_stream_batch(self, sock, conn_reader, flow, credits, bid,
                            rows, fmt, frames, collector,

@@ -154,6 +154,52 @@ CLIENT_WATERMARK_LAG = REGISTRY.gauge(
     "in the seed-tree order is stuck behind a slow or recovering worker "
     "while its peers run ahead")
 
+# -- pipeline autotuner (pipeline/autotune.py) -------------------------------
+
+AUTOTUNE_DECISIONS = REGISTRY.counter(
+    "petastorm_autotune_decisions_total",
+    "Knob changes the online autotuner applied, by knob and direction "
+    "(up/down = a capacity knob raised/lowered one hill-climb step, flip = "
+    "a placement knob moved, revert = a probe that regressed throughput "
+    "was rolled back). The decision journal: every entry here also lands "
+    "in the controller's in-memory trail with before/after values",
+    labels=("knob", "direction"))
+AUTOTUNE_KNOB_VALUE = REGISTRY.gauge(
+    "petastorm_autotune_knob_value",
+    "Current value of each autotuned pipeline knob (workers_count, "
+    "host_prefetch, device_prefetch, credits, ready_queue_depth; "
+    "transform_placement renders 0 = remote, 1 = local) — set when the "
+    "controller binds the knob and on every applied decision, so a scrape "
+    "shows the configuration actually in force, not the constructed one. "
+    "Labeled per controller instance (two concurrently autotuned loaders "
+    "must not clobber each other's gauges); a garbage-collected "
+    "controller's series are removed",
+    labels=("controller", "knob"))
+AUTOTUNE_ROUNDS = REGISTRY.counter(
+    "petastorm_autotune_rounds_total",
+    "Autotuner planning rounds by outcome: applied (a knob changed), "
+    "reverted (a regressing probe rolled back), noop (balanced, "
+    "hysteresis-held, or all candidate knobs settled), idle (window too "
+    "short or no rows moved). A converged pipeline shows only noop/idle "
+    "growth",
+    labels=("outcome",))
+
+# -- pipeline transform stage (placement-flippable batch transform) ----------
+
+WORKER_TRANSFORM_SECONDS = REGISTRY.histogram(
+    "petastorm_service_worker_transform_seconds",
+    "Per-batch time in the worker-side batch transform stage (the "
+    "placement-flippable collated-batch transform, applied when the "
+    "stream's transform_placement is remote — docs/guides/pipeline.md)",
+    labels=("worker",))
+CLIENT_TRANSFORM_SECONDS = REGISTRY.histogram(
+    "petastorm_service_client_transform_seconds",
+    "Per-batch time in the trainer-local batch transform stage (the same "
+    "placement-flippable transform executed client-side when "
+    "transform_placement is local — high values here with low consumer "
+    "stall say the trainer host can afford the stage; the autotuner flips "
+    "placement back when it cannot)")
+
 # -- JAX loader (jax_utils/loader.py) ----------------------------------------
 
 LOADER_BATCHES = REGISTRY.counter(

@@ -107,6 +107,20 @@ def decode_row(row, schema):
     return decoded_row
 
 
+def resize_bounded_queue(q, maxsize):
+    """Live-resize a ``queue.Queue``'s bound (the pipeline autotuner's
+    prefetch/ready-queue knobs — ``docs/guides/pipeline.md``): waiters
+    blocked on the old bound are woken so a raise takes effect
+    immediately; a shrink lets the queue drain down to the new bound
+    (``put`` re-checks ``maxsize`` under the mutex on every attempt, so
+    nothing is dropped). Reaches into ``queue.Queue`` internals
+    (``mutex``/``not_full`` share one lock by contract) — keep every
+    caller on THIS helper."""
+    with q.mutex:
+        q.maxsize = int(maxsize)
+        q.not_full.notify_all()
+
+
 def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
                        jitter=0.5, retry_on=(Exception,), no_retry_on=(),
                        description=None, sleep=None, rng=None,

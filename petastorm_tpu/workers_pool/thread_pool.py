@@ -36,6 +36,11 @@ class WorkerException(Exception):
         super().__init__(f"Worker raised {exc!r}\n{formatted_traceback}")
 
 
+class _RetireSentinel:
+    """``resize()`` shrink marker: exactly one worker thread exits on it
+    (unlike ``EOFSentinel`` it is minted per-retirement, never broadcast)."""
+
+
 class ThreadPool:
     #: This pool can attribute completion markers to their work item (the
     #: marker is created in-process with the item's kwargs in hand) — the
@@ -50,6 +55,13 @@ class ThreadPool:
         self._workers = []
         self._ventilator = None
         self._stop_event = threading.Event()
+        # resize() support: start() records how workers are built so grow
+        # can spawn identical ones, and a monotonic id keeps thread names
+        # unique across grow/shrink cycles.
+        self._worker_class = None
+        self._worker_setup_args = None
+        self._next_worker_id = workers_count
+        self._resize_lock = threading.Lock()
         self._ventilated_items = 0
         self._completed_items = 0
         self._results_pending = 0  # real RESULT payloads in the queue
@@ -91,18 +103,55 @@ class ThreadPool:
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._threads:
             raise RuntimeError("ThreadPool already started")
+        self._worker_class = worker_class
+        self._worker_setup_args = worker_setup_args
+        self._next_worker_id = self._workers_count
         for worker_id in range(self._workers_count):
-            worker = worker_class(worker_id, self._publish_result, worker_setup_args)
-            self._workers.append(worker)
-            thread = threading.Thread(
-                target=self._worker_loop, args=(worker,), daemon=True,
-                name=f"petastorm-tpu-worker-{worker_id}",
-            )
-            self._threads.append(thread)
-            thread.start()
+            self._spawn_worker(worker_id)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
+
+    def _spawn_worker(self, worker_id):
+        worker = self._worker_class(worker_id, self._publish_result,
+                                    self._worker_setup_args)
+        self._workers.append(worker)
+        thread = threading.Thread(
+            target=self._worker_loop, args=(worker,), daemon=True,
+            name=f"petastorm-tpu-worker-{worker_id}",
+        )
+        self._threads.append(thread)
+        thread.start()
+
+    def resize(self, workers_count):
+        """Live-resize the decode parallelism (the autotuner's
+        ``workers_count`` knob — ``docs/guides/pipeline.md``).
+
+        Grow spawns additional worker threads identical to the ones
+        ``start()`` built; shrink enqueues one retire sentinel per
+        surplus worker — each is honored by exactly one worker AFTER the
+        work items already queued ahead of it (FIFO), so no ventilated
+        item is dropped and in-flight accounting stays exact. Before
+        ``start()`` this just adjusts the constructed count.
+        """
+        workers_count = int(workers_count)
+        if workers_count < 1:
+            raise ValueError("workers_count must be >= 1")
+        with self._resize_lock:
+            if self._stop_event.is_set():
+                return
+            if not self._threads:
+                self._workers_count = workers_count  # pre-start resize
+                return
+            delta = workers_count - self._workers_count
+            if delta > 0:
+                for _ in range(delta):
+                    self._spawn_worker(self._next_worker_id)
+                    self._next_worker_id += 1
+            else:
+                for _ in range(-delta):
+                    self._ventilator_queue.put(_RetireSentinel())
+            self._workers_count = workers_count
 
     def _worker_loop(self, worker):
         while not self._stop_event.is_set():
@@ -110,7 +159,7 @@ class ThreadPool:
                 item = self._ventilator_queue.get(timeout=0.05)
             except queue.Empty:
                 continue
-            if isinstance(item, EOFSentinel):
+            if isinstance(item, (EOFSentinel, _RetireSentinel)):
                 break
             args, kwargs = item
             try:

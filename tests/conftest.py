@@ -63,6 +63,12 @@ _LIBRARY_SINGLETON_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
 #: for the rest of the session.
 _READER_POOL_THREAD_PREFIX = "petastorm-tpu-worker"
 
+#: The pipeline autotuner's controller thread is a daemon too; one
+#: surviving a test means an autotuned loader was never stopped — it
+#: keeps re-planning (and resizing pools!) against a dead pipeline for
+#: the rest of the session.
+_AUTOTUNE_THREAD_PREFIX = "pipeline-autotune"
+
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
@@ -98,7 +104,8 @@ def _resource_leak_guard(request):
         leaked_pool_threads = [
             t for t in threading.enumerate()
             if t not in before_threads and t.is_alive()
-            and t.name.startswith(_READER_POOL_THREAD_PREFIX)]
+            and t.name.startswith((_READER_POOL_THREAD_PREFIX,
+                                   _AUTOTUNE_THREAD_PREFIX))]
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
         if not leaked_threads and not leaked_pool_threads \
@@ -110,9 +117,11 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
-        f"reader-pool threads {[t.name for t in leaked_pool_threads]} "
+        f"reader-pool/autotune threads "
+        f"{[t.name for t in leaked_pool_threads]} "
         f"(an unstopped Reader — e.g. a streaming piece engine whose "
-        f"owner never stopped/joined it), "
+        f"owner never stopped/joined it — or an autotuned loader whose "
+        f"controller was never stopped), "
         f"sockets {sorted(leaked_sockets)}, "
         f"cache dirs {sorted(leaked_cache_dirs)} — stop/close every "
         f"service node, loader, engine, and connection the test started, "

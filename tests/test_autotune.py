@@ -1,0 +1,573 @@
+"""Pipeline stage graph + online autotuner (docs/guides/pipeline.md).
+
+Three layers:
+
+- the PURE planner: golden decisions from canned profile snapshots
+  (decode-bound, dispatch-bound, credit-wait-bound, worker-bound,
+  already-balanced), hysteresis/oscillation guarantees, bound safety;
+- the graph/knob bindings: live resizes actually land (thread pool,
+  loader prefetch queues, client ready-queue/credits, transform
+  placement round-trips through the service);
+- the tier-1 smoke guard: a tiny synthetic pipeline with the autotuner
+  enabled converges (trailing rounds become no-ops) and never leaves a
+  knob outside its declared bounds.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.pipeline import (
+    AutotuneController,
+    Knob,
+    PipelineGraph,
+    Planner,
+    StageNode,
+    build_loader_graph,
+    classify,
+)
+
+pytestmark = pytest.mark.autotune
+
+
+# ---------------------------------------------------------------------------
+# planner: golden decisions from canned profiles
+# ---------------------------------------------------------------------------
+
+KNOBS = {
+    "workers_count": {"kind": "int", "lo": 1, "hi": 16, "applies": "live"},
+    "host_prefetch": {"kind": "int", "lo": 1, "hi": 64, "applies": "live"},
+    "device_prefetch": {"kind": "int", "lo": 1, "hi": 16, "applies": "live"},
+    "credits": {"kind": "int", "lo": 1, "hi": 64, "applies": "next-stream"},
+    "ready_queue_depth": {"kind": "int", "lo": 2, "hi": 256,
+                          "applies": "live"},
+    "transform_placement": {"kind": "choice",
+                            "choices": ["remote", "local"],
+                            "applies": "next-iteration"},
+}
+
+
+def _profile(*, wall=1.0, rows=10000, stall=0.0, queue_wait=0.0,
+             decode=0.0, dispatch=0.0, credit_wait=None, recv_stall=None,
+             knobs=None):
+    out = {"wall_s": wall, "rows": rows, "stall_s": stall,
+           "queue_wait_s": queue_wait, "decode_s": decode,
+           "dispatch_s": dispatch,
+           "knobs": dict(knobs or {"workers_count": 2, "host_prefetch": 4,
+                                   "device_prefetch": 2, "credits": 8,
+                                   "ready_queue_depth": 16,
+                                   "transform_placement": "remote"})}
+    if credit_wait is not None:
+        out["credit_wait_s"] = credit_wait
+    if recv_stall is not None:
+        out["recv_stall_s"] = recv_stall
+    return out
+
+
+def _plan_until_decision(planner, profile, max_rounds=6):
+    """Feed the same profile until hysteresis admits a decision."""
+    for _ in range(max_rounds):
+        decisions = planner.plan(profile)
+        if decisions:
+            return decisions
+    return []
+
+
+def test_classify_golden():
+    assert classify(_profile(stall=0.5, decode=0.9,
+                             dispatch=0.1)) == "decode-bound"
+    assert classify(_profile(stall=0.5, decode=0.1,
+                             dispatch=0.6)) == "dispatch-bound"
+    assert classify(_profile(stall=0.5, decode=0.1, dispatch=0.0,
+                             credit_wait=0.6)) == "credit-bound"
+    assert classify(_profile(stall=0.5, decode=0.0, dispatch=0.0,
+                             credit_wait=0.01,
+                             recv_stall=0.9)) == "worker-bound"
+    assert classify(_profile(stall=0.01)) == "balanced"
+    assert classify(_profile(stall=0.01, queue_wait=0.5)) == \
+        "consumer-bound"
+    assert classify(_profile(rows=0)) == "idle"
+    assert classify(_profile(wall=0.0)) == "idle"
+
+
+def test_decode_bound_raises_workers_count():
+    planner = Planner(KNOBS, hysteresis=2)
+    decisions = _plan_until_decision(
+        planner, _profile(stall=0.5, decode=0.9, dispatch=0.1))
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("workers_count", "up", 4)]
+
+
+def test_dispatch_bound_raises_device_prefetch():
+    planner = Planner(KNOBS, hysteresis=2)
+    decisions = _plan_until_decision(
+        planner, _profile(stall=0.5, decode=0.1, dispatch=0.6))
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("device_prefetch", "up", 4)]
+
+
+def test_credit_bound_raises_credits():
+    planner = Planner(KNOBS, hysteresis=2)
+    decisions = _plan_until_decision(
+        planner, _profile(stall=0.5, credit_wait=0.6))
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("credits", "up", 16)]
+
+
+def test_worker_bound_flips_transform_local():
+    planner = Planner(KNOBS, hysteresis=2, placement_hysteresis=3)
+    profile = _profile(stall=0.6, recv_stall=0.9)
+    decisions = _plan_until_decision(planner, profile)
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("transform_placement", "flip", "local")]
+    assert decisions[0]["applies"] == "next-iteration"
+
+
+def test_balanced_is_a_noop_forever():
+    planner = Planner(KNOBS, hysteresis=1)
+    for _ in range(10):
+        assert planner.plan(_profile(stall=0.01)) == []
+        assert planner.last_outcome == "noop"
+
+
+def test_idle_windows_never_tune():
+    planner = Planner(KNOBS, hysteresis=1)
+    for _ in range(5):
+        assert planner.plan(_profile(rows=0)) == []
+        assert planner.last_outcome == "idle"
+
+
+def test_hysteresis_requires_persistent_class():
+    planner = Planner(KNOBS, hysteresis=3)
+    decode_bound = _profile(stall=0.5, decode=0.9, dispatch=0.1)
+    dispatch_bound = _profile(stall=0.5, decode=0.1, dispatch=0.6)
+    # Alternating bottleneck classes never build the 3-round streak.
+    for _ in range(6):
+        assert planner.plan(decode_bound) == []
+        assert planner.plan(dispatch_bound) == []
+
+
+def test_regressing_probe_reverts_and_settles():
+    """A probe that lowers throughput is rolled back and the knob is not
+    probed again while the bottleneck class persists — two adjacent
+    values cannot oscillate."""
+    planner = Planner(KNOBS, hysteresis=1, tolerance=0.05)
+    fast = _profile(stall=0.5, decode=0.9, dispatch=0.1, rows=10000)
+    slow = _profile(stall=0.5, decode=0.9, dispatch=0.1, rows=5000)
+    first = planner.plan(fast)
+    assert first and first[0]["knob"] == "workers_count" \
+        and first[0]["to"] == 4
+    # Next window: throughput halved -> revert to the previous value.
+    second = planner.plan(slow)
+    assert [(d["knob"], d["direction"], d["to"]) for d in second] == \
+        [("workers_count", "revert", 2)]
+    # Same class keeps holding: workers_count is settled, the fallback
+    # knob (host_prefetch) probes instead, and after IT settles the
+    # planner goes quiet — workers_count is never touched again.
+    later = []
+    for _ in range(8):
+        later.extend(planner.plan(fast))
+    assert all(d["knob"] != "workers_count" for d in later)
+
+
+def test_non_live_probe_defers_evaluation():
+    """A knob whose change is not live (credits apply to the NEXT
+    streams) is not judged on the windows before the change could have
+    landed: evaluation waits ``probe_defer`` informative windows."""
+    planner = Planner(KNOBS, hysteresis=1, probe_defer=2)
+    credit_bound = _profile(stall=0.5, credit_wait=0.6)
+    first = planner.plan(credit_bound)
+    assert first[0]["knob"] == "credits" and first[0]["to"] == 16
+    # The next two windows (pre-landing noise, here even a "regression")
+    # are held, not evaluated.
+    noisy = _profile(stall=0.5, credit_wait=0.6, rows=100)
+    assert planner.plan(noisy) == [] and planner.last_outcome == "noop"
+    assert planner.plan(noisy) == [] and planner.last_outcome == "noop"
+    # The third window is the evaluation: a real regression now reverts.
+    assert [(d["knob"], d["direction"], d["to"])
+            for d in planner.plan(noisy)] == [("credits", "revert", 8)]
+
+
+def test_neutral_probe_settles_without_oscillation():
+    """Equal throughput across a probe keeps the value but stops probing
+    the knob: the trail becomes a no-op stream, not an up/down ping-pong
+    between two adjacent values."""
+    planner = Planner(KNOBS, hysteresis=1, tolerance=0.05)
+    profile = _profile(stall=0.5, decode=0.9, dispatch=0.1)
+    decisions = [planner.plan(profile) for _ in range(12)]
+    flat = [d for ds in decisions for d in ds]
+    # One probe per candidate knob at most (workers_count, host_prefetch)
+    # and never a revisit: no knob appears twice.
+    assert len({d["knob"] for d in flat}) == len(flat)
+    assert decisions[-1] == [] and planner.last_outcome == "noop"
+
+
+def test_planner_never_leaves_declared_bounds():
+    planner = Planner(KNOBS, hysteresis=1, tolerance=1e9)  # keep everything
+    knobs = {"workers_count": 15, "host_prefetch": 63,
+             "device_prefetch": 15, "credits": 63, "ready_queue_depth": 255,
+             "transform_placement": "remote"}
+    for _ in range(30):
+        profile = _profile(stall=0.5, decode=0.9, dispatch=0.1,
+                           knobs=dict(knobs))
+        for decision in planner.plan(profile):
+            desc = KNOBS[decision["knob"]]
+            if desc["kind"] == "int":
+                assert desc["lo"] <= decision["to"] <= desc["hi"]
+            else:
+                assert decision["to"] in desc["choices"]
+            knobs[decision["knob"]] = decision["to"]
+
+
+# ---------------------------------------------------------------------------
+# graph + controller
+# ---------------------------------------------------------------------------
+
+def test_graph_rejects_bad_nodes_and_duplicate_knobs():
+    with pytest.raises(ValueError, match="placement"):
+        StageNode("x", "worker", "moon")
+    with pytest.raises(ValueError, match="side"):
+        StageNode("x", "elsewhere", "trainer")
+    node = StageNode("x", "worker", "trainer")
+    with pytest.raises(ValueError, match="unknown stage"):
+        PipelineGraph([node], [("x", "y")])
+    knob = Knob("k", get=lambda: 1, set=lambda v: None, lo=1, hi=4)
+    with pytest.raises(ValueError, match="duplicate knob"):
+        PipelineGraph([node], [], knobs=[knob, knob])
+
+
+def test_controller_applies_and_journals_within_bounds():
+    """One canned graph: the controller applies the planner's decision
+    through the binding, clamps to bounds, and journals to the trail."""
+    values = {"workers_count": 2}
+    hist = {"count": 0, "sum": 0.0}
+    signals = {"rows": lambda: sig["rows"], "stall_s": lambda: sig["stall"],
+               "queue_wait_s": lambda: 0.0,
+               "decode_s": lambda: sig["decode"],
+               "dispatch_s": lambda: 0.0, "consumer_s": lambda: 0.0}
+    sig = {"rows": 0, "stall": 0.0, "decode": 0.0}
+    graph = PipelineGraph(
+        [StageNode("decode", "worker", "trainer",
+                   metric=lambda: (hist["count"], hist["sum"]))],
+        [],
+        knobs=[Knob("workers_count", get=lambda: values["workers_count"],
+                    set=lambda v: values.__setitem__("workers_count", v),
+                    lo=1, hi=16)],
+        signals=signals)
+    controller = AutotuneController(
+        graph, interval_s=60,
+        planner=Planner({"workers_count": KNOBS["workers_count"]},
+                        hysteresis=1))
+    controller._prev = (time.perf_counter() - 1.0, graph.snapshot())
+    sig.update(rows=10000, stall=0.5, decode=0.9)
+    applied = controller.step()
+    assert values["workers_count"] == 4
+    assert applied[0]["knob"] == "workers_count"
+    report = controller.report()
+    assert report["trail"][-1]["decisions"][0]["to"] == 4
+    assert report["knobs"] == {"workers_count": 4}
+    assert not controller.running  # step() never started the thread
+
+
+def test_build_loader_graph_binds_local_knobs(petastorm_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="thread",
+                         workers_count=2, num_epochs=1)
+    loader = make_jax_dataloader(reader, 5, stage_to_device=False)
+    try:
+        graph = build_loader_graph(loader)
+        assert set(graph.knobs) == {"workers_count", "host_prefetch",
+                                    "device_prefetch"}
+        snapshot = graph.snapshot()
+        assert snapshot["knobs"]["workers_count"] == 2
+        assert snapshot["knobs"]["host_prefetch"] == 4
+        # The declared chain covers both sides of the model.
+        names = {name for _, name in graph.nodes}
+        assert {"read", "decode", "transform", "collate", "serialize",
+                "send", "recv", "queue", "device_put", "consume"} <= names
+        # workers_count binding resizes the real pool.
+        graph.knobs["workers_count"].set(3)
+        assert reader.diagnostics["workers_count"] == 3
+    finally:
+        loader.stop()
+        loader.join()
+        reader.stop()
+        reader.join()
+
+
+# ---------------------------------------------------------------------------
+# runtime-resizable bindings
+# ---------------------------------------------------------------------------
+
+def test_thread_pool_resize_grow_and_shrink(petastorm_dataset):
+    """A live reader's pool grows and shrinks mid-iteration without
+    dropping rows."""
+    from petastorm_tpu import make_reader
+
+    with make_reader(petastorm_dataset.url, reader_pool_type="thread",
+                     workers_count=1, num_epochs=3) as reader:
+        seen = []
+        it = iter(reader)
+        for _ in range(5):
+            seen.append(int(next(it).id))
+        reader.resize_workers(4)
+        assert reader.diagnostics["workers_count"] == 4
+        for _ in range(5):
+            seen.append(int(next(it).id))
+        reader.resize_workers(2)
+        assert reader.diagnostics["workers_count"] == 2
+        seen.extend(int(row.id) for row in it)
+        assert len(seen) == 3 * len(petastorm_dataset.rows)
+
+
+def test_thread_pool_resize_rejects_nonpositive():
+    from petastorm_tpu.workers_pool.thread_pool import ThreadPool
+
+    pool = ThreadPool(2)
+    with pytest.raises(ValueError):
+        pool.resize(0)
+    pool.resize(5)  # pre-start resize just adjusts the constructed count
+    assert pool.workers_count == 5
+
+
+def test_process_pool_reader_refuses_resize(petastorm_dataset):
+    from petastorm_tpu import make_reader
+
+    with make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                     num_epochs=1) as reader:
+        with pytest.raises(NotImplementedError, match="thread"):
+            reader.resize_workers(2)
+
+
+def test_loader_prefetch_knobs_resize_live_queues():
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+
+    def source():
+        def gen():
+            for i in range(50):
+                yield {"x": np.full((4,), i)}
+        return gen()
+
+    loader = JaxDataLoader(None, 4, batch_source=source,
+                           stage_to_device=False, host_prefetch=2)
+    it = iter(loader)
+    next(it)
+    assert loader.host_prefetch == 2
+    loader.host_prefetch = 6
+    assert loader._queue.maxsize == 6
+    loader.device_prefetch = 3
+    assert loader.device_prefetch == 3
+    with pytest.raises(ValueError):
+        loader.host_prefetch = 0
+    with pytest.raises(ValueError):
+        loader.device_prefetch = 0
+    loader.stop()
+    loader.join()
+
+
+def test_resize_bounded_queue_wakes_blocked_producer():
+    from petastorm_tpu.utils import resize_bounded_queue
+
+    q = queue.Queue(maxsize=1)
+    q.put(1)
+    landed = threading.Event()
+
+    def blocked_put():
+        q.put(2)
+        landed.set()
+
+    thread = threading.Thread(target=blocked_put, daemon=True)
+    thread.start()
+    time.sleep(0.05)
+    assert not landed.is_set()
+    resize_bounded_queue(q, 4)
+    assert landed.wait(2.0)
+    thread.join(timeout=2)
+
+
+def test_client_ready_queue_depth_derives_from_credits():
+    from petastorm_tpu.service.client import ServiceBatchSource
+
+    source = ServiceBatchSource(("127.0.0.1", 1), credits=8)
+    assert source._derived_ready_depth(2) == 16
+    assert source._derived_ready_depth(1) == 8
+    assert source._derived_ready_depth(100) == 256  # capped
+    uncredited = ServiceBatchSource(("127.0.0.1", 1), credits=None)
+    assert uncredited._derived_ready_depth(2) == 4   # legacy 2x streams
+    assert uncredited._derived_ready_depth(5) == 10
+    source.set_credits(2)
+    assert source.credits == 2
+    assert source._derived_ready_depth(2) == 4
+    with pytest.raises(ValueError):
+        source.set_credits(0)
+    source.set_ready_queue_depth(32)
+    assert source.ready_queue_depth == 32
+    with pytest.raises(ValueError):
+        ServiceBatchSource(("127.0.0.1", 1),
+                           transform_placement="sideways")
+    with pytest.raises(ValueError, match="transform"):
+        ServiceBatchSource(("127.0.0.1", 1), transform_placement="local")
+
+
+# ---------------------------------------------------------------------------
+# transform placement through the service
+# ---------------------------------------------------------------------------
+
+def _double_ids(batch):
+    out = dict(batch)
+    out["id_double"] = np.asarray(batch["id"]) * 2
+    return out
+
+
+@pytest.mark.service
+@pytest.mark.parametrize("placement", ["remote", "local"])
+def test_transform_placement_round_trip(petastorm_dataset, placement):
+    """The same batch transform produces identical data whether it runs
+    worker-side (remote) or trainer-side (local), and the stage's time
+    lands in the histogram of the side that ran it."""
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.telemetry.metrics import (
+        CLIENT_TRANSFORM_SECONDS,
+        WORKER_TRANSFORM_SECONDS,
+    )
+
+    worker_id = f"wt-{placement}"
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=7, worker_id=worker_id,
+                         batch_transform=_double_ids,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    transform=_double_ids,
+                                    transform_placement=placement)
+        got = {}
+        client_before = CLIENT_TRANSFORM_SECONDS.labels().count
+        for batch in source():
+            for i, d in zip(batch["id"], batch["id_double"]):
+                got[int(i)] = int(d)
+        assert got == {int(i): 2 * int(i) for i in got}
+        assert sorted(got) == sorted(
+            int(row["id"]) for row in petastorm_dataset.rows)
+        worker_count = WORKER_TRANSFORM_SECONDS.labels(worker_id).count
+        client_count = CLIENT_TRANSFORM_SECONDS.labels().count \
+            - client_before
+        if placement == "remote":
+            assert worker_count > 0 and client_count == 0
+        else:
+            assert worker_count == 0 and client_count > 0
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke guard: the autotuned pipeline converges and stays bounded
+# ---------------------------------------------------------------------------
+
+def test_autotuned_pipeline_converges_and_stays_bounded(petastorm_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+
+    bounds = {"workers_count": (1, 4), "host_prefetch": (1, 8),
+              "device_prefetch": (1, 4)}
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="thread",
+                         workers_count=1, num_epochs=40)
+    loader = make_jax_dataloader(
+        reader, 5, stage_to_device=False,
+        autotune={"interval_s": 0.05, "hysteresis": 1, "bounds": bounds})
+    rows = 0
+    with loader:
+        for batch in loader:
+            rows += len(batch["id"])
+    assert rows == 40 * len(petastorm_dataset.rows)
+    report = loader.autotune.report()
+    assert report["rounds"] >= 4
+    # Convergence: the decision trail went quiet — trailing rounds are
+    # no-ops (the planner settled every candidate knob for the steady
+    # bottleneck class).
+    assert report["noop_streak"] >= 2
+    # Bounded: no decision ever left the declared range, and the final
+    # values sit inside it.
+    for entry in report["trail"]:
+        for decision in entry["decisions"]:
+            lo, hi = bounds[decision["knob"]]
+            assert lo <= decision["to"] <= hi
+    for name, value in report["knobs"].items():
+        lo, hi = bounds[name]
+        assert lo <= value <= hi
+    # The controller thread is gone once the iteration ended (the leak
+    # guard would fail this test otherwise — but assert it explicitly).
+    assert not loader.autotune.running
+
+
+def test_autotune_disabled_is_default_and_inert(petastorm_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+
+    reader = make_reader(petastorm_dataset.url, reader_pool_type="thread",
+                         workers_count=1, num_epochs=1)
+    loader = make_jax_dataloader(reader, 5, stage_to_device=False)
+    with loader:
+        rows = sum(len(b["id"]) for b in loader)
+    assert rows == len(petastorm_dataset.rows)
+    assert loader.autotune is None
+    with pytest.raises(ValueError, match="autotune"):
+        make_jax_dataloader(reader, 5, autotune="yes")
+
+
+# ---------------------------------------------------------------------------
+# telemetry journal + status rendering
+# ---------------------------------------------------------------------------
+
+def test_decisions_journaled_to_telemetry_and_status_renders():
+    from petastorm_tpu.service.cli import render_autotune_status
+    from petastorm_tpu.telemetry.metrics import (
+        AUTOTUNE_DECISIONS,
+        AUTOTUNE_KNOB_VALUE,
+    )
+
+    values = {"credits": 8}
+    graph = PipelineGraph(
+        [StageNode("decode", "worker", "trainer")], [],
+        knobs=[Knob("credits", get=lambda: values["credits"],
+                    set=lambda v: values.__setitem__("credits", v),
+                    lo=1, hi=64, applies="next-stream")],
+        signals={"rows": lambda: sig["rows"],
+                 "stall_s": lambda: sig["stall"],
+                 "queue_wait_s": lambda: 0.0, "decode_s": lambda: 0.0,
+                 "dispatch_s": lambda: 0.0,
+                 "credit_wait_s": lambda: sig["credit_wait"]})
+    sig = {"rows": 0, "stall": 0.0, "credit_wait": 0.0}
+    controller = AutotuneController(
+        graph, interval_s=60,
+        planner=Planner({"credits": KNOBS["credits"]}, hysteresis=1))
+    before = AUTOTUNE_DECISIONS.labels("credits", "up").value
+    controller._prev = (time.perf_counter() - 1.0, graph.snapshot())
+    sig.update(rows=10000, stall=0.5, credit_wait=0.6)
+    controller.step()
+    assert values["credits"] == 16
+    assert AUTOTUNE_DECISIONS.labels("credits", "up").value == before + 1
+    assert AUTOTUNE_KNOB_VALUE.labels(controller._id,
+                                      "credits").value == 16.0
+    # The status tool's render, from the same shapes its /metrics.json
+    # poll produces.
+    text = render_autotune_status(
+        {"knobs": {("0", "credits"): 8.0}, "decisions": {}},
+        {"knobs": {("0", "credits"): 16.0},
+         "decisions": {("credits", "up"): before + 1}})
+    assert "credits=16" in text
+    assert "credits:up" in text
+    # Two controllers: values prefixed instead of merged.
+    text = render_autotune_status(
+        None, {"knobs": {("0", "credits"): 16.0, ("1", "credits"): 8.0},
+               "decisions": {}})
+    assert "0/credits=16" in text and "1/credits=8" in text
+    assert "unreachable" in render_autotune_status(None, None)
