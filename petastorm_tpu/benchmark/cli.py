@@ -79,7 +79,12 @@ def _scenario_main(argv):
                         choices=["static", "fcfs", "dynamic"],
                         help="service scenario sharding mode: static "
                              "per-client splits, fcfs shared queue, or "
-                             "dynamic work-stealing piece rebalancing "
+                             "dynamic work-stealing piece rebalancing. "
+                             "fcfs is single-tenant and single-epoch: no "
+                             "per-job assignment (register_job is "
+                             "rejected) and no per-client epoch "
+                             "boundaries — multi-job / multi-epoch runs "
+                             "need static or dynamic "
                              "(docs/guides/service.md#sharding-modes)")
     parser.add_argument("--mode", default=None,
                         choices=["static", "fcfs", "dynamic"],

@@ -675,6 +675,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                              connection_drop_action,
                                              delivery_invariants,
                                              dispatcher_restart_action,
+                                             job_cancel_action,
+                                             worker_drain_action,
                                              worker_kill_action)
 
     # --sharding is the canonical knob name (static|fcfs|dynamic); `mode`
@@ -732,8 +734,11 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "--epochs > 1 requires static or dynamic sharding: fcfs "
             "clients report no per-client epoch boundaries, so the "
             "per-epoch breakdown would silently lump every epoch into one "
-            "row — use --sharding dynamic for multi-epoch streams with "
-            "work-stealing rebalancing")
+            "row. fcfs is also single-tenant by construction — its one "
+            "shared queue has no per-job assignment, so the dispatcher "
+            "rejects register_job under it. Use --sharding dynamic for "
+            "multi-epoch streams, work-stealing rebalancing, and "
+            "multi-job fleets (--sharding static also supports both)")
     cache_tmp = None
     if cache == "mem+disk" and cache_dir is None:
         # One SHARED disk tier for the whole fleet (atomic-rename writes
@@ -826,6 +831,12 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                     actions.append((kind, worker_kill_action(fleet)))
                 elif kind == "cache-corrupt":
                     actions.append((kind, cache_corrupt_action(cache_dir)))
+                elif kind == "job-cancel":
+                    actions.append((kind, job_cancel_action(
+                        lambda: dispatcher_holder[0].address)))
+                elif kind == "worker-drain":
+                    actions.append((kind, worker_drain_action(
+                        lambda: dispatcher_holder[0])))
                 else:
                     actions.append((kind, connection_drop_action(
                         lambda: [dispatcher_holder[0]] + fleet)))

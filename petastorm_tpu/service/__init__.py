@@ -45,6 +45,14 @@ walkthrough in ``docs/guides/service.md``.
 from petastorm_tpu.service.chaos import ChaosInjector
 from petastorm_tpu.service.client import ServiceBatchSource, ServiceError
 from petastorm_tpu.service.dispatcher import Dispatcher
+from petastorm_tpu.service.fleet import (
+    AutoscaleConfig,
+    AutoscalePlanner,
+    JobHandle,
+    end_job,
+    plan_fair_shares,
+    register_job,
+)
 from petastorm_tpu.service.journal import Journal
 from petastorm_tpu.service.worker import BatchWorker
 
@@ -55,4 +63,10 @@ __all__ = [
     "ServiceError",
     "Journal",
     "ChaosInjector",
+    "AutoscaleConfig",
+    "AutoscalePlanner",
+    "JobHandle",
+    "register_job",
+    "end_job",
+    "plan_fair_shares",
 ]

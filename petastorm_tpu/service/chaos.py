@@ -25,7 +25,7 @@ from petastorm_tpu.telemetry.log import service_logger
 logger = service_logger(__name__)
 
 CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop",
-               "cache-corrupt")
+               "cache-corrupt", "job-cancel", "worker-drain")
 
 
 class ChaosInjector:
@@ -194,6 +194,58 @@ def cache_corrupt_action(cache_dir):
                 f.write(bytes([original[0] ^ 0x40]))
                 logger.warning("chaos: bit-flipped cache entry %s at "
                                "offset %d", victim, size // 2)
+    return action
+
+
+def job_cancel_action(dispatcher_address_fn, weight=0.5):
+    """Exercise one full job lifecycle per injection — register a
+    sacrificial job, then immediately ``end_job`` it — against a live
+    multi-tenant fleet. The isolation invariant under this kind: the
+    surviving jobs' streams keep flowing untouched (a cancelled job's
+    scoped fencing must never fence a peer), which the soak's per-job
+    zero-loss/zero-dup and byte-determinism assertions certify.
+    ``dispatcher_address_fn`` is called per event so the action tracks a
+    restarted dispatcher."""
+    state = {"count": 0}
+
+    def action():
+        from petastorm_tpu.service.fleet import end_job, register_job
+
+        job = f"chaos-job-{state['count']}"
+        state["count"] += 1
+        address = dispatcher_address_fn()
+        register_job(address, job, weight=weight)
+        end_job(address, job)
+    return action
+
+
+def worker_drain_action(dispatcher_fn, min_serving=1):
+    """Alternately drain a serving worker and re-admit it — the
+    autoscaler's lifecycle exercised as a fault: a drain mid-epoch must
+    hand the worker's queued backlog to serving peers exactly-once (the
+    ordinary revoke→extend steal path) while its in-flight pieces finish
+    at their watermarks. Never drains below ``min_serving``; victims
+    cycle deterministically (sorted order, no RNG — the harness obeys
+    the same determinism lint as the service). ``dispatcher_fn`` is
+    called per event so the action tracks a restarted dispatcher."""
+    state = {"drained": [], "count": 0}
+
+    def action():
+        dispatcher = dispatcher_fn()
+        if state["drained"]:
+            wid = state["drained"].pop(0)
+            dispatcher.admit_worker(wid, reason="chaos re-admit")
+            return
+        signals = dispatcher.fleet_signals()
+        serving = signals["serving"]
+        if len(serving) <= min_serving:
+            logger.warning("chaos: only %d serving worker(s) — not "
+                           "draining", len(serving))
+            return
+        wid = serving[state["count"] % len(serving)]
+        state["count"] += 1
+        if dispatcher.drain_worker(wid, reason="chaos drain"):
+            state["drained"].append(wid)
     return action
 
 

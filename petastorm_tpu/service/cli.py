@@ -78,6 +78,14 @@ def _build_parser():
                            "invariant to worker count, steals, and "
                            "restarts. Omit for ascending piece order "
                            "(docs/guides/service.md#deterministic-order)")
+    disp.add_argument("--autoscale", action="store_true",
+                      help="arm the fleet autoscaler: admit --standby "
+                           "workers into serving when backlog piles up, "
+                           "drain and retire them when the fleet idles; "
+                           "every decision journaled (docs/guides/"
+                           "service.md#multi-tenancy-and-autoscaling)")
+    disp.add_argument("--autoscale-interval", type=float, default=1.0,
+                      help="autoscaler planning tick, seconds")
 
     work = sub.add_parser("worker", help="run a batch worker")
     work.add_argument("--dispatcher", default=None,
@@ -117,6 +125,11 @@ def _build_parser():
     work.add_argument("--cache-disk-mb", type=float, default=None,
                       help="optional disk-tier budget (LRU eviction of "
                            "spill files beyond it); default unlimited")
+    work.add_argument("--standby", action="store_true",
+                      help="register as pooled standby capacity: leased "
+                           "and observable but granted nothing until the "
+                           "autoscaler (or Dispatcher.admit_worker) "
+                           "admits it into serving")
     work.add_argument("--batch-transform", default=None,
                       help="module:attr of the placement-flippable "
                            "collated-batch transform ({field: ndarray} -> "
@@ -159,7 +172,10 @@ def build_service_node(args):
                           journal_dir=args.journal_dir,
                           lease_timeout_s=args.lease_timeout or None,
                           journal_fsync=args.journal_fsync,
-                          shuffle_seed=args.shuffle_seed)
+                          shuffle_seed=args.shuffle_seed,
+                          autoscale=({"interval_s": args.autoscale_interval}
+                                     if getattr(args, "autoscale", False)
+                                     else None))
     from petastorm_tpu.cache_impl import CacheConfig
     from petastorm_tpu.service.worker import BatchWorker
 
@@ -169,6 +185,7 @@ def build_service_node(args):
                             if args.dispatcher else None),
         host=args.host, port=args.port, batch_size=args.batch_size,
         reader_factory=args.reader, worker_id=args.worker_id,
+        standby=getattr(args, "standby", False),
         heartbeat_interval_s=args.heartbeat_interval or None,
         batch_cache=CacheConfig(mode=getattr(args, "cache", "off"),
                                 mem_mb=getattr(args, "cache_mem_mb", 256.0),
@@ -320,12 +337,64 @@ def render_fleet_status(prev, cur):
             f"{hit_pct:>10} {perm_rate:>7} {steal_cols(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
+    fleet = status.get("fleet") or {}
+    by_state = fleet.get("workers_by_state") or {}
+    if by_state:
+        autoscale = fleet.get("autoscale") or {}
+        line = ("states: " + " ".join(
+            f"{state}={len(by_state.get(state) or [])}"
+            for state in ("serving", "standby", "draining")))
+        if any(autoscale.values()):
+            line += (" autoscale: " + " ".join(
+                f"{k}={v}" for k, v in sorted(autoscale.items()) if v))
+        if fleet.get("autoscaler_armed"):
+            line += " [autoscaler on]"
+        lines.append(line)
+    jobs = status.get("jobs") or {}
+    if len(jobs) > 1 or any(jid != "default" for jid in jobs):
+        # Per-job delivery rates from the workers' job attribution blocks
+        # (delta over the window, like the per-worker rates) — the live
+        # fairness view: equal-weight jobs should show ~equal ROWS/S.
+        prev_jobs = _job_row_totals(prev)
+        cur_jobs = _job_row_totals(cur)
+        for jid, job in sorted(jobs.items()):
+            rate = "--"
+            if jid in cur_jobs and jid in prev_jobs:
+                rate = f"{max(0.0, cur_jobs[jid] - prev_jobs[jid]) / dt:.1f}"
+            parts = [f"job {jid}:", f"rows/s={rate}",
+                     f"share={job.get('fair_share', 0.0):g}",
+                     f"epoch={job.get('epoch', 0)}",
+                     f"fencing={job.get('fencing_epoch', 0)}",
+                     f"clients={len(job.get('clients') or [])}"]
+            if "backlog" in job:
+                parts.append(f"backlog={job['backlog']}")
+                parts.append(f"steals={job.get('steals_in', 0)}/"
+                             f"{job.get('steals_out', 0)}")
+            job_recovery = {k: v for k, v
+                            in (job.get("recovery") or {}).items() if v}
+            if job_recovery:
+                parts.append("recovery: " + " ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(job_recovery.items())))
+            lines.append(" ".join(parts))
     recovery = status.get("recovery") or {}
     interesting = {k: v for k, v in recovery.items() if v}
     if interesting:
         lines.append("recovery: " + " ".join(
             f"{k}={v}" for k, v in sorted(interesting.items())))
     return "\n".join(lines)
+
+
+def _job_row_totals(sample):
+    """Summed per-job rows over every reachable worker's ``jobs``
+    attribution block — the numerator of the per-job rate lines."""
+    totals = {}
+    for snapshot in sample["workers"].values():
+        if not snapshot or "error" in snapshot:
+            continue
+        for jid, counts in (snapshot.get("jobs") or {}).items():
+            totals[jid] = totals.get(jid, 0) + counts.get("rows", 0)
+    return totals
 
 
 def collect_autotune_sample(metrics_address, timeout=3.0):

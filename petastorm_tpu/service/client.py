@@ -91,8 +91,13 @@ class _WorkerStream:
 
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
-                 starts=None, shuffle_seed=None, transform_placement=None):
+                 starts=None, shuffle_seed=None, transform_placement=None,
+                 job_id=None):
         self.worker_id = worker_id
+        #: The trainer job this stream belongs to (multi-tenant fleets):
+        #: carried on the stream request so the worker attributes rows
+        #: and cache lookups per job. ``None`` = single-tenant legacy.
+        self.job_id = job_id
         self.address = tuple(address)
         self.pieces = list(pieces)
         self.epoch = epoch
@@ -150,6 +155,8 @@ class _WorkerStream:
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "pieces": self.pieces,
                        "epoch": self.epoch}
+            if self.job_id is not None:
+                request["job_id"] = self.job_id
             if self.shuffle_seed is not None:
                 request["shuffle_seed"] = int(self.shuffle_seed)
             if self.transform_placement is not None:
@@ -459,8 +466,10 @@ class _DynamicStream:
     takeover path when the stream reports broken."""
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
-                 credits=None, shuffle_seed=None, transform_placement=None):
+                 credits=None, shuffle_seed=None, transform_placement=None,
+                 job_id=None):
         self.worker_id = worker_id
+        self.job_id = job_id  # see _WorkerStream.job_id
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
         # delivery watermark, so a (re)opened stream never repeats batches
@@ -490,6 +499,8 @@ class _DynamicStream:
             request = {"type": "stream", "dynamic": True,
                        "pieces": [list(t) for t in self.pairs],
                        "epoch": self.epoch}
+            if self.job_id is not None:
+                request["job_id"] = self.job_id
             if self.shuffle_seed is not None:
                 request["shuffle_seed"] = int(self.shuffle_seed)
             if self.transform_placement is not None:
@@ -712,6 +723,19 @@ class ServiceBatchSource:
         :meth:`set_transform_placement` flip (the autotuner's binding)
         takes effect at the next epoch/iteration boundary, never
         mid-stream.
+    :param job_id: the trainer JOB this source belongs to (multi-tenant
+        fleets — ``docs/guides/service.md#multi-tenancy-and-autoscaling``).
+        Carried on every control request and stream, so the dispatcher
+        scopes fencing and assignments per job and workers attribute rows
+        and cache lookups per job. Register the job first with
+        :func:`petastorm_tpu.service.fleet.register_job` for non-default
+        weights/quotas (and always pair with ``end_job``); an
+        unregistered job id materializes with weight 1.0. ``None``
+        (default) = the implicit single-tenant job — today's behavior,
+        bit for bit. The dispatcher's fair-share plan may scale this
+        job's flow-control windows (``credit_scale`` on assignment
+        replies): a job granted half the fair share opens its next
+        streams with half the configured credit window.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
@@ -720,7 +744,8 @@ class ServiceBatchSource:
                  credits=8, ready_queue_depth=None, heartbeat_interval_s=2.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  dynamic_sync_interval_s=0.25, ordered=False,
-                 transform=None, transform_placement="remote"):
+                 transform=None, transform_placement="remote",
+                 job_id=None):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if ready_queue_depth is not None and ready_queue_depth < 1:
@@ -737,6 +762,11 @@ class ServiceBatchSource:
         self._dispatcher_address = tuple(dispatcher_address)
         self.client_index = client_index
         self.num_clients = num_clients
+        self.job_id = str(job_id) if job_id is not None else None
+        # The dispatcher's fair-share credit scaling for this job (1.0 =
+        # full window). Updated from assignment/plan/sync replies; applied
+        # to streams opened AFTER the update, like set_credits.
+        self._credit_scale = 1.0
         self.client_id = client_id or (
             f"client-{client_index}-{os.getpid()}-{uuid.uuid4().hex[:6]}")
         self._connect_timeout = connect_timeout
@@ -849,6 +879,12 @@ class ServiceBatchSource:
         protocol errors raise immediately. Replies carrying a
         ``fencing_epoch`` update the observed-epoch counter."""
 
+        if self.job_id is not None and "job_id" not in header:
+            # Every control request carries the job identity: the
+            # dispatcher scopes fencing, assignment records, and recovery
+            # attribution by it (multi-tenant fleets).
+            header = dict(header, job_id=self.job_id)
+
         def once():
             with FramedConnection.connect(
                     self._dispatcher_address,
@@ -870,6 +906,11 @@ class ServiceBatchSource:
                 self._recovery["fencing_epoch"] = max(
                     self._recovery["fencing_epoch"],
                     int(reply["fencing_epoch"]))
+        if "credit_scale" in reply:
+            # The fair-share plan's flow-control scaling for this job —
+            # applied to streams opened after this reply (a live stream's
+            # window was negotiated on its request, like set_credits).
+            self._credit_scale = float(reply["credit_scale"])
         return reply
 
     # -- runtime knobs (live-adjustable: the autotuner's bindings) ---------
@@ -939,6 +980,19 @@ class ServiceBatchSource:
                 "transform= to make placement meaningful")
         self._transform_placement = placement
 
+    def _effective_credits(self):
+        """The configured credit window scaled by this job's fair share
+        (``credit_scale`` from the dispatcher): a job granted half the
+        capacity opens streams with half the window, which is how the
+        fair-scheduling plan actually bounds a tenant's in-flight claim
+        on each worker. Floor 1 (a stream must be able to move); 1.0 —
+        the single-tenant / equal-weight / largest-share case — is the
+        identity."""
+        credits = self._credits
+        if credits is None or self._credit_scale >= 1.0:
+            return credits
+        return max(1, int(round(credits * self._credit_scale)))
+
     def _derived_ready_depth(self, streams):
         """The default ready-queue bound when none was pinned: wide
         enough for every credit the flow-control windows can have in
@@ -994,6 +1048,13 @@ class ServiceBatchSource:
                 "ordered delivery requires static or dynamic sharding: "
                 "fcfs hands splits out first-come-first-served, so no "
                 "canonical piece order exists to sequence against")
+        if self.job_id is not None and info["mode"] == "fcfs":
+            raise ValueError(
+                "job_id requires static or dynamic sharding: fcfs hands "
+                "splits out of ONE shared queue with no per-job "
+                "assignment, so concurrent jobs would silently split — "
+                "not share — each epoch's data. Run the dispatcher with "
+                "mode='dynamic' (or 'static') for multi-tenant fleets")
         # Freeze the transform placement for this whole iteration: every
         # stream it opens (takeover/resync relaunches included) carries
         # the same placement, and the local applier wraps the iterator
@@ -1103,11 +1164,12 @@ class ServiceBatchSource:
                     pending_all.extend(pending)
                     streams[len(streams)] = _WorkerStream(
                         wid, reply["workers"][wid], pending, epoch,
-                        self._connect_timeout, credits=self._credits,
-                        tagged=True,
+                        self._connect_timeout,
+                        credits=self._effective_credits(), tagged=True,
                         starts={p: starts.get(p, 0) for p in pending},
                         shuffle_seed=self._shuffle_seed,
-                        transform_placement=self._iter_transform_placement)
+                        transform_placement=self._iter_transform_placement,
+                        job_id=self.job_id)
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1287,10 +1349,11 @@ class ServiceBatchSource:
                     wid, address,
                     piece_order(self._shuffle_seed, epoch, pieces),
                     epoch, self._connect_timeout,
-                    credits=self._credits, tagged=True,
+                    credits=self._effective_credits(), tagged=True,
                     starts={p: marks.get(p, 0) for p in pieces},
                     shuffle_seed=self._shuffle_seed,
-                    transform_placement=self._iter_transform_placement))
+                    transform_placement=self._iter_transform_placement,
+                    job_id=self.job_id))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1622,8 +1685,10 @@ class ServiceBatchSource:
             sid = next(sid_counter)
             stream = _DynamicStream(
                 wid, addresses[wid], pairs, epoch, self._connect_timeout,
-                credits=self._credits, shuffle_seed=self._shuffle_seed,
-                transform_placement=self._iter_transform_placement)
+                credits=self._effective_credits(),
+                shuffle_seed=self._shuffle_seed,
+                transform_placement=self._iter_transform_placement,
+                job_id=self.job_id)
             streams[sid] = stream
             sid_by_wid[wid] = sid
             with self._lock:
@@ -1760,9 +1825,11 @@ class ServiceBatchSource:
                 def attempt():
                     fresh = _DynamicStream(
                         wid, addresses[wid], pairs, epoch,
-                        self._connect_timeout, credits=self._credits,
+                        self._connect_timeout,
+                        credits=self._effective_credits(),
                         shuffle_seed=self._shuffle_seed,
-                        transform_placement=self._iter_transform_placement)
+                        transform_placement=self._iter_transform_placement,
+                        job_id=self.job_id)
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -2214,9 +2281,11 @@ class ServiceBatchSource:
         def attempt():
             fresh = _WorkerStream(
                 stream.worker_id, stream.address, pending, stream.epoch,
-                self._connect_timeout, credits=self._credits, tagged=True,
+                self._connect_timeout,
+                credits=self._effective_credits(), tagged=True,
                 starts=starts, shuffle_seed=self._shuffle_seed,
-                transform_placement=self._iter_transform_placement)
+                transform_placement=self._iter_transform_placement,
+                job_id=self.job_id)
             event = fresh.next_event()  # forces connect + first reply
             return fresh, event
 
@@ -2293,11 +2362,12 @@ class ServiceBatchSource:
                           piece_order(self._shuffle_seed, stream.epoch,
                                       pieces),
                           stream.epoch,
-                          self._connect_timeout, credits=self._credits,
-                          tagged=True,
+                          self._connect_timeout,
+                          credits=self._effective_credits(), tagged=True,
                           starts={p: starts.get(p, 0) for p in pieces},
                           shuffle_seed=self._shuffle_seed,
-                          transform_placement=self._iter_transform_placement)
+                          transform_placement=self._iter_transform_placement,
+                          job_id=self.job_id)
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2377,9 +2447,10 @@ class ServiceBatchSource:
             # bounds the worker's read-ahead past this client.
             stream = _WorkerStream(
                 wid, address, [piece], epoch, self._connect_timeout,
-                credits=self._credits, auto_replenish=True,
+                credits=self._effective_credits(), auto_replenish=True,
                 shuffle_seed=self._shuffle_seed,
-                transform_placement=self._iter_transform_placement)
+                transform_placement=self._iter_transform_placement,
+                job_id=self.job_id)
             try:
                 yield from self._drain_one(stream)
                 return True

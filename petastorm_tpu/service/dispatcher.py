@@ -72,6 +72,13 @@ from petastorm_tpu.reader_impl.framed_socket import (
     FramedServer,
     send_framed,
 )
+from petastorm_tpu.service.fleet import (
+    DEFAULT_JOB,
+    AutoscaleConfig,
+    AutoscaleController,
+    credit_scales,
+    plan_fair_shares,
+)
 from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
@@ -82,6 +89,12 @@ from petastorm_tpu.telemetry.metrics import (
     DISPATCHER_REQUESTS,
     DISPATCHER_STEALS,
     DISPATCHER_WORKERS,
+    FLEET_AUTOSCALE_DECISIONS,
+    FLEET_JOB_BACKLOG,
+    FLEET_JOB_FAIR_SHARE,
+    FLEET_JOB_FENCING_EPOCH,
+    FLEET_JOBS,
+    FLEET_WORKERS,
 )
 
 logger = service_logger(__name__)
@@ -95,7 +108,7 @@ STRAGGLER_RATE_FACTOR = 0.5
 
 
 def plan_steals(pending, stealable, rates,
-                straggler_factor=STRAGGLER_RATE_FACTOR):
+                straggler_factor=STRAGGLER_RATE_FACTOR, receivers=None):
     """Work-stealing planner (pure — unit-testable without sockets).
 
     :param pending: ``{worker_id: not-done piece count}`` over live workers.
@@ -105,6 +118,10 @@ def plan_steals(pending, stealable, rates,
         the race where one starts between report and revoke.
     :param rates: ``{worker_id: rows_per_s}`` from the client's PR 4
         delivery counters (may be empty early in an epoch).
+    :param receivers: worker ids eligible to RECEIVE pieces (``None`` =
+        every worker in ``pending``). The fleet autoscaler passes only
+        serving workers here: a draining worker may still donate its
+        backlog but must never be handed new work.
     :returns: ``[(piece, from_worker, to_worker), ...]`` — steals are taken
         from the donor's TAIL (farthest from being served).
 
@@ -127,6 +144,7 @@ def plan_steals(pending, stealable, rates,
     """
     pending = dict(pending)
     stealable = {wid: list(ps) for wid, ps in stealable.items()}
+    eligible = set(pending) if receivers is None else set(receivers)
     moves = []
     while True:
         donors = [wid for wid, ps in stealable.items()
@@ -134,26 +152,28 @@ def plan_steals(pending, stealable, rates,
         if not donors:
             return moves
         donor = max(donors, key=lambda w: (pending[w], w))
-        receivers = [wid for wid in pending
-                     if wid != donor and pending[wid] == 0]
-        if not receivers:
+        receivers_now = [wid for wid in pending
+                         if wid != donor and wid in eligible
+                         and pending[wid] == 0]
+        if not receivers_now:
             working = sorted(r for wid, r in rates.items()
                              if pending.get(wid, 0) > 0)
             median = working[len(working) // 2] if working else None
             donor_rate = rates.get(donor)
             if median and donor_rate is not None \
                     and donor_rate < straggler_factor * median:
-                receivers = [
+                receivers_now = [
                     wid for wid in pending
-                    if wid != donor and rates.get(wid, 0.0) >= median
+                    if wid != donor and wid in eligible
+                    and rates.get(wid, 0.0) >= median
                     # "materially less backlog" — waived while the donor
                     # has delivered nothing at all (equal backlogs say
                     # nothing when only one side is moving).
                     and (pending[wid] < pending[donor] - 1
                          or not donor_rate)]
-        if not receivers:
+        if not receivers_now:
             return moves
-        recv = min(receivers,
+        recv = min(receivers_now,
                    key=lambda w: (pending[w], -rates.get(w, 0.0), w))
         donor_rate, recv_rate = rates.get(donor), rates.get(recv)
         if donor_rate and recv_rate:
@@ -235,12 +255,23 @@ class Dispatcher:
         timing, and kill/resume. ``None`` = no shuffling (ascending piece
         order, equally deterministic). Static and dynamic modes; fcfs
         ignores it (its queue is inherently racy).
+    :param autoscale: arm the fleet autoscaler
+        (:mod:`petastorm_tpu.service.fleet`): ``True`` for defaults, a
+        dict of :class:`~petastorm_tpu.service.fleet.AutoscaleConfig`
+        kwargs, or a config instance. A controller thread (name prefix
+        ``fleet-autoscale``) then admits pooled standby workers into
+        serving when backlog piles up and drains/retires them when the
+        fleet idles, journaling every decision. ``None`` (default)
+        disables it — worker states still exist (a ``standby=True``
+        worker stays pooled until :meth:`admit_worker`), but nothing
+        decides automatically
+        (``docs/guides/service.md#multi-tenancy-and-autoscaling``).
     """
 
     def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1,
                  journal_dir=None, lease_timeout_s=DEFAULT_LEASE_TIMEOUT_S,
                  journal_compact_every=256, journal_fsync=False,
-                 max_frame_bytes=None, shuffle_seed=None):
+                 max_frame_bytes=None, shuffle_seed=None, autoscale=None):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if num_epochs is not None and num_epochs <= 0:
@@ -256,8 +287,42 @@ class Dispatcher:
         self.lease_timeout_s = lease_timeout_s or None
         self._max_frame_bytes = max_frame_bytes
         self._lock = threading.Lock()
-        self._workers = {}   # worker_id -> {address, num_pieces, alive}
-        self._clients = {}   # client_id -> {epoch, client_index, num_clients}
+        self._workers = {}   # worker_id -> {address, num_pieces, alive,
+        #                      state: serving|standby|draining}
+        self._clients = {}   # client_id -> {epoch, client_index,
+        #                      num_clients[, job_id]}
+        # job_id -> {"weight", "quota", "fencing_offset", "epoch"} — the
+        # fleet's first-class job objects (register_job/end_job). The
+        # DEFAULT_JOB exists implicitly (created on first touch, never
+        # journaled as a registration) so single-tenant deployments see
+        # zero new requests and identical journals. A job's scoped
+        # fencing epoch is `global + fencing_offset`: fleet-wide events
+        # (restart, eviction) move the global base for everyone, while a
+        # job-scoped bump (its own restart/cancel) moves only its offset
+        # — one job's chaos can never fence another's streams.
+        self._jobs = {}
+        # job_id -> per-job recovery counters (failures_reported,
+        # stale_fencing_rejections, fencing_bumps) — the per-job breakout
+        # of the fleet-global `_recovery`, so one job's takeover storm is
+        # attributable in `status --watch`.
+        self._job_recovery = {}
+        # Monotonicity floor for job fencing offsets: ending a job
+        # raises the floor past its final offset, and every LATER job
+        # incarnation starts there — so a stale client of an ended
+        # incarnation can never pass the scoped stale-fencing check
+        # against a recreated job of the same name. One scalar (not
+        # per-name tombstones): unique chaos job names must not grow the
+        # snapshot forever, and an inflated starting offset for an
+        # unrelated new job is harmless (epochs only compare within a
+        # job).
+        self._job_fence_floor = 0
+        # Journaled autoscale decision counters (admit/drain/retire) —
+        # replayed byte-identically with the rest of the snapshot.
+        self._autoscale_counts = {"admit": 0, "drain": 0, "retire": 0}
+        # Runtime-only: last per-worker delivery rates reported through
+        # dynamic_sync — the autoscaler's EMA'd signal feed (never
+        # persisted: rates are meaningless across a restart).
+        self._last_rates = {}
         # client_id -> {"epoch", "watermarks": {piece: next ordinal}} —
         # delivery watermarks riding client heartbeats, journaled so a
         # restarted dispatcher (and `status`) knows how far each piece
@@ -277,8 +342,12 @@ class Dispatcher:
         # Dirty marker for the per-worker backlog/steal gauges: the
         # aggregation walks every client's owner map, so it runs only
         # after a request that actually mutated dynamic state — not on
-        # every heartbeat/ping of a large fleet.
+        # every heartbeat/ping of a large fleet. The per-JOB aggregation
+        # is memoized on the same events (_per_job_memo): fair shares,
+        # telemetry, and status may each read it on one request without
+        # re-walking the owner maps under the lock.
         self._dyn_dirty = True
+        self._per_job_memo = None
         self._generation = 0
         # runtime-only liveness clocks (never persisted: wall-clock leases
         # restart from "now" after a recovery — a restored worker gets a
@@ -302,6 +371,10 @@ class Dispatcher:
                                     compact_every=journal_compact_every,
                                     fsync=journal_fsync)
         self._lease_thread = None
+        self._autoscaler = None
+        if autoscale:
+            self._autoscaler = AutoscaleController(
+                self, AutoscaleConfig.coerce(autoscale))
         self._server = FramedServer(self._serve_connection, host=host,
                                     port=port, name="service-dispatcher")
 
@@ -316,6 +389,8 @@ class Dispatcher:
                 target=self._lease_loop, daemon=True,
                 name="service-dispatcher-leases")
             self._lease_thread.start()
+        if self._autoscaler is not None:
+            self._autoscaler.start()
         return self
 
     @property
@@ -324,6 +399,10 @@ class Dispatcher:
         return self._server.address
 
     def stop(self):
+        # The autoscaler mutates journaled state: stop it FIRST so no
+        # decision lands between handler drain and journal close.
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         self._server.stop()
         # Drain handler threads BEFORE closing the journal: an in-flight
         # mutation must finish its append (or fail its request), never
@@ -362,6 +441,11 @@ class Dispatcher:
             "num_pieces": self._num_pieces,
             "workers": {wid: dict(w) for wid, w in self._workers.items()},
             "clients": {cid: dict(c) for cid, c in self._clients.items()},
+            "jobs": {jid: dict(j) for jid, j in self._jobs.items()},
+            "job_recovery": {jid: dict(r)
+                             for jid, r in self._job_recovery.items()},
+            "job_fence_floor": self._job_fence_floor,
+            "autoscale": dict(self._autoscale_counts),
             "client_watermarks": {
                 cid: {"epoch": entry["epoch"],
                       "watermarks": {str(p): n for p, n
@@ -443,8 +527,19 @@ class Dispatcher:
                                or {}).items()}
         self._workers = {wid: dict(w)
                          for wid, w in state.get("workers", {}).items()}
+        for worker in self._workers.values():
+            worker.setdefault("state", "serving")  # pre-fleet journals
         self._clients = {cid: dict(c)
                          for cid, c in state.get("clients", {}).items()}
+        self._jobs = {jid: dict(j)
+                      for jid, j in (state.get("jobs") or {}).items()}
+        self._job_recovery = {
+            jid: dict(r)
+            for jid, r in (state.get("job_recovery") or {}).items()}
+        self._job_fence_floor = int(state.get("job_fence_floor", 0))
+        autoscale = state.get("autoscale") or {}
+        for key in self._autoscale_counts:
+            self._autoscale_counts[key] = int(autoscale.get(key, 0))
         self._fcfs_epoch = int(state.get("fcfs_epoch", 0))
         queue = state.get("fcfs_queue")
         self._fcfs_queue = deque(queue) if queue is not None else None
@@ -454,7 +549,7 @@ class Dispatcher:
             self._recovery[key] = int(recovered.get(key, 0))
         self._generation = int(state.get("generation", 0))
         self._dyn = {}
-        self._dyn_dirty = True
+        self._mark_dyn_dirty_locked()
         for cid, dyn in (state.get("dyn") or {}).items():
             self._dyn[cid] = {
                 "epoch": int(dyn["epoch"]),
@@ -476,16 +571,27 @@ class Dispatcher:
                 record["worker_id"],
                 [record["host"], int(record["port"])],
                 int(record["num_pieces"]),
-                re_register=bool(record.get("re_register")))
+                re_register=bool(record.get("re_register")),
+                standby=bool(record.get("standby")))
         elif op == "worker_dead":
             self._mark_worker_dead_locked(record["worker_id"],
-                                          record.get("reason", "reported"))
+                                          record.get("reason", "reported"),
+                                          job_id=record.get("job_id"))
         elif op == "client":
-            self._clients[record["client_id"]] = {
-                "epoch": int(record["epoch"]),
-                "client_index": int(record["client_index"]),
-                "num_clients": int(record["num_clients"]),
-            }
+            self._install_client_locked(
+                record["client_id"], int(record["epoch"]),
+                int(record["client_index"]), int(record["num_clients"]),
+                record.get("job_id"))
+        elif op == "job_register":
+            self._install_job_locked(
+                record["job_id"], float(record.get("weight", 1.0)),
+                record.get("quota"),
+                restart=bool(record.get("restart")))
+        elif op == "job_end":
+            self._remove_job_locked(record["job_id"])
+        elif op == "autoscale":
+            self._apply_autoscale_locked(record["action"],
+                                         record["worker_id"])
         elif op == "next_split":
             self._replay_next_split_locked(int(record["piece"]),
                                            int(record["epoch"]))
@@ -576,26 +682,39 @@ class Dispatcher:
                     self._bump_fencing_locked("lease_expiry")
                     self._sync_telemetry_locked()
 
-    def _mark_worker_dead_locked(self, worker_id, reason):
+    def _mark_worker_dead_locked(self, worker_id, reason, job_id=None):
         worker = self._workers.get(worker_id)
         if worker is None or not worker["alive"]:
             return False
         worker["alive"] = False
         self._worker_leases.pop(worker_id, None)
+        self._last_rates.pop(worker_id, None)  # stale signal, never fed
         if reason == "lease_expired":
             self._recovery["evictions"] += 1
         else:
             self._recovery["failures_reported"] += 1
+            if job_id is not None:
+                # Per-job attribution: the reporting client's job — the
+                # breakout that makes one job's takeover storm visible in
+                # `status` instead of smearing fleet-wide.
+                self._job_recovery_locked(job_id)["failures_reported"] += 1
         return True
 
     def _install_worker_locked(self, worker_id, address, num_pieces,
-                               re_register=False):
+                               re_register=False, standby=False):
         known = worker_id in self._workers
+        # Preserve the lifecycle state of a worker the autoscaler already
+        # placed (a heartbeat-healed re-registration must not silently
+        # flip an admitted worker back to its launch-time standby flag);
+        # fresh workers start where their flag says.
+        prev_state = (self._workers[worker_id].get("state")
+                      if known else None)
         self._num_pieces = num_pieces
         self._workers[worker_id] = {
             "address": list(address),
             "num_pieces": num_pieces,
             "alive": True,
+            "state": prev_state or ("standby" if standby else "serving"),
         }
         if known or re_register:
             self._recovery["re_registrations"] += 1
@@ -603,11 +722,160 @@ class Dispatcher:
             time.monotonic() + (self.lease_timeout_s or 0.0))
         return known
 
+    # -- jobs (multi-tenancy) ----------------------------------------------
+
+    def _job_recovery_locked(self, job_id):
+        return self._job_recovery.setdefault(
+            job_id, {"failures_reported": 0, "stale_fencing_rejections": 0,
+                     "fencing_bumps": 0})
+
+    def _install_job_locked(self, job_id, weight=1.0, quota=None,
+                            restart=False):
+        """Create (or restart) a job record. A restart — re-registering a
+        live job — bumps only ITS scoped fencing offset: its own stale
+        clients resync while every other job's epoch stays put."""
+        job = self._jobs.get(job_id)
+        if job is None:
+            self._jobs[job_id] = {
+                "weight": float(weight),
+                "quota": (float(quota) if quota is not None else None),
+                # Start at the retirement floor: a recreated job's scoped
+                # epoch is strictly past every token its ended namesake's
+                # clients could still hold.
+                "fencing_offset": self._job_fence_floor,
+                "epoch": 0,
+            }
+            return False
+        job["weight"] = float(weight)
+        job["quota"] = float(quota) if quota is not None else None
+        if restart:
+            job["fencing_offset"] += 1
+            self._job_recovery_locked(job_id)["fencing_bumps"] += 1
+        # Job churn re-arms the gauge sync: without this, an idle
+        # dynamic dispatcher would keep exporting the pre-restart
+        # fencing epoch / fair shares until an unrelated mutation.
+        self._mark_dyn_dirty_locked()
+        return True
+
+    def _ensure_job_locked(self, job_id):
+        """Implicit job creation on first touch. The DEFAULT_JOB (and any
+        job a client names without registering) materializes with weight
+        1.0 and no quota; explicit ``register_job`` is only required for
+        non-default weights/quotas — and is what the open-registration
+        leak guard tracks."""
+        if job_id not in self._jobs:
+            self._install_job_locked(job_id)
+        return self._jobs[job_id]
+
+    def _remove_job_locked(self, job_id):
+        job = self._jobs.pop(job_id, None)
+        if job is None:
+            return False
+        self._job_fence_floor = max(self._job_fence_floor,
+                                    job["fencing_offset"] + 1)
+        self._job_recovery.pop(job_id, None)
+        self._mark_dyn_dirty_locked()  # surviving jobs' shares shifted
+        # Drop the job's labeled gauge series: an ended job must vanish
+        # from /metrics, not report stale shares forever (the job-cancel
+        # chaos kind would otherwise grow the registry per injection).
+        for family in (FLEET_JOB_FENCING_EPOCH, FLEET_JOB_FAIR_SHARE,
+                       FLEET_JOB_BACKLOG):
+            family.remove(job_id)
+        ended_clients = [cid for cid, c in self._clients.items()
+                         if c.get("job_id", DEFAULT_JOB) == job_id]
+        for cid in ended_clients:
+            self._clients.pop(cid, None)
+            self._client_heartbeats.pop(cid, None)
+            self._client_watermarks.pop(cid, None)
+            if self._dyn.pop(cid, None) is not None:
+                self._mark_dyn_dirty_locked()
+        return True
+
+    def _job_fencing_locked(self, job_id):
+        """The job's scoped fencing epoch: the fleet-wide base plus its
+        private offset — monotone under both fleet-wide and job-scoped
+        bumps, and equal to the global epoch for a job that has never
+        been individually fenced (the single-tenant identity)."""
+        job = self._jobs.get(job_id)
+        offset = job["fencing_offset"] if job is not None else 0
+        return self._fencing_epoch + offset
+
+    def _client_job_locked(self, client_id, header=None):
+        """The job a request belongs to: the explicit ``job_id`` field,
+        else whatever the client registered under, else the default."""
+        if header is not None and header.get("job_id"):
+            return str(header["job_id"])
+        client = self._clients.get(client_id)
+        if client is not None:
+            return client.get("job_id", DEFAULT_JOB)
+        return DEFAULT_JOB
+
+    def _install_client_locked(self, client_id, epoch, client_index,
+                               num_clients, job_id=None):
+        entry = {
+            "epoch": int(epoch),
+            "client_index": int(client_index),
+            "num_clients": int(num_clients),
+        }
+        if job_id is not None and job_id != DEFAULT_JOB:
+            entry["job_id"] = str(job_id)
+        if self._clients.get(client_id) != entry:
+            self._per_job_memo = None  # job association shifted
+        self._clients[client_id] = entry
+        job = self._ensure_job_locked(job_id or DEFAULT_JOB)
+        job["epoch"] = max(job["epoch"], int(epoch))
+
+    def _job_shares_locked(self):
+        """Weighted max-min fair shares of serving-worker capacity across
+        live jobs (:func:`petastorm_tpu.service.fleet.plan_fair_shares`).
+        Demand is each job's unserved backlog (dynamic mode) or simple
+        presence (static — every job with clients wants its full share);
+        weights/quotas come from the job records."""
+        serving = self._serving_workers()
+        capacity = float(max(1, len(serving)))
+        per_job = self._dynamic_per_job_locked() if self.mode == "dynamic" \
+            else {}
+        jobs_with_clients = {c.get("job_id", DEFAULT_JOB)
+                             for c in self._clients.values()}
+        demands = {}
+        for jid in self._jobs:
+            backlog = per_job.get(jid, {}).get("backlog", 0)
+            if backlog:
+                demands[jid] = float(backlog)
+            elif jid in jobs_with_clients:
+                # Present but between epochs: it wants its full share.
+                demands[jid] = capacity
+            else:
+                # Registered but clientless: an idle reservation must
+                # not shrink active jobs' windows — max-min means no
+                # capacity idles while anyone has demand.
+                demands[jid] = 0.0
+        if not demands:
+            return {}
+        return plan_fair_shares(
+            capacity, demands,
+            weights={jid: j["weight"] for jid, j in self._jobs.items()},
+            quotas={jid: j["quota"] for jid, j in self._jobs.items()})
+
+    def _credit_scale_locked(self, job_id):
+        """This job's flow-control scale factor from the fair-share plan
+        (1.0 when it holds the largest share — the single-tenant and
+        equal-weight identity). Short-circuits BEFORE computing shares
+        when at most one job exists: the share plan walks every client's
+        owner map, which must stay off the single-tenant sync hot path
+        (the same discipline as the telemetry dirty flag)."""
+        if len(self._jobs) <= 1:
+            return 1.0
+        shares = self._job_shares_locked()
+        if len(shares) <= 1:
+            return 1.0
+        return round(credit_scales(shares).get(job_id, 1.0), 4)
+
     # -- dynamic-mode mutations (shared by live handlers and WAL replay) ---
 
     def _install_dynamic_plan_locked(self, client_id, epoch, owner,
                                      generation):
-        self._dyn_dirty = True
+        self._mark_dyn_dirty_locked()
         self._dyn[client_id] = {
             "epoch": epoch,
             "owner": dict(owner),
@@ -624,7 +892,7 @@ class Dispatcher:
         state = self._dyn.get(client_id)
         if state is None:
             return
-        self._dyn_dirty = True
+        self._mark_dyn_dirty_locked()
         state["owner"][piece] = [to_wid, generation]
         self._generation = max(self._generation, generation)
         self._steal_counts_locked(state, from_wid)["out"] += 1
@@ -638,7 +906,7 @@ class Dispatcher:
         state = self._dyn.get(client_id)
         if state is None:
             return
-        self._dyn_dirty = True
+        self._mark_dyn_dirty_locked()
         state["owner"][piece] = [kept_wid, generation]
         self._generation = max(self._generation, generation)
 
@@ -688,6 +956,25 @@ class Dispatcher:
         DISPATCHER_WORKERS.labels("dead").set(len(self._workers) - alive)
         for event, count in self._recovery.items():
             DISPATCHER_RECOVERY_EVENTS.labels(event).set(count)
+        for state in ("serving", "standby", "draining"):
+            FLEET_WORKERS.labels(state).set(sum(
+                1 for w in self._workers.values()
+                if w["alive"] and w.get("state", "serving") == state))
+        FLEET_JOBS.set(len(self._jobs))
+        if self._jobs and (self.mode != "dynamic" or self._dyn_dirty):
+            # Same dirty-flag discipline as the per-worker gauges below:
+            # the per-job aggregation walks every client's owner map, so
+            # it only runs after a request that mutated dynamic state.
+            shares = self._job_shares_locked()
+            per_job = (self._dynamic_per_job_locked()
+                       if self.mode == "dynamic" else {})
+            for jid in self._jobs:
+                FLEET_JOB_FENCING_EPOCH.labels(jid).set(
+                    self._job_fencing_locked(jid))
+                FLEET_JOB_FAIR_SHARE.labels(jid).set(
+                    round(shares.get(jid, 0.0), 4))
+                FLEET_JOB_BACKLOG.labels(jid).set(
+                    per_job.get(jid, {}).get("backlog", 0))
         if self.mode == "dynamic":
             DISPATCHER_GENERATION.set(self._generation)
             if not self._dyn_dirty:
@@ -727,18 +1014,154 @@ class Dispatcher:
                 e["steals_out"] += counts["out"]
         return per_worker
 
+    def _mark_dyn_dirty_locked(self):
+        """One site for "dynamic state changed": re-arms the gauge
+        aggregation AND drops the per-job memo (they derive from the
+        same owner maps and must invalidate together)."""
+        self._dyn_dirty = True
+        self._per_job_memo = None
+
+    def _dynamic_per_job_locked(self):
+        """Per-JOB backlog/steal aggregation: the per-worker books of
+        :meth:`_dynamic_per_worker_locked`, re-keyed by each client's job
+        — steals are intra-job by construction (the planner runs per
+        client, and every client belongs to exactly one job), so a job's
+        ``steals`` count the rebalancing ITS pieces went through, never a
+        neighbor's. Memoized until the next dynamic-state mutation
+        (fair shares + telemetry + status may each read it per request
+        — the walk is O(clients × pieces) under the global lock).
+        Callers must treat the result as read-only."""
+        if self._per_job_memo is not None:
+            return self._per_job_memo
+        per_job = {}
+        for cid, state in self._dyn.items():
+            jid = self._client_job_locked(cid)
+            entry = per_job.setdefault(
+                jid, {"backlog": 0, "steals_in": 0, "steals_out": 0,
+                      "pieces_done": 0, "pieces_total": 0,
+                      "active_clients": 0})
+            entry["active_clients"] += 1
+            entry["pieces_done"] += len(state["done"])
+            entry["pieces_total"] += len(state["owner"])
+            entry["backlog"] += sum(
+                1 for piece in state["owner"] if piece not in state["done"])
+            for counts in state["steals"].values():
+                entry["steals_in"] += counts["in"]
+                entry["steals_out"] += counts["out"]
+        self._per_job_memo = per_job
+        return per_job
+
     def _dynamic_status_locked(self):
         """Per-worker steal/backlog aggregation for ``status`` (and the
         ``STEALS`` column of ``status --watch``)."""
         return {
             "generation": self._generation,
             "per_worker": self._dynamic_per_worker_locked(),
+            "per_job": self._dynamic_per_job_locked(),
             "clients": {
                 cid: {"epoch": state["epoch"],
+                      "job_id": self._client_job_locked(cid),
                       "pieces_done": len(state["done"]),
                       "pieces_total": len(state["owner"])}
                 for cid, state in self._dyn.items()},
         }
+
+    # -- fleet autoscaling -------------------------------------------------
+
+    def fleet_signals(self):
+        """The autoscaler planner's input: worker lifecycle states plus
+        the dispatcher's live backlog and last-reported delivery rates
+        (the same EMA'd signals the steal planner consumes). Pure data —
+        the planner never touches dispatcher internals."""
+        with self._lock:
+            by_state = {"serving": [], "standby": [], "draining": []}
+            for wid, worker in sorted(self._workers.items()):
+                if worker["alive"]:
+                    by_state.setdefault(
+                        worker.get("state", "serving"), []).append(wid)
+            backlog = {}
+            if self.mode == "dynamic":
+                backlog = {wid: entry["backlog"] for wid, entry
+                           in self._dynamic_per_worker_locked().items()}
+            return {
+                "serving": by_state["serving"],
+                "standby": by_state["standby"],
+                "draining": by_state["draining"],
+                "backlog": backlog,
+                # Static/fcfs dispatchers track no per-worker progress:
+                # without a real backlog signal the planner must not read
+                # "zero backlog" as "idle fleet" and drain busy workers.
+                "backlog_known": self.mode == "dynamic",
+                "rates": dict(self._last_rates),
+            }
+
+    def _apply_autoscale_locked(self, action, worker_id):
+        """The one state machine for autoscale transitions (live AND WAL
+        replay): admit standby/draining → serving, drain serving →
+        draining, retire drained → standby. Returns whether the
+        transition applied — an invalid one (worker gone, wrong state) is
+        a no-op, so a replayed decision against a since-evicted worker
+        converges instead of corrupting."""
+        worker = self._workers.get(worker_id)
+        if worker is None or not worker["alive"]:
+            return False
+        state = worker.get("state", "serving")
+        if action == "admit" and state in ("standby", "draining"):
+            worker["state"] = "serving"
+        elif action == "drain" and state == "serving":
+            # Hard floor, enforced at APPLY time: concurrent drainers
+            # (autoscaler + chaos + operator) each check-then-act from
+            # their own snapshots, so without this the last serving
+            # worker could drain and every grant request would error.
+            # Deliberately a CONSTANT floor of one (not min_serving): the
+            # planner's policy floor lives planner-side, and a journaled
+            # drain must re-apply identically on a replay regardless of
+            # how the restarted dispatcher's autoscaler is configured.
+            serving = sum(
+                1 for w in self._workers.values()
+                if w["alive"] and w.get("state", "serving") == "serving")
+            if serving <= 1:
+                return False
+            worker["state"] = "draining"
+        elif action == "retire" and state == "draining":
+            worker["state"] = "standby"
+        else:
+            return False
+        self._autoscale_counts[action] += 1
+        self._mark_dyn_dirty_locked()
+        return True
+
+    def apply_autoscale(self, action, worker_id, reason=None):
+        """Apply one autoscale decision, journaled (the controller's — and
+        the chaos harness's — entry point). Admission takes effect on the
+        next plan/steal round (PR 7's mid-epoch join path feeds the new
+        worker); a drain stops new grants while live streams finish and
+        the steal path sheds the not-yet-started backlog exactly-once
+        through the ordinary revoke→extend re-grant handshake."""
+        with self._lock:
+            applied = self._apply_autoscale_locked(action, worker_id)
+            if applied:
+                self._journal_locked({"op": "autoscale", "action": action,
+                                      "worker_id": worker_id})
+                FLEET_AUTOSCALE_DECISIONS.labels(action).inc()
+                self._sync_telemetry_locked()
+        if applied:
+            logger.info("autoscale: %s worker (%s)", action,
+                        reason or "operator", worker_id=worker_id)
+        return applied
+
+    def admit_worker(self, worker_id, reason="manual"):
+        """Promote a standby (or draining) worker into serving."""
+        return self.apply_autoscale("admit", worker_id, reason=reason)
+
+    def drain_worker(self, worker_id, reason="manual"):
+        """Stop granting to a serving worker; its live streams complete
+        and its queued backlog is stolen away to serving peers."""
+        return self.apply_autoscale("drain", worker_id, reason=reason)
+
+    def retire_worker(self, worker_id, reason="manual"):
+        """Return a fully-drained worker to the standby pool."""
+        return self.apply_autoscale("retire", worker_id, reason=reason)
 
     # -- handlers ----------------------------------------------------------
 
@@ -749,6 +1172,7 @@ class Dispatcher:
         worker_id = header["worker_id"]
         num_pieces = int(header["num_pieces"])
         re_register = bool(header.get("re_register"))
+        standby = bool(header.get("standby"))
         with self._lock:
             if self._num_pieces is not None \
                     and self._num_pieces != num_pieces:
@@ -759,17 +1183,66 @@ class Dispatcher:
                     f"planning config")}
             self._install_worker_locked(
                 worker_id, [header["host"], int(header["port"])],
-                num_pieces, re_register=re_register)
+                num_pieces, re_register=re_register, standby=standby)
             self._journal_locked({
                 "op": "register_worker", "worker_id": worker_id,
                 "host": header["host"], "port": int(header["port"]),
-                "num_pieces": num_pieces, "re_register": re_register})
+                "num_pieces": num_pieces, "re_register": re_register,
+                "standby": standby})
             fencing = self._fencing_epoch
-        logger.info("worker %sregistered at %s:%s (%d pieces)",
+            state = self._workers[worker_id]["state"]
+        logger.info("worker %sregistered at %s:%s (%d pieces, %s)",
                     "re-" if re_register else "",
-                    header["host"], header["port"], num_pieces,
+                    header["host"], header["port"], num_pieces, state,
                     worker_id=worker_id, fencing_epoch=fencing)
-        return {"type": "ok", "fencing_epoch": fencing}
+        return {"type": "ok", "fencing_epoch": fencing, "state": state}
+
+    def _handle_register_job(self, header):
+        """Register (or restart) a first-class trainer job. Multi-job
+        scheduling needs a per-job assignment to isolate, which fcfs's
+        shared first-come-first-served queue does not have — rejected
+        with the constraint named instead of undefined sharing."""
+        if self.mode == "fcfs":
+            return {"type": "error", "error": (
+                "register_job requires static or dynamic sharding: fcfs "
+                "hands splits out of ONE shared first-come-first-served "
+                "queue with no per-job assignment, so multiple jobs would "
+                "silently split (not share) every epoch's data — run the "
+                "dispatcher with mode='dynamic' (recommended: work-"
+                "stealing + autoscaling) or mode='static'")}
+        job_id = str(header["job_id"])
+        weight = float(header.get("weight", 1.0))
+        if weight <= 0:
+            return {"type": "error",
+                    "error": f"job weight must be > 0, got {weight}"}
+        quota = header.get("quota")
+        with self._lock:
+            restarted = self._install_job_locked(job_id, weight, quota,
+                                                 restart=True)
+            self._journal_locked({
+                "op": "job_register", "job_id": job_id, "weight": weight,
+                "quota": (float(quota) if quota is not None else None),
+                "restart": True})
+            fencing = self._job_fencing_locked(job_id)
+        logger.info("job %s (weight=%g quota=%s)",
+                    "restarted" if restarted else "registered", weight,
+                    quota, job_id=job_id, fencing_epoch=fencing)
+        return {"type": "ok", "job_id": job_id, "restarted": restarted,
+                "fencing_epoch": fencing}
+
+    def _handle_end_job(self, header):
+        """End a job: release its clients, piece queues, watermarks, and
+        quota. Idempotent — ending an unknown (or already-ended) job is a
+        no-op reply so teardown paths can call it unconditionally."""
+        job_id = str(header["job_id"])
+        with self._lock:
+            removed = self._remove_job_locked(job_id)
+            if removed:
+                self._journal_locked({"op": "job_end", "job_id": job_id})
+        if removed:
+            logger.info("job ended — clients, piece queues, and quota "
+                        "released", job_id=job_id)
+        return {"type": "ok", "job_id": job_id, "removed": removed}
 
     def _handle_worker_heartbeat(self, header):
         worker_id = header["worker_id"]
@@ -822,19 +1295,35 @@ class Dispatcher:
             return {
                 "type": "ok",
                 "known": known,
-                "fencing_epoch": self._fencing_epoch,
+                # Job-scoped: a peer job's restart bumps ITS offset only,
+                # so this client never sees a fence event for it.
+                "fencing_epoch": self._job_fencing_locked(
+                    self._client_job_locked(client_id, header)),
                 "recovery": dict(self._recovery),
             }
 
-    def _alive_workers(self):
-        return {wid: w for wid, w in self._workers.items() if w["alive"]}
+    def _alive_workers(self, states=("serving", "draining")):
+        """Live workers in the given lifecycle states. The default —
+        serving + draining — is "workers with streams that may still
+        flow"; standby workers are pooled capacity and never referenced
+        by a plan until admitted."""
+        return {wid: w for wid, w in self._workers.items()
+                if w["alive"] and w.get("state", "serving") in states}
+
+    def _serving_workers(self):
+        """Workers eligible to receive NEW grants (assignments, steals,
+        fcfs splits): alive and not standby/draining."""
+        return self._alive_workers(("serving",))
 
     def _handle_list_workers(self, header):
         with self._lock:
+            # Serving workers only: standby capacity is invisible to
+            # clients until admitted, and a draining worker takes no new
+            # fcfs splits (its live streams keep flowing regardless).
             return {
                 "type": "workers",
                 "workers": {wid: w["address"]
-                            for wid, w in self._alive_workers().items()},
+                            for wid, w in self._serving_workers().items()},
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
                 "num_pieces": self._num_pieces,
@@ -860,11 +1349,12 @@ class Dispatcher:
             return {"type": "error", "error":
                     f"client_index {client_index} out of range "
                     f"[0, {num_clients})"}
+        job_id = str(header.get("job_id") or DEFAULT_JOB)
         with self._lock:
             if self._num_pieces is None:
                 return {"type": "error",
                         "error": "no workers have registered yet"}
-            alive = self._alive_workers()
+            alive = self._serving_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
             # Partition the ASCENDING piece list (epoch-invariant), then
@@ -883,20 +1373,22 @@ class Dispatcher:
                 wid: piece_order(self.shuffle_seed, epoch_number, pieces)
                 for wid, pieces in self._partition(client_pieces,
                                                    worker_ids).items()}
-            self._clients[header["client_id"]] = {
-                "epoch": int(header.get("epoch", 0)),
-                "client_index": client_index,
-                "num_clients": num_clients,
-            }
+            self._install_client_locked(
+                header["client_id"], epoch_number, client_index,
+                num_clients, job_id)
             self._client_heartbeats[header["client_id"]] = time.monotonic()
-            self._journal_locked({
+            record = {
                 "op": "client", "client_id": header["client_id"],
-                "epoch": int(header.get("epoch", 0)),
-                "client_index": client_index, "num_clients": num_clients})
+                "epoch": epoch_number,
+                "client_index": client_index, "num_clients": num_clients}
+            if job_id != DEFAULT_JOB:
+                record["job_id"] = job_id
+            self._journal_locked(record)
             return {
                 "type": "assignment",
-                "epoch": int(header.get("epoch", 0)),
-                "fencing_epoch": self._fencing_epoch,
+                "epoch": epoch_number,
+                "fencing_epoch": self._job_fencing_locked(job_id),
+                "credit_scale": self._credit_scale_locked(job_id),
                 "assignments": assignments,
                 "workers": {wid: alive[wid]["address"]
                             for wid in assignments},
@@ -907,26 +1399,43 @@ class Dispatcher:
         pieces = [int(p) for p in header.get("pieces", [])]
         token = header.get("fencing_epoch")
         with self._lock:
-            if token is not None and int(token) < self._fencing_epoch:
+            job_id = self._client_job_locked(header.get("client_id"),
+                                             header)
+            if token is not None \
+                    and int(token) < self._job_fencing_locked(job_id):
                 # The client is acting on a plan the fencing epoch has
                 # since invalidated (dispatcher restart, eviction it has
                 # not seen): make it resync before any takeover — acting
                 # on the stale report could evict a worker that already
                 # re-registered, or re-partition splits the client no
-                # longer owns.
+                # longer owns. The comparison is against the client's
+                # JOB-scoped epoch, so a peer job's restart never
+                # invalidates this job's takeover.
                 self._recovery["stale_fencing_rejections"] += 1
+                self._job_recovery_locked(job_id)[
+                    "stale_fencing_rejections"] += 1
                 logger.warning(
                     "rejecting stale report_failure (token %s)", token,
                     client_id=header.get("client_id"),
-                    fencing_epoch=self._fencing_epoch)
+                    fencing_epoch=self._job_fencing_locked(job_id))
                 return {"type": "stale_fencing",
-                        "fencing_epoch": self._fencing_epoch}
-            if self._mark_worker_dead_locked(worker_id, "reported"):
+                        "fencing_epoch": self._job_fencing_locked(job_id)}
+            if self._mark_worker_dead_locked(worker_id, "reported",
+                                             job_id=job_id):
+                # job_id always in the record (default included): replay
+                # must re-attribute failures_reported to the same job or
+                # the restored per-job counters would diverge from the
+                # live ones.
                 self._journal_locked({"op": "worker_dead",
                                       "worker_id": worker_id,
-                                      "reason": "reported"})
+                                      "reason": "reported",
+                                      "job_id": job_id})
                 self._bump_fencing_locked("report_failure")
-            alive = self._alive_workers()
+            # Takeover targets must be grantable: a draining worker keeps
+            # its live streams but never receives a dead peer's pieces
+            # (falling back to draining workers only when nothing else
+            # is left beats failing the epoch outright).
+            alive = self._serving_workers() or self._alive_workers()
             if not alive:
                 return {"type": "error", "error": (
                     f"worker {worker_id!r} reported dead and no live workers "
@@ -1015,11 +1524,12 @@ class Dispatcher:
                     f"client_index {client_index} out of range "
                     f"[0, {num_clients})"}
         client_id = header["client_id"]
+        job_id = str(header.get("job_id") or DEFAULT_JOB)
         with self._lock:
             if self._num_pieces is None:
                 return {"type": "error",
                         "error": "no workers have registered yet"}
-            alive = self._alive_workers()
+            alive = self._serving_workers()
             if not alive:
                 return {"type": "error", "error": "no live workers"}
             # Sticky initial deques + per-deque canonical order, like the
@@ -1039,15 +1549,15 @@ class Dispatcher:
                      for piece in pieces}
             self._install_dynamic_plan_locked(client_id, epoch, owner,
                                               generation)
-            self._clients[client_id] = {
-                "epoch": epoch,
-                "client_index": client_index,
-                "num_clients": num_clients,
-            }
+            self._install_client_locked(client_id, epoch, client_index,
+                                        num_clients, job_id)
             self._client_heartbeats[client_id] = time.monotonic()
-            self._journal_locked({
+            record = {
                 "op": "client", "client_id": client_id, "epoch": epoch,
-                "client_index": client_index, "num_clients": num_clients})
+                "client_index": client_index, "num_clients": num_clients}
+            if job_id != DEFAULT_JOB:
+                record["job_id"] = job_id
+            self._journal_locked(record)
             self._journal_locked({
                 "op": "dynamic_plan", "client_id": client_id,
                 "epoch": epoch,
@@ -1058,7 +1568,8 @@ class Dispatcher:
                 "type": "plan",
                 "epoch": epoch,
                 "generation": generation,
-                "fencing_epoch": self._fencing_epoch,
+                "fencing_epoch": self._job_fencing_locked(job_id),
+                "credit_scale": self._credit_scale_locked(job_id),
                 "assignments": {
                     wid: [[piece, generation] for piece in pieces]
                     for wid, pieces in assignments.items()},
@@ -1092,13 +1603,17 @@ class Dispatcher:
                   for p, wid, gen, failed_gen
                   in header.get("failed_steals", [])]
         with self._lock:
+            job_id = self._client_job_locked(client_id, header)
+            # Keep the autoscaler's rate feed fresh: these are the same
+            # EMA'd client-side delivery rates the steal planner consumes.
+            self._last_rates.update(rates)
             state = self._dyn.get(client_id)
             if state is None or state["epoch"] != epoch:
                 # Restarted without a journal (or a plan this dispatcher
                 # never saw): the client must re-plan — its streams keep
                 # flowing meanwhile, exactly like static's resync path.
                 return {"type": "unknown_plan",
-                        "fencing_epoch": self._fencing_epoch}
+                        "fencing_epoch": self._job_fencing_locked(job_id)}
             for piece, kept_wid, kept_gen, failed_gen in failed:
                 # The revert is valid only against the exact assignment
                 # the failed steal created: a report can be retried across
@@ -1117,7 +1632,7 @@ class Dispatcher:
                     "generation": kept_gen})
             fresh_done = done - state["done"]
             if fresh_done:
-                self._dyn_dirty = True
+                self._mark_dyn_dirty_locked()
                 state["done"].update(fresh_done)
                 self._journal_locked({
                     "op": "dynamic_done", "client_id": client_id,
@@ -1143,16 +1658,43 @@ class Dispatcher:
             # mid-epoch has no stream yet (owned is empty for it) but is
             # exactly the drained receiver work-stealing exists to feed;
             # its address ships in the reply so the client can open one.
+            # Steals are INTRA-JOB by construction: the plan runs per
+            # client, and a client belongs to exactly one job — one job's
+            # rebalancing can never move a peer job's pieces.
             pending = {wid: 0 for wid in alive}
             for piece, (wid, gen) in state["owner"].items():
                 if piece not in state["done"] and wid in pending:
                     pending[wid] += 1
-            moves = plan_steals(pending, {
+            live_stealable = {
                 wid: [p for p in pieces
                       if p not in state["done"]
                       and state["owner"].get(p, (None,))[0] == wid]
-                for wid, pieces in stealable.items() if wid in pending},
-                rates)
+                for wid, pieces in stealable.items() if wid in pending}
+            serving_ids = set(self._serving_workers())
+            moves = []
+            draining_ids = sorted(wid for wid in alive
+                                  if wid not in serving_ids)
+            if draining_ids and serving_ids:
+                # Drain handoff: a draining worker sheds its ENTIRE
+                # not-yet-started backlog to the least-loaded serving
+                # peers in one sync — the exactly-once path is the
+                # ordinary revoke→extend steal handshake (pieces already
+                # streaming finish at their watermarks on the drainer).
+                for dwid in draining_ids:
+                    for piece in sorted(live_stealable.get(dwid, [])):
+                        recv = min(serving_ids,
+                                   key=lambda w: (pending[w], w))
+                        moves.append((piece, dwid, recv))
+                        pending[dwid] -= 1
+                        pending[recv] += 1
+                    live_stealable[dwid] = []
+            # receivers is ALWAYS the serving set — when it is empty
+            # (every alive worker draining) nothing may receive, so no
+            # steals are planned and granted work finishes where it is
+            # (an empty set must not fall through to "everyone").
+            moves.extend(plan_steals(
+                pending, live_stealable, rates,
+                receivers=serving_ids))
             for piece, from_wid, to_wid in moves:
                 self._generation += 1
                 self._apply_steal_locked(client_id, piece, from_wid,
@@ -1176,7 +1718,8 @@ class Dispatcher:
                 "type": "deltas",
                 "steals": deltas,
                 "generation": self._generation,
-                "fencing_epoch": self._fencing_epoch,
+                "fencing_epoch": self._job_fencing_locked(job_id),
+                "credit_scale": self._credit_scale_locked(job_id),
                 # Steal targets may be workers the client has no stream to
                 # yet (a worker that joined mid-epoch): ship addresses so
                 # the grant can open one.
@@ -1197,8 +1740,12 @@ class Dispatcher:
 
         timeout = self._probe_timeout(header)
         with self._lock:
-            workers = {wid: tuple(w["address"])
-                       for wid, w in self._alive_workers().items()}
+            # Observability covers the WHOLE fleet, standby pool included
+            # (an operator watching a drain wants to see the drainer).
+            workers = {
+                wid: tuple(w["address"])
+                for wid, w in self._alive_workers(
+                    ("serving", "draining", "standby")).items()}
 
         def probe(address):
             try:
@@ -1231,6 +1778,9 @@ class Dispatcher:
     def _handle_status(self, header):
         now = time.monotonic()
         with self._lock:
+            shares = self._job_shares_locked()
+            per_job = (self._dynamic_per_job_locked()
+                       if self.mode == "dynamic" else {})
             return {
                 "type": "status",
                 "mode": self.mode,
@@ -1249,11 +1799,41 @@ class Dispatcher:
                 "workers": {
                     wid: {"address": w["address"],
                           "alive": w["alive"],
+                          "state": w.get("state", "serving"),
                           "lease_expires_in_s": (
                               round(self._worker_leases[wid] - now, 3)
                               if wid in self._worker_leases else None)}
                     for wid, w in self._workers.items()},
                 "clients": {cid: dict(c) for cid, c in self._clients.items()},
+                # Fleet tier: job objects with scoped fencing, fair
+                # shares, per-job recovery breakout, and the autoscaler's
+                # journaled decision counts — what `status --watch`
+                # renders as the jobs/fleet lines.
+                "fleet": {
+                    "workers_by_state": {
+                        state: sorted(
+                            wid for wid, w in self._workers.items()
+                            if w["alive"]
+                            and w.get("state", "serving") == state)
+                        for state in ("serving", "standby", "draining")},
+                    "autoscale": dict(self._autoscale_counts),
+                    "autoscaler_armed": self._autoscaler is not None,
+                },
+                "jobs": {
+                    jid: {
+                        "weight": job["weight"],
+                        "quota": job["quota"],
+                        "epoch": job["epoch"],
+                        "fencing_epoch": self._job_fencing_locked(jid),
+                        "fair_share": round(shares.get(jid, 0.0), 4),
+                        "clients": sorted(
+                            cid for cid, c in self._clients.items()
+                            if c.get("job_id", DEFAULT_JOB) == jid),
+                        "recovery": dict(self._job_recovery.get(jid, {})),
+                        **(per_job.get(jid, {})
+                           if self.mode == "dynamic" else {}),
+                    }
+                    for jid, job in self._jobs.items()},
                 "fcfs_epoch": self._fcfs_epoch,
                 "fcfs_remaining": (len(self._fcfs_queue)
                                    if self._fcfs_queue is not None else None),

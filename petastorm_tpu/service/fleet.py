@@ -1,0 +1,455 @@
+"""Fleet management: multi-tenant jobs, fair scheduling, and autoscaling.
+
+This is the control-plane layer that turns the disaggregated data service
+from "one trainer's worker pool" into "one shared data service feeding many
+training jobs with zero idle hosts" (the tf.data service deployment model,
+arxiv 2210.14826 §4: elasticity + ephemeral data sharing; cedar's arxiv
+2401.08895 argument that scaling decisions should come from measured
+profiles). Three pure, socket-free pieces live here, plus the controller
+thread and the trainer-side job API:
+
+- :func:`plan_fair_shares` — weighted max-min (water-filling) allocation of
+  fleet capacity across jobs, from per-job weights and optional quotas.
+  The dispatcher derives per-job ``credit_scale`` factors from it: a job's
+  streams open with their flow-control window scaled by its fair share, so
+  worker capacity is apportioned by policy instead of by whoever pulls
+  hardest. With one job (or equal weights) every scale is 1.0 — bit-for-bit
+  the single-tenant behavior.
+- :class:`AutoscalePlanner` — the pure admit/drain/retire planner
+  (golden-tested on canned signal dicts, mirroring PR 7's ``plan_steals``
+  and the pipeline autotuner's ``Planner``). Hysteresis by consecutive-
+  window streaks plus a post-decision cooldown, so a noisy backlog signal
+  cannot flap the fleet.
+- :class:`AutoscaleController` — the dispatcher-side thread (name prefix
+  ``fleet-autoscale``, watched by the test-suite leak guard) that windows
+  :meth:`Dispatcher.fleet_signals`, runs the planner, and applies decisions
+  through the dispatcher's journaled mutations.
+
+Trainer-side job API: :func:`register_job` / :func:`end_job` (or the
+:class:`JobHandle` context manager). Every open registration is tracked
+process-wide so the test suite can fail a test that registers a job and
+never ends it — the control-plane analogue of the cache-directory leak
+guard (``docs/guides/service.md#multi-tenancy-and-autoscaling``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from petastorm_tpu.telemetry.log import service_logger
+
+logger = service_logger(__name__)
+
+#: The implicit job every client belongs to unless it names one — the
+#: single-tenant degenerate case. Never needs registration and is never
+#: tracked by the open-registration guard.
+DEFAULT_JOB = "default"
+
+#: Worker lifecycle states the dispatcher tracks. ``serving`` workers
+#: receive grants; ``standby`` workers are registered, heartbeating pool
+#: capacity awaiting admission; ``draining`` workers finish what they were
+#: granted (watermarks complete, steals shed their backlog) but receive
+#: nothing new until the autoscaler retires them back to standby.
+WORKER_STATES = ("serving", "standby", "draining")
+
+
+def plan_fair_shares(capacity, demands, weights=None, quotas=None):
+    """Weighted max-min fair allocation of ``capacity`` across jobs.
+
+    Classic water-filling: capacity is poured across jobs proportionally
+    to their weights; a job whose remaining demand (or quota) is met drops
+    out and its unused share is re-poured over the rest — so no job can be
+    starved below its weighted fair share by a hungrier peer, and no
+    capacity idles while any job still has demand (max-min fairness).
+    Pure and deterministic (jobs iterate sorted).
+
+    :param capacity: total capacity to allocate (any consistent unit —
+        the dispatcher uses serving-worker count).
+    :param demands: ``{job: demand}``; a job never receives more than it
+        asks for.
+    :param weights: ``{job: weight}`` (default 1.0 each) — relative
+        entitlement between jobs competing for the same capacity.
+    :param quotas: ``{job: max_share}`` optional hard caps, same unit as
+        ``capacity`` — a job never receives more than its quota even with
+        the fleet otherwise idle.
+    :returns: ``{job: allocation}`` with
+        ``sum(allocations) <= capacity`` and each allocation
+        ``<= min(demand, quota)``.
+    """
+    weights = dict(weights or {})
+    quotas = dict(quotas or {})
+    jobs = sorted(demands)
+    limit = {}
+    for job in jobs:
+        cap = float(demands[job])
+        if job in quotas and quotas[job] is not None:
+            cap = min(cap, float(quotas[job]))
+        limit[job] = max(0.0, cap)
+    alloc = {job: 0.0 for job in jobs}
+    active = [job for job in jobs if limit[job] > 0]
+    remaining = float(capacity)
+    while active and remaining > 1e-12:
+        wsum = sum(weights.get(job, 1.0) for job in active)
+        unit = remaining / wsum
+        capped = [job for job in active
+                  if limit[job] - alloc[job]
+                  <= unit * weights.get(job, 1.0) + 1e-12]
+        if not capped:
+            for job in active:
+                alloc[job] += unit * weights.get(job, 1.0)
+            break
+        for job in capped:
+            give = limit[job] - alloc[job]
+            alloc[job] = limit[job]
+            remaining -= give
+        active = [job for job in active if job not in capped]
+    return alloc
+
+
+def credit_scales(shares):
+    """Fair shares → per-job flow-control scale factors in ``(0, 1]``.
+
+    Normalized so the LARGEST share maps to 1.0 (that job's streams keep
+    their full configured credit window) and every other job's window
+    shrinks proportionally — the enforceable lever: a worker's in-flight
+    capacity divides across jobs by the planned ratio instead of by pull
+    pressure. Equal shares (the default single-tenant / equal-weight
+    case) yield 1.0 for everyone: today's behavior, untouched.
+    """
+    top = max(shares.values(), default=0.0)
+    if top <= 0:
+        return {job: 1.0 for job in shares}
+    return {job: max(share / top, 1e-3) for job, share in shares.items()}
+
+
+class AutoscaleConfig:
+    """Knobs of the fleet autoscaler (all windows are controller ticks).
+
+    :param interval_s: controller tick period.
+    :param scale_up_backlog: admit a standby worker once the mean backlog
+        per serving worker has exceeded this for ``up_windows`` ticks.
+    :param scale_down_backlog: drain the least-loaded serving worker once
+        mean backlog has been below this for ``down_windows`` ticks.
+    :param up_windows/down_windows: hysteresis streak lengths.
+    :param cooldown_windows: ticks after any admit/drain during which no
+        further admit/drain is planned (retires still happen — they only
+        complete an in-flight drain).
+    :param min_serving: never drain below this many serving workers.
+    """
+
+    def __init__(self, interval_s=1.0, scale_up_backlog=4.0,
+                 scale_down_backlog=0.5, up_windows=2, down_windows=3,
+                 cooldown_windows=2, min_serving=1):
+        if min_serving < 1:
+            raise ValueError("min_serving must be >= 1")
+        if scale_down_backlog >= scale_up_backlog:
+            raise ValueError(
+                "scale_down_backlog must be < scale_up_backlog "
+                "(equal/inverted thresholds would flap admit against "
+                "drain on every window)")
+        self.interval_s = float(interval_s)
+        self.scale_up_backlog = float(scale_up_backlog)
+        self.scale_down_backlog = float(scale_down_backlog)
+        self.up_windows = int(up_windows)
+        self.down_windows = int(down_windows)
+        self.cooldown_windows = int(cooldown_windows)
+        self.min_serving = int(min_serving)
+
+    @classmethod
+    def coerce(cls, value):
+        """``True``/dict/config → an :class:`AutoscaleConfig`."""
+        if isinstance(value, cls):
+            return value
+        if value is True:
+            return cls()
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(
+            f"autoscale must be True, a dict of AutoscaleConfig kwargs, "
+            f"or an AutoscaleConfig — got {value!r}")
+
+
+class AutoscalePlanner:
+    """Pure admit/drain/retire planner over one fleet-signals snapshot.
+
+    ``plan(signals)`` consumes the dict :meth:`Dispatcher.fleet_signals`
+    produces::
+
+        {"serving": [wid...], "standby": [wid...], "draining": [wid...],
+         "backlog": {wid: pending pieces}, "backlog_known": bool,
+         "rates": {wid: rows/s}}
+
+    ``backlog_known=False`` (static/fcfs dispatchers, which track no
+    per-worker progress) limits planning to retire decisions — an absent
+    signal must not read as an idle fleet.
+
+    and returns ``[{"action": "admit"|"drain"|"retire", "worker_id": wid,
+    "reason": str}, ...]``. Stateful only in its hysteresis streaks — no
+    clocks, no sockets, no randomness — so canned-signal goldens pin its
+    behavior exactly (the PR 7 ``plan_steals`` / autotuner ``Planner``
+    discipline).
+
+    Decision rules, in order:
+
+    - **retire**: a draining worker whose backlog reached zero hands back
+      to the standby pool immediately (its watermarks completed and the
+      steal path re-granted the rest — the drain is done; holding it
+      drained-but-booked would be the idle host the autoscaler exists to
+      eliminate).
+    - **admit**: mean backlog per serving worker above
+      ``scale_up_backlog`` for ``up_windows`` consecutive windows, and a
+      standby worker exists → admit the (deterministically) first one.
+      A worker mid-drain is re-admitted in preference to a standby one —
+      it is already warm.
+    - **drain**: mean backlog below ``scale_down_backlog`` for
+      ``down_windows`` windows with more than ``min_serving`` serving →
+      drain the least-backlogged serving worker, ties broken by the
+      LOWEST reported delivery rate (the EMA'd signal the steal planner
+      already feeds — retire the slowest idle capacity first), then id.
+    - **hysteresis**: a window that satisfies neither trigger resets both
+      streaks; any admit/drain starts a ``cooldown_windows`` cooldown in
+      which neither trigger accumulates — one noisy window can never
+      flap the fleet, and scale-ups don't immediately re-drain.
+    """
+
+    def __init__(self, config=None):
+        self.config = config or AutoscaleConfig()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = 0
+
+    def plan(self, signals):
+        cfg = self.config
+        serving = sorted(signals.get("serving") or [])
+        standby = sorted(signals.get("standby") or [])
+        draining = sorted(signals.get("draining") or [])
+        backlog = dict(signals.get("backlog") or {})
+        decisions = [
+            {"action": "retire", "worker_id": wid,
+             "reason": "drain complete (backlog 0)"}
+            for wid in draining if not backlog.get(wid, 0)]
+        if not serving:
+            # A fleet with zero serving workers serves nobody: admit
+            # unconditionally if anything is poolable — BEFORE the
+            # cooldown gate (an emergency outranks decision pacing) and
+            # regardless of backlog_known (no signal needed to see an
+            # empty serving set).
+            pool = draining + standby
+            if pool:
+                decisions.append({"action": "admit", "worker_id": pool[0],
+                                  "reason": "no serving workers"})
+                self._cooldown = cfg.cooldown_windows
+            return decisions
+        if not signals.get("backlog_known", True):
+            # No per-worker progress signal (static/fcfs dispatchers):
+            # admit/drain would be guesses — only complete in-flight
+            # drains (retire gates nothing: worker state only affects
+            # NEW grants, never streams already flowing).
+            return decisions
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return decisions
+        rates = dict(signals.get("rates") or {})
+        mean_backlog = (sum(backlog.get(wid, 0) for wid in serving)
+                        / len(serving))
+        if mean_backlog > cfg.scale_up_backlog and (standby or draining):
+            self._up_streak += 1
+            self._down_streak = 0
+            if self._up_streak >= cfg.up_windows:
+                # Prefer re-admitting a mid-drain worker: it is already
+                # warm (connections, cache) and flipping it back costs
+                # nothing; a standby admission spins up cold.
+                pool = draining + standby
+                decisions.append({
+                    "action": "admit", "worker_id": pool[0],
+                    "reason": (f"backlog {mean_backlog:.1f}/worker > "
+                               f"{cfg.scale_up_backlog:g} for "
+                               f"{self._up_streak} windows")})
+                self._up_streak = 0
+                self._cooldown = cfg.cooldown_windows
+        elif mean_backlog < cfg.scale_down_backlog \
+                and len(serving) > cfg.min_serving:
+            self._down_streak += 1
+            self._up_streak = 0
+            if self._down_streak >= cfg.down_windows:
+                victim = min(serving,
+                             key=lambda wid: (backlog.get(wid, 0),
+                                              rates.get(wid, 0.0), wid))
+                decisions.append({
+                    "action": "drain", "worker_id": victim,
+                    "reason": (f"backlog {mean_backlog:.1f}/worker < "
+                               f"{cfg.scale_down_backlog:g} for "
+                               f"{self._down_streak} windows")})
+                self._down_streak = 0
+                self._cooldown = cfg.cooldown_windows
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+        return decisions
+
+
+class AutoscaleController:
+    """The dispatcher-side autoscaler thread.
+
+    Each tick snapshots :meth:`Dispatcher.fleet_signals`, runs the pure
+    planner, and applies each decision through
+    :meth:`Dispatcher.apply_autoscale` — which journals it through the
+    WAL, so a restarted dispatcher replays the fleet's admit/drain/retire
+    history byte-identically. Thread name carries the ``fleet-autoscale``
+    prefix the conftest leak guard watches: a controller outliving its
+    dispatcher keeps mutating a dead fleet's state.
+    """
+
+    def __init__(self, dispatcher, config=None):
+        self._dispatcher = dispatcher
+        self.planner = AutoscalePlanner(config)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="fleet-autoscale-controller")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def tick(self):
+        """One planning round (also the test seam — deterministic without
+        the thread's clock)."""
+        signals = self._dispatcher.fleet_signals()
+        decisions = self.planner.plan(signals)
+        for decision in decisions:
+            self._dispatcher.apply_autoscale(decision["action"],
+                                             decision["worker_id"],
+                                             reason=decision.get("reason"))
+        return decisions
+
+    def _run(self):
+        interval = self.planner.config.interval_s
+        while not self._stop.wait(interval):
+            try:
+                self.tick()
+            except Exception:
+                # A planning failure must not kill the control loop (the
+                # dispatcher may be mid-stop; the next tick re-evaluates).
+                logger.exception("autoscale tick failed")
+
+
+# -- trainer-side job API ----------------------------------------------------
+
+#: Open job registrations this process has made and not yet ended:
+#: ``(address, job_id)`` tuples. The conftest leak guard fails a test that
+#: leaves one behind — an orphaned registration keeps its quota booked on
+#: the dispatcher forever (the fleet-tier analogue of a leaked cache dir).
+_OPEN_JOBS = set()
+_OPEN_JOBS_LOCK = threading.Lock()
+
+
+def open_job_registrations():
+    """Snapshot of this process's un-ended job registrations (the test
+    suite's leak guard reads it around every test)."""
+    with _OPEN_JOBS_LOCK:
+        return set(_OPEN_JOBS)
+
+
+def _job_rpc(dispatcher_address, header, rpc_deadline_s=30.0):
+    from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+    from petastorm_tpu.service.client import ServiceError
+    from petastorm_tpu.utils import retry_with_backoff
+
+    def once():
+        with FramedConnection.connect(tuple(dispatcher_address),
+                                      timeout=10.0) as conn:
+            reply, _ = conn.request(header)
+        if reply.get("type") == "error":
+            raise ServiceError(reply.get("error", "dispatcher error"))
+        return reply
+
+    return retry_with_backoff(
+        once, retries=3, base_delay=0.1, retry_on=(OSError,),
+        no_retry_on=(ServiceError,), deadline_s=rpc_deadline_s,
+        description=f"job request {header.get('type')!r}")
+
+
+def register_job(dispatcher_address, job_id, weight=1.0, quota=None,
+                 rpc_deadline_s=30.0):
+    """Register a trainer job with the dispatcher's fleet manager.
+
+    :param job_id: the job's stable identity — every
+        :class:`~petastorm_tpu.service.client.ServiceBatchSource` this
+        trainer opens should carry the same ``job_id=``.
+    :param weight: relative fair-share entitlement
+        (:func:`plan_fair_shares`); 1.0 = one equal share.
+    :param quota: optional hard cap on the job's share of fleet capacity,
+        in serving-worker units (``None`` = its fair share only).
+    :returns: the dispatcher's reply dict (carries the job's scoped
+        ``fencing_epoch``).
+
+    Re-registering a live job is a *restart*: the job's scoped fencing
+    epoch bumps so its own stale clients resync, while every other job's
+    epoch — and streams — stay untouched (job isolation). Always pair
+    with :func:`end_job` (or use :class:`JobHandle`): the test suite
+    fails tests that orphan a registration.
+    """
+    reply = _job_rpc(dispatcher_address, {
+        "type": "register_job", "job_id": str(job_id),
+        "weight": float(weight),
+        "quota": float(quota) if quota is not None else None,
+    }, rpc_deadline_s=rpc_deadline_s)
+    with _OPEN_JOBS_LOCK:
+        _OPEN_JOBS.add((tuple(dispatcher_address), str(job_id)))
+    return reply
+
+
+def end_job(dispatcher_address, job_id, rpc_deadline_s=30.0):
+    """End a job: the dispatcher releases its clients, piece queues, and
+    quota, and journals the removal. Idempotent AND teardown-safe —
+    ending an unknown job is a no-op reply, and an unreachable dispatcher
+    (already stopped/crashed) is logged and swallowed (``None`` returned)
+    rather than raised: ``JobHandle.__exit__`` must never mask the
+    with-body's real exception with a connection error, and a dead
+    dispatcher has no job state left to release anyway."""
+    with _OPEN_JOBS_LOCK:
+        _OPEN_JOBS.discard((tuple(dispatcher_address), str(job_id)))
+    try:
+        return _job_rpc(dispatcher_address,
+                        {"type": "end_job", "job_id": str(job_id)},
+                        rpc_deadline_s=rpc_deadline_s)
+    except OSError as exc:
+        logger.warning("end_job(%r) could not reach the dispatcher at "
+                       "%s (%s) — nothing left to release", job_id,
+                       tuple(dispatcher_address), exc)
+        return None
+
+
+class JobHandle:
+    """Context-managed job registration::
+
+        with JobHandle(dispatcher.address, "exp-17", weight=2.0):
+            source = ServiceBatchSource(dispatcher.address, job_id="exp-17")
+            ...
+
+    ``__exit__`` ends the job even on error, keeping the open-registration
+    guard green."""
+
+    def __init__(self, dispatcher_address, job_id, weight=1.0, quota=None):
+        self.dispatcher_address = tuple(dispatcher_address)
+        self.job_id = str(job_id)
+        self.weight = weight
+        self.quota = quota
+
+    def __enter__(self):
+        register_job(self.dispatcher_address, self.job_id,
+                     weight=self.weight, quota=self.quota)
+        return self
+
+    def end(self):
+        end_job(self.dispatcher_address, self.job_id)
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.end()

@@ -33,6 +33,8 @@ from petastorm_tpu.reader_impl.framed_socket import (
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
+    FLEET_JOB_CACHE_LOOKUPS,
+    FLEET_JOB_ROWS,
     WORKER_ACTIVE_STREAMS,
     WORKER_BATCHES_SENT,
     WORKER_CREDIT_WAIT,
@@ -162,6 +164,12 @@ class BatchWorker:
         Distinct from the reader-level ``transform_spec`` (row/DataFrame
         granularity, fixed at reader construction), which stays where it
         is.
+    :param standby: register as pooled STANDBY capacity instead of
+        serving: the dispatcher keeps the worker registered and leased
+        but grants it nothing until the fleet autoscaler (or an operator
+        via ``Dispatcher.admit_worker``) admits it into serving — the
+        zero-idle-hosts elasticity pool
+        (``docs/guides/service.md#multi-tenancy-and-autoscaling``).
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
@@ -170,7 +178,7 @@ class BatchWorker:
                  register_retries=5, register_backoff=0.2,
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
-                 batch_cache=None, batch_transform=None):
+                 batch_cache=None, batch_transform=None, standby=False):
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
@@ -222,6 +230,16 @@ class BatchWorker:
         # caused them (consumer-side boundary sampling would smear
         # prefetched lookups into the previous epoch). Bounded dict.
         self._cache_epochs = {}      # epoch -> {"hits": n, "misses": n}
+        # Per-JOB attribution (multi-tenant fleets): rows/batches served
+        # and cache lookups bucketed by the stream request's job_id — how
+        # shared-cache economics ("3 jobs decoded this once") and per-job
+        # delivery fairness are measured. Bounded: a long-lived worker in
+        # a fleet serving many short-lived jobs evicts the
+        # oldest-tracked job (and its labeled metric series) beyond
+        # _JOBS_TRACKED_KEPT, like the per-epoch cache buckets.
+        self._jobs_served = {}       # job -> {"rows": n, "batches": n}
+        self._cache_jobs = {}        # job -> {"hits": n, "misses": n}
+        self._standby = bool(standby)
         self._log = logger.bind(worker_id=self.worker_id)
         # Interned registry children (telemetry.metrics): typed, scrapeable
         # counters behind the legacy diagnostics snapshots.
@@ -358,6 +376,7 @@ class BatchWorker:
             "port": port,
             "num_pieces": self.num_pieces,
             "re_register": re_register,
+            "standby": self._standby,
         }, description=f"worker {self.worker_id} registration",
             retries=retries)
         if reply.get("type") != "ok":
@@ -538,8 +557,15 @@ class BatchWorker:
             pieces = [int(p) for p in header["pieces"]]
         credits = header.get("credits")
         credits = int(credits) if credits is not None else None
+        # Multi-tenant attribution: the stream request's job_id buckets
+        # this stream's rows and cache lookups per job ("job" rides in
+        # flow, so completed-stream diagnostics carry it too).
+        job = header.get("job_id")
+        job = str(job) if job else None
         flow = {"credits_window": credits, "credits_left": credits,
                 "batches_sent": 0, "credit_wait_s": 0.0}
+        if job is not None:
+            flow["job"] = job
         stream_key = f"{uuid.uuid4().hex[:8]}"
         # The stream's mutable serving state: the cached path swaps
         # per-piece readers through "reader" (None while serving from
@@ -556,17 +582,20 @@ class BatchWorker:
                 rows_sent = self._stream_dynamic(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn,
+                    job=job)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, starts, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn,
+                    job=job)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
-                    shuffle_seed=shuffle_seed, transform_fn=transform_fn)
+                    shuffle_seed=shuffle_seed, transform_fn=transform_fn,
+                    job=job)
             else:
                 if shuffle_seed is not None:
                     # This serving path cannot compose the serve-time
@@ -592,11 +621,11 @@ class BatchWorker:
                     rows_sent = self._stream_pieces_cached(
                         sock, conn_reader, state, pieces, flow, credits,
                         stream_key, epoch=header.get("epoch"),
-                        transform_fn=transform_fn)
+                        transform_fn=transform_fn, job=job)
                 else:
                     rows_sent = self._stream_pieces_direct(
                         sock, conn_reader, state, pieces, flow, credits,
-                        stream_key, transform_fn=transform_fn)
+                        stream_key, transform_fn=transform_fn, job=job)
             if rows_sent is None:
                 return  # worker stopped mid-stream
             send_framed(sock, {"type": "end", "rows": rows_sent,
@@ -619,6 +648,20 @@ class BatchWorker:
                 self._completed[stream_key] = dict(snapshot, **flow)
                 while len(self._completed) > _COMPLETED_SNAPSHOTS_KEPT:
                     self._completed.pop(next(iter(self._completed)))
+                if job is not None and flow.get("job_batches"):
+                    # LRU fold (pop + reinsert = touch): only jobs idle
+                    # longest age out of the bounded attribution — an
+                    # actively-streaming tenant must never have its
+                    # fairness counters silently reset by newer jobs.
+                    counts = self._jobs_served.pop(
+                        job, {"rows": 0, "batches": 0})
+                    counts["rows"] += flow.get("job_rows", 0)
+                    counts["batches"] += flow["job_batches"]
+                    self._jobs_served[job] = counts
+                    while len(self._jobs_served) > self._JOBS_TRACKED_KEPT:
+                        old_job = next(iter(self._jobs_served))
+                        self._jobs_served.pop(old_job)
+                        FLEET_JOB_ROWS.remove(old_job)
             self._m_active.dec()
             WORKER_STREAMS.labels(self.worker_id, outcome).inc()
             if reader is not None:
@@ -626,7 +669,8 @@ class BatchWorker:
                 reader.join()
 
     def _stream_pieces_direct(self, sock, conn_reader, state, pieces, flow,
-                              credits, stream_key, transform_fn=None):
+                              credits, stream_key, transform_fn=None,
+                              job=None):
         """Uncached serving: one reader over the whole piece set, batches
         collated across piece boundaries. Returns rows sent, or ``None``
         when the worker stopped mid-stream."""
@@ -668,7 +712,7 @@ class BatchWorker:
 
     def _stream_pieces_cached(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
-                              transform_fn=None):
+                              transform_fn=None, job=None):
         """Cache-armed serving, piece by piece: a warm piece's batches are
         scatter-gathered straight out of cache memory (zero decode, zero
         re-serialization — ``send_framed_frames``); a cold piece is decoded
@@ -688,7 +732,7 @@ class BatchWorker:
             key = self._piece_cache_key(
                 piece, transformed=transform_fn is not None)
             entry = cache.get(key)
-            self._note_cache_lookup(epoch, hit=entry is not None)
+            self._note_cache_lookup(epoch, hit=entry is not None, job=job)
             if entry is not None:
                 for cached in entry.batches():
                     bid = (f"{self.worker_id}:{stream_key}:"
@@ -743,7 +787,8 @@ class BatchWorker:
         return self._reader_kwargs.get(
             "reader_pool_type", "thread") in ("thread", "dummy")
 
-    def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None):
+    def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None,
+                     job=None):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
@@ -782,7 +827,7 @@ class BatchWorker:
                     piece, transformed=transformed))
                 if cache is not None else None),
             cache_note_fn=(
-                (lambda hit: self._note_cache_lookup(epoch, hit))
+                (lambda hit: self._note_cache_lookup(epoch, hit, job=job))
                 if cache is not None else None),
             permute_fn=permute_fn, transform_fn=transform_fn)
 
@@ -801,7 +846,8 @@ class BatchWorker:
 
     def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
-                              shuffle_seed=None, transform_fn=None):
+                              shuffle_seed=None, transform_fn=None,
+                              job=None):
         """Cache-armed serving through the streaming engine: warm pieces
         scatter-gather straight from cache memory, cold pieces decode
         through the stream's ONE shared pipeline and fill the cache — the
@@ -814,12 +860,13 @@ class BatchWorker:
                                           flow, credits, stream_key, {},
                                           epoch=epoch, tagged=False,
                                           shuffle_seed=shuffle_seed,
-                                          transform_fn=transform_fn)
+                                          transform_fn=transform_fn,
+                                          job=job)
 
     def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
                               tagged=True, shuffle_seed=None,
-                              transform_fn=None):
+                              transform_fn=None, job=None):
         """Exactly-once static serving: piece-aligned batches through the
         streaming engine, every ``batch`` frame tagged with its piece and
         absolute ``ordinal``, every finished piece announced with a
@@ -831,7 +878,8 @@ class BatchWorker:
         the same loop as the legacy untagged engine stream (no tags, no
         markers)."""
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch, shuffle_seed, transform_fn)
+        engine = self._make_engine(epoch, shuffle_seed, transform_fn,
+                                   job=job)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -868,7 +916,7 @@ class BatchWorker:
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
                         credits, stream_key, epoch=None, shuffle_seed=None,
-                        transform_fn=None):
+                        transform_fn=None, job=None):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -885,7 +933,8 @@ class BatchWorker:
                 f"worker runs "
                 f"{self._reader_kwargs.get('reader_pool_type')!r}")
         collector = tracing.COLLECTOR
-        engine = self._make_engine(epoch, shuffle_seed, transform_fn)
+        engine = self._make_engine(epoch, shuffle_seed, transform_fn,
+                                   job=job)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -943,16 +992,37 @@ class BatchWorker:
                                    "generation": gen, "rows": rows})
 
     _CACHE_EPOCHS_KEPT = 64
+    #: Distinct jobs whose rows/cache attribution is retained (evicted
+    #: oldest-first beyond it, along with their labeled metric series) —
+    #: a shared fleet outliving thousands of short jobs must not grow
+    #: its diagnostics and /metrics cardinality forever.
+    _JOBS_TRACKED_KEPT = 64
 
-    def _note_cache_lookup(self, epoch, hit):
+    def _note_cache_lookup(self, epoch, hit, job=None):
         """Bucket one cache lookup by the requesting stream's epoch —
-        exact cold-vs-warm attribution for the per-epoch breakdown."""
+        exact cold-vs-warm attribution for the per-epoch breakdown — and
+        by its JOB (multi-tenant sharing economics: N jobs over one
+        dataset should fill once and hit ever after)."""
+        key = "hits" if hit else "misses"
+        if job is not None:
+            FLEET_JOB_CACHE_LOOKUPS.labels(
+                job, "hit" if hit else "miss").inc()
+            with self._lock:
+                bucket = self._cache_jobs.pop(job,
+                                              {"hits": 0, "misses": 0})
+                bucket[key] += 1
+                self._cache_jobs[job] = bucket  # pop+reinsert = LRU touch
+                while len(self._cache_jobs) > self._JOBS_TRACKED_KEPT:
+                    old_job = next(iter(self._cache_jobs))
+                    self._cache_jobs.pop(old_job)
+                    FLEET_JOB_CACHE_LOOKUPS.remove(old_job, "hit")
+                    FLEET_JOB_CACHE_LOOKUPS.remove(old_job, "miss")
         if epoch is None:
             return
         with self._lock:
             bucket = self._cache_epochs.setdefault(
                 int(epoch), {"hits": 0, "misses": 0})
-            bucket["hits" if hit else "misses"] += 1
+            bucket[key] += 1
             while len(self._cache_epochs) > self._CACHE_EPOCHS_KEPT:
                 self._cache_epochs.pop(min(self._cache_epochs))
 
@@ -962,6 +1032,20 @@ class BatchWorker:
         with self._lock:
             return {epoch: dict(bucket)
                     for epoch, bucket in self._cache_epochs.items()}
+
+    def cache_stats_by_job(self):
+        """``{job: {"hits", "misses"}}`` — per-tenant attribution of the
+        shared decoded-batch cache (empty when uncached or untagged)."""
+        with self._lock:
+            return {job: dict(bucket)
+                    for job, bucket in self._cache_jobs.items()}
+
+    def rows_by_job(self):
+        """``{job: {"rows", "batches"}}`` served per job — the fairness
+        measurement surface (the ``multi_tenant`` bench leg reads it)."""
+        with self._lock:
+            return {job: dict(counts)
+                    for job, counts in self._jobs_served.items()}
 
     def _make_stream_reader(self, pieces):
         self._m_readers.inc()
@@ -1007,6 +1091,8 @@ class BatchWorker:
     def _send_stream_batch(self, sock, conn_reader, flow, credits, bid,
                            rows, fmt, frames, collector,
                            extra_header=None, on_frame=None):
+        # NB ``flow["job"]`` (set by _stream from the request's job_id)
+        # drives per-job delivery attribution below.
         """The shared per-batch send step: honor stop, drain/await credits,
         apply fault-injection pacing, scatter-gather the frames, account.
         Returns ``False`` when the worker stopped (caller aborts the
@@ -1057,6 +1143,16 @@ class BatchWorker:
         flow["batches_sent"] += 1
         self._m_batches.inc()
         self._m_rows.inc(rows)
+        if flow.get("job") is not None:
+            # Per-batch: only the registry child's own fine-grained lock
+            # (the labels()-per-batch idiom the client counters use).
+            # Worker-level attribution accumulates lock-free in the flow
+            # dict and folds into _jobs_served ONCE at stream teardown —
+            # the send path must not serialize every tenant's batches on
+            # the worker's global lock.
+            FLEET_JOB_ROWS.labels(flow["job"]).inc(rows)
+            flow["job_rows"] = flow.get("job_rows", 0) + rows
+            flow["job_batches"] = flow.get("job_batches", 0) + 1
         if credits is not None:
             flow["credits_left"] -= 1
         return True
@@ -1086,6 +1182,10 @@ class BatchWorker:
                       for key, entry in self._active.items()}
             completed = {key: dict(diag)
                          for key, diag in self._completed.items()}
+            jobs_served = {job: dict(counts)
+                           for job, counts in self._jobs_served.items()}
+            cache_jobs = {job: dict(bucket)
+                          for job, bucket in self._cache_jobs.items()}
         metrics = {
             "batches_sent_total": self._m_batches.value,
             "rows_sent_total": self._m_rows.value,
@@ -1100,6 +1200,10 @@ class BatchWorker:
             "completed_streams": completed,
             "metrics": metrics,
         }
+        if jobs_served:
+            out["jobs"] = jobs_served
+        if cache_jobs:
+            out["cache_by_job"] = cache_jobs
         if self._batch_cache is not None:
             stats = self._batch_cache.stats()
             metrics["cache_hits_total"] = stats["hits"]

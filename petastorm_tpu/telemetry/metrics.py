@@ -108,6 +108,57 @@ DISPATCHER_BACKLOG_PIECES = REGISTRY.gauge(
     "reported done (summed over clients) — the backlog the work-stealing "
     "planner balances",
     labels=("worker",))
+# -- fleet tier: multi-tenant jobs + autoscaler (service/fleet.py,
+# service/dispatcher.py, service/worker.py) ---------------------------------
+
+FLEET_WORKERS = REGISTRY.gauge(
+    "petastorm_fleet_workers",
+    "Live workers by lifecycle state (serving/standby/draining): serving "
+    "workers receive grants, standby workers are pooled capacity awaiting "
+    "autoscaler admission, draining workers finish their granted work and "
+    "retire back to standby",
+    labels=("state",))
+FLEET_JOBS = REGISTRY.gauge(
+    "petastorm_fleet_jobs",
+    "Jobs the dispatcher currently tracks (register_job/end_job plus the "
+    "implicit default job once touched)")
+FLEET_AUTOSCALE_DECISIONS = REGISTRY.counter(
+    "petastorm_fleet_autoscale_decisions_total",
+    "Fleet autoscale decisions applied (and journaled), by action "
+    "(admit/drain/retire)",
+    labels=("action",))
+FLEET_JOB_FENCING_EPOCH = REGISTRY.gauge(
+    "petastorm_fleet_job_fencing_epoch",
+    "Per-job scoped fencing epoch (the fleet-wide base plus the job's "
+    "private offset): fleet-wide events move every job's epoch, a job's "
+    "own restart moves only its own — one job's chaos never fences "
+    "another's streams",
+    labels=("job",))
+FLEET_JOB_FAIR_SHARE = REGISTRY.gauge(
+    "petastorm_fleet_job_fair_share",
+    "Each job's weighted max-min fair share of serving-worker capacity "
+    "(fleet.plan_fair_shares over the jobs' weights/quotas and live "
+    "backlog) — the allocation credit scaling enforces",
+    labels=("job",))
+FLEET_JOB_BACKLOG = REGISTRY.gauge(
+    "petastorm_fleet_job_backlog_pieces",
+    "Dynamic-mode pieces booked to each JOB and not yet done (summed over "
+    "its clients) — the per-tenant view of the dispatcher backlog gauge",
+    labels=("job",))
+FLEET_JOB_ROWS = REGISTRY.counter(
+    "petastorm_fleet_job_rows_total",
+    "Rows streamed to each job's clients (worker-side attribution from "
+    "the stream request's job_id) — two scrapes give per-job delivery "
+    "rates, the fairness measurement",
+    labels=("job",))
+FLEET_JOB_CACHE_LOOKUPS = REGISTRY.counter(
+    "petastorm_fleet_job_cache_lookups_total",
+    "Decoded-batch cache lookups attributed to each job, by outcome "
+    "(hit/miss) — N jobs sharing one cache tier decode once, and this is "
+    "how the sharing is measured (a job whose every lookup hits paid "
+    "zero decode)",
+    labels=("job", "outcome"))
+
 DISPATCHER_GENERATION = REGISTRY.gauge(
     "petastorm_service_dispatcher_generation",
     "Dynamic-mode ownership-generation high-water mark: every assignment, "

@@ -69,6 +69,11 @@ _READER_POOL_THREAD_PREFIX = "petastorm-tpu-worker"
 #: the rest of the session.
 _AUTOTUNE_THREAD_PREFIX = "pipeline-autotune"
 
+#: The fleet autoscaler's controller thread: one surviving a test means a
+#: dispatcher armed with autoscale= was never stopped — it keeps applying
+#: (and journaling!) admit/drain decisions against a dead fleet.
+_FLEET_AUTOSCALE_THREAD_PREFIX = "fleet-autoscale"
+
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
@@ -87,6 +92,7 @@ def _resource_leak_guard(request):
     whatever survives it is a leak. Opt out with
     ``@pytest.mark.allow_resource_leaks`` (and a reason)."""
     from petastorm_tpu.cache_impl import live_cache_dirs
+    from petastorm_tpu.service.fleet import open_job_registrations
 
     if request.node.get_closest_marker("allow_resource_leaks"):
         yield
@@ -94,6 +100,7 @@ def _resource_leak_guard(request):
     before_threads = set(threading.enumerate())
     before_sockets = _open_socket_fds()
     before_cache_dirs = live_cache_dirs()
+    before_jobs = open_job_registrations()
     yield
     deadline = time.monotonic() + 2.0
     while True:
@@ -105,11 +112,14 @@ def _resource_leak_guard(request):
             t for t in threading.enumerate()
             if t not in before_threads and t.is_alive()
             and t.name.startswith((_READER_POOL_THREAD_PREFIX,
-                                   _AUTOTUNE_THREAD_PREFIX))]
+                                   _AUTOTUNE_THREAD_PREFIX,
+                                   _FLEET_AUTOSCALE_THREAD_PREFIX))]
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
+        leaked_jobs = open_job_registrations() - before_jobs
         if not leaked_threads and not leaked_pool_threads \
-                and not leaked_sockets and not leaked_cache_dirs:
+                and not leaked_sockets and not leaked_cache_dirs \
+                and not leaked_jobs:
             return
         if time.monotonic() >= deadline:
             break
@@ -117,13 +127,16 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
-        f"reader-pool/autotune threads "
+        f"reader-pool/autotune/fleet-autoscale threads "
         f"{[t.name for t in leaked_pool_threads]} "
         f"(an unstopped Reader — e.g. a streaming piece engine whose "
-        f"owner never stopped/joined it — or an autotuned loader whose "
-        f"controller was never stopped), "
+        f"owner never stopped/joined it — an autotuned loader whose "
+        f"controller was never stopped, or a Dispatcher(autoscale=) "
+        f"never stopped), "
         f"sockets {sorted(leaked_sockets)}, "
-        f"cache dirs {sorted(leaked_cache_dirs)} — stop/close every "
+        f"cache dirs {sorted(leaked_cache_dirs)}, "
+        f"open job registrations {sorted(leaked_jobs)} (a register_job "
+        f"without end_job — use fleet.JobHandle) — stop/close every "
         f"service node, loader, engine, and connection the test started, "
         f"and cleanup() every cache "
         f"(mark allow_resource_leaks only with a documented reason)",
