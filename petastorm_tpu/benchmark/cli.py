@@ -101,9 +101,36 @@ def _scenario_main(argv):
                              "this file (BENCH-style perf trajectory)")
     parser.add_argument("--chaos", default=None,
                         help="service scenario fault harness: "
-                             "dispatcher-restart, worker-kill, conn-drop "
-                             "(comma-separable). Checks delivery "
-                             "invariants and raises on violation")
+                             "dispatcher-restart, worker-kill, conn-drop, "
+                             "cache-corrupt, job-cancel, worker-drain, "
+                             "failpoints (comma-separable; failpoints = "
+                             "the seeded in-process fault schedule — see "
+                             "--chaos-seed). Checks delivery invariants "
+                             "and raises on violation")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        dest="chaos_seed",
+                        help="reproducer seed: drives the failpoint "
+                             "schedule AND the timed chaos kinds' event "
+                             "sequence, so the same seed injects the "
+                             "identical fault sequence (the injection "
+                             "log lands in the --json-out result)")
+    parser.add_argument("--failpoint-points", default=None,
+                        dest="failpoint_points",
+                        help="comma-separated failpoint names restricting "
+                             "the armed --chaos failpoints vocabulary "
+                             "(the fuzz shrinker's reproducers use this)")
+    parser.add_argument("--failpoint-window", type=int, default=None,
+                        dest="failpoint_window",
+                        help="fire indices land in [4, window) calls per "
+                             "failpoint (default 400); fuzz reproducers "
+                             "pin the small window their runs used")
+    parser.add_argument("--rows", type=int, default=None,
+                        help="service scenario: synthesized dataset rows "
+                             "(fuzz reproducers pin the small geometry "
+                             "their runs used)")
+    parser.add_argument("--days", type=int, default=None,
+                        help="service scenario: synthesized dataset day "
+                             "chunks = row-group pieces")
     parser.add_argument("--chaos-interval", type=float, default=None,
                         dest="chaos_interval_s",
                         help="seconds between injected chaos events")
@@ -185,6 +212,13 @@ def _scenario_main(argv):
             ("chaos_interval_s", "--chaos-interval", args.chaos_interval_s),
             ("chaos_max_events", "--chaos-max-events",
              args.chaos_max_events),
+            ("chaos_seed", "--chaos-seed", args.chaos_seed),
+            ("failpoint_points", "--failpoint-points",
+             args.failpoint_points),
+            ("failpoint_window", "--failpoint-window",
+             args.failpoint_window),
+            ("rows", "--rows", args.rows),
+            ("days", "--days", args.days),
             ("journal_dir", "--journal-dir", args.journal_dir),
             ("metrics_port", "--metrics-port", args.metrics_port),
             ("trace_out", "--trace-out", args.trace_out),

@@ -583,7 +583,10 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               batch_size=512, mode="static", skew_ms=0.0,
                               credits=8, json_out=None, chaos=None,
                               chaos_interval_s=1.5, chaos_max_events=4,
-                              journal_dir=None, metrics_port=None,
+                              chaos_seed=None, failpoint_points=None,
+                              failpoint_window=None,
+                              journal_dir=None,
+                              metrics_port=None,
                               trace_out=None, epochs=1, cache="off",
                               cache_mem_mb=256.0, cache_dir=None,
                               sharding=None, shuffle_seed=None,
@@ -611,7 +614,19 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     ``chaos_interval_s`` while the epoch streams, at most
     ``chaos_max_events`` times (``None`` = unbounded — note that repeated
     ``conn-drop`` restarts every in-flight piece set, so an unbounded
-    drop rate faster than a piece set streams never converges). The scenario then checks
+    drop rate faster than a piece set streams never converges).
+    ``"failpoints"`` is different in kind: instead of timed external
+    events it arms the process-wide **seeded failpoint schedule**
+    (:mod:`petastorm_tpu.failpoints`) for the run — torn frames and
+    connection resets inside the framed transport, dropped dispatcher
+    replies AFTER the state mutation applied, WAL append/fsync ENOSPC,
+    damaged cache-entry writes — each fired at call indices derived from
+    ``chaos_seed``, so two runs of one seed inject the identical fault
+    sequence (the injection log lands in the result as
+    ``failpoint_injections``). ``chaos_seed`` also drives the TIMED
+    kinds' event sequence (action choice + interval jitter via the seed
+    tree), making every chaos run reproducible from its ``--json-out``
+    line. The scenario then checks
     delivery invariants on the dataset's unique ``sample_index`` — zero
     lost rows always; zero duplicates too when only the control plane was
     perturbed (dispatcher restarts) — and RAISES if they are violated, so
@@ -761,10 +776,12 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         journal_tmp = tempfile.mkdtemp(prefix="petastorm_tpu_journal_")
         journal_dir = journal_tmp
 
-    # Chaos pacing: loopback drains a synthetic epoch in well under a
-    # second, which no failure could land inside — pace every worker so the
-    # epoch spans several injection intervals.
-    chaos_pace_s = 0.03 if chaos_kinds else 0.0
+    # "failpoints" is the seeded in-process schedule, not a timed external
+    # event — only the TIMED kinds need an injector thread and the pacing
+    # that makes the epoch span its intervals (failpoints fire on call
+    # counts, so the epoch needs no minimum wall time).
+    timed_kinds = [k for k in chaos_kinds if k != "failpoints"]
+    chaos_pace_s = 0.03 if timed_kinds else 0.0
     lease_timeout_s = 2.0 if chaos_kinds else 30.0
 
     def make_dispatcher(host="127.0.0.1", port=0):
@@ -785,6 +802,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     dispatcher_holder = []
     fleet = []
     injector = None
+    failpoint_schedule = None
     try:
         if metrics_port is not None:
             from petastorm_tpu.telemetry.http import MetricsServer
@@ -821,9 +839,31 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False,
                                trace_path=trace_out or None)
-        if chaos_kinds:
+        if "failpoints" in chaos_kinds:
+            from petastorm_tpu import failpoints as failpoints_mod
+
+            # Armed AFTER bring-up so the schedule's budget lands on the
+            # streaming epoch, not on registration; derived entirely from
+            # the seed, so the same --chaos-seed replays byte-identically.
+            # ``failpoint_points`` restricts the armed vocabulary (the
+            # fuzzer's shrinker; a comma string from the CLI); a replay
+            # PIN uses ``failpoint_window`` well below every armed
+            # point's call count, so both runs reach every scheduled
+            # fire and the logs compare equal.
+            if isinstance(failpoint_points, str):
+                failpoint_points = tuple(
+                    p.strip() for p in failpoint_points.split(",")
+                    if p.strip())
+            schedule_kwargs = {"points": failpoint_points}
+            if failpoint_window is not None:
+                schedule_kwargs["window"] = int(failpoint_window)
+            failpoint_schedule = failpoints_mod.arm(
+                failpoints_mod.FaultSchedule(
+                    chaos_seed if chaos_seed is not None else 0,
+                    **schedule_kwargs))
+        if timed_kinds:
             actions = []
-            for kind in chaos_kinds:
+            for kind in timed_kinds:
                 if kind == "dispatcher-restart":
                     actions.append((kind, dispatcher_restart_action(
                         dispatcher_holder, make_dispatcher)))
@@ -843,7 +883,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             injector = ChaosInjector(actions,
                                      interval_s=chaos_interval_s,
                                      max_events=(chaos_max_events
-                                                 or None)).start()
+                                                 or None),
+                                     seed=chaos_seed).start()
         def fleet_cache_totals():
             """Summed (hits, misses) across the fleet's batch caches, or
             ``None`` when caching is off."""
@@ -1040,11 +1081,17 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 list(range(rows)) * epochs, got_ids, allow_duplicates)
             status = source.dispatcher_status()
             recovery = status.get("recovery", {})
+            chaos_events = injector.events if injector is not None else []
+            injection_log = (failpoint_schedule.log_snapshot()
+                             if failpoint_schedule is not None else [])
             result.update({
                 "chaos": ",".join(chaos_kinds),
-                "chaos_events": injector.events,
-                "chaos_errors": injector.errors,
+                "chaos_seed": chaos_seed,
+                "chaos_events": chaos_events,
+                "chaos_errors": (injector.errors
+                                 if injector is not None else []),
                 "chaos_pace_s": chaos_pace_s,
+                "failpoint_injections": injection_log,
                 "lost_rows": invariants["lost_rows"],
                 "duplicate_rows": invariants["duplicate_rows"],
                 "fencing_epoch": status.get("fencing_epoch"),
@@ -1056,14 +1103,22 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                     f"chaos run violated delivery invariants: "
                     f"{invariants['lost_rows']} lost rows, "
                     f"{invariants['duplicate_rows']} duplicates "
-                    f"(allow_duplicates={allow_duplicates}); events: "
-                    f"{injector.events}")
+                    f"(allow_duplicates={allow_duplicates}); seed: "
+                    f"{chaos_seed}; events: {chaos_events}; "
+                    f"failpoints: {injection_log}")
+            if "failpoints" in chaos_kinds and failpoint_points is None \
+                    and not injection_log:
+                raise RuntimeError(
+                    "failpoints chaos ran but the schedule fired nothing "
+                    "— the run proved no robustness (too-short epoch "
+                    "never reached the seeded fire indices, or the "
+                    "failpoints were compiled out)")
             if "dispatcher-restart" in chaos_kinds and (
                     recovery.get("journal_replays", 0) < 1
                     or recovery.get("fencing_bumps", 0) < 1):
                 raise RuntimeError(
                     f"dispatcher-restart chaos recorded no recovery: "
-                    f"{recovery} (events: {injector.events})")
+                    f"{recovery} (events: {chaos_events})")
             if "cache-corrupt" in chaos_kinds and (
                     result["cache"]["corrupt_entries"] < 1):
                 raise RuntimeError(
@@ -1071,7 +1126,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                     "corrupt entry: either no injection landed on an "
                     "entry a warm epoch later loaded, or — the bug this "
                     "guard exists for — a damaged entry was served "
-                    f"without detection (events: {injector.events})")
+                    f"without detection (events: {chaos_events})")
         if json_out:
             import json
 
@@ -1081,6 +1136,10 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     finally:
         if injector is not None:
             injector.stop()
+        if failpoint_schedule is not None:
+            from petastorm_tpu import failpoints as failpoints_mod
+
+            failpoints_mod.disarm()
         for worker in fleet:
             worker.stop()
         if dispatcher_holder:

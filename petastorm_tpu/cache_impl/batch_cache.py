@@ -41,10 +41,12 @@ import threading
 import time
 from collections import OrderedDict
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
     CACHE_BYTES,
     CACHE_CORRUPT,
+    CACHE_DISK_WRITE_ERRORS,
     CACHE_ENTRIES,
     CACHE_EVICTIONS,
     CACHE_FILL_SECONDS,
@@ -290,6 +292,7 @@ class BatchCache:
         self.corrupt_entries = 0
         self.version_evicted = 0
         self.permuted_serves = 0
+        self.disk_write_errors = 0
         self._m_hits_mem = CACHE_HITS.labels("mem")
         self._m_hits_disk = CACHE_HITS.labels("disk")
         self._m_bytes_mem = CACHE_BYTES.labels("mem")
@@ -440,7 +443,16 @@ class BatchCache:
             old_size = os.path.getsize(path)
         except OSError:
             old_size = None
+        fp = failpoints.ACTIVE
+        partial = False
         try:
+            if fp is not None:
+                # "oserror" raises into the degrade-to-pass-through path
+                # below; "partial" PUBLISHES a truncated entry — the torn
+                # write a crash mid-replace-free filesystem still allows —
+                # which the warm load must detect (frame-length sum / crc)
+                # and degrade from, never serve.
+                partial = fp.fire("cache.write") == "partial"
             # mkstemp INSIDE the guard: a vanished/unwritable cache dir is
             # a degraded cache, not a stream error — the tier is
             # best-effort end to end.
@@ -449,9 +461,18 @@ class BatchCache:
                 f.write(_MAGIC)
                 f.write(_LEN.pack(len(meta)))
                 f.write(meta)
-                f.write(entry.buf)
+                if partial:
+                    f.write(entry.buf[:entry.nbytes // 2])
+                else:
+                    f.write(entry.buf)
             os.replace(tmp_path, path)
         except OSError:  # disk full, dir removed, fd exhaustion — skip
+            with self._lock:
+                self.disk_write_errors += 1
+            CACHE_DISK_WRITE_ERRORS.inc()
+            logger.warning(
+                "disk-tier cache entry write failed — skipping the entry "
+                "(cache degrades to pass-through for it)", exc_info=True)
             if tmp_path is not None:
                 try:
                     os.unlink(tmp_path)
@@ -489,6 +510,10 @@ class BatchCache:
 
         path = self._entry_path(key)
         try:
+            fp = failpoints.ACTIVE
+            if fp is not None:
+                fp.fire("cache.read")  # oserror → a transient read
+                #   failure is a MISS (fresh decode), never a stream error
             with open(path, "rb") as f:
                 blob = f.read()
         except OSError:
@@ -578,6 +603,7 @@ class BatchCache:
                 "corrupt_entries": self.corrupt_entries,
                 "version_evicted": self.version_evicted,
                 "permuted_serves": self.permuted_serves,
+                "disk_write_errors": self.disk_write_errors,
                 "cache_dir": self._dir,
             }
 

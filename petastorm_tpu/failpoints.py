@@ -1,0 +1,285 @@
+"""Deterministic in-process fault injection: named failpoints + schedules.
+
+The chaos harness's six kinds (``service/chaos.py``) are coarse, externally
+applied events — kill a process, drop every connection. The failure modes
+that actually dominate production input services (tf.data service paper,
+PAPERS.md 2210.14826) are finer: a torn frame mid-message, an fsync that
+returns ENOSPC, an RPC reply dropped *after* the state mutation applied, a
+row group that does not decode. This module compiles **named failpoints**
+into those exact hot-path I/O boundaries and drives them from a **seeded
+schedule**, so every robustness bug becomes a one-line reproducer
+(``--chaos failpoints --chaos-seed N``) instead of a flaky soak.
+
+Design constraints, in priority order:
+
+- **Zero disabled cost.** Every site is guarded by one load of the module
+  global :data:`ACTIVE` and a branch on ``None`` — no function call, no
+  dict lookup, nothing on the hot path while disarmed (the loopback bench
+  leg must not move).
+- **Determinism.** A :class:`FaultSchedule` derives, per failpoint, a
+  fixed set of *call indices* at which it fires (and which action fires)
+  purely from ``(seed, point)`` via the same blake2b fold-in construction
+  as :mod:`petastorm_tpu.service.seedtree`. The i-th call of a point
+  therefore takes the same action in every run of the same seed — the
+  injection log is replayable, and two runs of the service scenario under
+  one seed assert byte-identical stream digests.
+- **Survivability is the point.** Every action a schedule can take is one
+  the stack claims to survive: transport faults funnel into the client's
+  retry/takeover/watermark machinery, journal faults into the
+  dispatcher's degraded-read-only path, cache faults into
+  degrade-to-fresh-decode, poisoned pieces into the quarantine policy.
+  A seed that makes an invariant fail is a bug, and the fuzzer
+  (:mod:`petastorm_tpu.service.fuzz`) shrinks it to a minimal reproducer.
+
+Failpoint vocabulary (point → actions a schedule may choose):
+
+====================== =============================================
+``transport.send``     ``reset`` (ECONNRESET before any byte),
+                       ``torn`` (a PARTIAL length prefix hits the
+                       wire, then reset — the peer sees a torn
+                       frame mid-message), ``delay``
+``transport.recv``     ``reset``, ``delay``
+``journal.append``     ``enospc`` (WAL append fails — the
+                       dispatcher degrades read-only)
+``journal.fsync``      ``enospc``
+``journal.compact``    ``torn_rename`` (crash between snapshot
+                       tmp-write and rename: tmp exists, the old
+                       snapshot and the full WAL survive)
+``cache.write``        ``oserror`` (entry write skipped —
+                       pass-through), ``partial`` (a truncated
+                       entry is PUBLISHED; the warm load must
+                       detect and degrade)
+``cache.read``         ``oserror`` (load fails — a miss)
+``dispatcher.reply``   ``drop`` (the reply vanishes AFTER the
+                       handler mutated state — the client retries
+                       and the op is duplicated), ``delay``
+``worker.heartbeat``   ``drop`` (one lease-renewal tick lost)
+``piece.decode``       ``poison`` (the named piece is undecodable —
+                       only via ``poison_pieces=``, never randomly)
+====================== =============================================
+
+Arming is process-wide and explicitly scoped::
+
+    schedule = FaultSchedule(seed=7)
+    with failpoints.armed(schedule):
+        ...   # run the workload; schedule.log is the injection record
+
+The tests' conftest leak guard asserts :data:`ACTIVE` is ``None`` after
+every test — a schedule leaking past its scope would poison the suite.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import threading
+import time
+from contextlib import contextmanager
+
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import FAILPOINT_ARMED, FAILPOINT_FIRES
+
+logger = service_logger(__name__)
+
+#: The full failpoint vocabulary: point name → the actions a schedule may
+#: derive for it. ``generic`` actions (reset/delay/enospc/oserror) are
+#: performed by :meth:`FaultSchedule.fire` itself; the rest are returned
+#: to the call site, which implements the site-specific damage (a torn
+#: prefix needs the socket, a partial entry needs the file).
+POINTS = {
+    "transport.send": ("reset", "torn", "delay"),
+    "transport.recv": ("reset", "delay"),
+    "journal.append": ("enospc",),
+    "journal.fsync": ("enospc",),
+    "journal.compact": ("torn_rename",),
+    "cache.write": ("oserror", "partial"),
+    "cache.read": ("oserror",),
+    "dispatcher.reply": ("drop", "delay"),
+    "worker.heartbeat": ("drop",),
+}
+
+#: ``piece.decode`` is separate: it only ever fires for explicitly named
+#: ``poison_pieces`` — a schedule must not randomly poison data.
+POISON_POINT = "piece.decode"
+
+_KEY_BYTES = 8
+_KEY_MASK = (1 << (8 * _KEY_BYTES)) - 1
+
+
+def _fold_in(key, data):
+    """Seed-tree key derivation — the same blake2b construction as
+    :func:`petastorm_tpu.service.seedtree.fold_in`, duplicated here (a
+    dozen lines) because this module is imported by
+    ``reader_impl/framed_socket.py``, which the ``service`` package's
+    ``__init__`` imports: importing ``service.seedtree`` from here would
+    close that cycle at import time."""
+    h = hashlib.blake2b(digest_size=_KEY_BYTES)
+    h.update((int(key) & _KEY_MASK).to_bytes(_KEY_BYTES, "big",
+                                             signed=False))
+    h.update(repr(data).encode("utf-8"))
+    return int.from_bytes(h.digest(), "big")
+
+
+class FaultSchedule:
+    """One seeded, replayable fault schedule over the failpoint vocabulary.
+
+    :param seed: the reproducer seed. Everything the schedule will ever do
+        — which call index of which point fires which action — is a pure
+        function of it (and the ``points``/``max_fires``/``window``
+        shape knobs).
+    :param points: iterable restricting which failpoints are armed
+        (default: every name in :data:`POINTS`). The fuzzer's shrinker
+        narrows a failing schedule by re-running with subsets.
+    :param max_fires_per_point: fire indices derived per point.
+    :param window: fire indices land in ``[min_index, window)`` — calls
+        past the window never fire, so a run converges instead of
+        re-injecting forever (retries re-enter the same points).
+    :param min_index: the first few calls of every point are fault-free,
+        so service bring-up (registration, the first plan) is never
+        permanently wedged — faults land mid-flight, where they belong.
+    :param poison_pieces: piece indices :meth:`poison_piece` reports as
+        undecodable (the quarantine policy's injection vector). Never
+        derived from the seed: poisoning is an explicit, named choice.
+    :param delay_s: sleep for ``delay`` actions.
+    :param fires: explicit ``{point: {call_index: action}}`` override for
+        tests that need a fault at an exact call (bypasses derivation for
+        the named points).
+    """
+
+    def __init__(self, seed, points=None, max_fires_per_point=2,
+                 window=400, min_index=4, poison_pieces=None,
+                 delay_s=0.05, fires=None):
+        self.seed = int(seed)
+        self.points = tuple(points) if points is not None \
+            else tuple(sorted(POINTS))
+        unknown = [p for p in self.points
+                   if p not in POINTS and p != POISON_POINT]
+        if unknown:
+            raise ValueError(
+                f"unknown failpoint(s) {unknown}; choose from "
+                f"{sorted(POINTS)} + [{POISON_POINT!r}]")
+        self.poison_pieces = frozenset(
+            int(p) for p in (poison_pieces or ()))
+        self.delay_s = float(delay_s)
+        self._lock = threading.Lock()
+        self._calls = {}    # point -> call counter
+        self._fires = {}    # point -> {call_index: action}
+        self.log = []       # [(point, call_index, action)] in fire order
+        for point in self.points:
+            if point == POISON_POINT:
+                continue
+            plan = {}
+            actions = POINTS[point]
+            for k in range(int(max_fires_per_point)):
+                index = min_index + _fold_in(
+                    self.seed, ("fire", point, k)) % max(
+                        1, int(window) - int(min_index))
+                action = actions[_fold_in(
+                    self.seed, ("action", point, k)) % len(actions)]
+                plan.setdefault(index, action)  # collisions: first wins
+            self._fires[point] = plan
+        for point, plan in (fires or {}).items():
+            self._fires[point] = {int(i): a for i, a in plan.items()}
+
+    def check(self, point):
+        """Advance ``point``'s call counter; return the action firing at
+        this call (logged), or ``None``. Pure bookkeeping — the caller
+        (or :meth:`fire`) performs the action."""
+        with self._lock:
+            index = self._calls.get(point, 0)
+            self._calls[point] = index + 1
+            action = self._fires.get(point, {}).get(index)
+            if action is not None:
+                self.log.append((point, index, action))
+        if action is not None:
+            FAILPOINT_FIRES.labels(point, action).inc()
+            logger.warning("failpoint %s fired action %r (call %d, "
+                           "seed %d)", point, action, index, self.seed)
+        return action
+
+    def fire(self, point):
+        """:meth:`check`, then perform the generic actions in place:
+        ``delay`` sleeps, ``enospc``/``oserror`` raise :class:`OSError`,
+        ``reset`` raises :class:`ConnectionResetError`. Site-specific
+        actions (``torn``/``partial``/``drop``/``torn_rename``) are
+        returned for the call site to implement."""
+        action = self.check(point)
+        if action is None:
+            return None
+        if action == "delay":
+            time.sleep(self.delay_s)
+            return "delay"
+        if action == "enospc":
+            raise OSError(errno.ENOSPC,
+                          f"failpoint {point}: injected ENOSPC")
+        if action == "oserror":
+            raise OSError(f"failpoint {point}: injected I/O error")
+        if action == "reset":
+            raise ConnectionResetError(
+                f"failpoint {point}: injected connection reset")
+        return action
+
+    def poison_piece(self, piece):
+        """Whether ``piece`` is in the schedule's poison set (the
+        streaming engine asks before decoding). Logged per query that
+        answers yes, so the injection record shows every poisoned serve
+        attempt."""
+        if int(piece) not in self.poison_pieces:
+            return False
+        with self._lock:
+            index = self._calls.get(POISON_POINT, 0)
+            self._calls[POISON_POINT] = index + 1
+            self.log.append((POISON_POINT, index, f"poison:{int(piece)}"))
+        FAILPOINT_FIRES.labels(POISON_POINT, "poison").inc()
+        return True
+
+    def log_snapshot(self):
+        """The injection log as JSON-ready rows (point, call index,
+        action) — what the service scenario embeds in ``--json-out``."""
+        with self._lock:
+            return [list(entry) for entry in self.log]
+
+
+#: The armed schedule, or ``None``. Hot-path sites read this ONCE and
+#: branch on ``None`` — the entire disarmed cost.
+ACTIVE = None
+
+_ARM_LOCK = threading.Lock()
+
+
+def arm(schedule):
+    """Arm ``schedule`` process-wide. Exactly one schedule may be armed;
+    arming over a live one raises (a leaked schedule must be loud)."""
+    global ACTIVE
+    with _ARM_LOCK:
+        if ACTIVE is not None:
+            raise RuntimeError(
+                "a FaultSchedule is already armed — disarm() it first "
+                "(overlapping schedules would make the injection log "
+                "unattributable)")
+        ACTIVE = schedule
+    FAILPOINT_ARMED.set(1)
+    logger.warning("failpoints armed (seed=%d, points=%s, poison=%s)",
+                   schedule.seed, ",".join(schedule.points),
+                   sorted(schedule.poison_pieces))
+    return schedule
+
+
+def disarm():
+    """Disarm whatever is armed (idempotent); returns the schedule."""
+    global ACTIVE
+    with _ARM_LOCK:
+        schedule, ACTIVE = ACTIVE, None
+    FAILPOINT_ARMED.set(0)
+    return schedule
+
+
+@contextmanager
+def armed(schedule):
+    """``with failpoints.armed(FaultSchedule(seed)):`` — arm for a scope,
+    always disarm on the way out (the leak guard checks)."""
+    arm(schedule)
+    try:
+        yield schedule
+    finally:
+        disarm()

@@ -42,6 +42,7 @@ import struct
 import threading
 import time
 
+from petastorm_tpu import failpoints as _failpoints
 from petastorm_tpu.reader_impl.pickle_serializer import PickleSerializer
 from petastorm_tpu.telemetry.metrics import (
     TRANSPORT_BYTES,
@@ -103,6 +104,25 @@ def _check_header_len(header_len):
             f"Framed header length {header_len} exceeds the "
             f"{MAX_HEADER_BYTES}-byte header limit (desynced or "
             f"non-protocol peer?)")
+
+
+def _decode_header(raw):
+    """Parse the header JSON; a stream whose length prefix happened to
+    pass the size check but whose bytes are not a JSON object is desynced
+    (torn frame, wrong peer) — that is a :class:`ProtocolError` (framing
+    lost, connection unrecoverable), never a raw ``JSONDecodeError``
+    escaping into a server thread."""
+    try:
+        header = json.loads(str(raw, "utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(
+            f"framed header is not valid JSON ({exc}) — desynced or "
+            f"non-protocol peer") from exc
+    if not isinstance(header, dict):
+        raise ProtocolError(
+            f"framed header decodes to {type(header).__name__}, not an "
+            f"object — desynced or non-protocol peer")
+    return header
 
 
 class BufferPool:
@@ -258,6 +278,24 @@ def send_framed_frames(sock, header, fmt, frames):
     the socket by ``sendmsg`` with zero re-serialization (no pickle, no
     copy — the cached bytes are the wire bytes)."""
     header_bytes = json.dumps(header).encode("utf-8")
+    fp = _failpoints.ACTIVE
+    if fp is not None:  # disarmed cost: one global load + None branch
+        if fp.fire("transport.send") == "torn":
+            # A torn frame: HALF the length prefix reaches the peer, then
+            # the CONNECTION DIES — shutdown, not just a local raise,
+            # because that is the only way TCP produces a torn frame (a
+            # sender crashing mid-write). Without the shutdown the bytes
+            # would desync a still-live socket whose sender swallows send
+            # errors (credit acks) — a permanent two-sided hang no real
+            # fault can produce: the peer must see a mid-field close
+            # (ConnectionClosedError) and run its broken-stream recovery.
+            try:
+                sock.sendall(_LEN.pack(len(header_bytes))[:_LEN.size // 2])
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already-broken socket: the reset below still lands
+            raise ConnectionResetError(
+                "failpoint transport.send: torn frame injected")
     parts = [_LEN.pack(len(header_bytes)), header_bytes,
              _FMT.pack(fmt), _NFRAMES.pack(len(frames))]
     total_bytes = len(header_bytes) + _LEN.size + _FMT.size + _NFRAMES.size
@@ -289,9 +327,12 @@ def recv_framed(sock, max_frame_bytes=None):
     receivers use :class:`FramedReader`, which buffers large reads and
     recycles transient buffers across messages.
     """
+    fp = _failpoints.ACTIVE
+    if fp is not None:
+        fp.fire("transport.recv")
     header_len = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
     _check_header_len(header_len)
-    header = json.loads(_recv_exact(sock, header_len).decode("utf-8"))
+    header = _decode_header(_recv_exact(sock, header_len))
     fmt = _FMT.unpack(_recv_exact(sock, _FMT.size))[0]
     n_frames = _NFRAMES.unpack(_recv_exact(sock, _NFRAMES.size))[0]
     total_bytes = _LEN.size + header_len + _FMT.size + _NFRAMES.size
@@ -400,11 +441,23 @@ class FramedReader:
         peer: bytes already buffered, or bytes readable on the socket.
         Lets a sender drain incoming control messages (credit acks)
         opportunistically instead of only when it must block."""
+        return self.wait_data(0.0)
+
+    def wait_data(self, timeout):
+        """Block up to ``timeout`` seconds for a read to be able to make
+        progress (buffered bytes, or bytes readable on the socket); return
+        whether it can. The bounded-wait primitive behind every
+        credit-starved serve loop: polling this instead of parking in a
+        timeout-less ``recv`` lets the loop re-check its stop flag, so a
+        peer that vanished without FIN/RST can never pin the thread
+        forever (the blocking-read audit,
+        ``docs/guides/service.md#failure-model-and-recovery``)."""
         if self._end > self._start:
             return True
         import select
 
-        readable, _, _ = select.select([self._sock], [], [], 0)
+        readable, _, _ = select.select([self._sock], [], [],
+                                       max(0.0, timeout))
         return bool(readable)
 
     def _read_into(self, out, n):
@@ -420,9 +473,12 @@ class FramedReader:
 
     def recv(self):
         """Receive one framed message → ``(header dict, payload)``."""
+        fp = _failpoints.ACTIVE
+        if fp is not None:
+            fp.fire("transport.recv")
         header_len = _LEN.unpack_from(self._take(_LEN.size))[0]
         _check_header_len(header_len)
-        header = json.loads(str(self._take(header_len), "utf-8"))
+        header = _decode_header(self._take(header_len))
         meta = self._take(_FMT.size + _NFRAMES.size)
         fmt = _FMT.unpack_from(meta, 0)[0]
         n_frames = _NFRAMES.unpack_from(meta, _FMT.size)[0]

@@ -25,30 +25,43 @@ from petastorm_tpu.telemetry.log import service_logger
 logger = service_logger(__name__)
 
 CHAOS_KINDS = ("dispatcher-restart", "worker-kill", "conn-drop",
-               "cache-corrupt", "job-cancel", "worker-drain")
+               "cache-corrupt", "job-cancel", "worker-drain",
+               "failpoints")
 
 
 class ChaosInjector:
-    """Run ``actions`` round-robin on a background thread.
+    """Run ``actions`` on a background thread — round-robin by default,
+    or **seed-derived** (action choice AND inter-event interval jitter)
+    when ``seed`` is given, so a timed chaos run is reproducible: the
+    n-th injected event is the same action at the same nominal offset in
+    every run of the same seed (wall-clock scheduling still jitters with
+    the host, which is why the *failpoint* schedule — call-count-indexed
+    — is the byte-replayable substrate; the seed here makes the coarse
+    kinds replayable at the sequence level and lands the full injection
+    record in the scenario's ``--json-out``).
 
     :param actions: list of ``(label, callable)`` — each callable injects
         one fault when invoked (and must tolerate being called while the
         topology is mid-recovery from the previous one).
-    :param interval_s: pause between injected events.
+    :param interval_s: nominal pause between injected events.
     :param initial_delay_s: pause before the first event (lets the epoch's
         streams start so the fault lands mid-flight, not at setup).
     :param max_events: stop injecting after this many events (``None`` =
         until :meth:`stop`).
+    :param seed: derive the event sequence from this seed
+        (``seedtree.fold_in`` — no hidden RNG state). ``None`` keeps the
+        legacy fixed-interval round-robin.
     """
 
     def __init__(self, actions, interval_s=1.5, initial_delay_s=0.4,
-                 max_events=None):
+                 max_events=None, seed=None):
         if not actions:
             raise ValueError("chaos needs at least one (label, action)")
         self._actions = list(actions)
         self._interval_s = interval_s
         self._initial_delay_s = initial_delay_s
         self._max_events = max_events
+        self._seed = int(seed) if seed is not None else None
         self._stop = threading.Event()
         self._thread = None
         self._start_time = None
@@ -82,12 +95,28 @@ class ChaosInjector:
     def __exit__(self, exc_type, exc_val, exc_tb):
         self.stop()
 
+    def _event_plan(self, count):
+        """``(label, action, interval)`` for event ``count`` — seed-derived
+        when a seed is armed (pure in ``(seed, count)``), else the legacy
+        round-robin at the fixed interval."""
+        if self._seed is None:
+            label, action = self._actions[count % len(self._actions)]
+            return label, action, self._interval_s
+        from petastorm_tpu.service.seedtree import fold_in
+
+        key = fold_in(self._seed, ("chaos-event", count))
+        label, action = self._actions[key % len(self._actions)]
+        # Interval jitter in [0.5, 1.5) × nominal, derived — not drawn.
+        interval = self._interval_s * (
+            0.5 + (fold_in(key, "interval") % 1000) / 1000.0)
+        return label, action, interval
+
     def _run(self):
         if self._stop.wait(self._initial_delay_s):
             return
         count = 0
         while not self._stop.is_set():
-            label, action = self._actions[count % len(self._actions)]
+            label, action, interval = self._event_plan(count)
             elapsed = time.perf_counter() - self._start_time
             logger.warning("chaos: injecting %s at t=%.2fs", label, elapsed)
             try:
@@ -99,7 +128,7 @@ class ChaosInjector:
             count += 1
             if self._max_events is not None and count >= self._max_events:
                 return
-            if self._stop.wait(self._interval_s):
+            if self._stop.wait(interval):
                 return
 
 

@@ -130,6 +130,17 @@ def _build_parser():
                            "and observable but granted nothing until the "
                            "autoscaler (or Dispatcher.admit_worker) "
                            "admits it into serving")
+    work.add_argument("--on-piece-error", default="fail",
+                      choices=["fail", "quarantine"],
+                      dest="on_piece_error",
+                      help="poison-piece policy: 'fail' errors the stream "
+                           "on an undecodable piece (default); "
+                           "'quarantine' skips it, announces piece_failed "
+                           "to the client (which reports it to the "
+                           "dispatcher for journaled exclusion), and "
+                           "keeps serving every healthy piece "
+                           "exactly-once (docs/guides/service.md"
+                           "#failure-model-and-recovery)")
     work.add_argument("--batch-transform", default=None,
                       help="module:attr of the placement-flippable "
                            "collated-batch transform ({field: ndarray} -> "
@@ -186,6 +197,7 @@ def build_service_node(args):
         host=args.host, port=args.port, batch_size=args.batch_size,
         reader_factory=args.reader, worker_id=args.worker_id,
         standby=getattr(args, "standby", False),
+        on_piece_error=getattr(args, "on_piece_error", "fail"),
         heartbeat_interval_s=args.heartbeat_interval or None,
         batch_cache=CacheConfig(mode=getattr(args, "cache", "off"),
                                 mem_mb=getattr(args, "cache_mem_mb", 256.0),

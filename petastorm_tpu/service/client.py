@@ -44,6 +44,7 @@ import uuid
 from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedConnection,
+    ProtocolError,
 )
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
@@ -56,6 +57,7 @@ from petastorm_tpu.telemetry.metrics import (
     CLIENT_RECV_STALL,
     CLIENT_TRANSFORM_SECONDS,
     CLIENT_WATERMARK_LAG,
+    QUARANTINE_REPORTS,
 )
 from petastorm_tpu.utils import resize_bounded_queue, retry_with_backoff
 
@@ -65,6 +67,15 @@ logger = service_logger(__name__)
 class ServiceError(RuntimeError):
     """A non-transient service-protocol failure (dispatcher/worker replied
     ``error``, or the service cannot make progress)."""
+
+
+class DegradedDispatcherError(OSError):
+    """The dispatcher refused a state-mutating request because it is in
+    degraded read-only mode (a journal write failed — ENOSPC). An
+    ``OSError`` on purpose: the shared retry policy treats it as
+    transient, because every mutating request first attempts recovery (a
+    full snapshot compaction) and the next retry may find a healed
+    dispatcher (``docs/guides/service.md#failure-model-and-recovery``)."""
 
 
 class _WorkerStream:
@@ -92,7 +103,7 @@ class _WorkerStream:
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
                  starts=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None):
+                 job_id=None, recv_timeout=None):
         self.worker_id = worker_id
         #: The trainer job this stream belongs to (multi-tenant fleets):
         #: carried on the stream request so the worker attributes rows
@@ -124,13 +135,20 @@ class _WorkerStream:
         self.last_ordinal = None
         self._auto_replenish = auto_replenish
         self._connect_timeout = connect_timeout
+        #: Optional hard deadline on every stream recv (the blocking-read
+        #: audit's knob): ``None`` keeps the deliberate timeout-less
+        #: socket (keepalive covers silent host death); a value turns a
+        #: socket.timeout into the ordinary broken-stream retry path.
+        self._recv_timeout = recv_timeout
         self._conn = None
         self._closed = False
 
     def next_event(self):
         """``(kind, payload)`` — ``("batch", payload_dict)`` (tags exposed
         via ``last_piece``/``last_ordinal``/``last_bid``), ``("piece_done",
-        piece)``, or ``("end", None)`` when the stream ended cleanly."""
+        piece)``, ``("piece_failed", (piece, error))`` (the worker
+        quarantined a poison piece and keeps streaming the rest), or
+        ``("end", None)`` when the stream ended cleanly."""
         if self._closed:
             # Terminal: a teardown close() must not be mistaken for the
             # lazy not-yet-connected state — reconnecting here would send
@@ -146,7 +164,7 @@ class _WorkerStream:
             # blocking this timeout-less recv forever.
             self._conn = FramedConnection.connect(
                 self.address, timeout=self._connect_timeout,
-                stream_timeout=None, keepalive=True)
+                stream_timeout=self._recv_timeout, keepalive=True)
             if self._closed:
                 # close() raced the dial: tear the fresh socket down
                 # instead of streaming into an abandoned stream object.
@@ -184,6 +202,9 @@ class _WorkerStream:
             return ("batch", payload)
         if kind == "piece_done":
             return ("piece_done", int(header["piece"]))
+        if kind == "piece_failed":
+            return ("piece_failed", (int(header["piece"]),
+                                     str(header.get("error", ""))))
         if kind == "end":
             self.close()
             return ("end", None)
@@ -411,9 +432,11 @@ class _StreamReader(threading.Thread):
                 try:
                     kind, payload = self._stream.next_event()
                 except (ConnectionClosedError, ConnectionError,
-                        OSError) as exc:
+                        OSError, ProtocolError) as exc:
                     # A close() from the consumer's teardown also lands here
                     # — the stop flag distinguishes it from a real failure.
+                    # ProtocolError = the socket desynced (torn frame):
+                    # framing is lost, so it is a broken connection too.
                     if not self._stopped.is_set():
                         self._put(("broken", self._sid, exc))
                     return
@@ -423,8 +446,8 @@ class _StreamReader(threading.Thread):
                 if kind == "end":
                     self._put(("end", self._sid, None))
                     return
-                if kind == "piece_done":
-                    self._put(("piece_done", self._sid, payload))
+                if kind in ("piece_done", "piece_failed"):
+                    self._put((kind, self._sid, payload))
                     continue
                 bid = self._stream.last_bid
                 if collector.enabled:
@@ -467,7 +490,7 @@ class _DynamicStream:
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
                  credits=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None):
+                 job_id=None, recv_timeout=None):
         self.worker_id = worker_id
         self.job_id = job_id  # see _WorkerStream.job_id
         self.address = tuple(address)
@@ -479,6 +502,7 @@ class _DynamicStream:
         self.shuffle_seed = shuffle_seed  # see _WorkerStream.shuffle_seed
         self.transform_placement = transform_placement  # see _WorkerStream
         self._connect_timeout = connect_timeout
+        self._recv_timeout = recv_timeout  # see _WorkerStream._recv_timeout
         self._conn = None
         self._closed = False
         self._send_lock = threading.Lock()
@@ -492,7 +516,7 @@ class _DynamicStream:
                 return self._conn
             conn = FramedConnection.connect(
                 self.address, timeout=self._connect_timeout,
-                stream_timeout=None, keepalive=True)
+                stream_timeout=self._recv_timeout, keepalive=True)
             if self._closed:
                 conn.close()
                 raise ConnectionClosedError("stream closed")
@@ -543,6 +567,10 @@ class _DynamicStream:
             return ("piece_done", (int(header["piece"]),
                                    int(header.get("generation", 0)),
                                    int(header.get("rows", 0))))
+        if kind == "piece_failed":
+            return ("piece_failed", (int(header["piece"]),
+                                     int(header.get("generation", 0)),
+                                     str(header.get("error", ""))))
         if kind == "revoked":
             return ("revoked", (header.get("req"),
                                 [int(p) for p in header.get("pieces", [])]))
@@ -616,7 +644,7 @@ class _DynamicStreamReader(threading.Thread):
                 try:
                     kind, item = self._stream.next_event()
                 except (ConnectionClosedError, ConnectionError,
-                        OSError) as exc:
+                        OSError, ProtocolError) as exc:
                     if not self._stopped.is_set():
                         self._put(("broken", self._sid, exc))
                     return
@@ -736,6 +764,25 @@ class ServiceBatchSource:
         job's flow-control windows (``credit_scale`` on assignment
         replies): a job granted half the fair share opens its next
         streams with half the configured credit window.
+    :param on_piece_error: poison-piece policy, the client half (pair
+        with ``BatchWorker(on_piece_error=...)``). ``"fail"`` (default):
+        a worker's ``piece_failed`` frame raises :class:`ServiceError`
+        into the training loop. ``"quarantine"``: the piece is recorded
+        (``diagnostics["quarantined_pieces"]``, recovery counter
+        ``pieces_quarantined``), reported to the dispatcher
+        (``report_poison_piece`` — journaled, excluded from re-grant),
+        and the drain completes the piece with zero rows so every
+        HEALTHY piece still delivers exactly-once and the epoch
+        finishes (``docs/guides/service.md#failure-model-and-recovery``).
+    :param stream_recv_timeout_s: optional hard deadline (seconds) on
+        every batch-stream ``recv``. Default ``None`` — deliberately
+        timeout-less, because an inter-batch gap has no upper bound
+        (reader construction, cold storage reads) and TCP keepalive
+        already bounds silent host death to ~2 minutes. Set it when the
+        deployment wants a hard latency ceiling instead: a tick without
+        a byte then surfaces as an ordinary broken stream and rides the
+        shared ``retry_with_backoff`` recovery (same-worker retry →
+        takeover), exactly-once throughout.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
@@ -745,9 +792,14 @@ class ServiceBatchSource:
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  dynamic_sync_interval_s=0.25, ordered=False,
                  transform=None, transform_placement="remote",
-                 job_id=None):
+                 job_id=None, on_piece_error="fail",
+                 stream_recv_timeout_s=None):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
+        if on_piece_error not in ("fail", "quarantine"):
+            raise ValueError(
+                "on_piece_error must be 'fail' or 'quarantine', got "
+                f"{on_piece_error!r}")
         if ready_queue_depth is not None and ready_queue_depth < 1:
             raise ValueError(
                 "ready_queue_depth must be a positive integer or None")
@@ -787,6 +839,9 @@ class ServiceBatchSource:
         self._heartbeat_interval_s = heartbeat_interval_s
         self._rpc_deadline_s = rpc_deadline_s
         self._max_frame_bytes = max_frame_bytes
+        self._on_piece_error = on_piece_error
+        self._stream_recv_timeout_s = stream_recv_timeout_s
+        self._quarantined = []  # [{"piece","worker_id","error","epoch"}]
         self._dynamic_sync_interval_s = dynamic_sync_interval_s
         self._ordered = bool(ordered)
         self._shuffle_seed = None     # dispatcher config, read at __call__
@@ -823,6 +878,8 @@ class ServiceBatchSource:
             #                           repeated (the exactly-once safety
             #                           net — 0 when the worker-side
             #                           watermark skip did its job)
+            "pieces_quarantined": 0,  # poison pieces recorded under
+            #                           on_piece_error="quarantine"
             "fencing_epoch": 0,       # last fencing epoch observed
             "dispatcher": {},         # dispatcher recovery counters (last
         }                             # heartbeat reply)
@@ -870,6 +927,46 @@ class ServiceBatchSource:
         self._recovery[event] += n
         CLIENT_RECOVERY_EVENTS.labels(event).inc(n)
 
+    # -- poison-piece quarantine -------------------------------------------
+
+    def _note_quarantined(self, piece, worker_id, error, epoch):
+        """Record one quarantined piece (worker sent ``piece_failed``
+        under policy ``"quarantine"``) and report it to the dispatcher on
+        a helper thread — journaled there, excluded from every future
+        grant. The report is best-effort with the shared retry policy: if
+        the dispatcher is unreachable the piece is simply re-granted (and
+        re-quarantined) next epoch, which converges."""
+        piece = int(piece)
+        with self._lock:
+            if any(entry["piece"] == piece and entry["epoch"] == epoch
+                   for entry in self._quarantined):
+                return  # duplicate frame (re-serve raced the quarantine)
+            self._quarantined.append({"piece": piece,
+                                      "worker_id": worker_id,
+                                      "error": str(error),
+                                      "epoch": int(epoch)})
+            self._recovery_inc("pieces_quarantined")
+        QUARANTINE_REPORTS.labels("client").inc()
+        self._log.warning(
+            "piece %d quarantined by worker (%s) — continuing without it",
+            piece, error, worker_id=worker_id)
+
+        def report():
+            try:
+                self._dispatcher_request({
+                    "type": "report_poison_piece",
+                    "client_id": self.client_id, "piece": piece,
+                    "worker_id": worker_id, "error": str(error),
+                    "epoch": int(epoch)}, retries=1)
+            except (ServiceError, OSError):
+                self._log.warning(
+                    "poison-piece report for piece %d did not reach the "
+                    "dispatcher — it will be re-reported when the piece "
+                    "is re-granted", piece)
+
+        threading.Thread(target=report, daemon=True,
+                         name=f"service-quarantine-{self.client_id}").start()
+
     # -- dispatcher control channel ---------------------------------------
 
     def _dispatcher_request(self, header, retries=None):
@@ -892,13 +989,23 @@ class ServiceBatchSource:
                     max_frame_bytes=self._max_frame_bytes) as conn:
                 reply, _ = conn.request(header)
             if reply.get("type") == "error":
+                if reply.get("retryable"):
+                    # A degraded (read-only) dispatcher heals itself via
+                    # a recovery snapshot on a later request — transient,
+                    # so it rides the OSError retry path instead of
+                    # killing training like a protocol error would.
+                    raise DegradedDispatcherError(
+                        reply.get("error", "dispatcher degraded"))
                 raise ServiceError(reply.get("error", "dispatcher error"))
             return reply
 
         reply = retry_with_backoff(
             once, retries=self._max_retries if retries is None else retries,
             base_delay=self._backoff_base,
-            max_delay=self._backoff_max, retry_on=(OSError,),
+            max_delay=self._backoff_max,
+            # ProtocolError = a desynced control connection (torn frame):
+            # the conn is dropped and a fresh dial retries cleanly.
+            retry_on=(OSError, ProtocolError),
             no_retry_on=(ServiceError,), deadline_s=self._rpc_deadline_s,
             description=f"dispatcher request {header.get('type')!r}")
         if "fencing_epoch" in reply:
@@ -1169,7 +1276,8 @@ class ServiceBatchSource:
                         starts={p: starts.get(p, 0) for p in pending},
                         shuffle_seed=self._shuffle_seed,
                         transform_placement=self._iter_transform_placement,
-                        job_id=self.job_id)
+                        job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1353,7 +1461,8 @@ class ServiceBatchSource:
                     starts={p: marks.get(p, 0) for p in pieces},
                     shuffle_seed=self._shuffle_seed,
                     transform_placement=self._iter_transform_placement,
-                    job_id=self.job_id))
+                    job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1429,6 +1538,30 @@ class ServiceBatchSource:
                     stream = streams.get(sid)
                     if stream is None:
                         continue
+                    if sequencer is not None:
+                        released = sequencer.finish_piece(
+                            piece, stream.worker_id)
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.complete_piece(piece, stream.worker_id)
+                elif kind == "piece_failed":
+                    piece, failure = item
+                    stream = streams.get(sid)
+                    if stream is None:
+                        continue
+                    if self._on_piece_error != "quarantine":
+                        raise ServiceError(
+                            f"worker {stream.worker_id} failed piece "
+                            f"{piece}: {failure} (on_piece_error='fail' — "
+                            f"run with 'quarantine' to skip poison pieces "
+                            f"instead)")
+                    # Quarantine: record + report, then COMPLETE the piece
+                    # with zero rows so the epoch (and ordered mode's
+                    # sequencer) drains past it — every healthy piece
+                    # still delivers exactly-once.
+                    self._note_quarantined(piece, stream.worker_id,
+                                           failure, epoch)
                     if sequencer is not None:
                         released = sequencer.finish_piece(
                             piece, stream.worker_id)
@@ -1688,7 +1821,8 @@ class ServiceBatchSource:
                 credits=self._effective_credits(),
                 shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
-                job_id=self.job_id)
+                job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
             streams[sid] = stream
             sid_by_wid[wid] = sid
             with self._lock:
@@ -1829,7 +1963,8 @@ class ServiceBatchSource:
                         credits=self._effective_credits(),
                         shuffle_seed=self._shuffle_seed,
                         transform_placement=self._iter_transform_placement,
-                        job_id=self.job_id)
+                        job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -1840,10 +1975,11 @@ class ServiceBatchSource:
                     fresh = retry_with_backoff(
                         attempt, retries=self._max_retries,
                         base_delay=self._backoff_base,
-                        max_delay=self._backoff_max, retry_on=(OSError,),
+                        max_delay=self._backoff_max,
+                        retry_on=(OSError, ProtocolError),
                         no_retry_on=(ServiceError,),
                         description=f"reconnect to worker {wid}")
-                except OSError:
+                except (OSError, ProtocolError):
                     fresh = None
                 if fresh is not None:
                     if not post(("drecovered", sid, (wid, fresh))):
@@ -2018,6 +2154,29 @@ class ServiceBatchSource:
                         # still holds backlog: rebalance NOW instead of on
                         # the next interval tick.
                         sync_poke.set()
+                elif kind == "piece_failed":
+                    piece, gen, failure = item
+                    st = piece_state.get(piece)
+                    if st is None or st["done"] or st["gen"] != gen:
+                        continue  # a superseded grant's quarantine: stale
+                    stream = streams.get(sid)
+                    wid = stream.worker_id if stream is not None else None
+                    if self._on_piece_error != "quarantine":
+                        raise ServiceError(
+                            f"worker {wid} failed piece {piece}: {failure} "
+                            f"(on_piece_error='fail' — run with "
+                            f"'quarantine' to skip poison pieces instead)")
+                    self._note_quarantined(piece, wid, failure, epoch)
+                    with self._lock:
+                        st["done"] = True
+                        outstanding.get(st["wid"], set()).discard(piece)
+                    if sequencer is not None:
+                        released = sequencer.finish_piece(piece, wid)
+                        CLIENT_WATERMARK_LAG.set(sequencer.lag)
+                        yield from book.emit(released)
+                    else:
+                        book.complete_piece(piece, wid)
+                    remaining -= 1
                 elif kind == "revoked":
                     on_revoked(sid, item)
                 elif kind == "deltas":
@@ -2285,17 +2444,28 @@ class ServiceBatchSource:
                 credits=self._effective_credits(), tagged=True,
                 starts=starts, shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
-                job_id=self.job_id)
-            event = fresh.next_event()  # forces connect + first reply
+                job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
+            try:
+                event = fresh.next_event()  # forces connect + first reply
+            except BaseException:
+                # The dial succeeded but the request/first-reply failed
+                # (peer died mid-handshake, injected reset): close the
+                # half-open socket before the retry dials a new one.
+                fresh.close()
+                raise
             return fresh, event
 
         try:
             fresh, event = retry_with_backoff(
                 attempt, retries=self._max_retries,
                 base_delay=self._backoff_base, max_delay=self._backoff_max,
-                retry_on=(OSError,), no_retry_on=(ServiceError,),
+                # ProtocolError = desynced peer: same broken-connection
+                # class the established-stream readers already recover.
+                retry_on=(OSError, ProtocolError),
+                no_retry_on=(ServiceError,),
                 description=f"reconnect to worker {stream.worker_id}")
-        except OSError:
+        except (OSError, ProtocolError):
             return None
         # The first event was consumed by the probe; hand it back by
         # buffering it on the stream object.
@@ -2367,7 +2537,8 @@ class ServiceBatchSource:
                           starts={p: starts.get(p, 0) for p in pieces},
                           shuffle_seed=self._shuffle_seed,
                           transform_placement=self._iter_transform_placement,
-                          job_id=self.job_id)
+                          job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2450,11 +2621,13 @@ class ServiceBatchSource:
                 credits=self._effective_credits(), auto_replenish=True,
                 shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
-                job_id=self.job_id)
+                job_id=self.job_id,
+                        recv_timeout=self._stream_recv_timeout_s)
             try:
                 yield from self._drain_one(stream)
                 return True
-            except (ConnectionClosedError, ConnectionError, OSError) as exc:
+            except (ConnectionClosedError, ConnectionError, OSError,
+                    ProtocolError) as exc:
                 if attempt == self._max_retries:
                     return False
                 sleep_s = next(delays)
@@ -2629,6 +2802,11 @@ class ServiceBatchSource:
                           "credits_outstanding": counters["inflight"],
                           "pieces": counters.get("pieces", 0)}
                     for wid, counters in self._per_worker.items()},
+                # Poison pieces recorded under on_piece_error="quarantine"
+                # (piece, reporting worker, error, epoch) — the trainer-
+                # side account of what the epoch was delivered WITHOUT.
+                "quarantined_pieces": [dict(entry)
+                                       for entry in self._quarantined],
                 "recovery": {
                     key: (dict(value) if isinstance(value, dict)
                           else value)

@@ -52,8 +52,13 @@ Request vocabulary (header ``type``):
   rebalance loop — the client reports progress and per-worker backlog,
   the dispatcher journals steals away from drained/straggler-bound
   workers and replies with the moves (``docs/guides/service.md#sharding-modes``)
+- ``report_poison_piece`` ``{client_id, piece, worker_id, error, epoch}``
+  → ``ok`` (the piece is journaled into the quarantine set and excluded
+  from every future grant — assignment, plan, takeover re-partition, fcfs
+  split; idempotent, restart-safe)
 - ``status`` → full control-plane snapshot (workers, clients, queue depth,
-  fencing epoch, recovery counters, journal stats)
+  fencing epoch, recovery counters, quarantine set, degraded flag,
+  journal stats)
 - ``worker_diagnostics`` → one fan-out to every live worker's
   ``diagnostics`` endpoint, aggregated — a trainer (or an operator's
   one-liner) reads the whole fleet's reader/flow-control state through the
@@ -67,7 +72,9 @@ import threading
 import time
 from collections import deque
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
     FramedReader,
     FramedServer,
     send_framed,
@@ -95,6 +102,8 @@ from petastorm_tpu.telemetry.metrics import (
     FLEET_JOB_FENCING_EPOCH,
     FLEET_JOBS,
     FLEET_WORKERS,
+    QUARANTINE_PIECES,
+    QUARANTINE_REPORTS,
 )
 
 logger = service_logger(__name__)
@@ -362,7 +371,23 @@ class Dispatcher:
             "failures_reported": 0,   # client-reported worker deaths
             "re_registrations": 0,
             "stale_fencing_rejections": 0,
+            "journal_write_failures": 0,  # WAL appends/compactions that
+            #                               raised (ENOSPC…) → degraded
+            "pieces_quarantined": 0,  # poison pieces reported + journaled
         }
+        # Poison-piece quarantine: piece -> {"worker_id", "client_id",
+        # "error", "epoch"} — journaled, restored on replay, excluded
+        # from every future grant (assignment, plan, takeover
+        # re-partition, fcfs split) until the journal is reset.
+        self._quarantined = {}
+        # WAL/disk-exhaustion degradation: None, or the reason string
+        # that flipped this dispatcher READ-ONLY — a journal write failed
+        # (ENOSPC), so state-mutating requests are refused LOUDLY instead
+        # of silently diverging from the journal. Every mutating handler
+        # first attempts recovery: a full snapshot compaction (which
+        # supersedes any lost WAL record); success clears the flag
+        # (docs/guides/service.md#failure-model-and-recovery).
+        self._degraded = None
         self._journal = None
         if journal_dir is not None:
             from petastorm_tpu.service.journal import Journal
@@ -456,6 +481,8 @@ class Dispatcher:
                            if self._fcfs_queue is not None else None),
             "fencing_epoch": self._fencing_epoch,
             "recovery": dict(self._recovery),
+            "quarantined": {str(p): dict(info)
+                            for p, info in self._quarantined.items()},
             "generation": self._generation,
             # owner maps keyed by int piece → serialized as triplet lists
             # (JSON object keys must be strings).
@@ -547,6 +574,8 @@ class Dispatcher:
         recovered = state.get("recovery", {})
         for key in self._recovery:
             self._recovery[key] = int(recovered.get(key, 0))
+        self._quarantined = {int(p): dict(info) for p, info
+                             in (state.get("quarantined") or {}).items()}
         self._generation = int(state.get("generation", 0))
         self._dyn = {}
         self._mark_dyn_dirty_locked()
@@ -620,6 +649,13 @@ class Dispatcher:
                                in (record.get("watermarks")
                                    or {}).items()},
             }
+        elif op == "quarantine":
+            self._quarantine_piece_locked(
+                int(record["piece"]),
+                {"worker_id": record.get("worker_id"),
+                 "client_id": record.get("client_id"),
+                 "error": record.get("error"),
+                 "epoch": int(record.get("epoch", 0))})
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
@@ -645,8 +681,55 @@ class Dispatcher:
     def _journal_locked(self, record):
         if self._journal is None:
             return
-        self._journal.append(record)
-        self._journal.maybe_compact(self._state_dict_locked)
+        if self._degraded is not None:
+            # A WAL with a lost record must not take further appends:
+            # replaying around the gap would restore divergent state.
+            # Only a full snapshot (the recovery path in
+            # _check_writable_locked) may resume journaling.
+            return
+        try:
+            self._journal.append(record)
+            self._journal.maybe_compact(self._state_dict_locked)
+        except OSError as exc:
+            # WAL/disk exhaustion: the in-memory mutation already applied,
+            # but durability is gone — fail LOUDLY into read-only instead
+            # of crashing mid-write or silently diverging from the
+            # journal. Recovery (attempted by the next mutating request)
+            # is a full snapshot compaction, which supersedes whatever
+            # record was just lost.
+            self._degraded = f"journal write failed: {exc}"
+            self._recovery["journal_write_failures"] += 1
+            logger.error(
+                "journal write failed (%s) — dispatcher is now READ-ONLY: "
+                "state-mutating requests will be refused until a recovery "
+                "snapshot succeeds", exc)
+
+    def _check_writable_locked(self):
+        """Degradation gate for state-MUTATING handlers: ``None`` when the
+        journal is healthy (or recovery just succeeded), else the error
+        reply to return. Recovery = one full snapshot compaction — it
+        captures every in-memory mutation (including any whose WAL record
+        was lost when degradation hit), so a transient ENOSPC heals the
+        moment space frees up."""
+        if self._degraded is None:
+            return None
+        try:
+            self._journal.snapshot(self._state_dict_locked())
+        except OSError as exc:
+            self._recovery["journal_write_failures"] += 1
+            # retryable: degradation is transient-capable (the next
+            # request's recovery snapshot may succeed once space frees) —
+            # clients back off and retry instead of killing training.
+            return {"type": "error", "retryable": True, "error": (
+                f"dispatcher is read-only (degraded: {self._degraded}; "
+                f"recovery snapshot failed: {exc}) — state-mutating "
+                f"requests are refused so the control plane cannot "
+                f"diverge from its journal")}
+        logger.warning(
+            "journal recovered via full snapshot — leaving degraded "
+            "read-only mode (was: %s)", self._degraded)
+        self._degraded = None
+        return None
 
     def _bump_fencing_locked(self, reason):
         self._fencing_epoch += 1
@@ -656,6 +739,61 @@ class Dispatcher:
                               "reason": reason})
         logger.info("fencing epoch bumped",
                     fencing_epoch=self._fencing_epoch, reason=reason)
+
+    # -- poison-piece quarantine -------------------------------------------
+
+    def _quarantine_piece_locked(self, piece, info):
+        """One mutation site for quarantining a piece (live handler AND
+        WAL replay): record it, exclude it from every client's dynamic
+        books (marked done so the steal planner and reconciliation never
+        re-grant it), and keep the recovery counter in step. Idempotent —
+        a duplicate report (retried RPC, second client) is a no-op."""
+        if piece in self._quarantined:
+            return False
+        self._quarantined[piece] = dict(info)
+        self._recovery["pieces_quarantined"] += 1
+        for state in self._dyn.values():
+            if piece in state["owner"] and piece not in state["done"]:
+                state["done"].add(piece)
+                self._mark_dyn_dirty_locked()
+        return True
+
+    def _grantable_pieces_locked(self, pieces):
+        """Filter quarantined pieces out of a grant list — the one
+        exclusion rule every grant path (assignment, plan, takeover
+        re-partition, fcfs split) applies."""
+        if not self._quarantined:
+            return list(pieces)
+        return [p for p in pieces if p not in self._quarantined]
+
+    def _handle_report_poison_piece(self, header):
+        """A client observed a worker quarantine an undecodable piece
+        (``piece_failed`` frame): journal it and exclude the piece from
+        every future grant. Idempotent; survives dispatcher restarts via
+        the journal (the acceptance contract of
+        ``on_piece_error="quarantine"``)."""
+        piece = int(header["piece"])
+        with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            info = {"worker_id": header.get("worker_id"),
+                    "client_id": header.get("client_id"),
+                    "error": str(header.get("error", ""))[:512],
+                    "epoch": int(header.get("epoch", 0))}
+            fresh = self._quarantine_piece_locked(piece, info)
+            if fresh:
+                self._journal_locked(dict(info, op="quarantine",
+                                          piece=piece))
+            quarantined = sorted(self._quarantined)
+        if fresh:
+            QUARANTINE_REPORTS.labels("dispatcher").inc()
+            logger.warning(
+                "piece %d quarantined (worker %s: %s) — excluded from all "
+                "future grants", piece, info["worker_id"], info["error"],
+                client_id=info["client_id"])
+        return {"type": "ok", "piece": piece, "fresh": fresh,
+                "quarantined": quarantined}
 
     # -- liveness ----------------------------------------------------------
 
@@ -921,6 +1059,17 @@ class Dispatcher:
             except Exception as exc:  # reply instead of killing the conn
                 logger.exception("dispatcher request %r failed", header)
                 reply = {"type": "error", "error": str(exc)}
+            fp = failpoints.ACTIVE
+            if fp is not None:
+                # The duplicated-control-op case: the handler RAN (state
+                # mutated, journal appended) and only the reply vanishes —
+                # the client's retry re-sends the request, so every
+                # handler must be idempotent under replay. `delay` is
+                # handled inside fire().
+                if fp.fire("dispatcher.reply") == "drop":
+                    raise ConnectionClosedError(
+                        "failpoint dispatcher.reply: reply dropped after "
+                        "the state mutation applied")
             # A handler may return (header, payload) when the reply carries
             # non-JSON data (worker_diagnostics aggregates arbitrary
             # Reader.diagnostics values).
@@ -956,6 +1105,7 @@ class Dispatcher:
         DISPATCHER_WORKERS.labels("dead").set(len(self._workers) - alive)
         for event, count in self._recovery.items():
             DISPATCHER_RECOVERY_EVENTS.labels(event).set(count)
+        QUARANTINE_PIECES.set(len(self._quarantined))
         for state in ("serving", "standby", "draining"):
             FLEET_WORKERS.labels(state).set(sum(
                 1 for w in self._workers.values()
@@ -1139,6 +1289,8 @@ class Dispatcher:
         the steal path sheds the not-yet-started backlog exactly-once
         through the ordinary revoke→extend re-grant handshake."""
         with self._lock:
+            if self._check_writable_locked() is not None:
+                return False  # degraded read-only: no journaled decisions
             applied = self._apply_autoscale_locked(action, worker_id)
             if applied:
                 self._journal_locked({"op": "autoscale", "action": action,
@@ -1174,6 +1326,9 @@ class Dispatcher:
         re_register = bool(header.get("re_register"))
         standby = bool(header.get("standby"))
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             if self._num_pieces is not None \
                     and self._num_pieces != num_pieces:
                 return {"type": "error", "error": (
@@ -1217,6 +1372,9 @@ class Dispatcher:
                     "error": f"job weight must be > 0, got {weight}"}
         quota = header.get("quota")
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             restarted = self._install_job_locked(job_id, weight, quota,
                                                  restart=True)
             self._journal_locked({
@@ -1236,6 +1394,9 @@ class Dispatcher:
         no-op reply so teardown paths can call it unconditionally."""
         job_id = str(header["job_id"])
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             removed = self._remove_job_locked(job_id)
             if removed:
                 self._journal_locked({"op": "job_end", "job_id": job_id})
@@ -1351,6 +1512,9 @@ class Dispatcher:
                     f"[0, {num_clients})"}
         job_id = str(header.get("job_id") or DEFAULT_JOB)
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             if self._num_pieces is None:
                 return {"type": "error",
                         "error": "no workers have registered yet"}
@@ -1366,8 +1530,8 @@ class Dispatcher:
             # client's reorder buffer shallow — the canonical next piece
             # is always at the head of some live stream's remaining work.
             epoch_number = int(header.get("epoch", 0))
-            client_pieces = list(
-                range(self._num_pieces))[client_index::num_clients]
+            client_pieces = self._grantable_pieces_locked(list(
+                range(self._num_pieces))[client_index::num_clients])
             worker_ids = sorted(alive)
             assignments = {
                 wid: piece_order(self.shuffle_seed, epoch_number, pieces)
@@ -1399,6 +1563,13 @@ class Dispatcher:
         pieces = [int(p) for p in header.get("pieces", [])]
         token = header.get("fencing_epoch")
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            # A quarantined piece must not ride a takeover back into the
+            # plan: the reporting client may not have seen the
+            # quarantine yet (another client reported it).
+            pieces = self._grantable_pieces_locked(pieces)
             job_id = self._client_job_locked(header.get("client_id"),
                                              header)
             if token is not None \
@@ -1487,20 +1658,37 @@ class Dispatcher:
                     "next_split is an fcfs-mode request; static clients use "
                     "get_assignment"}
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             if self._num_pieces is None:
                 return {"type": "error",
                         "error": "no workers have registered yet"}
             if self._fcfs_queue is None:
                 self._fcfs_queue = deque(range(self._num_pieces))
-            if not self._fcfs_queue:
-                # Epoch boundary: refill while epochs remain (None = forever).
-                if self.num_epochs is not None \
-                        and self._fcfs_epoch + 1 >= self.num_epochs:
-                    return {"type": "end_of_stream",
-                            "epochs_completed": self._fcfs_epoch + 1}
-                self._fcfs_epoch += 1
-                self._fcfs_queue.extend(range(self._num_pieces))
-            piece = self._fcfs_queue.popleft()
+            if self._quarantined \
+                    and len(self._quarantined) >= self._num_pieces:
+                # EVERY piece is quarantined (O(1) check — this runs per
+                # split under the global lock): nothing will ever be
+                # grantable again, so end the stream instead of spinning
+                # the refill-and-skip loop below forever (num_epochs=None
+                # would otherwise deadlock the whole control plane).
+                return {"type": "end_of_stream",
+                        "epochs_completed": self._fcfs_epoch,
+                        "reason": "all pieces quarantined"}
+            while True:
+                if not self._fcfs_queue:
+                    # Epoch boundary: refill while epochs remain
+                    # (None = forever).
+                    if self.num_epochs is not None \
+                            and self._fcfs_epoch + 1 >= self.num_epochs:
+                        return {"type": "end_of_stream",
+                                "epochs_completed": self._fcfs_epoch + 1}
+                    self._fcfs_epoch += 1
+                    self._fcfs_queue.extend(range(self._num_pieces))
+                piece = self._fcfs_queue.popleft()
+                if piece not in self._quarantined:
+                    break  # quarantined splits are skipped, not granted
             self._journal_locked({"op": "next_split", "piece": piece,
                                   "epoch": self._fcfs_epoch})
             return {"type": "split", "piece": piece,
@@ -1526,6 +1714,9 @@ class Dispatcher:
         client_id = header["client_id"]
         job_id = str(header.get("job_id") or DEFAULT_JOB)
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             if self._num_pieces is None:
                 return {"type": "error",
                         "error": "no workers have registered yet"}
@@ -1535,8 +1726,8 @@ class Dispatcher:
             # Sticky initial deques + per-deque canonical order, like the
             # static path: cache warmth survives shuffled epochs (steals
             # may still move pieces — the shared disk tier covers those).
-            client_pieces = list(
-                range(self._num_pieces))[client_index::num_clients]
+            client_pieces = self._grantable_pieces_locked(list(
+                range(self._num_pieces))[client_index::num_clients])
             worker_ids = sorted(alive)
             assignments = {
                 wid: piece_order(self.shuffle_seed, epoch, pieces)
@@ -1603,6 +1794,9 @@ class Dispatcher:
                   for p, wid, gen, failed_gen
                   in header.get("failed_steals", [])]
         with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
             job_id = self._client_job_locked(client_id, header)
             # Keep the autoscaler's rate feed fresh: these are the same
             # EMA'd client-side delivery rates the steal planner consumes.
@@ -1788,6 +1982,12 @@ class Dispatcher:
                 "num_pieces": self._num_pieces,
                 "shuffle_seed": self.shuffle_seed,
                 "fencing_epoch": self._fencing_epoch,
+                # None while healthy; the reason string while the journal
+                # is failing and the dispatcher refuses mutations.
+                "degraded": self._degraded,
+                # Journaled poison-piece quarantine: piece -> report info.
+                "quarantined": {str(p): dict(info) for p, info
+                                in sorted(self._quarantined.items())},
                 "client_watermarks": {
                     cid: {"epoch": entry["epoch"],
                           "watermarks": {str(p): n for p, n

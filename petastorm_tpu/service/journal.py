@@ -35,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.telemetry.log import service_logger
 
 logger = service_logger(__name__)
@@ -66,6 +67,7 @@ class Journal:
         self._since_snapshot = 0       # records appended since last snapshot
         self.records_appended = 0      # this process's appends
         self.compactions = 0           # this process's compactions
+        self.snapshot_failures = 0     # compactions that failed (OSError)
 
     # -- recovery ----------------------------------------------------------
 
@@ -164,6 +166,11 @@ class Journal:
             # racing shutdown would durably write a record that post-dates
             # the stop and leak the reopened handle.
             raise RuntimeError(f"journal {self.path} is closed")
+        fp = failpoints.ACTIVE
+        if fp is not None:
+            fp.fire("journal.append")  # enospc raises BEFORE the write:
+            #   the WAL never holds a half-applied record, and the seq
+            #   cursor below stays consistent with what is on disk.
         self._seq += 1
         record = dict(record, seq=self._seq)
         if self._wal_file is None:
@@ -171,6 +178,8 @@ class Journal:
         self._wal_file.write(json.dumps(record) + "\n")
         self._wal_file.flush()
         if self._fsync:
+            if fp is not None:
+                fp.fire("journal.fsync")
             os.fsync(self._wal_file.fileno())
         self.records_appended += 1
         self._since_snapshot += 1
@@ -182,12 +191,34 @@ class Journal:
         if self._closed:
             raise RuntimeError(f"journal {self.path} is closed")
         tmp = self._snapshot_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"seq": self._seq, "state": state}, f)
-            f.flush()
-            if self._fsync:
-                os.fsync(f.fileno())
-        os.replace(tmp, self._snapshot_path)
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"seq": self._seq, "state": state}, f)
+                f.flush()
+                if self._fsync:
+                    os.fsync(f.fileno())
+            fp = failpoints.ACTIVE
+            if fp is not None and fp.fire("journal.compact") \
+                    == "torn_rename":
+                # The crash-between-tmp-write-and-rename signature: the
+                # tmp file exists, snapshot.json is still the OLD one, and
+                # the WAL was NOT truncated — recovery must replay the
+                # pre-compaction WAL byte-identically.
+                raise OSError(
+                    "failpoint journal.compact: torn snapshot rename")
+            os.replace(tmp, self._snapshot_path)
+        except OSError:
+            # A failed compaction must leave the journal exactly as it
+            # was: old snapshot intact, WAL intact, seq/since-snapshot
+            # cursors untouched (the truncation below never ran). The
+            # orphan tmp is removed so a later compaction cannot be
+            # confused by it; recovery ignores it either way.
+            self.snapshot_failures += 1
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         # Crash window here is safe: the WAL still holds <= watermark
         # records, which load() skips.
         if self._wal_file is not None:
@@ -216,5 +247,6 @@ class Journal:
             "path": self.path,
             "records_appended": self.records_appended,
             "compactions": self.compactions,
+            "snapshot_failures": self.snapshot_failures,
             "records_since_snapshot": self._since_snapshot,
         }

@@ -41,8 +41,10 @@ from collections import deque
 
 import numpy as np
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.reader_impl.framed_socket import encode_payload
 from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import QUARANTINE_REPORTS
 from petastorm_tpu.workers_pool import (
     EmptyResultError,
     TimeoutWaitingForResultError,
@@ -52,8 +54,10 @@ logger = service_logger(__name__)
 
 #: Piece lifecycle states. "staged" = fully materialized into the ready set
 #: (cache hit, or decode finished) but nothing sent yet — still revocable.
-_QUEUED, _DECODING, _SERVING, _DONE, _REVOKED = (
-    "queued", "decoding", "serving", "done", "revoked")
+#: "failed" = the piece is poison (undecodable / injected) and was
+#: quarantined instead of erroring the stream.
+_QUEUED, _DECODING, _SERVING, _DONE, _REVOKED, _FAILED = (
+    "queued", "decoding", "serving", "done", "revoked", "failed")
 
 
 class _PieceCollator:
@@ -164,15 +168,44 @@ class StreamingPieceEngine:
         ``batch_transform`` wrapper here when the stream's placement is
         remote; ``None`` (local placement or no transform) leaves
         batches untouched.
+    :param on_piece_error: the poison-piece policy
+        (``docs/guides/service.md#failure-model-and-recovery``).
+        ``"fail"`` (default): a piece whose decode raises errors the
+        stream — the pre-quarantine behavior. ``"quarantine"``: the
+        failing piece is skipped and reported as a ``("piece_failed",
+        piece, generation, error)`` event; the reader pipeline (which
+        the failure may have wedged) is torn down and lazily rebuilt,
+        and every other piece keeps serving. Decode errors raised from
+        the shared pool are attributed to the pieces in flight at the
+        time (``lookahead`` bounds that set; with the default lookahead
+        the blast radius is the poison piece plus at most one
+        neighbor, both reported). The explicit
+        ``failpoints.FaultSchedule(poison_pieces=...)`` injection fires
+        BEFORE dispatch and is always attributed exactly.
     """
 
     def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
                  cache_note_fn=None, lookahead=2, permute_fn=None,
-                 transform_fn=None):
+                 transform_fn=None, on_piece_error="fail"):
+        if on_piece_error not in ("fail", "quarantine"):
+            raise ValueError(
+                "on_piece_error must be 'fail' or 'quarantine', got "
+                f"{on_piece_error!r}")
         if callable(reader) and not hasattr(reader, "read_next_tagged"):
             self._reader = None
             self._reader_factory = reader
         else:
+            if on_piece_error == "quarantine":
+                # Quarantining a decode error tears the (possibly wedged)
+                # reader down and lazily REBUILDS it — impossible from a
+                # bare instance. Require the factory form up front rather
+                # than failing the first stream the policy should have
+                # saved.
+                raise ValueError(
+                    "on_piece_error='quarantine' needs a reader FACTORY "
+                    "(zero-arg callable), not a reader instance: the "
+                    "engine must be able to rebuild the pipeline after "
+                    "tearing down one a poison piece wedged")
             self._reader = None
             self._reader_factory = None
             self._install_reader(reader)
@@ -204,6 +237,8 @@ class StreamingPieceEngine:
         self._served_pieces = 0
         self._revoked_pieces = 0
         self._rows_emitted = 0
+        self._on_piece_error = on_piece_error
+        self._quarantined_pieces = 0
 
     def _install_reader(self, reader):
         if not getattr(reader, "dynamic", False):
@@ -332,7 +367,9 @@ class StreamingPieceEngine:
                         try:
                             self._reader.finish_pieces()
                         except Exception:  # teardown races: non-fatal here
-                            pass
+                            logger.debug(
+                                "engine: finish_pieces raced teardown",
+                                exc_info=True)
                 return None
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
@@ -353,6 +390,11 @@ class StreamingPieceEngine:
                 # caller checks `finished`/its own stop flags.
                 self._finished = True
                 return None
+            except Exception as exc:
+                if self._on_piece_error != "quarantine":
+                    raise
+                self._quarantine_inflight(exc)
+                continue
             self._pull_s += time.perf_counter() - t0
             self._route(out, piece)
 
@@ -372,6 +414,54 @@ class StreamingPieceEngine:
                 self._served_pieces += 1
             return ev
 
+    def _fail_piece(self, piece, gen, error):
+        """Quarantine one piece: purge anything of it still buffered,
+        clear its decode state, and emit a ``piece_failed`` event in place
+        of its batches/``piece_done`` — the stream survives, the piece is
+        reported, nothing of it is served past this point."""
+        message = str(error)
+        with self._lock:
+            self._state[piece] = _FAILED
+            self._inflight.discard(piece)
+            self._collators.pop(piece, None)
+            self._builders.pop(piece, None)
+            self._pending.pop(piece, None)
+            self._out = deque(ev for ev in self._out if ev[1] != piece)
+            self._out.append(("piece_failed", piece, gen, message))
+            self._quarantined_pieces += 1
+        QUARANTINE_REPORTS.labels("worker").inc()
+        logger.warning("engine: quarantining poison piece %d (%s)", piece,
+                       message)
+
+    def _quarantine_inflight(self, exc):
+        """A decode error surfaced from the shared pool (quarantine
+        policy): attribute it to the pieces in flight — the pool gives no
+        finer attribution, and ``lookahead`` bounds the set — fail each,
+        and tear the reader down (the error may have wedged its pool);
+        the next cold dispatch lazily rebuilds it. Queued pieces are
+        untouched and re-dispatch on the fresh pipeline."""
+        with self._lock:
+            victims = sorted(self._inflight)
+            reader, self._reader = self._reader, None
+        logger.warning(
+            "engine: decode error under quarantine policy — attributing "
+            "to in-flight piece(s) %s and rebuilding the reader: %r",
+            victims, exc)
+        if reader is not None:
+            try:
+                reader.stop()
+                reader.join()
+            except Exception:
+                logger.warning("engine: poisoned reader teardown failed",
+                               exc_info=True)
+        self._pull_s = 0.0
+        for piece in victims:
+            self._fail_piece(piece, self._gen.get(piece, 0), exc)
+        if not victims:
+            # Nothing in flight to attribute: the error is the pipeline's
+            # own (construction/ventilation) — quarantine cannot help.
+            raise exc
+
     def _dispatch_queued(self):
         """Top up the pipeline: pop queued pieces up to ``lookahead`` cold
         pieces in flight; warm pieces are staged straight from the cache
@@ -382,6 +472,18 @@ class StreamingPieceEngine:
                     return
                 piece = self._queue.popleft()
                 gen = self._gen[piece]
+            fp = failpoints.ACTIVE
+            if fp is not None and fp.poison_piece(piece):
+                # Injected poison fires BEFORE dispatch: exact attribution,
+                # nothing submitted to the pool. Policy still decides
+                # whether it errors the stream or quarantines.
+                if self._on_piece_error != "quarantine":
+                    raise RuntimeError(
+                        f"piece {piece} is poisoned (failpoint "
+                        f"piece.decode) and on_piece_error='fail'")
+                self._fail_piece(piece, gen,
+                                 "failpoint piece.decode: poisoned piece")
+                continue
             entry = tier = None
             if self._cache is not None and self._cache_key_fn is not None:
                 entry, tier = self._cache.get_tiered(
@@ -586,6 +688,7 @@ class StreamingPieceEngine:
                 "engine_pieces_in_flight": len(self._inflight),
                 "engine_pieces_served": self._served_pieces,
                 "engine_pieces_revoked": self._revoked_pieces,
+                "engine_pieces_quarantined": self._quarantined_pieces,
                 "engine_rows_emitted": self._rows_emitted,
                 "engine_finished": self._finished,
             })

@@ -26,6 +26,7 @@ from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedReader,
     FramedServer,
+    ProtocolError,
     encode_payload,
     send_framed,
     send_framed_frames,
@@ -170,6 +171,17 @@ class BatchWorker:
         via ``Dispatcher.admit_worker``) admits it into serving — the
         zero-idle-hosts elasticity pool
         (``docs/guides/service.md#multi-tenancy-and-autoscaling``).
+    :param on_piece_error: poison-piece policy for streams served through
+        the streaming engine (tagged static + dynamic — the exactly-once
+        protocols). ``"fail"`` (default): an undecodable piece errors the
+        stream, the pre-quarantine behavior. ``"quarantine"``: the piece
+        is skipped, announced to the client with a ``piece_failed``
+        frame, and every other piece keeps serving exactly-once; the
+        client records it, reports it to the dispatcher (journaled,
+        excluded from re-grant), and the epoch completes without it
+        (``docs/guides/service.md#failure-model-and-recovery``). Legacy
+        untagged/fcfs streams cannot express ``piece_failed`` and keep
+        the fail behavior regardless.
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
@@ -178,7 +190,12 @@ class BatchWorker:
                  register_retries=5, register_backoff=0.2,
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
-                 batch_cache=None, batch_transform=None, standby=False):
+                 batch_cache=None, batch_transform=None, standby=False,
+                 on_piece_error="fail"):
+        if on_piece_error not in ("fail", "quarantine"):
+            raise ValueError(
+                "on_piece_error must be 'fail' or 'quarantine', got "
+                f"{on_piece_error!r}")
         self.dataset_url = dataset_url
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
@@ -240,6 +257,7 @@ class BatchWorker:
         self._jobs_served = {}       # job -> {"rows": n, "batches": n}
         self._cache_jobs = {}        # job -> {"hits": n, "misses": n}
         self._standby = bool(standby)
+        self._on_piece_error = on_piece_error
         self._log = logger.bind(worker_id=self.worker_id)
         # Interned registry children (telemetry.metrics): typed, scrapeable
         # counters behind the legacy diagnostics snapshots.
@@ -305,7 +323,8 @@ class BatchWorker:
             try:
                 reader.stop()  # also cleans the reader's row-group cache
             except Exception:
-                pass
+                self._log.warning("straggler stream reader stop failed",
+                                  exc_info=True)
         if self._batch_cache is not None:
             try:
                 self._batch_cache.cleanup()
@@ -398,13 +417,23 @@ class BatchWorker:
             with FramedConnection.connect(self._dispatcher_address,
                                           timeout=10.0) as conn:
                 reply, _ = conn.request(header)
+            if reply.get("type") == "error" and reply.get("retryable"):
+                # Degraded (read-only) dispatcher: transient by contract —
+                # the next request's recovery snapshot may heal it, so a
+                # worker registering during an ENOSPC window backs off
+                # and retries instead of dying on a fatal rejection (the
+                # client side does the same via DegradedDispatcherError).
+                raise OSError(reply.get("error", "dispatcher degraded"))
             return reply
 
         return retry_with_backoff(
             attempt,
             retries=self._register_retries if retries is None else retries,
             base_delay=self._register_backoff,
-            retry_on=(OSError,), deadline_s=self._rpc_deadline_s,
+            # ProtocolError = a desynced (torn) control reply: the conn
+            # is gone either way, a fresh dial retries cleanly.
+            retry_on=(OSError, ProtocolError),
+            deadline_s=self._rpc_deadline_s,
             description=description)
 
     def _heartbeat_loop(self):
@@ -413,16 +442,22 @@ class BatchWorker:
         worker's state, or evicted it) triggers re-registration under the
         same ``worker_id``. A dispatcher outage is just a missed tick —
         the loop keeps trying until the dispatcher returns."""
+        from petastorm_tpu import failpoints
+
         while not self._heartbeat_stop.wait(self._heartbeat_interval_s):
             if self._heartbeat_paused.is_set():
                 continue
+            fp = failpoints.ACTIVE
+            if fp is not None and fp.check("worker.heartbeat") == "drop":
+                continue  # injected lost tick: the lease absorbs it (or
+                #   expires and the re-registration path heals)
             try:
                 reply = self._control_rpc(
                     {"type": "worker_heartbeat", "worker_id": self.worker_id},
                     description=f"worker {self.worker_id} heartbeat",
                     retries=0)
-            except OSError:
-                continue  # dispatcher down: retry next tick
+            except (OSError, ProtocolError):
+                continue  # dispatcher down/desynced: retry next tick
             if reply.get("type") == "unknown_worker" \
                     and not self._heartbeat_stop.is_set():
                 self._log.warning(
@@ -434,7 +469,7 @@ class BatchWorker:
                     # loop itself is the retry, and stop() must not wait
                     # out a 30s backoff budget against a dead dispatcher.
                     self._register(re_register=True, retries=0)
-                except (OSError, RuntimeError):
+                except (OSError, RuntimeError, ProtocolError):
                     continue  # registration retried on the next tick
 
     # -- serving -----------------------------------------------------------
@@ -634,6 +669,14 @@ class BatchWorker:
         except (ConnectionClosedError, OSError):
             outcome = "disconnected"
             raise  # client hung up — nothing to tell it
+        except ProtocolError:
+            # The client side of this socket desynced (torn control
+            # frame): framing is lost, so the connection is dead — treat
+            # it like a hangup (the client's broken-stream recovery
+            # re-serves pending pieces at their watermarks), NOT like a
+            # stream error (which would raise into the training loop).
+            outcome = "disconnected"
+            raise
         except Exception as exc:
             outcome = "error"
             self._log.exception("stream failed", stream=stream_key,
@@ -788,7 +831,7 @@ class BatchWorker:
             "reader_pool_type", "thread") in ("thread", "dummy")
 
     def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None,
-                     job=None):
+                     job=None, allow_quarantine=False):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
@@ -829,7 +872,12 @@ class BatchWorker:
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit, job=job))
                 if cache is not None else None),
-            permute_fn=permute_fn, transform_fn=transform_fn)
+            permute_fn=permute_fn, transform_fn=transform_fn,
+            # Quarantine needs a frame vocabulary that can SAY
+            # "piece_failed": only the tagged/dynamic protocols have one —
+            # a legacy plain/fcfs stream keeps failing loudly.
+            on_piece_error=(self._on_piece_error if allow_quarantine
+                            else "fail"))
 
     def _note_engine_decode(self, collector, decode_s, bid):
         """Engine events carry decode DURATION, not absolute span times
@@ -879,7 +927,7 @@ class BatchWorker:
         markers)."""
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
-                                   job=job)
+                                   job=job, allow_quarantine=tagged)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -909,6 +957,13 @@ class BatchWorker:
                                       if tagged else None)):
                     return None
                 rows_sent += rows
+            elif event[0] == "piece_failed":
+                # Quarantine (tagged-only by construction: the engine runs
+                # policy "fail" on plain streams): the poison piece is
+                # reported in place of its batches; the stream survives.
+                _, piece, _gen, error = event
+                send_framed(sock, {"type": "piece_failed", "piece": piece,
+                                   "error": error})
             elif tagged:  # piece_done: plain streams carry no such frame
                 _, piece, _gen, rows = event
                 send_framed(sock, {"type": "piece_done", "piece": piece,
@@ -934,7 +989,7 @@ class BatchWorker:
                 f"{self._reader_kwargs.get('reader_pool_type')!r}")
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
-                                   job=job)
+                                   job=job, allow_quarantine=True)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -986,10 +1041,19 @@ class BatchWorker:
                         on_frame=on_frame):
                     return None
                 rows_sent += rows
+            elif event[0] == "piece_failed":
+                _, piece, gen, error = event
+                send_framed(sock, {"type": "piece_failed", "piece": piece,
+                                   "generation": gen, "error": error})
             else:  # piece_done
                 _, piece, gen, rows = event
                 send_framed(sock, {"type": "piece_done", "piece": piece,
                                    "generation": gen, "rows": rows})
+
+    #: Credit-starved streams poll for replenishment on this period so the
+    #: wait stays interruptible (stop flag, dead-peer teardown) — TCP
+    #: keepalive still detects the silent-host case underneath.
+    CREDIT_POLL_S = 1.0
 
     _CACHE_EPOCHS_KEPT = 64
     #: Distinct jobs whose rows/cache attribution is retained (evicted
@@ -1122,6 +1186,14 @@ class BatchWorker:
                 while flow["credits_left"] <= 0:
                     if self._server.stopped.is_set():
                         return False
+                    # Bounded wait, not a timeout-less recv: a client HOST
+                    # that vanished without FIN/RST must not pin this
+                    # stream thread forever — the poll re-checks the stop
+                    # flag every CREDIT_POLL_S (the blocking-recv audit;
+                    # recv itself only runs once bytes are readable, so
+                    # framing is never torn by a timeout mid-message).
+                    if not conn_reader.wait_data(self.CREDIT_POLL_S):
+                        continue
                     reply, _ = conn_reader.recv()
                     if reply.get("type") == "credit":
                         flow["credits_left"] += int(reply.get("n", 1))

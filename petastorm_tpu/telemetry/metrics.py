@@ -335,6 +335,40 @@ CACHE_VERSION_EVICTED = REGISTRY.counter(
     "detected on load, deleted, and treated as a miss (fresh decode "
     "refills them in the current format — a format bump never errors a "
     "stream)")
+CACHE_DISK_WRITE_ERRORS = REGISTRY.counter(
+    "petastorm_cache_disk_write_errors_total",
+    "Disk-tier entry writes that failed with an OSError (ENOSPC, vanished "
+    "directory, fd exhaustion) and were skipped: the cache degrades to "
+    "pass-through for that entry — the batch still streams, it just is "
+    "not persisted (docs/guides/service.md#failure-model-and-recovery)")
+
+# -- failpoints + quarantine (failpoints.py, service/*) ----------------------
+
+FAILPOINT_FIRES = REGISTRY.counter(
+    "petastorm_failpoint_fires_total",
+    "Deterministic fault injections fired by the armed FaultSchedule, by "
+    "failpoint name and action (reset/torn/delay/enospc/oserror/partial/"
+    "drop/torn_rename/poison). Zero — and zero overhead beyond one "
+    "branch-on-None per site — when no schedule is armed",
+    labels=("point", "action"))
+FAILPOINT_ARMED = REGISTRY.gauge(
+    "petastorm_failpoint_armed",
+    "1 while a FaultSchedule is armed process-wide (failpoints compiled "
+    "into the hot-path I/O boundaries are live), else 0. A nonzero value "
+    "outside a chaos/fuzz run means a schedule leaked past its context")
+QUARANTINE_REPORTS = REGISTRY.counter(
+    "petastorm_quarantine_reports_total",
+    "Poison-piece quarantine events, by the site that observed them "
+    "(worker = engine detected an undecodable/poisoned piece and sent "
+    "piece_failed; client = the drain recorded it and kept streaming; "
+    "dispatcher = the report was journaled and the piece excluded from "
+    "re-grant)",
+    labels=("site",))
+QUARANTINE_PIECES = REGISTRY.gauge(
+    "petastorm_quarantine_pieces",
+    "Pieces currently quarantined in the dispatcher's (journaled) "
+    "quarantine set — excluded from every future assignment, plan, "
+    "takeover re-partition, and fcfs split until the journal is reset")
 
 # -- reader / worker pools / ventilator --------------------------------------
 

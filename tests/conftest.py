@@ -74,6 +74,11 @@ _AUTOTUNE_THREAD_PREFIX = "pipeline-autotune"
 #: (and journaling!) admit/drain decisions against a dead fleet.
 _FLEET_AUTOSCALE_THREAD_PREFIX = "fleet-autoscale"
 
+#: The chaos-schedule fuzzer's per-seed run threads: one surviving a test
+#: means a fuzz run hung past its join budget and was abandoned with a
+#: live service topology inside it.
+_FUZZ_THREAD_PREFIX = "failpoint-fuzz"
+
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
@@ -91,6 +96,7 @@ def _resource_leak_guard(request):
     (daemon handler threads closing sockets, GC-collected connections);
     whatever survives it is a leak. Opt out with
     ``@pytest.mark.allow_resource_leaks`` (and a reason)."""
+    from petastorm_tpu import failpoints
     from petastorm_tpu.cache_impl import live_cache_dirs
     from petastorm_tpu.service.fleet import open_job_registrations
 
@@ -102,7 +108,17 @@ def _resource_leak_guard(request):
     before_cache_dirs = live_cache_dirs()
     before_jobs = open_job_registrations()
     yield
-    deadline = time.monotonic() + 2.0
+    leaked_schedule = failpoints.ACTIVE
+    if leaked_schedule is not None:
+        # Disarm FIRST so one leak cannot inject faults into every later
+        # test, then fail: an armed schedule outliving its test is the
+        # quarantine/chaos analogue of an unstopped node.
+        failpoints.disarm()
+    # A leaked schedule is already a failure — the grace loop below only
+    # absorbs ASYNCHRONOUS teardown, which cannot un-leak it: take one
+    # pass collecting the other leak classes and fail immediately.
+    deadline = time.monotonic() + (0.0 if leaked_schedule is not None
+                                   else 2.0)
     while True:
         leaked_threads = [
             t for t in threading.enumerate()
@@ -113,13 +129,14 @@ def _resource_leak_guard(request):
             if t not in before_threads and t.is_alive()
             and t.name.startswith((_READER_POOL_THREAD_PREFIX,
                                    _AUTOTUNE_THREAD_PREFIX,
-                                   _FLEET_AUTOSCALE_THREAD_PREFIX))]
+                                   _FLEET_AUTOSCALE_THREAD_PREFIX,
+                                   _FUZZ_THREAD_PREFIX))]
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
         leaked_jobs = open_job_registrations() - before_jobs
         if not leaked_threads and not leaked_pool_threads \
                 and not leaked_sockets and not leaked_cache_dirs \
-                and not leaked_jobs:
+                and not leaked_jobs and leaked_schedule is None:
             return
         if time.monotonic() >= deadline:
             break
@@ -127,18 +144,21 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
-        f"reader-pool/autotune/fleet-autoscale threads "
+        f"reader-pool/autotune/fleet-autoscale/failpoint-fuzz threads "
         f"{[t.name for t in leaked_pool_threads]} "
         f"(an unstopped Reader — e.g. a streaming piece engine whose "
         f"owner never stopped/joined it — an autotuned loader whose "
-        f"controller was never stopped, or a Dispatcher(autoscale=) "
-        f"never stopped), "
+        f"controller was never stopped, a Dispatcher(autoscale=) never "
+        f"stopped, or a hung fuzz run), "
         f"sockets {sorted(leaked_sockets)}, "
         f"cache dirs {sorted(leaked_cache_dirs)}, "
         f"open job registrations {sorted(leaked_jobs)} (a register_job "
-        f"without end_job — use fleet.JobHandle) — stop/close every "
-        f"service node, loader, engine, and connection the test started, "
-        f"and cleanup() every cache "
+        f"without end_job — use fleet.JobHandle), "
+        f"armed failpoint schedule "
+        f"{'yes (now disarmed)' if leaked_schedule is not None else 'no'} "
+        f"(use failpoints.armed(...) so the scope always disarms) — "
+        f"stop/close every service node, loader, engine, and connection "
+        f"the test started, and cleanup() every cache "
         f"(mark allow_resource_leaks only with a documented reason)",
         pytrace=False)
 

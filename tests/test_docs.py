@@ -133,6 +133,34 @@ def test_no_unseeded_rng_in_data_path():
         "or add a documented allowlist entry): " + "; ".join(offenders))
 
 
+#: Swallowed-error lint: a bare ``except Exception: pass`` (or
+#: BaseException) silently eats poison pieces, torn writes, and ENOSPC —
+#: the exact failure classes the failpoint substrate exists to surface.
+#: Handlers in the service/cache/transport trees must either narrow the
+#: exception type, log (``exc_info=True``), count, or degrade explicitly.
+_SWALLOWED_RE = re.compile(
+    r"except\s+(?:Exception|BaseException)\s*(?:as\s+\w+\s*)?:"
+    r"\s*(?:#[^\n]*)?\n\s*pass\b")
+
+_SWALLOWED_DIRS = ("petastorm_tpu/service", "petastorm_tpu/cache_impl",
+                   "petastorm_tpu/reader_impl")
+
+
+def test_no_swallowed_errors_in_service_trees():
+    offenders = []
+    for root in _SWALLOWED_DIRS:
+        for py in sorted((REPO / root).rglob("*.py")):
+            rel = str(py.relative_to(REPO))
+            for match in _SWALLOWED_RE.finditer(py.read_text()):
+                lineno = py.read_text()[:match.start()].count("\n") + 1
+                offenders.append(f"{rel}:{lineno}")
+    assert not offenders, (
+        "bare `except Exception: pass` in the service/cache/transport "
+        "trees (narrow the type, log with exc_info, count it, or degrade "
+        "explicitly — silent swallowing is how poison pieces and ENOSPC "
+        "disappear): " + "; ".join(offenders))
+
+
 def test_documented_apis_exist():
     """Spot-check that names the docs teach are importable."""
     from petastorm_tpu import (  # noqa: F401

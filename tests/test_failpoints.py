@@ -1,0 +1,519 @@
+"""Deterministic failpoint substrate, quarantine, degradation, fuzzing.
+
+Covers the robustness tentpole end to end (docs/guides/service.md
+#failure-model-and-recovery):
+
+- seeded ``FaultSchedule`` determinism and the disarmed zero-cost default;
+- transport failpoints (reset / torn frame) surfacing as the connection
+  failures the recovery machinery already owns;
+- dispatcher reply dropped AFTER the state mutation applied (the
+  duplicated-control-op case) survived by the client's idempotent retry;
+- WAL ENOSPC → degraded read-only dispatcher → recovery via snapshot;
+- torn snapshot-compaction swap (crash between tmp-write and rename)
+  replaying the pre-compaction WAL byte-identically;
+- poison-piece quarantine end to end (worker piece_failed → client
+  records + reports → dispatcher journals + excludes → restart-safe);
+- the seeded chaos replay pin: two scenario runs of one --chaos-seed
+  inject the identical fault sequence and produce byte-identical digests;
+- the fuzz shrinker producing a minimal, seed-stamped reproducer.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import failpoints
+from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
+    FramedConnection,
+    recv_framed,
+    send_framed,
+)
+from petastorm_tpu.service import (
+    BatchWorker,
+    Dispatcher,
+    ServiceBatchSource,
+    ServiceError,
+)
+
+pytestmark = pytest.mark.service
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule mechanics
+# ---------------------------------------------------------------------------
+
+def test_schedule_is_deterministic_and_seed_sensitive():
+    a = failpoints.FaultSchedule(7)
+    b = failpoints.FaultSchedule(7)
+    c = failpoints.FaultSchedule(8)
+    assert a._fires == b._fires
+    assert a._fires != c._fires
+    # Every armed point has fire indices inside [min_index, window).
+    for point, plan in a._fires.items():
+        for index, action in plan.items():
+            assert 4 <= index < 400
+            assert action in failpoints.POINTS[point]
+
+
+def test_check_fires_at_derived_indices_and_logs():
+    sched = failpoints.FaultSchedule(
+        0, points=("worker.heartbeat",),
+        fires={"worker.heartbeat": {2: "drop"}})
+    assert sched.check("worker.heartbeat") is None   # call 0
+    assert sched.check("worker.heartbeat") is None   # call 1
+    assert sched.check("worker.heartbeat") == "drop"  # call 2
+    assert sched.check("worker.heartbeat") is None   # call 3
+    assert sched.log == [("worker.heartbeat", 2, "drop")]
+
+
+def test_disarmed_by_default_and_armed_scope():
+    assert failpoints.ACTIVE is None
+    sched = failpoints.FaultSchedule(1, points=())
+    with failpoints.armed(sched):
+        assert failpoints.ACTIVE is sched
+        with pytest.raises(RuntimeError):
+            failpoints.arm(failpoints.FaultSchedule(2))
+    assert failpoints.ACTIVE is None
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        failpoints.FaultSchedule(0, points=("no.such.point",))
+
+
+# ---------------------------------------------------------------------------
+# transport failpoints over a socketpair
+# ---------------------------------------------------------------------------
+
+def test_transport_send_reset_failpoint():
+    a, b = socket.socketpair()
+    try:
+        sched = failpoints.FaultSchedule(
+            0, points=("transport.send",),
+            fires={"transport.send": {0: "reset"}})
+        with failpoints.armed(sched):
+            with pytest.raises(ConnectionResetError):
+                send_framed(a, {"type": "ping"})
+            # The socket itself is untouched: the next send round-trips.
+            send_framed(a, {"type": "ping"})
+        assert recv_framed(b) == ({"type": "ping"}, None)
+    finally:
+        a.close(), b.close()
+
+
+def test_transport_send_torn_frame_desyncs_peer():
+    a, b = socket.socketpair()
+    try:
+        sched = failpoints.FaultSchedule(
+            0, points=("transport.send",),
+            fires={"transport.send": {0: "torn"}})
+        with failpoints.armed(sched):
+            with pytest.raises(ConnectionResetError):
+                send_framed(a, {"type": "ping"})
+        a.close()  # the sender tears the connection down, like the stack
+        # The peer received HALF a length prefix then EOF: a mid-field
+        # close, never a silently-short message.
+        with pytest.raises(ConnectionClosedError):
+            recv_framed(b)
+    finally:
+        b.close()
+        if a.fileno() != -1:
+            a.close()
+
+
+# ---------------------------------------------------------------------------
+# journal: ENOSPC degradation + torn compaction swap
+# ---------------------------------------------------------------------------
+
+def test_torn_compaction_swap_replays_pre_compaction_wal(tmp_path):
+    from petastorm_tpu.service.journal import Journal
+
+    path = str(tmp_path / "journal")
+    j = Journal(path, compact_every=10_000)
+    j.snapshot({"n": 1})
+    appended = [j.append({"op": "x", "i": i}) for i in range(5)]
+    sched = failpoints.FaultSchedule(
+        0, points=("journal.compact",),
+        fires={"journal.compact": {0: "torn_rename"}})
+    with failpoints.armed(sched):
+        with pytest.raises(OSError):
+            j.snapshot({"n": 2})
+    j.close()
+    assert j.stats["snapshot_failures"] == 1
+    # The crash signature: old snapshot intact, WAL intact, no tmp left.
+    assert not os.path.exists(os.path.join(path, "snapshot.json.tmp"))
+    replay = Journal(path)
+    state, records = replay.load()
+    assert state == {"n": 1}
+    assert records == appended  # byte-identical pre-compaction replay
+    replay.close()
+
+
+def test_journal_enospc_degrades_dispatcher_read_only(tmp_path):
+    dispatcher = Dispatcher(port=0, mode="static",
+                            journal_dir=str(tmp_path / "j")).start()
+    try:
+        register = {"type": "register_worker", "worker_id": "w0",
+                    "host": "127.0.0.1", "port": 1, "num_pieces": 3}
+        always = {i: "enospc" for i in range(512)}
+        torn = {i: "torn_rename" for i in range(512)}
+        sched = failpoints.FaultSchedule(
+            0, points=("journal.append", "journal.compact"),
+            fires={"journal.append": always, "journal.compact": torn})
+        with failpoints.armed(sched):
+            with FramedConnection.connect(dispatcher.address,
+                                          timeout=5) as conn:
+                # The mutation applies; the failed append degrades AFTER.
+                reply, _ = conn.request(register)
+                assert reply["type"] == "ok"
+                # Degraded: mutations refused (recovery snapshot fails
+                # too under the compact failpoint), reads keep serving.
+                reply, _ = conn.request(dict(register, worker_id="w1"))
+                assert reply["type"] == "error"
+                assert reply.get("retryable") is True
+                assert "read-only" in reply["error"]
+                reply, _ = conn.request({"type": "ping"})
+                assert reply["type"] == "pong"
+                status, _ = conn.request({"type": "status"})
+                assert status["degraded"] is not None
+                assert status["recovery"]["journal_write_failures"] >= 2
+        # Failpoints disarmed = space freed: the next mutating request's
+        # recovery snapshot succeeds and the dispatcher heals itself.
+        with FramedConnection.connect(dispatcher.address,
+                                      timeout=5) as conn:
+            reply, _ = conn.request(dict(register, worker_id="w1"))
+            assert reply["type"] == "ok"
+            status, _ = conn.request({"type": "status"})
+            assert status["degraded"] is None
+            assert set(status["workers"]) == {"w0", "w1"}
+    finally:
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher reply dropped after the mutation applied
+# ---------------------------------------------------------------------------
+
+def test_dropped_reply_after_mutation_survived_by_retry():
+    dispatcher = Dispatcher(port=0, mode="static").start()
+    try:
+        register = {"type": "register_worker", "worker_id": "w0",
+                    "host": "127.0.0.1", "port": 1, "num_pieces": 3}
+        sched = failpoints.FaultSchedule(
+            0, points=("dispatcher.reply",),
+            fires={"dispatcher.reply": {0: "drop"}})
+        with failpoints.armed(sched):
+            with pytest.raises((ConnectionClosedError, OSError)):
+                with FramedConnection.connect(dispatcher.address,
+                                              timeout=5) as conn:
+                    conn.request(register)  # reply dropped post-apply
+            # The retry duplicates the control op; registration is
+            # idempotent (counted as a re-registration, not corrupted).
+            with FramedConnection.connect(dispatcher.address,
+                                          timeout=5) as conn:
+                reply, _ = conn.request(register)
+                assert reply["type"] == "ok"
+                status, _ = conn.request({"type": "status"})
+        assert status["workers"]["w0"]["alive"]
+        assert status["recovery"]["re_registrations"] == 1
+    finally:
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# poison-piece quarantine end to end
+# ---------------------------------------------------------------------------
+
+def _collect_ids(source):
+    got = []
+    for batch in source():
+        got.extend(int(i) for i in batch["id"])
+    return got
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+def test_quarantine_static_end_to_end(petastorm_dataset, tmp_path):
+    """One poisoned piece under quarantine: every healthy piece delivers
+    exactly-once, the quarantine lands in client diagnostics AND
+    dispatcher status, survives a dispatcher restart (journaled), and the
+    next epoch's assignment excludes the piece."""
+    journal_dir = str(tmp_path / "journal")
+    dispatcher = Dispatcher(port=0, mode="static",
+                            journal_dir=journal_dir).start()
+    workers = [
+        BatchWorker(petastorm_dataset.url,
+                    dispatcher_address=dispatcher.address, batch_size=7,
+                    reader_factory="row", worker_id=f"w{i}",
+                    on_piece_error="quarantine",
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        sched = failpoints.FaultSchedule(0, points=(), poison_pieces={1})
+        with failpoints.armed(sched):
+            source = ServiceBatchSource(dispatcher.address,
+                                        on_piece_error="quarantine")
+            got = _collect_ids(source)
+        # 3 row groups × 10 rows; piece 1 is poison: 20 healthy rows,
+        # each exactly once.
+        assert len(got) == 20
+        assert len(set(got)) == 20
+        diag = source.diagnostics
+        assert diag["recovery"]["pieces_quarantined"] == 1
+        assert diag["quarantined_pieces"][0]["piece"] == 1
+        # The background report reaches the dispatcher and is journaled.
+        assert _wait_for(
+            lambda: "1" in source.dispatcher_status()["quarantined"])
+        status = source.dispatcher_status()
+        assert status["recovery"]["pieces_quarantined"] == 1
+        # Poison injections land in the schedule's replayable log.
+        assert ("piece.decode", 0, "poison:1") in sched.log
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+    # Restart from the journal: the quarantine survives, and grants
+    # exclude the piece.
+    restarted = Dispatcher(port=0, mode="static",
+                           journal_dir=journal_dir).start()
+    try:
+        with FramedConnection.connect(restarted.address, timeout=5) as conn:
+            status, _ = conn.request({"type": "status"})
+            assert "1" in status["quarantined"]
+            reply, _ = conn.request({
+                "type": "get_assignment", "client_id": "c-after",
+                "client_index": 0, "num_clients": 1, "epoch": 1})
+        # The journal restored the workers (fresh leases), so the next
+        # epoch's assignment is granted — WITHOUT the quarantined piece.
+        assert reply["type"] == "assignment"
+        granted = sorted(p for pieces in reply["assignments"].values()
+                         for p in pieces)
+        assert granted == [0, 2]
+    finally:
+        restarted.stop()
+
+
+def test_quarantine_dynamic_end_to_end(petastorm_dataset):
+    dispatcher = Dispatcher(port=0, mode="dynamic").start()
+    workers = [
+        BatchWorker(petastorm_dataset.url,
+                    dispatcher_address=dispatcher.address, batch_size=7,
+                    reader_factory="row", worker_id=f"w{i}",
+                    on_piece_error="quarantine",
+                    reader_kwargs={"workers_count": 2}).start()
+        for i in range(2)]
+    try:
+        sched = failpoints.FaultSchedule(0, points=(), poison_pieces={0})
+        with failpoints.armed(sched):
+            source = ServiceBatchSource(dispatcher.address,
+                                        on_piece_error="quarantine")
+            got = _collect_ids(source)
+        assert len(got) == 20
+        assert len(set(got)) == 20
+        assert source.diagnostics["recovery"]["pieces_quarantined"] == 1
+        assert _wait_for(
+            lambda: "0" in source.dispatcher_status()["quarantined"])
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_poison_piece_default_policy_fails_loudly(petastorm_dataset):
+    dispatcher = Dispatcher(port=0, mode="static").start()
+    workers = [
+        BatchWorker(petastorm_dataset.url,
+                    dispatcher_address=dispatcher.address, batch_size=7,
+                    reader_factory="row", worker_id="w0",
+                    reader_kwargs={"workers_count": 2}).start()]
+    try:
+        sched = failpoints.FaultSchedule(0, points=(), poison_pieces={1})
+        with failpoints.armed(sched):
+            source = ServiceBatchSource(dispatcher.address)
+            with pytest.raises(ServiceError):
+                _collect_ids(source)
+    finally:
+        for w in workers:
+            w.stop()
+        dispatcher.stop()
+
+
+def test_on_piece_error_validated():
+    with pytest.raises(ValueError):
+        BatchWorker("file:///nowhere", on_piece_error="explode")
+    with pytest.raises(ValueError):
+        ServiceBatchSource(("127.0.0.1", 1), on_piece_error="explode")
+
+
+def test_engine_quarantine_requires_reader_factory():
+    """Quarantine tears a wedged reader down and lazily rebuilds it —
+    impossible from a bare instance, so the combination is rejected at
+    construction instead of crashing the first stream mid-recovery."""
+    from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+
+    class FakeReader:  # instance form (not a factory, not tagged-capable)
+        dynamic = True
+
+    with pytest.raises(ValueError, match="FACTORY"):
+        StreamingPieceEngine(FakeReader(), 8, on_piece_error="quarantine")
+
+
+def test_fcfs_all_pieces_quarantined_ends_stream():
+    """Every piece quarantined + num_epochs=None must end the fcfs
+    stream, not spin the skip loop forever under the dispatcher lock."""
+    dispatcher = Dispatcher(port=0, mode="fcfs", num_epochs=None).start()
+    try:
+        with FramedConnection.connect(dispatcher.address, timeout=5) as c:
+            reply, _ = c.request({"type": "register_worker",
+                                  "worker_id": "w0", "host": "h",
+                                  "port": 1, "num_pieces": 2})
+            assert reply["type"] == "ok"
+            for piece in (0, 1):
+                reply, _ = c.request({"type": "report_poison_piece",
+                                      "client_id": "c0", "piece": piece,
+                                      "worker_id": "w0", "error": "x",
+                                      "epoch": 0})
+                assert reply["type"] == "ok"
+            reply, _ = c.request({"type": "next_split",
+                                  "client_id": "c0"})
+            assert reply["type"] == "end_of_stream"
+            assert reply["reason"] == "all pieces quarantined"
+            # And the control plane is still alive afterwards.
+            reply, _ = c.request({"type": "ping"})
+            assert reply["type"] == "pong"
+    finally:
+        dispatcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos replay (the acceptance pin) — full loopback scenario × 2
+# ---------------------------------------------------------------------------
+
+def test_failpoint_chaos_replay_is_byte_identical():
+    """Two runs of the service scenario under one --chaos-seed inject the
+    identical fault sequence and produce byte-identical stream digests
+    with 0 lost / 0 duplicate rows."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    # Points restricted to the high-traffic transport boundaries and the
+    # fire window pinned well below their per-run call counts (~30+), so
+    # BOTH runs reach every scheduled fire index — log equality is then a
+    # determinism statement, not a run-length coin flip. The full
+    # vocabulary (and the digest contract under it) is the slow soak's
+    # job (test_fuzz_soak_twenty_seeds_green).
+    kwargs = dict(rows=420, days=4, workers=2, batch_size=64,
+                  chaos="failpoints", chaos_seed=17,
+                  failpoint_points=("transport.send", "transport.recv"),
+                  failpoint_window=16,
+                  shuffle_seed=5, ordered=True)
+    first = service_loopback_scenario(**kwargs)
+    second = service_loopback_scenario(**kwargs)
+    for result in (first, second):
+        assert result["lost_rows"] == 0
+        assert result["duplicate_rows"] == 0
+    assert first["failpoint_injections"], "schedule fired nothing"
+    assert first["stream_digest"] == second["stream_digest"]
+    assert (sorted(map(tuple, first["failpoint_injections"]))
+            == sorted(map(tuple, second["failpoint_injections"])))
+    assert first["chaos_seed"] == 17
+    # The injection record is JSON-serializable (it rides --json-out).
+    json.dumps(first["failpoint_injections"])
+
+
+# ---------------------------------------------------------------------------
+# fuzzer: shrinking + the slow soak
+# ---------------------------------------------------------------------------
+
+def test_fuzz_shrinker_produces_minimal_seed_stamped_reproducer():
+    from petastorm_tpu.service import fuzz
+
+    def broken_build(seed, points):
+        # The "deliberately-broken build": any schedule containing the
+        # cache.write failpoint trips the (pretend) bug.
+        if points is None or "cache.write" in points:
+            raise RuntimeError("invariant violated: 3 lost rows")
+        return {"stream_digest": "d", "failpoint_injections": []}
+
+    with pytest.raises(fuzz.FuzzFailure) as err:
+        fuzz.fuzz([3], run_fn=broken_build, shrink=True,
+                  check_determinism=False, timeout_s=10)
+    failure = err.value.report["failures"][0]
+    assert failure["seed"] == 3
+    assert failure["points"] == ["cache.write"]
+    assert "--chaos-seed 3" in failure["reproducer"]
+    assert "cache.write" in failure["reproducer"]
+
+
+def test_fuzz_green_run_reports_and_checks_determinism():
+    from petastorm_tpu.service import fuzz
+
+    calls = []
+
+    def healthy(seed, points):
+        calls.append(seed)
+        return {"stream_digest": f"digest-{seed}",
+                "failpoint_injections": [["transport.send", 5, "reset"]]}
+
+    report = fuzz.fuzz([1, 2], run_fn=healthy, check_determinism=True,
+                       timeout_s=10)
+    assert report["runs"] == 4  # each seed runs twice (digest replay)
+    assert report["failures"] == []
+    assert calls == [1, 1, 2, 2]
+
+
+def test_fuzz_flags_nondeterministic_digests():
+    from petastorm_tpu.service import fuzz
+
+    state = {"n": 0}
+
+    def flappy(seed, points):
+        state["n"] += 1
+        return {"stream_digest": f"digest-{state['n']}",
+                "failpoint_injections": []}
+
+    with pytest.raises(fuzz.FuzzFailure) as err:
+        fuzz.fuzz([9], run_fn=flappy, shrink=False, timeout_s=10)
+    assert "digest-determinism" in str(err.value)
+
+
+def test_fuzz_hung_run_is_bounded_and_reported():
+    from petastorm_tpu.service import fuzz
+
+    release = threading.Event()
+
+    def hangs(seed, points):
+        release.wait(30)
+        return {}
+
+    try:
+        with pytest.raises(fuzz.FuzzFailure) as err:
+            fuzz.fuzz([4], run_fn=hangs, shrink=False,
+                      check_determinism=False, timeout_s=0.3)
+        assert "hung" in str(err.value)
+    finally:
+        release.set()  # unblock the abandoned thread so it exits
+        time.sleep(0.05)
+
+
+@pytest.mark.slow
+def test_fuzz_soak_twenty_seeds_green():
+    """The acceptance soak: 20 seeded schedules through the real loopback
+    service, zero-dup/zero-loss and digest-determinism per seed."""
+    from petastorm_tpu.service import fuzz
+
+    report = fuzz.fuzz(range(20), check_determinism=True,
+                       timeout_s=fuzz.DEFAULT_RUN_TIMEOUT_S)
+    assert report["failures"] == []
+    assert report["runs"] == 40
