@@ -1584,6 +1584,236 @@ def leg_flash_memsweep(_url):
                 "times (execution forced via D2H loss fetch)"}
 
 
+def leg_llm_packing(_url):
+    """LLM sequence-packing workload (docs/guides/llm.md): packed
+    ``[slots, T]`` batches vs ``last_batch='pad'`` per-sequence padding
+    through ONE compute-bound sequence-model step (token embedding →
+    causal segment-masked attention → vocab projection), on a skewed
+    length distribution — token/s counts REAL tokens, so the ratio is
+    the padding waste packing eliminates. Plus a mid-run mixture
+    weight-reload sub-leg: two corpora under one dispatcher, weights
+    flipped through the journaled set_mixture_weights op between
+    passes, served draw fractions proving the mix moved at the
+    boundary."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_utils.packing import (
+        PACK_POSITION_KEY,
+        PACK_SEGMENT_KEY,
+        iter_ragged_rows,
+        pack_ragged,
+    )
+    from petastorm_tpu.models.sequence_model import attention_reference
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_token_dataset,
+    )
+
+    max_len = int(os.environ.get("BENCH_LLM_MAX_LEN", "128"))
+    slots = int(os.environ.get("BENCH_LLM_SLOTS", "8"))
+    n_rows = int(os.environ.get("BENCH_LLM_ROWS", "2048"))
+    d_model, heads, vocab = 128, 4, 50_000
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_llm_")
+    try:
+        url = f"file://{tmp}/tok"
+        create_test_token_dataset(url, rows_count=n_rows,
+                                  rows_per_row_group=256,
+                                  max_len=max_len, skew=3.0)
+        key = jax.random.PRNGKey(0)
+        params = {
+            "emb": jax.random.normal(key, (vocab, d_model),
+                                     jnp.float32) * 0.02,
+            "qkv": jax.random.normal(key, (d_model, 3 * d_model),
+                                     jnp.float32) * 0.02,
+            "out": jax.random.normal(key, (d_model, vocab),
+                                     jnp.float32) * 0.02,
+        }
+
+        @jax.jit
+        def step(params, tokens, seg, pos):
+            x = params["emb"][tokens]                     # [B, T, D]
+            q, k, v = jnp.split(x @ params["qkv"], 3, axis=-1)
+            b, t = tokens.shape
+            dh = d_model // heads
+            o = attention_reference(
+                q.reshape(b, t, heads, dh), k.reshape(b, t, heads, dh),
+                v.reshape(b, t, heads, dh), causal=True, segment_ids=seg)
+            logits = o.reshape(b, t, d_model) @ params["out"]
+            mask = (seg >= 0).astype(jnp.float32)
+            # Next-token NLL inside each segment (pos>0 positions have an
+            # in-segment predecessor) — a loss-shaped scalar that keeps
+            # every matmul live.
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            tgt = tokens[:, 1:]
+            keep = mask[:, 1:] * (pos[:, 1:] > 0)
+            nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                       axis=-1)[..., 0]
+            return (nll * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+
+        def reader():
+            return make_reader(url, reader_pool_type="thread",
+                               workers_count=2, num_epochs=1,
+                               shuffle_row_groups=False,
+                               schema_fields=["tokens", "length"])
+
+        def packed_batches():
+            with reader() as r:
+                yield from pack_ragged(
+                    iter_ragged_rows(r, ["tokens"], "length"),
+                    slot_len=max_len, slots=slots)
+
+        def padded_batches():
+            # last_batch='pad' semantics: one sequence per row, padded to
+            # the static T — the layout packing replaces.
+            buf_t, buf_l = [], []
+            with reader() as r:
+                for row in r:
+                    buf_t.append(np.asarray(row.tokens))
+                    buf_l.append(int(row.length))
+                    if len(buf_t) == slots:
+                        yield np.stack(buf_t), np.asarray(buf_l)
+                        buf_t, buf_l = [], []
+                if buf_t:
+                    pad = slots - len(buf_t)
+                    buf_t += [np.zeros(max_len, np.int32)] * pad
+                    buf_l += [0] * pad
+                    yield np.stack(buf_t), np.asarray(buf_l)
+
+        positions = np.arange(max_len, dtype=np.int32)
+
+        def run_packed():
+            tokens = capacity = batches = 0
+            t0 = time.perf_counter()
+            for batch in packed_batches():
+                seg = batch[PACK_SEGMENT_KEY]
+                step(params, batch["tokens"], seg,
+                     batch[PACK_POSITION_KEY]).block_until_ready()
+                tokens += int((seg >= 0).sum())
+                capacity += seg.size
+                batches += 1
+            return tokens, capacity, batches, time.perf_counter() - t0
+
+        def run_padded():
+            tokens = capacity = batches = 0
+            t0 = time.perf_counter()
+            for toks, lens in padded_batches():
+                seg = np.where(positions[None, :] < lens[:, None],
+                               0, -1).astype(np.int32)
+                pos = np.where(seg >= 0, positions[None, :],
+                               0).astype(np.int32)
+                step(params, toks, seg, pos).block_until_ready()
+                tokens += int(lens.sum())
+                capacity += seg.size
+                batches += 1
+            return tokens, capacity, batches, time.perf_counter() - t0
+
+        # Warm the jit once off the clock (both paths share one [B, T]
+        # program), then interleave A/B passes and keep each side's best.
+        warm = np.zeros((slots, max_len), np.int32)
+        step(params, warm, np.full_like(warm, -1),
+             np.zeros_like(warm)).block_until_ready()
+        packed = padded = None
+        for _ in range(REPEATS):
+            p = run_packed()
+            d = run_padded()
+            if packed is None or p[3] < packed[3]:
+                packed = p
+            if padded is None or d[3] < padded[3]:
+                padded = d
+        pk_tokens, pk_cap, pk_batches, pk_wall = packed
+        pd_tokens, pd_cap, pd_batches, pd_wall = padded
+        pk_rate = pk_tokens / max(pk_wall, 1e-9)
+        pd_rate = pd_tokens / max(pd_wall, 1e-9)
+
+        reload_block = _llm_weight_reload_subleg(tmp, max_len)
+        return {
+            "slot_len": max_len, "slots": slots, "sequences": n_rows,
+            "packed_tokens_per_sec": round(pk_rate, 1),
+            "padded_tokens_per_sec": round(pd_rate, 1),
+            "packed_vs_padded": round(pk_rate / max(pd_rate, 1e-9), 2),
+            "packed_batches": pk_batches,
+            "padded_batches": pd_batches,
+            "packed_padding_waste_pct": round(
+                100.0 * (1 - pk_tokens / max(pk_cap, 1)), 1),
+            "padded_padding_waste_pct": round(
+                100.0 * (1 - pd_tokens / max(pd_cap, 1)), 1),
+            "weight_reload": reload_block,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _llm_weight_reload_subleg(tmp, max_len):
+    """Two corpora under ONE dispatcher, weights hot-flipped through the
+    journaled set_mixture_weights op between mixture passes — reports
+    the served draw fractions on both sides of the boundary."""
+    from petastorm_tpu.service import (
+        BatchWorker,
+        Dispatcher,
+        MixedBatchSource,
+        ServiceBatchSource,
+        set_mixture_weights,
+    )
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_token_dataset,
+    )
+
+    urls = {}
+    for name, skew in (("a", 3.0), ("b", 1.5)):
+        urls[name] = f"file://{tmp}/mix_{name}"
+        create_test_token_dataset(urls[name], rows_count=240,
+                                  rows_per_row_group=40,
+                                  max_len=max_len, skew=skew)
+    rk = {"reader_pool_type": "thread", "workers_count": 1,
+          "schema_fields": ["tokens", "length"]}
+    workers = []
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1,
+                            shuffle_seed=13).start()
+    try:
+        for name in urls:
+            workers.append(BatchWorker(
+                urls[name], dispatcher_address=dispatcher.address,
+                batch_size=32, reader_factory="row", corpus=name,
+                reader_kwargs=dict(rk)).start())
+
+        def factory(name):
+            return lambda: ServiceBatchSource(
+                dispatcher.address, corpus=name, ordered=True)
+
+        mix = MixedBatchSource(
+            {name: factory(name) for name in sorted(urls)},
+            weights={"a": 0.8, "b": 0.2}, seed=29, exhaustion="stop",
+            dispatcher_address=dispatcher.address, factories=True)
+
+        def run_pass():
+            n = 0
+            for _ in mix():
+                n += 1
+            draws = dict(mix.diagnostics["mixture"]["draws"])
+            total = max(sum(draws.values()), 1)
+            return {"batches": n,
+                    "fractions": {k: round(v / total, 3)
+                                  for k, v in sorted(draws.items())}}
+
+        before = run_pass()
+        reply = set_mixture_weights(dispatcher.address,
+                                    {"a": 0.2, "b": 0.8},
+                                    effective_epoch=1)
+        after = run_pass()
+        return {"before": before, "after": after,
+                "journal_seq": reply["seq"],
+                "weights_before": {"a": 0.8, "b": 0.2},
+                "weights_after": {"a": 0.2, "b": 0.8}}
+    finally:
+        for worker in workers:
+            worker.stop()
+        dispatcher.stop()
+
+
 LEGS = {
     "decode_row": leg_decode_row,
     "decode_columnar": leg_decode_columnar,
@@ -1601,13 +1831,14 @@ LEGS = {
     "flash_memsweep": leg_flash_memsweep,
     "multichip_child": leg_multichip_child,
     "multichip_scaling": leg_multichip_scaling,
+    "llm_packing": leg_llm_packing,
 }
 
 # Legs that measure evidence, not throughput: run ONCE outside the
 # best-of-ROUNDS loop (numerics and OOM ceilings are not host-weather).
 ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
                 "multichip_child", "multichip_scaling", "skewed_service",
-                "autotune", "multi_tenant")
+                "autotune", "multi_tenant", "llm_packing")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
@@ -1672,8 +1903,9 @@ def main():
         multichip = _run_leg_subprocess("multichip_scaling", url)
         skewed_service = _run_leg_subprocess("skewed_service", url)
         autotune_ab = _run_leg_subprocess("autotune", url)
+        llm_packing = _run_leg_subprocess("llm_packing", url)
         for extra in (flash_numerics, flash_memory, multichip,
-                      skewed_service, autotune_ab):
+                      skewed_service, autotune_ab, llm_packing):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -1773,6 +2005,13 @@ def main():
             # the convergence number tracked in BENCH_r06+ and
             # decision_trail is the auditable knob journal.
             "autotune_ab": autotune_ab,
+            # LLM sequence-packing workload (docs/guides/llm.md): packed
+            # vs last_batch='pad' real-token/s through one compute-bound
+            # sequence step on a skewed length distribution
+            # (packed_vs_padded is the padding-waste win), plus the
+            # mid-run mixture weight-reload sub-leg (served fractions on
+            # both sides of the journaled boundary).
+            "llm_packing": llm_packing,
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),

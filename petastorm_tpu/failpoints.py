@@ -56,6 +56,9 @@ Failpoint vocabulary (point → actions a schedule may choose):
 ``worker.heartbeat``   ``drop`` (one lease-renewal tick lost)
 ``piece.decode``       ``poison`` (the named piece is undecodable —
                        only via ``poison_pieces=``, never randomly)
+``packing.state``      ``torn`` (a sequence packer's checkpointed
+                       open-batch state is truncated mid-write — the
+                       crc-guarded restore must detect and refuse it)
 ====================== =============================================
 
 Arming is process-wide and explicitly scoped::
@@ -96,6 +99,7 @@ POINTS = {
     "cache.read": ("oserror",),
     "dispatcher.reply": ("drop", "delay"),
     "worker.heartbeat": ("drop",),
+    "packing.state": ("torn",),
 }
 
 #: ``piece.decode`` is separate: it only ever fires for explicitly named

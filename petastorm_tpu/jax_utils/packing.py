@@ -29,7 +29,11 @@ Conventions of the packed layout:
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 PACK_SEGMENT_KEY = "__segment_ids__"
 PACK_POSITION_KEY = "__positions__"
@@ -51,7 +55,11 @@ def pack_ragged(rows, slot_len, slots, keys=None):
     :param slot_len: tokens per batch row (the static T).
     :param slots: batch rows per emitted batch (the static B).
     :param keys: fields to pack (default: every ndarray field of the first
-        row with ndim >= 1).
+        row with ndim >= 1). An explicit key absent from the first row
+        raises ``ValueError`` naming it — a typo must not silently pack
+        the wrong field set. Fields NOT packed (scalars, 0-d arrays, or
+        keys left out of an explicit list) are dropped with a one-time
+        warning naming them.
     :return: yields dicts of ``{key: [slots, slot_len, ...]}`` plus
         ``PACK_SEGMENT_KEY`` / ``PACK_POSITION_KEY`` int32 arrays. The final
         batch is emitted even if partially filled (all -1 rows possible).
@@ -62,13 +70,32 @@ def pack_ragged(rows, slot_len, slots, keys=None):
     sequences are skipped (they carry no tokens to place).
     """
     state = None
+    warned_dropped = False
 
     def fresh(proto):
-        nonlocal keys
+        nonlocal keys, warned_dropped
         if keys is None:
             keys = [k for k, val in proto.items() if val.ndim >= 1]
             if not keys:
                 raise ValueError("no packable (array) fields in row")
+        else:
+            unknown = [k for k in keys if k not in proto]
+            if unknown:
+                raise ValueError(
+                    f"keys={unknown} not present in row (row has "
+                    f"{sorted(proto)}) — packing an absent field is a "
+                    f"configuration error, not a drop")
+        dropped = sorted(k for k in proto if k not in keys)
+        if dropped and not warned_dropped:
+            # Once per pack_ragged call: silently losing fields is how
+            # labels/ids vanish from a training stream with no error
+            # anywhere.
+            warned_dropped = True
+            logger.warning(
+                "pack_ragged: dropping non-packed field(s) %s — packing "
+                "has no per-sequence row to carry them on (keep them "
+                "upstream, fold them into a packed field, or name them "
+                "in keys=)", dropped)
         cols = {}
         for key in keys:
             trailing = proto[key].shape[1:]

@@ -41,13 +41,19 @@ from petastorm_tpu.telemetry.metrics import (
 )
 
 #: Bottleneck classes → the ordered knob candidates that attack them.
-#: (``transform_placement`` entries carry the placement the class wants.)
+#: (``transform_placement``/``packing_placement`` entries carry the
+#: placement the class wants: worker-bound pipelines shed the movable
+#: stage to the trainer, consumer-bound ones push it back to the
+#: workers. Absent knobs — no transform armed, no packing wrapper — are
+#: skipped, so each class falls through to its next lever.)
 _CLASS_KNOBS = {
     "decode-bound": ("workers_count", "host_prefetch"),
     "dispatch-bound": ("device_prefetch", "host_prefetch"),
     "credit-bound": ("credits", "ready_queue_depth"),
-    "worker-bound": ("transform_placement:local", "credits"),
-    "consumer-bound": ("transform_placement:remote",),
+    "worker-bound": ("transform_placement:local",
+                     "packing_placement:trainer", "credits"),
+    "consumer-bound": ("transform_placement:remote",
+                       "packing_placement:worker"),
     "balanced": (),
     "idle": (),
 }
@@ -497,10 +503,12 @@ class AutotuneController:
 
 
 def _gauge_value(value):
-    """Knob value → gauge float (transform_placement: 0 remote, 1 local)."""
-    if value == "remote":
+    """Knob value → gauge float (transform_placement: 0 remote, 1 local;
+    packing_placement: 0 worker, 1 trainer — in both conventions 0 is
+    the service side, 1 the trainer host)."""
+    if value in ("remote", "worker"):
         return 0.0
-    if value == "local":
+    if value in ("local", "trainer"):
         return 1.0
     try:
         return float(value)

@@ -294,15 +294,39 @@ def build_loader_graph(loader, bounds=None):
     nodes.append(StageNode(
         "collate", "worker", worker_placement,
         description="rows → fixed-size numpy batch"))
+    packing_spec = _packing_spec(source)
+    if packing_spec is not None:
+        # The sequence-packing stage (docs/guides/llm.md): ratio-changing
+        # (N row batches → M packed batches), placement-flippable when
+        # the source is wrapped in a PackedBatchSource — worker-side it
+        # runs pre-serialization (cache entries hold packed frames),
+        # trainer-side it packs the received row stream.
+        nodes.append(StageNode(
+            "pack", "worker",
+            worker_placement if _packing_remote(source) else "trainer",
+            flippable=_packing_flippable(source),
+            metric=_packing_metric,
+            placement_fn=(
+                (lambda: "trainer" if not _packing_remote(source)
+                 else worker_placement)
+                if _packing_flippable(source) else None),
+            description=(f"sequence packing into "
+                         f"[{packing_spec['slots']}, "
+                         f"{packing_spec['slot_len']}] + segment ids")))
     nodes.append(StageNode(
         "serialize", "worker", worker_placement,
         description="batch → wire frames (service path only)"))
     nodes.append(StageNode(
         "send", "worker", worker_placement,
         description="framed socket send (service path only)"))
-    edges += [("read", "decode"), ("decode", "transform"),
-              ("transform", "collate"), ("collate", "serialize"),
-              ("serialize", "send")]
+    if packing_spec is not None:
+        edges += [("read", "decode"), ("decode", "transform"),
+                  ("transform", "collate"), ("collate", "pack"),
+                  ("pack", "serialize"), ("serialize", "send")]
+    else:
+        edges += [("read", "decode"), ("decode", "transform"),
+                  ("transform", "collate"), ("collate", "serialize"),
+                  ("serialize", "send")]
 
     # -- client side: recv → queue → raw_stage/device_decode → device_put
     #    → consume
@@ -390,6 +414,17 @@ def build_loader_graph(loader, bounds=None):
             set=source.set_transform_placement,
             kind="choice", choices=("remote", "local"),
             applies="next-iteration"))
+    if _packing_flippable(source):
+        # The set_transform_placement-style binding for the packing
+        # stage: the autotuner may move packing between the workers
+        # (cache holds packed frames, trainer receives dense batches)
+        # and the trainer (workers serve row batches, this host packs).
+        knobs.append(Knob(
+            "packing_placement",
+            get=lambda: source.packing_placement,
+            set=source.set_packing_placement,
+            kind="choice", choices=("worker", "trainer"),
+            applies="next-iteration"))
 
     signals = {
         "rows": lambda: loader._m_rows.value,
@@ -410,6 +445,44 @@ def build_loader_graph(loader, bounds=None):
 def _has_transform(source):
     return (source is not None
             and getattr(source, "transform", None) is not None)
+
+
+def _packing_spec(source):
+    """The packing spec dict when the source packs (PackedBatchSource
+    wrapper, or a ServiceBatchSource with packing= armed), else None."""
+    if source is None:
+        return None
+    spec = getattr(source, "spec", None)
+    if spec is not None and hasattr(spec, "key_dict") \
+            and hasattr(source, "packing_placement"):
+        return spec.key_dict()
+    packing = getattr(source, "packing", None)
+    return packing.key_dict() if packing is not None \
+        and hasattr(packing, "key_dict") else None
+
+
+def _packing_flippable(source):
+    return (source is not None
+            and hasattr(source, "set_packing_placement")
+            and _packing_spec(source) is not None)
+
+
+def _packing_remote(source):
+    return (getattr(source, "packing_placement", "worker") == "worker"
+            if source is not None else True)
+
+
+def _packing_metric():
+    """Cumulative (count, seconds) of the packing stage across both
+    placements (trainer-side always in-process; worker-side series join
+    loopback deployments), mirroring ``_transform_metric``."""
+    from petastorm_tpu.telemetry.metrics import PACKING_SECONDS
+
+    count = total = 0
+    for child in PACKING_SECONDS.children().values():
+        count += child.count
+        total += child.sum
+    return count, total
 
 
 def _transform_remote(source):

@@ -54,6 +54,18 @@ from petastorm_tpu.service.fleet import (
     register_job,
 )
 from petastorm_tpu.service.journal import Journal
+from petastorm_tpu.service.mixture import (
+    MixedBatchSource,
+    MixtureSampler,
+    MixtureSpec,
+    get_mixture_weights,
+    set_mixture_weights,
+)
+from petastorm_tpu.service.packing_stage import (
+    PackedBatchSource,
+    PackingSpec,
+    StreamPacker,
+)
 from petastorm_tpu.service.worker import BatchWorker
 
 __all__ = [
@@ -69,4 +81,12 @@ __all__ = [
     "register_job",
     "end_job",
     "plan_fair_shares",
+    "MixedBatchSource",
+    "MixtureSampler",
+    "MixtureSpec",
+    "set_mixture_weights",
+    "get_mixture_weights",
+    "PackedBatchSource",
+    "PackingSpec",
+    "StreamPacker",
 ]

@@ -141,6 +141,14 @@ def _build_parser():
                            "keeps serving every healthy piece "
                            "exactly-once (docs/guides/service.md"
                            "#failure-model-and-recovery)")
+    work.add_argument("--corpus", default="",
+                      help="corpus name for multi-corpus fleets: workers "
+                           "serving different datasets under ONE "
+                           "dispatcher register distinct corpora; "
+                           "clients request per-corpus assignments for "
+                           "deterministic weighted mixing "
+                           "(docs/guides/llm.md#mixtures). Default: the "
+                           "single-dataset corpus")
     work.add_argument("--batch-transform", default=None,
                       help="module:attr of the placement-flippable "
                            "collated-batch transform ({field: ndarray} -> "
@@ -170,7 +178,40 @@ def _build_parser():
                            "(host:port): renders the pipeline autotuner's "
                            "knob gauges and decision counters under the "
                            "fleet table (docs/guides/pipeline.md)")
+
+    mix = sub.add_parser(
+        "set-mixture-weights",
+        help="journal a mixture weight change at the dispatcher — the "
+             "hot-reload lever: every MixedBatchSource of the job "
+             "applies it at the effective epoch boundary, no fleet or "
+             "trainer restart (docs/guides/llm.md#hot-reloading-the-mix)")
+    mix.add_argument("--dispatcher", required=True,
+                     help="dispatcher address host:port")
+    mix.add_argument("--job", default="default",
+                     help="the job whose mixture to rebalance")
+    mix.add_argument("--weights", required=True,
+                     help="corpus=weight pairs, comma-separated "
+                          "(e.g. web=0.6,code=0.3,books=0.1)")
+    mix.add_argument("--effective-epoch", type=int, default=None,
+                     help="the mixture pass the change takes effect at "
+                          "(its start boundary); omit to apply at the "
+                          "next pass any source starts — name it "
+                          "explicitly when the run must stay bit-"
+                          "reproducible from the weight-change log")
     return parser
+
+
+def parse_weights(spec):
+    """``corpus=weight,…`` → ``{corpus: float}`` (the set-mixture-weights
+    CLI payload)."""
+    out = {}
+    for pair in spec.split(","):
+        if "=" not in pair:
+            raise ValueError(
+                f"--weights expects corpus=weight pairs, got {pair!r}")
+        name, _, value = pair.partition("=")
+        out[name.strip()] = float(value)
+    return out
 
 
 def build_service_node(args):
@@ -198,6 +239,7 @@ def build_service_node(args):
         reader_factory=args.reader, worker_id=args.worker_id,
         standby=getattr(args, "standby", False),
         on_piece_error=getattr(args, "on_piece_error", "fail"),
+        corpus=getattr(args, "corpus", ""),
         heartbeat_interval_s=args.heartbeat_interval or None,
         batch_cache=CacheConfig(mode=getattr(args, "cache", "off"),
                                 mem_mb=getattr(args, "cache_mem_mb", 256.0),
@@ -515,6 +557,16 @@ def main(argv=None, run_seconds=None, stop_event=None):
     be able to tear the node down instead of leaking its sockets for the
     rest of ``run_seconds``); the default serves until SIGINT/SIGTERM."""
     args = _build_parser().parse_args(argv)
+    if args.role == "set-mixture-weights":
+        from petastorm_tpu.service.mixture import set_mixture_weights
+
+        reply = set_mixture_weights(
+            parse_address(args.dispatcher), parse_weights(args.weights),
+            job_id=args.job, effective_epoch=args.effective_epoch)
+        print(json.dumps({"job_id": reply.get("job_id"),
+                          "seq": reply.get("seq"),
+                          "entries": reply.get("entries")}), flush=True)
+        return 0
     if args.role == "status":
         try:
             return run_status(parse_address(args.dispatcher),

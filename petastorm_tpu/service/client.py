@@ -103,8 +103,13 @@ class _WorkerStream:
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
                  starts=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None, recv_timeout=None):
+                 job_id=None, recv_timeout=None, packing=None):
         self.worker_id = worker_id
+        #: Worker-placement sequence packing: the spec's dict form rides
+        #: the stream request; the worker packs pre-serialization and
+        #: ordinals/watermarks number PACKED batches. ``None`` = no
+        #: packing (or trainer placement).
+        self.packing = packing
         #: The trainer job this stream belongs to (multi-tenant fleets):
         #: carried on the stream request so the worker attributes rows
         #: and cache lookups per job. ``None`` = single-tenant legacy.
@@ -179,6 +184,8 @@ class _WorkerStream:
                 request["shuffle_seed"] = int(self.shuffle_seed)
             if self.transform_placement is not None:
                 request["transform_placement"] = self.transform_placement
+            if self.packing is not None:
+                request["packing"] = dict(self.packing)
             if self.tagged:
                 request["tagged"] = True
                 if self.starts:
@@ -490,9 +497,10 @@ class _DynamicStream:
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
                  credits=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None, recv_timeout=None):
+                 job_id=None, recv_timeout=None, packing=None):
         self.worker_id = worker_id
         self.job_id = job_id  # see _WorkerStream.job_id
+        self.packing = packing  # see _WorkerStream.packing
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
         # delivery watermark, so a (re)opened stream never repeats batches
@@ -529,6 +537,8 @@ class _DynamicStream:
                 request["shuffle_seed"] = int(self.shuffle_seed)
             if self.transform_placement is not None:
                 request["transform_placement"] = self.transform_placement
+            if self.packing is not None:
+                request["packing"] = dict(self.packing)
             if self.credits is not None:
                 request["credits"] = self.credits
             try:
@@ -793,7 +803,7 @@ class ServiceBatchSource:
                  dynamic_sync_interval_s=0.25, ordered=False,
                  transform=None, transform_placement="remote",
                  job_id=None, on_piece_error="fail",
-                 stream_recv_timeout_s=None):
+                 stream_recv_timeout_s=None, packing=None, corpus=""):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if on_piece_error not in ("fail", "quarantine"):
@@ -815,6 +825,26 @@ class ServiceBatchSource:
         self.client_index = client_index
         self.num_clients = num_clients
         self.job_id = str(job_id) if job_id is not None else None
+        # Multi-corpus fleets: request assignments over the named corpus's
+        # worker group ("" = the default single-dataset corpus). Rides
+        # every control request that plans or repairs piece ownership.
+        self.corpus = str(corpus or "")
+        # Worker-placement sequence packing (docs/guides/llm.md): the
+        # spec rides every stream request; workers pack pre-serialization
+        # and delivered batches arrive packed. Flipped (next-iteration)
+        # by PackedBatchSource.set_packing_placement via set_packing.
+        self._packing = None
+        if packing is not None:
+            from petastorm_tpu.service.packing_stage import PackingSpec
+
+            self._packing = PackingSpec.from_dict(packing)
+        if self._packing is not None and transform is not None:
+            raise ValueError(
+                "packing= and transform= cannot combine on one source: "
+                "the batch transform is a row-batch stage and packing "
+                "changes the batch vocabulary — apply the transform "
+                "upstream (transform_spec) instead")
+        self._iter_packing = self._packing
         # The dispatcher's fair-share credit scaling for this job (1.0 =
         # full window). Updated from assignment/plan/sync replies; applied
         # to streams opened AFTER the update, like set_credits.
@@ -981,6 +1011,11 @@ class ServiceBatchSource:
             # dispatcher scopes fencing, assignment records, and recovery
             # attribution by it (multi-tenant fleets).
             header = dict(header, job_id=self.job_id)
+        if self.corpus:
+            # Multi-corpus fleets: assignment planning, takeover
+            # re-partitions, and quarantine reports all scope to this
+            # source's corpus worker group.
+            header = dict(header, corpus=self.corpus)
 
         def once():
             with FramedConnection.connect(
@@ -1087,6 +1122,35 @@ class ServiceBatchSource:
                 "transform= to make placement meaningful")
         self._transform_placement = placement
 
+    @property
+    def packing(self):
+        """The worker-placement packing spec in force from the next
+        iteration on (``None`` = workers serve row batches)."""
+        return self._packing
+
+    def set_packing(self, packing):
+        """Arm (or disarm, ``None``) worker-placement sequence packing.
+        Takes effect at the next iteration boundary, like
+        :meth:`set_transform_placement` — the placement wrapper
+        (:class:`~petastorm_tpu.service.packing_stage.PackedBatchSource`)
+        calls this when its ``packing_placement`` knob flips."""
+        if packing is None:
+            self._packing = None
+            return
+        from petastorm_tpu.service.packing_stage import PackingSpec
+
+        if self.transform is not None:
+            raise ValueError(
+                "packing and transform= cannot combine on one source "
+                "(the transform is a row-batch stage)")
+        self._packing = PackingSpec.from_dict(packing)
+
+    def _iter_packing_dict(self):
+        """The frozen iteration's packing spec in wire form (``None``
+        when the iteration serves row batches)."""
+        return (self._iter_packing.to_dict()
+                if self._iter_packing is not None else None)
+
     def _effective_credits(self):
         """The configured credit window scaled by this job's fair share
         (``credit_scale`` from the dispatcher): a job granted half the
@@ -1162,6 +1226,18 @@ class ServiceBatchSource:
                 "assignment, so concurrent jobs would silently split — "
                 "not share — each epoch's data. Run the dispatcher with "
                 "mode='dynamic' (or 'static') for multi-tenant fleets")
+        if self._packing is not None and info["mode"] == "fcfs":
+            raise ValueError(
+                "packing requires static or dynamic sharding: fcfs "
+                "serves untagged per-split streams outside the streaming "
+                "engine, which is where worker-side packing runs — or "
+                "pack trainer-side (PackedBatchSource placement="
+                "'trainer')")
+        if self.corpus and info["mode"] == "fcfs":
+            raise ValueError(
+                "corpus= requires static or dynamic sharding: fcfs "
+                "splits one shared default-corpus queue (multi-corpus "
+                "mixes need per-corpus assignments)")
         # Freeze the transform placement for this whole iteration: every
         # stream it opens (takeover/resync relaunches included) carries
         # the same placement, and the local applier wraps the iterator
@@ -1169,6 +1245,9 @@ class ServiceBatchSource:
         self._iter_transform_placement = (self._transform_placement
                                           if self.transform is not None
                                           else None)
+        # Packing is frozen the same way: an iteration's streams (and
+        # their cache keys) all agree on whether the workers pack.
+        self._iter_packing = self._packing
         local = self._iter_transform_placement == "local"
         if info["mode"] == "static":
             # The multiplexed drain prefetches into its ready-queue behind
@@ -1277,7 +1356,8 @@ class ServiceBatchSource:
                         shuffle_seed=self._shuffle_seed,
                         transform_placement=self._iter_transform_placement,
                         job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                        recv_timeout=self._stream_recv_timeout_s,
+                        packing=self._iter_packing_dict())
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1462,7 +1542,8 @@ class ServiceBatchSource:
                     shuffle_seed=self._shuffle_seed,
                     transform_placement=self._iter_transform_placement,
                     job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s))
+                    recv_timeout=self._stream_recv_timeout_s,
+                    packing=self._iter_packing_dict()))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1822,7 +1903,8 @@ class ServiceBatchSource:
                 shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
                 job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                recv_timeout=self._stream_recv_timeout_s,
+                packing=self._iter_packing_dict())
             streams[sid] = stream
             sid_by_wid[wid] = sid
             with self._lock:
@@ -1964,7 +2046,8 @@ class ServiceBatchSource:
                         shuffle_seed=self._shuffle_seed,
                         transform_placement=self._iter_transform_placement,
                         job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                        recv_timeout=self._stream_recv_timeout_s,
+                        packing=self._iter_packing_dict())
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -2445,7 +2528,8 @@ class ServiceBatchSource:
                 starts=starts, shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
                 job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                recv_timeout=self._stream_recv_timeout_s,
+                packing=self._iter_packing_dict())
             try:
                 event = fresh.next_event()  # forces connect + first reply
             except BaseException:
@@ -2538,7 +2622,8 @@ class ServiceBatchSource:
                           shuffle_seed=self._shuffle_seed,
                           transform_placement=self._iter_transform_placement,
                           job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                          recv_timeout=self._stream_recv_timeout_s,
+                          packing=self._iter_packing_dict())
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2732,6 +2817,10 @@ class ServiceBatchSource:
                 # that bit-identical order is off the table.
                 "shuffle_seed": self._shuffle_seed,
                 "ordered": self._ordered,
+                # Worker-placement packing in force: watermarks/ordinals
+                # above number PACKED batches, so a resume must re-arm
+                # the identical spec (validated at restore).
+                "packing": self._iter_packing_dict(),
             }
 
     def _validate_resume_state(self, state):
@@ -2749,6 +2838,15 @@ class ServiceBatchSource:
                     f"resume_state mismatch on {key!r}: checkpoint has "
                     f"{state.get(key)!r}, this client has "
                     f"{getattr(self, key)!r}")
+        saved_packing = state.get("packing")
+        current_packing = (self._packing.to_dict()
+                           if self._packing is not None else None)
+        if saved_packing != current_packing:
+            raise ValueError(
+                f"resume_state packing mismatch: checkpoint watermarks "
+                f"number batches under {saved_packing!r}, this source "
+                f"runs {current_packing!r} — resuming would re-grant at "
+                f"positions in a different batch vocabulary")
 
     @property
     def diagnostics(self):

@@ -339,6 +339,17 @@ class Dispatcher:
         # what re-grants actually use (it is never behind this one).
         self._client_watermarks = {}
         self._num_pieces = None
+        # Multi-corpus fleets: corpus name -> that corpus's row-group
+        # count ("" = the default corpus, mirrored into _num_pieces).
+        # Workers register with a corpus; clients request per-corpus
+        # assignments — one job's assignment may span several dataset
+        # urls through per-corpus worker groups and piece queues.
+        self._corpus_pieces = {}
+        # Journaled per-job mixture weight logs (set_mixture_weights):
+        # job_id -> {"seq": n, "entries": [{"seq", "weights",
+        # "effective_epoch"}]} — replayed byte-identically, fetched by
+        # MixedBatchSource at epoch boundaries (docs/guides/llm.md).
+        self._mixtures = {}
         # fcfs shared queue: lazily built once the piece count is known.
         self._fcfs_queue = None
         self._fcfs_epoch = 0
@@ -380,6 +391,9 @@ class Dispatcher:
         # from every future grant (assignment, plan, takeover
         # re-partition, fcfs split) until the journal is reset.
         self._quarantined = {}
+        # Default-corpus pieces of the map above (the fcfs paths' O(1)
+        # view — fcfs only ever grants the default corpus).
+        self._quarantined_default = set()
         # WAL/disk-exhaustion degradation: None, or the reason string
         # that flipped this dispatcher READ-ONLY — a journal write failed
         # (ENOSPC), so state-mutating requests are refused LOUDLY instead
@@ -464,6 +478,11 @@ class Dispatcher:
             "num_epochs": self.num_epochs,
             "shuffle_seed": self.shuffle_seed,
             "num_pieces": self._num_pieces,
+            "corpus_pieces": dict(self._corpus_pieces),
+            "mixtures": {jid: {"seq": m["seq"],
+                               "entries": [dict(e) for e in m["entries"]],
+                               "last_token": m.get("last_token")}
+                         for jid, m in self._mixtures.items()},
             "workers": {wid: dict(w) for wid, w in self._workers.items()},
             "clients": {cid: dict(c) for cid, c in self._clients.items()},
             "jobs": {jid: dict(j) for jid, j in self._jobs.items()},
@@ -481,8 +500,13 @@ class Dispatcher:
                            if self._fcfs_queue is not None else None),
             "fencing_epoch": self._fencing_epoch,
             "recovery": dict(self._recovery),
-            "quarantined": {str(p): dict(info)
-                            for p, info in self._quarantined.items()},
+            # Corpus-scoped keys: "piece" for the default corpus (the
+            # legacy wire/snapshot shape) or "corpus:piece"; the corpus
+            # also rides in each info dict, which is what the parse
+            # trusts.
+            "quarantined": {(f"{c}:{p}" if c else str(p)): dict(info)
+                            for (c, p), info
+                            in self._quarantined.items()},
             "generation": self._generation,
             # owner maps keyed by int piece → serialized as triplet lists
             # (JSON object keys must be strings).
@@ -545,6 +569,16 @@ class Dispatcher:
                 f"a different seed would silently change the piece order "
                 f"mid-run and break the determinism contract")
         self._num_pieces = state.get("num_pieces")
+        self._corpus_pieces = {str(c): int(n) for c, n
+                               in (state.get("corpus_pieces")
+                                   or {}).items()}
+        if self._num_pieces is not None:
+            self._corpus_pieces.setdefault("", self._num_pieces)
+        self._mixtures = {
+            str(jid): {"seq": int(m.get("seq", 0)),
+                       "entries": [dict(e) for e in m.get("entries", ())],
+                       "last_token": m.get("last_token")}
+            for jid, m in (state.get("mixtures") or {}).items()}
         self._client_watermarks = {
             cid: {"epoch": int(entry.get("epoch", 0)),
                   "watermarks": {int(p): int(n) for p, n
@@ -574,8 +608,12 @@ class Dispatcher:
         recovered = state.get("recovery", {})
         for key in self._recovery:
             self._recovery[key] = int(recovered.get(key, 0))
-        self._quarantined = {int(p): dict(info) for p, info
-                             in (state.get("quarantined") or {}).items()}
+        self._quarantined = {
+            (str(info.get("corpus", "") or ""),
+             int(str(p).rsplit(":", 1)[-1])): dict(info)
+            for p, info in (state.get("quarantined") or {}).items()}
+        self._quarantined_default = {p for (c, p) in self._quarantined
+                                     if not c}
         self._generation = int(state.get("generation", 0))
         self._dyn = {}
         self._mark_dyn_dirty_locked()
@@ -601,7 +639,8 @@ class Dispatcher:
                 [record["host"], int(record["port"])],
                 int(record["num_pieces"]),
                 re_register=bool(record.get("re_register")),
-                standby=bool(record.get("standby")))
+                standby=bool(record.get("standby")),
+                corpus=record.get("corpus", ""))
         elif op == "worker_dead":
             self._mark_worker_dead_locked(record["worker_id"],
                                           record.get("reason", "reported"),
@@ -610,7 +649,7 @@ class Dispatcher:
             self._install_client_locked(
                 record["client_id"], int(record["epoch"]),
                 int(record["client_index"]), int(record["num_clients"]),
-                record.get("job_id"))
+                record.get("job_id"), corpus=record.get("corpus", ""))
         elif op == "job_register":
             self._install_job_locked(
                 record["job_id"], float(record.get("weight", 1.0)),
@@ -650,12 +689,19 @@ class Dispatcher:
                                    or {}).items()},
             }
         elif op == "quarantine":
-            self._quarantine_piece_locked(
-                int(record["piece"]),
-                {"worker_id": record.get("worker_id"),
-                 "client_id": record.get("client_id"),
-                 "error": record.get("error"),
-                 "epoch": int(record.get("epoch", 0))})
+            info = {"worker_id": record.get("worker_id"),
+                    "client_id": record.get("client_id"),
+                    "error": record.get("error"),
+                    "epoch": int(record.get("epoch", 0))}
+            if record.get("corpus"):
+                info["corpus"] = record["corpus"]
+            self._quarantine_piece_locked(int(record["piece"]), info)
+        elif op == "mixture_weights":
+            self._install_mixture_locked(
+                record["job_id"], int(record["seq"]),
+                dict(record["weights"]),
+                record.get("effective_epoch"),
+                token=record.get("token"))
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
@@ -748,23 +794,37 @@ class Dispatcher:
         books (marked done so the steal planner and reconciliation never
         re-grant it), and keep the recovery counter in step. Idempotent —
         a duplicate report (retried RPC, second client) is a no-op."""
-        if piece in self._quarantined:
+        corpus = str(info.get("corpus", "") or "")
+        if (corpus, piece) in self._quarantined:
             return False
-        self._quarantined[piece] = dict(info)
+        self._quarantined[(corpus, piece)] = dict(info)
+        if not corpus:
+            # Cached default-corpus piece set: the fcfs split loop's
+            # per-request membership checks stay O(1) under the global
+            # lock.
+            self._quarantined_default.add(piece)
         self._recovery["pieces_quarantined"] += 1
-        for state in self._dyn.values():
+        for cid, state in self._dyn.items():
+            # Corpus-scoped exclusion: piece indices are per-dataset, so
+            # only clients OF THIS CORPUS may have the poison piece
+            # marked done — corpus B's healthy piece with the same index
+            # must keep serving.
+            if self._clients.get(cid, {}).get("corpus", "") != corpus:
+                continue
             if piece in state["owner"] and piece not in state["done"]:
                 state["done"].add(piece)
                 self._mark_dyn_dirty_locked()
         return True
 
-    def _grantable_pieces_locked(self, pieces):
+    def _grantable_pieces_locked(self, pieces, corpus=""):
         """Filter quarantined pieces out of a grant list — the one
         exclusion rule every grant path (assignment, plan, takeover
-        re-partition, fcfs split) applies."""
+        re-partition, fcfs split) applies. Quarantine entries are
+        corpus-scoped: piece indices are per-dataset, so corpus A's
+        poison piece 3 must not block corpus B's healthy piece 3."""
         if not self._quarantined:
             return list(pieces)
-        return [p for p in pieces if p not in self._quarantined]
+        return [p for p in pieces if (corpus, p) not in self._quarantined]
 
     def _handle_report_poison_piece(self, header):
         """A client observed a worker quarantine an undecodable piece
@@ -781,11 +841,15 @@ class Dispatcher:
                     "client_id": header.get("client_id"),
                     "error": str(header.get("error", ""))[:512],
                     "epoch": int(header.get("epoch", 0))}
+            if header.get("corpus"):
+                info["corpus"] = str(header["corpus"])
             fresh = self._quarantine_piece_locked(piece, info)
             if fresh:
                 self._journal_locked(dict(info, op="quarantine",
                                           piece=piece))
-            quarantined = sorted(self._quarantined)
+            corpus = info.get("corpus", "")
+            quarantined = sorted(p for (c, p) in self._quarantined
+                                 if c == corpus)
         if fresh:
             QUARANTINE_REPORTS.labels("dispatcher").inc()
             logger.warning(
@@ -839,7 +903,8 @@ class Dispatcher:
         return True
 
     def _install_worker_locked(self, worker_id, address, num_pieces,
-                               re_register=False, standby=False):
+                               re_register=False, standby=False,
+                               corpus=""):
         known = worker_id in self._workers
         # Preserve the lifecycle state of a worker the autoscaler already
         # placed (a heartbeat-healed re-registration must not silently
@@ -847,13 +912,22 @@ class Dispatcher:
         # fresh workers start where their flag says.
         prev_state = (self._workers[worker_id].get("state")
                       if known else None)
-        self._num_pieces = num_pieces
+        corpus = str(corpus or "")
+        # Per-corpus piece universes (multi-corpus fleets): each corpus's
+        # workers agree on their own dataset's row-group count; the
+        # default corpus "" keeps feeding the legacy single-dataset
+        # paths (_num_pieces, fcfs).
+        self._corpus_pieces[corpus] = num_pieces
+        if not corpus:
+            self._num_pieces = num_pieces
         self._workers[worker_id] = {
             "address": list(address),
             "num_pieces": num_pieces,
             "alive": True,
             "state": prev_state or ("standby" if standby else "serving"),
         }
+        if corpus:
+            self._workers[worker_id]["corpus"] = corpus
         if known or re_register:
             self._recovery["re_registrations"] += 1
         self._worker_leases[worker_id] = (
@@ -905,8 +979,29 @@ class Dispatcher:
             self._install_job_locked(job_id)
         return self._jobs[job_id]
 
+    def _install_mixture_locked(self, job_id, seq, weights,
+                                effective_epoch, token=None):
+        """One mutation site for a mixture weight-log entry (live handler
+        AND WAL replay): append in seq order, idempotent on duplicate
+        seqs. ``token`` is the caller's per-request idempotency id — the
+        handler dedups a retried RPC whose reply was dropped against it
+        (restored on replay, so the dedup survives a restart too)."""
+        mixture = self._mixtures.setdefault(
+            str(job_id), {"seq": 0, "entries": [], "last_token": None})
+        if seq <= mixture["seq"]:
+            return False
+        entry = {"seq": int(seq),
+                 "weights": {str(n): float(w) for n, w in weights.items()}}
+        if effective_epoch is not None:
+            entry["effective_epoch"] = int(effective_epoch)
+        mixture["entries"].append(entry)
+        mixture["seq"] = int(seq)
+        mixture["last_token"] = token
+        return True
+
     def _remove_job_locked(self, job_id):
         job = self._jobs.pop(job_id, None)
+        self._mixtures.pop(job_id, None)
         if job is None:
             return False
         self._job_fence_floor = max(self._job_fence_floor,
@@ -949,7 +1044,7 @@ class Dispatcher:
         return DEFAULT_JOB
 
     def _install_client_locked(self, client_id, epoch, client_index,
-                               num_clients, job_id=None):
+                               num_clients, job_id=None, corpus=""):
         entry = {
             "epoch": int(epoch),
             "client_index": int(client_index),
@@ -957,6 +1052,8 @@ class Dispatcher:
         }
         if job_id is not None and job_id != DEFAULT_JOB:
             entry["job_id"] = str(job_id)
+        if corpus:
+            entry["corpus"] = str(corpus)
         if self._clients.get(client_id) != entry:
             self._per_job_memo = None  # job association shifted
         self._clients[client_id] = entry
@@ -1325,25 +1422,31 @@ class Dispatcher:
         num_pieces = int(header["num_pieces"])
         re_register = bool(header.get("re_register"))
         standby = bool(header.get("standby"))
+        corpus = str(header.get("corpus") or "")
         with self._lock:
             blocked = self._check_writable_locked()
             if blocked is not None:
                 return blocked
-            if self._num_pieces is not None \
-                    and self._num_pieces != num_pieces:
+            known_pieces = self._corpus_pieces.get(corpus)
+            if known_pieces is not None and known_pieces != num_pieces:
                 return {"type": "error", "error": (
                     f"worker {worker_id!r} enumerated {num_pieces} row-group "
-                    f"pieces but the service plan has {self._num_pieces} — "
-                    f"all workers must read the same dataset with the same "
-                    f"planning config")}
+                    f"pieces but corpus {corpus or 'default'!r}'s service "
+                    f"plan has {known_pieces} — all of a corpus's workers "
+                    f"must read the same dataset with the same planning "
+                    f"config")}
             self._install_worker_locked(
                 worker_id, [header["host"], int(header["port"])],
-                num_pieces, re_register=re_register, standby=standby)
-            self._journal_locked({
+                num_pieces, re_register=re_register, standby=standby,
+                corpus=corpus)
+            record = {
                 "op": "register_worker", "worker_id": worker_id,
                 "host": header["host"], "port": int(header["port"]),
                 "num_pieces": num_pieces, "re_register": re_register,
-                "standby": standby})
+                "standby": standby}
+            if corpus:
+                record["corpus"] = corpus
+            self._journal_locked(record)
             fencing = self._fencing_epoch
             state = self._workers[worker_id]["state"]
         logger.info("worker %sregistered at %s:%s (%d pieces, %s)",
@@ -1404,6 +1507,82 @@ class Dispatcher:
             logger.info("job ended — clients, piece queues, and quota "
                         "released", job_id=job_id)
         return {"type": "ok", "job_id": job_id, "removed": removed}
+
+    def _handle_set_mixture_weights(self, header):
+        """Journal a mixture weight change for one job — the hot-reload
+        lever (``docs/guides/llm.md#hot-reloading-the-mix``): every
+        ``MixedBatchSource`` following the job applies the entry at the
+        ``effective_epoch`` boundary, so the served mix rebalances
+        mid-run with no fleet restart and the stream stays a pure
+        function of ``(seed, weight-change log)``. Job-scoped and
+        fenced: a caller holding a pre-restart fencing epoch is told to
+        resync instead of journaling a change against state it has not
+        seen. The WAL op replays byte-identically (idempotent by seq —
+        a retried RPC whose reply was dropped cannot double-apply)."""
+        from petastorm_tpu.service.mixture import validate_weights
+
+        job_id = str(header.get("job_id") or DEFAULT_JOB)
+        try:
+            weights = validate_weights(header.get("weights"))
+        except ValueError as exc:
+            return {"type": "error", "error": str(exc)}
+        effective_epoch = header.get("effective_epoch")
+        fencing_token = header.get("fencing_epoch")
+        request_token = header.get("token")
+        with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            if fencing_token is not None \
+                    and int(fencing_token) < self._job_fencing_locked(
+                        job_id):
+                self._recovery["stale_fencing_rejections"] += 1
+                self._job_recovery_locked(job_id)[
+                    "stale_fencing_rejections"] += 1
+                return {"type": "stale_fencing",
+                        "fencing_epoch": self._job_fencing_locked(job_id)}
+            self._ensure_job_locked(job_id)
+            mixture = self._mixtures.setdefault(
+                job_id, {"seq": 0, "entries": [], "last_token": None})
+            if request_token is not None \
+                    and mixture.get("last_token") == request_token:
+                # Retried RPC whose reply was dropped after the mutation
+                # applied (the dispatcher.reply failpoint's exact case):
+                # answer for the already-journaled entry, do not
+                # double-append.
+                return {"type": "ok", "job_id": job_id,
+                        "seq": mixture["seq"],
+                        "entries": [dict(e) for e in mixture["entries"]],
+                        "fencing_epoch": self._job_fencing_locked(job_id)}
+            seq = mixture["seq"] + 1
+            self._install_mixture_locked(job_id, seq, weights,
+                                         effective_epoch,
+                                         token=request_token)
+            record = {"op": "mixture_weights", "job_id": job_id,
+                      "seq": seq, "weights": weights}
+            if effective_epoch is not None:
+                record["effective_epoch"] = int(effective_epoch)
+            if request_token is not None:
+                record["token"] = request_token
+            self._journal_locked(record)
+            entries = [dict(e) for e in mixture["entries"]]
+            fencing = self._job_fencing_locked(job_id)
+        logger.info(
+            "mixture weights for job %r set to %s (seq %d, effective "
+            "epoch %s)", job_id, weights, seq,
+            effective_epoch if effective_epoch is not None else "next")
+        return {"type": "ok", "job_id": job_id, "seq": seq,
+                "entries": entries, "fencing_epoch": fencing}
+
+    def _handle_get_mixture(self, header):
+        """The job's journaled mixture weight log (read-only)."""
+        job_id = str(header.get("job_id") or DEFAULT_JOB)
+        with self._lock:
+            mixture = self._mixtures.get(job_id, {"seq": 0, "entries": []})
+            return {"type": "mixture", "job_id": job_id,
+                    "seq": mixture["seq"],
+                    "entries": [dict(e) for e in mixture["entries"]],
+                    "fencing_epoch": self._job_fencing_locked(job_id)}
 
     def _handle_worker_heartbeat(self, header):
         worker_id = header["worker_id"]
@@ -1471,23 +1650,36 @@ class Dispatcher:
         return {wid: w for wid, w in self._workers.items()
                 if w["alive"] and w.get("state", "serving") in states}
 
-    def _serving_workers(self):
+    def _serving_workers(self, corpus=None):
         """Workers eligible to receive NEW grants (assignments, steals,
-        fcfs splits): alive and not standby/draining."""
-        return self._alive_workers(("serving",))
+        fcfs splits): alive and not standby/draining. ``corpus``
+        restricts to one corpus's worker group (``None`` = no filter,
+        the legacy single-corpus behavior)."""
+        workers = self._alive_workers(("serving",))
+        if corpus is None:
+            return workers
+        return {wid: w for wid, w in workers.items()
+                if w.get("corpus", "") == corpus}
 
     def _handle_list_workers(self, header):
+        corpus = str(header.get("corpus") or "")
         with self._lock:
             # Serving workers only: standby capacity is invisible to
             # clients until admitted, and a draining worker takes no new
             # fcfs splits (its live streams keep flowing regardless).
+            # The view is ALWAYS corpus-scoped ("" = the default corpus,
+            # which legacy corpus-less workers belong to): in a mixed
+            # fleet a default-corpus fcfs client must not open split
+            # streams to foreign-corpus workers serving a different
+            # dataset's piece indices.
             return {
                 "type": "workers",
                 "workers": {wid: w["address"]
-                            for wid, w in self._serving_workers().items()},
+                            for wid, w
+                            in self._serving_workers(corpus).items()},
                 "mode": self.mode,
                 "num_epochs": self.num_epochs,
-                "num_pieces": self._num_pieces,
+                "num_pieces": self._corpus_pieces.get(corpus),
                 "shuffle_seed": self.shuffle_seed,
                 "fencing_epoch": self._fencing_epoch,
             }
@@ -1511,16 +1703,21 @@ class Dispatcher:
                     f"client_index {client_index} out of range "
                     f"[0, {num_clients})"}
         job_id = str(header.get("job_id") or DEFAULT_JOB)
+        corpus = str(header.get("corpus") or "")
         with self._lock:
             blocked = self._check_writable_locked()
             if blocked is not None:
                 return blocked
-            if self._num_pieces is None:
-                return {"type": "error",
-                        "error": "no workers have registered yet"}
-            alive = self._serving_workers()
+            num_pieces = self._corpus_pieces.get(corpus)
+            if num_pieces is None:
+                return {"type": "error", "error": (
+                    "no workers have registered yet"
+                    + (f" for corpus {corpus!r}" if corpus else ""))}
+            alive = self._serving_workers(corpus)
             if not alive:
-                return {"type": "error", "error": "no live workers"}
+                return {"type": "error", "error": (
+                    "no live workers"
+                    + (f" for corpus {corpus!r}" if corpus else ""))}
             # Partition the ASCENDING piece list (epoch-invariant), then
             # order each worker's share by the epoch's seed-tree keys.
             # Sticky piece→worker assignment is what keeps the workers'
@@ -1530,8 +1727,9 @@ class Dispatcher:
             # client's reorder buffer shallow — the canonical next piece
             # is always at the head of some live stream's remaining work.
             epoch_number = int(header.get("epoch", 0))
-            client_pieces = self._grantable_pieces_locked(list(
-                range(self._num_pieces))[client_index::num_clients])
+            client_pieces = self._grantable_pieces_locked(
+                list(range(num_pieces))[client_index::num_clients],
+                corpus=corpus)
             worker_ids = sorted(alive)
             assignments = {
                 wid: piece_order(self.shuffle_seed, epoch_number, pieces)
@@ -1539,7 +1737,7 @@ class Dispatcher:
                                                    worker_ids).items()}
             self._install_client_locked(
                 header["client_id"], epoch_number, client_index,
-                num_clients, job_id)
+                num_clients, job_id, corpus=corpus)
             self._client_heartbeats[header["client_id"]] = time.monotonic()
             record = {
                 "op": "client", "client_id": header["client_id"],
@@ -1547,6 +1745,8 @@ class Dispatcher:
                 "client_index": client_index, "num_clients": num_clients}
             if job_id != DEFAULT_JOB:
                 record["job_id"] = job_id
+            if corpus:
+                record["corpus"] = corpus
             self._journal_locked(record)
             return {
                 "type": "assignment",
@@ -1562,6 +1762,7 @@ class Dispatcher:
         worker_id = header["worker_id"]
         pieces = [int(p) for p in header.get("pieces", [])]
         token = header.get("fencing_epoch")
+        corpus = str(header.get("corpus") or "")
         with self._lock:
             blocked = self._check_writable_locked()
             if blocked is not None:
@@ -1569,7 +1770,7 @@ class Dispatcher:
             # A quarantined piece must not ride a takeover back into the
             # plan: the reporting client may not have seen the
             # quarantine yet (another client reported it).
-            pieces = self._grantable_pieces_locked(pieces)
+            pieces = self._grantable_pieces_locked(pieces, corpus=corpus)
             job_id = self._client_job_locked(header.get("client_id"),
                                              header)
             if token is not None \
@@ -1605,8 +1806,13 @@ class Dispatcher:
             # Takeover targets must be grantable: a draining worker keeps
             # its live streams but never receives a dead peer's pieces
             # (falling back to draining workers only when nothing else
-            # is left beats failing the epoch outright).
-            alive = self._serving_workers() or self._alive_workers()
+            # is left beats failing the epoch outright). Corpus-scoped:
+            # a dead corpus-A worker's pieces can only move to corpus-A
+            # survivors — a corpus-B worker cannot read its dataset.
+            alive = (self._serving_workers(corpus)
+                     or {wid: w for wid, w
+                         in self._alive_workers().items()
+                         if w.get("corpus", "") == corpus})
             if not alive:
                 return {"type": "error", "error": (
                     f"worker {worker_id!r} reported dead and no live workers "
@@ -1666,8 +1872,9 @@ class Dispatcher:
                         "error": "no workers have registered yet"}
             if self._fcfs_queue is None:
                 self._fcfs_queue = deque(range(self._num_pieces))
-            if self._quarantined \
-                    and len(self._quarantined) >= self._num_pieces:
+            default_quarantined = self._quarantined_default
+            if default_quarantined \
+                    and len(default_quarantined) >= self._num_pieces:
                 # EVERY piece is quarantined (O(1) check — this runs per
                 # split under the global lock): nothing will ever be
                 # grantable again, so end the stream instead of spinning
@@ -1687,7 +1894,7 @@ class Dispatcher:
                     self._fcfs_epoch += 1
                     self._fcfs_queue.extend(range(self._num_pieces))
                 piece = self._fcfs_queue.popleft()
-                if piece not in self._quarantined:
+                if piece not in default_quarantined:
                     break  # quarantined splits are skipped, not granted
             self._journal_locked({"op": "next_split", "piece": piece,
                                   "epoch": self._fcfs_epoch})
@@ -1713,21 +1920,27 @@ class Dispatcher:
                     f"[0, {num_clients})"}
         client_id = header["client_id"]
         job_id = str(header.get("job_id") or DEFAULT_JOB)
+        corpus = str(header.get("corpus") or "")
         with self._lock:
             blocked = self._check_writable_locked()
             if blocked is not None:
                 return blocked
-            if self._num_pieces is None:
-                return {"type": "error",
-                        "error": "no workers have registered yet"}
-            alive = self._serving_workers()
+            num_pieces = self._corpus_pieces.get(corpus)
+            if num_pieces is None:
+                return {"type": "error", "error": (
+                    "no workers have registered yet"
+                    + (f" for corpus {corpus!r}" if corpus else ""))}
+            alive = self._serving_workers(corpus)
             if not alive:
-                return {"type": "error", "error": "no live workers"}
+                return {"type": "error", "error": (
+                    "no live workers"
+                    + (f" for corpus {corpus!r}" if corpus else ""))}
             # Sticky initial deques + per-deque canonical order, like the
             # static path: cache warmth survives shuffled epochs (steals
             # may still move pieces — the shared disk tier covers those).
-            client_pieces = self._grantable_pieces_locked(list(
-                range(self._num_pieces))[client_index::num_clients])
+            client_pieces = self._grantable_pieces_locked(
+                list(range(num_pieces))[client_index::num_clients],
+                corpus=corpus)
             worker_ids = sorted(alive)
             assignments = {
                 wid: piece_order(self.shuffle_seed, epoch, pieces)
@@ -1741,13 +1954,15 @@ class Dispatcher:
             self._install_dynamic_plan_locked(client_id, epoch, owner,
                                               generation)
             self._install_client_locked(client_id, epoch, client_index,
-                                        num_clients, job_id)
+                                        num_clients, job_id, corpus=corpus)
             self._client_heartbeats[client_id] = time.monotonic()
             record = {
                 "op": "client", "client_id": client_id, "epoch": epoch,
                 "client_index": client_index, "num_clients": num_clients}
             if job_id != DEFAULT_JOB:
                 record["job_id"] = job_id
+            if corpus:
+                record["corpus"] = corpus
             self._journal_locked(record)
             self._journal_locked({
                 "op": "dynamic_plan", "client_id": client_id,
@@ -1831,7 +2046,13 @@ class Dispatcher:
                 self._journal_locked({
                     "op": "dynamic_done", "client_id": client_id,
                     "pieces": sorted(fresh_done)})
-            alive = self._alive_workers()
+            # Corpus-scoped rebalancing: a multi-corpus client's steals
+            # may only move pieces among ITS corpus's workers (a peer
+            # corpus's worker cannot read this corpus's dataset).
+            client_corpus = self._clients.get(client_id, {}).get(
+                "corpus", "")
+            alive = {wid: w for wid, w in self._alive_workers().items()
+                     if w.get("corpus", "") == client_corpus}
             # Reconcile: a piece the dispatcher's (journal-restored) state
             # places on a different worker than the client's live view is
             # re-issued as a corrective steal — the client applies it
@@ -1864,7 +2085,7 @@ class Dispatcher:
                       if p not in state["done"]
                       and state["owner"].get(p, (None,))[0] == wid]
                 for wid, pieces in stealable.items() if wid in pending}
-            serving_ids = set(self._serving_workers())
+            serving_ids = set(self._serving_workers(client_corpus))
             moves = []
             draining_ids = sorted(wid for wid in alive
                                   if wid not in serving_ids)
@@ -1985,8 +2206,10 @@ class Dispatcher:
                 # None while healthy; the reason string while the journal
                 # is failing and the dispatcher refuses mutations.
                 "degraded": self._degraded,
-                # Journaled poison-piece quarantine: piece -> report info.
-                "quarantined": {str(p): dict(info) for p, info
+                # Journaled poison-piece quarantine: "piece" (default
+                # corpus) or "corpus:piece" -> report info.
+                "quarantined": {(f"{c}:{p}" if c else str(p)): dict(info)
+                                for (c, p), info
                                 in sorted(self._quarantined.items())},
                 "client_watermarks": {
                     cid: {"epoch": entry["epoch"],
@@ -2039,4 +2262,12 @@ class Dispatcher:
                                    if self._fcfs_queue is not None else None),
                 "dynamic": (self._dynamic_status_locked()
                             if self.mode == "dynamic" else None),
+                # Multi-corpus piece universes and per-job mixture
+                # weight-log heads (seq + the latest weights in force).
+                "corpora": dict(self._corpus_pieces),
+                "mixtures": {
+                    jid: {"seq": m["seq"],
+                          "weights": (dict(m["entries"][-1]["weights"])
+                                      if m["entries"] else None)}
+                    for jid, m in self._mixtures.items()},
             }

@@ -126,6 +126,12 @@ class _PieceCollator:
             return None
         return self._emit(self._pending_rows)
 
+    def flush_all(self):
+        """Piece-boundary drain as a list (the packing collator's tail
+        can legally be several batches; the plain collator's is 0 or 1)."""
+        tail = self.flush()
+        return [] if tail is None else [tail]
+
 
 class StreamingPieceEngine:
     """Serve an edit-able queue of pieces through one reader pipeline.
@@ -168,6 +174,22 @@ class StreamingPieceEngine:
         ``batch_transform`` wrapper here when the stream's placement is
         remote; ``None`` (local placement or no transform) leaves
         batches untouched.
+    :param packer_factory: optional zero-arg callable returning a fresh
+        :class:`~petastorm_tpu.service.packing_stage.StreamPacker` — arms
+        worker-side sequence packing: each cold piece's collated rows are
+        packed BEFORE serialization (and before the cache fill, so warm
+        entries hold packed frames and serve with zero re-pack), the
+        packer is flushed at the piece boundary (packed batches stay
+        piece-aligned; a piece's packed emission is a pure function of
+        its rows), and event ordinals number the PACKED stream — the
+        batch count of a piece is no longer derivable from its row count,
+        which is exactly why the cache entry's own frame index is the
+        authority for warm serves and watermark seeks. One fresh packer
+        per piece: carry-over never crosses a piece boundary worker-side
+        (trainer-side placement carries it instead —
+        ``docs/guides/llm.md#packed-layout``). Composes with
+        ``permute_fn`` (the permutation is over packed batch counts) and
+        ``starts`` re-grants unchanged.
     :param on_piece_error: the poison-piece policy
         (``docs/guides/service.md#failure-model-and-recovery``).
         ``"fail"`` (default): a piece whose decode raises errors the
@@ -186,7 +208,8 @@ class StreamingPieceEngine:
 
     def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
                  cache_note_fn=None, lookahead=2, permute_fn=None,
-                 transform_fn=None, on_piece_error="fail"):
+                 transform_fn=None, on_piece_error="fail",
+                 packer_factory=None):
         if on_piece_error not in ("fail", "quarantine"):
             raise ValueError(
                 "on_piece_error must be 'fail' or 'quarantine', got "
@@ -215,6 +238,13 @@ class StreamingPieceEngine:
         self._cache_note_fn = cache_note_fn
         self._permute = permute_fn
         self._transform = transform_fn
+        self._packer_factory = packer_factory
+        if packer_factory is not None and transform_fn is not None:
+            raise ValueError(
+                "packer_factory and transform_fn cannot combine: the "
+                "batch transform is a row-batch stage and packing "
+                "changes the batch vocabulary — apply the transform "
+                "upstream (transform_spec) or run it trainer-side")
         self._lookahead = max(1, int(lookahead))
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -500,9 +530,20 @@ class StreamingPieceEngine:
                 self._state[piece] = _DECODING
                 self._inflight.add(piece)
                 self._ordinal[piece] = 0  # fresh decode restarts ordinals
-                self._collators[piece] = _PieceCollator(
+                collator = _PieceCollator(
                     self._batch_size, reader.batched_output,
                     getattr(reader, "ngram", None))
+                if self._packer_factory is not None:
+                    from petastorm_tpu.service.packing_stage import (
+                        PackingCollator,
+                    )
+
+                    # One fresh packer per piece: packed batches stay
+                    # piece-aligned and a re-decode of the piece replays
+                    # the identical packed stream (watermark contract).
+                    collator = PackingCollator(collator,
+                                               self._packer_factory())
+                self._collators[piece] = collator
                 self._builders[piece] = (
                     self._cache.begin_fill(self._cache_key_fn(piece))
                     if self._cache is not None else None)
@@ -654,8 +695,7 @@ class StreamingPieceEngine:
             gen = self._gen.get(piece, 0)
         if state not in (_DECODING, _SERVING) or collator is None:
             return  # revoked (or unknown): partial fill discarded, no tail
-        tail = collator.flush()
-        if tail is not None:
+        for tail in collator.flush_all():
             self._emit_batch(piece, gen, tail, builder)
         if builder is not None:
             try:

@@ -191,12 +191,18 @@ class BatchWorker:
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  batch_cache=None, batch_transform=None, standby=False,
-                 on_piece_error="fail"):
+                 on_piece_error="fail", corpus=""):
         if on_piece_error not in ("fail", "quarantine"):
             raise ValueError(
                 "on_piece_error must be 'fail' or 'quarantine', got "
                 f"{on_piece_error!r}")
         self.dataset_url = dataset_url
+        # Multi-corpus fleets: workers serving different datasets under
+        # ONE dispatcher register with distinct corpus names; clients
+        # request per-corpus assignments (docs/guides/llm.md#mixtures).
+        # "" = the default (single-dataset) corpus, bit-for-bit the
+        # legacy protocol.
+        self.corpus = str(corpus or "")
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self._dispatcher_address = (tuple(dispatcher_address)
                                     if dispatcher_address else None)
@@ -396,6 +402,7 @@ class BatchWorker:
             "num_pieces": self.num_pieces,
             "re_register": re_register,
             "standby": self._standby,
+            "corpus": self.corpus,
         }, description=f"worker {self.worker_id} registration",
             retries=retries)
         if reply.get("type") != "ok":
@@ -547,6 +554,37 @@ class BatchWorker:
         keeps at-least-once bookkeeping for that worker."""
         dynamic = bool(header.get("dynamic"))
         tagged = bool(header.get("tagged"))
+        # Worker-placement sequence packing: the stream request names the
+        # spec; pieces are packed pre-serialization (cache entries hold
+        # packed frames; ordinals/watermarks number packed batches).
+        packing = None
+        if header.get("packing") is not None:
+            from petastorm_tpu.service.packing_stage import PackingSpec
+
+            packing = PackingSpec.from_dict(header["packing"])
+            if not (dynamic or tagged) or not self._engine_supported():
+                send_framed(sock, {
+                    "type": "error",
+                    "error": "stream requested packing but this serving "
+                             "path cannot pack: packing runs inside the "
+                             "streaming piece engine (tagged/dynamic "
+                             "protocols, reader_pool_type='thread') — "
+                             "use static or dynamic sharding, or pack "
+                             "trainer-side (packing_placement="
+                             "'trainer')"})
+                return
+            if self._batch_transform is not None \
+                    and header.get("transform_placement") != "local":
+                send_framed(sock, {
+                    "type": "error",
+                    "error": "stream requested packing but this worker "
+                             "has a batch_transform armed remote-side: "
+                             "the transform is a row-batch stage and "
+                             "packing changes the batch vocabulary — "
+                             "run the transform trainer-side "
+                             "(transform_placement='local') or drop "
+                             "--batch-transform"})
+                return
         # Placement-flippable batch transform: "local" tells this worker
         # to SKIP its configured batch_transform — the client applies the
         # identical callable trainer-side (docs/guides/pipeline.md).
@@ -618,13 +656,13 @@ class BatchWorker:
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job)
+                    job=job, packing=packing)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, starts, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job)
+                    job=job, packing=packing)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
@@ -831,7 +869,7 @@ class BatchWorker:
             "reader_pool_type", "thread") in ("thread", "dummy")
 
     def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None,
-                     job=None, allow_quarantine=False):
+                     job=None, allow_quarantine=False, packing=None):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
@@ -863,16 +901,23 @@ class BatchWorker:
 
         cache = self._batch_cache
         transformed = transform_fn is not None
+        packer_factory = None
+        if packing is not None:
+            from petastorm_tpu.service.packing_stage import StreamPacker
+
+            packer_factory = (
+                lambda: StreamPacker(packing, placement="worker"))
         return StreamingPieceEngine(
             build_reader, self._batch_size, cache=cache,
             cache_key_fn=(
                 (lambda piece: self._piece_cache_key(
-                    piece, transformed=transformed))
+                    piece, transformed=transformed, packing=packing))
                 if cache is not None else None),
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit, job=job))
                 if cache is not None else None),
             permute_fn=permute_fn, transform_fn=transform_fn,
+            packer_factory=packer_factory,
             # Quarantine needs a frame vocabulary that can SAY
             # "piece_failed": only the tagged/dynamic protocols have one —
             # a legacy plain/fcfs stream keeps failing loudly.
@@ -914,7 +959,7 @@ class BatchWorker:
     def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
                               tagged=True, shuffle_seed=None,
-                              transform_fn=None, job=None):
+                              transform_fn=None, job=None, packing=None):
         """Exactly-once static serving: piece-aligned batches through the
         streaming engine, every ``batch`` frame tagged with its piece and
         absolute ``ordinal``, every finished piece announced with a
@@ -927,7 +972,8 @@ class BatchWorker:
         markers)."""
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
-                                   job=job, allow_quarantine=tagged)
+                                   job=job, allow_quarantine=tagged,
+                                   packing=packing)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -971,7 +1017,7 @@ class BatchWorker:
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
                         credits, stream_key, epoch=None, shuffle_seed=None,
-                        transform_fn=None, job=None):
+                        transform_fn=None, job=None, packing=None):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -989,7 +1035,8 @@ class BatchWorker:
                 f"{self._reader_kwargs.get('reader_pool_type')!r}")
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
-                                   job=job, allow_quarantine=True)
+                                   job=job, allow_quarantine=True,
+                                   packing=packing)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -1118,7 +1165,7 @@ class BatchWorker:
                              cur_shard=0, shard_count=1,
                              **self._reader_kwargs)
 
-    def _piece_cache_key(self, piece, transformed=False):
+    def _piece_cache_key(self, piece, transformed=False, packing=None):
         from petastorm_tpu.cache_impl import batch_fingerprint
 
         kwargs = self._reader_kwargs
@@ -1145,6 +1192,13 @@ class BatchWorker:
             extra["batch_transform"] = (
                 _transform_identity(self._batch_transform)
                 if transformed else None)
+        if packing is not None:
+            # Packed entries hold a different vocabulary entirely
+            # ([slots, slot_len] frames whose batch count is a function
+            # of the length distribution): key on the full geometry so
+            # they can never serve an unpacked stream — or a different
+            # slot shape — and vice versa.
+            extra["packing"] = packing.key_dict()
         return batch_fingerprint(
             self.dataset_url, [signature], self._batch_size,
             fields=kwargs.get("schema_fields"),

@@ -370,6 +370,55 @@ QUARANTINE_PIECES = REGISTRY.gauge(
     "quarantine set — excluded from every future assignment, plan, "
     "takeover re-partition, and fcfs split until the journal is reset")
 
+# -- sequence packing + mixture sampling (service/packing_stage.py,
+#    service/mixture.py) -------------------------------------------------------
+
+PACKING_BATCHES = REGISTRY.counter(
+    "petastorm_packing_batches_total",
+    "Dense [slots, slot_len] batches emitted by the sequence-packing "
+    "stage, by placement (worker = packed pre-serialization inside the "
+    "streaming engine; trainer = packed client-side)",
+    labels=("placement",))
+PACKING_SEQUENCES = REGISTRY.counter(
+    "petastorm_packing_sequences_total",
+    "Variable-length sequences placed by the packing stage, by placement",
+    labels=("placement",))
+PACKING_TOKENS = REGISTRY.counter(
+    "petastorm_packing_tokens_total",
+    "Real (non-padding) tokens placed by the packing stage, by placement",
+    labels=("placement",))
+PACKING_SECONDS = REGISTRY.histogram(
+    "petastorm_packing_seconds",
+    "Per-row packing cost (first-fit placement + copy), by placement",
+    labels=("placement",))
+PACKING_FILL_RATIO = REGISTRY.gauge(
+    "petastorm_packing_fill_ratio",
+    "Real-token fraction of the most recently emitted packed batch's "
+    "slots x slot_len capacity, by placement (1 - fill = padding waste; "
+    "compare against last_batch='pad' in the llm_packing bench leg)",
+    labels=("placement",))
+MIXTURE_DRAWS = REGISTRY.counter(
+    "petastorm_mixture_draws_total",
+    "Mixture-sampler draws that yielded a batch, by corpus (the served "
+    "mix; compare ratios against the configured weights)",
+    labels=("corpus",))
+MIXTURE_EXHAUSTED = REGISTRY.counter(
+    "petastorm_mixture_exhausted_total",
+    "Corpus-exhaustion events observed by the mixture sampler (the "
+    "exhaustion policy — stop/exhaust/reweight — decides what happens "
+    "next), by corpus",
+    labels=("corpus",))
+MIXTURE_WEIGHT = REGISTRY.gauge(
+    "petastorm_mixture_weight",
+    "The mixture weight currently in force per corpus (moves on "
+    "set_mixture_weights reloads and reweight-policy exhaustions)",
+    labels=("corpus",))
+MIXTURE_WEIGHT_RELOADS = REGISTRY.counter(
+    "petastorm_mixture_weight_reloads_total",
+    "Weight-change events applied by mixture samplers in this process "
+    "(journaled set_mixture_weights entries + reweight-policy "
+    "exhaustions)")
+
 # -- reader / worker pools / ventilator --------------------------------------
 
 READER_READERS = REGISTRY.counter(

@@ -64,6 +64,55 @@ def create_test_dataset(dataset_url, rows_count=30, rows_per_row_group=10,
     return rows
 
 
+#: Ragged-in-Parquet token layout (docs/guides/llm.md#datasets): static
+#: [TOKEN_MAX_LEN] arrays on disk, the true sequence length as data — the
+#: packing stage trims to ``length`` before first-fit placement.
+TOKEN_MAX_LEN = 48
+
+TokenSchema = Unischema("TokenSchema", [
+    UnischemaField("id", np.int64, (), ScalarCodec(), False),
+    UnischemaField("tokens", np.int32, (TOKEN_MAX_LEN,), NdarrayCodec(),
+                   False),
+    UnischemaField("length", np.int32, (), ScalarCodec(), False),
+])
+
+
+def make_token_row(index, max_len=TOKEN_MAX_LEN, skew=2.5):
+    """One deterministic variable-length 'tokenized document': lengths
+    are short-heavy (mean ≈ ``max_len / (1 + skew)`` — many short, few
+    near-max, the padding waste packing exists to eliminate; ``skew=1``
+    is uniform), tokens derived from the index so every byte is
+    reproducible."""
+    rng = np.random.RandomState(977 + index)
+    length = max(1, min(max_len,
+                        int(round(max_len * (1.0 - rng.power(skew))))))
+    tokens = np.zeros(max_len, dtype=np.int32)
+    tokens[:length] = (np.arange(length, dtype=np.int32) * 7919
+                       + index * 31 + 1) % 50000
+    return {"id": index, "tokens": tokens, "length": np.int32(length)}
+
+
+def create_test_token_dataset(dataset_url, rows_count=60,
+                              rows_per_row_group=10, max_len=TOKEN_MAX_LEN,
+                              skew=2.5, **write_kwargs):
+    """Write a petastorm-format variable-length token dataset (the LLM
+    sequence-packing workload's fixture); returns the source rows."""
+    if max_len == TOKEN_MAX_LEN:
+        schema = TokenSchema
+    else:
+        schema = Unischema("TokenSchema", [
+            UnischemaField("id", np.int64, (), ScalarCodec(), False),
+            UnischemaField("tokens", np.int32, (max_len,), NdarrayCodec(),
+                           False),
+            UnischemaField("length", np.int32, (), ScalarCodec(), False),
+        ])
+    rows = [make_token_row(i, max_len=max_len, skew=skew)
+            for i in range(rows_count)]
+    materialize_rows(dataset_url, schema, rows,
+                     rows_per_row_group=rows_per_row_group, **write_kwargs)
+    return rows
+
+
 ScalarSchema = Unischema("ScalarSchema", [
     UnischemaField("id", np.int64, (), None, False),
     UnischemaField("float_col", np.float64, (), None, False),

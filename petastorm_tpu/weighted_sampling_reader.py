@@ -6,7 +6,10 @@ Reference parity: ``petastorm/weighted_sampling_reader.py::WeightedSamplingReade
 
 from __future__ import annotations
 
+import logging
 import random
+
+logger = logging.getLogger(__name__)
 
 
 class WeightedSamplingReader:
@@ -30,6 +33,16 @@ class WeightedSamplingReader:
         for p in probabilities:
             acc += p / total
             self._cum.append(acc)
+        if random_seed is None:
+            # Reference parity keeps the nondeterministic default, but
+            # nothing downstream of it is reproducible or checkpointable
+            # — the service-grade replacement is the seed-tree sampler.
+            logger.warning(
+                "WeightedSamplingReader(random_seed=None) draws from an "
+                "unseeded RNG: the mix is not reproducible or "
+                "resumable. Pass an explicit seed, or use "
+                "petastorm_tpu.service.mixture.MixedBatchSource (seeded, "
+                "checkpointable, hot-reloadable — docs/guides/llm.md)")
         self._random = random.Random(random_seed)
 
         # Mixing requires compatible row types; expose the first reader's

@@ -99,6 +99,7 @@ def _resource_leak_guard(request):
     from petastorm_tpu import failpoints
     from petastorm_tpu.cache_impl import live_cache_dirs
     from petastorm_tpu.service.fleet import open_job_registrations
+    from petastorm_tpu.service.mixture import open_mixture_passes
 
     if request.node.get_closest_marker("allow_resource_leaks"):
         yield
@@ -107,6 +108,7 @@ def _resource_leak_guard(request):
     before_sockets = _open_socket_fds()
     before_cache_dirs = live_cache_dirs()
     before_jobs = open_job_registrations()
+    before_mixture_passes = open_mixture_passes()
     yield
     leaked_schedule = failpoints.ACTIVE
     if leaked_schedule is not None:
@@ -134,9 +136,14 @@ def _resource_leak_guard(request):
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
         leaked_jobs = open_job_registrations() - before_jobs
+        # An abandoned MixedBatchSource pass holds N per-corpus inner
+        # iterators (stream threads, heartbeats, sockets) — the mixture
+        # analogue of an unstopped Reader.
+        leaked_mixture = open_mixture_passes() - before_mixture_passes
         if not leaked_threads and not leaked_pool_threads \
                 and not leaked_sockets and not leaked_cache_dirs \
-                and not leaked_jobs and leaked_schedule is None:
+                and not leaked_jobs and leaked_mixture <= 0 \
+                and leaked_schedule is None:
             return
         if time.monotonic() >= deadline:
             break
@@ -154,6 +161,9 @@ def _resource_leak_guard(request):
         f"cache dirs {sorted(leaked_cache_dirs)}, "
         f"open job registrations {sorted(leaked_jobs)} (a register_job "
         f"without end_job — use fleet.JobHandle), "
+        f"open mixture passes {max(leaked_mixture, 0)} (a "
+        f"MixedBatchSource iterator abandoned without close() — its "
+        f"per-corpus inner sources stay live), "
         f"armed failpoint schedule "
         f"{'yes (now disarmed)' if leaked_schedule is not None else 'no'} "
         f"(use failpoints.armed(...) so the scope always disarms) — "

@@ -571,3 +571,31 @@ def test_decisions_journaled_to_telemetry_and_status_renders():
                "decisions": {}})
     assert "0/credits=16" in text and "1/credits=8" in text
     assert "unreachable" in render_autotune_status(None, None)
+
+
+def test_worker_bound_flips_packing_trainer_when_no_transform():
+    """The packing stage's placement knob is the worker-bound class's
+    lever when no batch transform is armed (docs/guides/llm.md): the
+    planner falls through the absent transform knob and flips packing
+    to the trainer; consumer-bound pushes it back."""
+    knobs = {
+        "credits": {"kind": "int", "lo": 1, "hi": 64,
+                    "applies": "next-stream"},
+        "packing_placement": {"kind": "choice",
+                              "choices": ["worker", "trainer"],
+                              "applies": "next-iteration"},
+    }
+    base = {"credits": 8, "packing_placement": "worker"}
+    planner = Planner(knobs, hysteresis=2, placement_hysteresis=3)
+    decisions = _plan_until_decision(
+        planner, _profile(stall=0.6, recv_stall=0.9, knobs=dict(base)))
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("packing_placement", "flip", "trainer")]
+    assert decisions[0]["applies"] == "next-iteration"
+
+    back = Planner(knobs, hysteresis=2, placement_hysteresis=3)
+    flipped = dict(base, packing_placement="trainer")
+    decisions = _plan_until_decision(
+        back, _profile(stall=0.01, queue_wait=0.5, knobs=flipped))
+    assert [(d["knob"], d["direction"], d["to"]) for d in decisions] == \
+        [("packing_placement", "flip", "worker")]

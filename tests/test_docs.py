@@ -108,6 +108,14 @@ _DETERMINISM_DIRS = ("petastorm_tpu/service", "petastorm_tpu/reader",
                      "petastorm_tpu/reader_impl", "petastorm_tpu/jax_utils",
                      "petastorm_tpu/cache_impl")
 
+#: Single files outside those trees that also feed the training stream.
+#: ``weighted_sampling_reader.py`` is the legacy mixing entry point
+#: (reference parity; ``random_seed=None`` is its own documented
+#: nondeterminism — the service-grade replacement is
+#: ``service/mixture.py``, whose sampler REQUIRES a seed).
+_DETERMINISM_FILES = ("petastorm_tpu/weighted_sampling_reader.py",
+                      "petastorm_tpu/ngram.py")
+
 #: Explicitly-documented nondeterministic spots (file → why). Empty today;
 #: an entry here must cite where the nondeterminism is documented.
 _UNSEEDED_RNG_ALLOWED = {}
@@ -118,15 +126,17 @@ def test_no_unseeded_rng_in_data_path():
     the service/reader/jax_utils trees — a future PR cannot silently
     reintroduce run-to-run nondeterminism into the delivered stream."""
     offenders = []
-    for root in _DETERMINISM_DIRS:
-        for py in sorted((REPO / root).rglob("*.py")):
-            rel = str(py.relative_to(REPO))
-            if rel in _UNSEEDED_RNG_ALLOWED:
-                continue
-            for lineno, line in enumerate(py.read_text().splitlines(), 1):
-                code = line.split("#", 1)[0]
-                if _UNSEEDED_RNG_RE.search(code):
-                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    files = [py for root in _DETERMINISM_DIRS
+             for py in sorted((REPO / root).rglob("*.py"))]
+    files += [REPO / rel for rel in _DETERMINISM_FILES]
+    for py in files:
+        rel = str(py.relative_to(REPO))
+        if rel in _UNSEEDED_RNG_ALLOWED:
+            continue
+        for lineno, line in enumerate(py.read_text().splitlines(), 1):
+            code = line.split("#", 1)[0]
+            if _UNSEEDED_RNG_RE.search(code):
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
     assert not offenders, (
         "unseeded RNG calls in the data path (derive from an explicit "
         "seed — seedtree.fold_in, random.Random(seed), jax.random keys — "

@@ -246,3 +246,175 @@ def test_packed_loader_rejects_row_batching_knobs_and_unagreed_sharding(
             reader, slot_len=8, slots=2, sequence_fields=["tok"],
             sharding=NamedSharding(mesh, P("data")))
     reader.stop(); reader.join()
+
+
+# ---------------------------------------------------------------------------
+# pack_ragged input hygiene (ISSUE 14 satellites)
+# ---------------------------------------------------------------------------
+
+def test_pack_warns_once_on_dropped_fields(caplog):
+    """Non-array/scalar fields are dropped with ONE structured warning
+    naming them — silently losing labels from a training stream is how
+    data bugs ship."""
+    import logging
+
+    rows = [{"tokens": np.arange(3), "label": 7, "weight": 0.5}
+            for _ in range(5)]
+    with caplog.at_level(logging.WARNING,
+                         logger="petastorm_tpu.jax_utils.packing"):
+        list(pack_ragged(iter(rows), slot_len=8, slots=1))
+    drops = [r for r in caplog.records if "dropping non-packed" in r.message
+             or "dropping" in r.getMessage()]
+    assert len(drops) == 1
+    assert "label" in drops[0].getMessage()
+    assert "weight" in drops[0].getMessage()
+
+
+def test_pack_rejects_unknown_explicit_key():
+    """An explicit keys= entry absent from the rows is a configuration
+    error named in the exception, never a silent drop."""
+    rows = [{"tokens": np.arange(3)}]
+    with pytest.raises(ValueError, match="typo_field"):
+        list(pack_ragged(iter(rows), slot_len=8, slots=1,
+                         keys=["typo_field"]))
+
+
+# ---------------------------------------------------------------------------
+# StreamPacker — the service stage's incremental core
+# ---------------------------------------------------------------------------
+
+def _token_rows(lengths, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"tokens": rng.randint(1, 1000, size=n).astype(np.int32)}
+            for n in lengths]
+
+
+def _spec(slot_len=16, slots=2):
+    from petastorm_tpu.service.packing_stage import PackingSpec
+
+    return PackingSpec(slot_len=slot_len, slots=slots,
+                       sequence_fields=["tokens"])
+
+
+def test_stream_packer_matches_pack_ragged_golden():
+    """The incremental packer's emission is bit-identical to the
+    whole-stream generator fed the same rows — one first-fit semantics
+    at every layer."""
+    from petastorm_tpu.service.packing_stage import StreamPacker
+
+    rng = np.random.RandomState(11)
+    rows = _token_rows(list(rng.randint(1, 16, size=60)))
+    packer = StreamPacker(_spec())
+    got = []
+    for row in rows:
+        got.extend(packer.add_row(row))
+    tail = packer.flush()
+    if tail is not None:
+        got.append(tail)
+    want = list(pack_ragged(iter(rows), slot_len=16, slots=2,
+                            keys=["tokens"]))
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert sorted(a) == sorted(b)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key])
+
+
+def test_stream_packer_state_dict_round_trip_bit_exact():
+    """Kill-then-restore mid-pack: a fresh packer restored from
+    state_dict() continues the packed stream bit-exactly — the open
+    (carry-over) batch is real state, not replay."""
+    from petastorm_tpu.service.packing_stage import StreamPacker
+
+    rng = np.random.RandomState(7)
+    rows = _token_rows(list(rng.randint(1, 16, size=40)), seed=7)
+    a = StreamPacker(_spec())
+    for row in rows[:25]:
+        a.add_row(row)
+    snapshot = a.state_dict()
+    assert snapshot["open"] is not None  # mid-pack, carry-over live
+    b = StreamPacker(_spec())
+    b.load_state_dict(snapshot)
+    rest_a, rest_b = [], []
+    for row in rows[25:]:
+        rest_a.extend(a.add_row(row))
+        rest_b.extend(b.add_row(row))
+    rest_a.append(a.flush())
+    rest_b.append(b.flush())
+    assert len(rest_a) == len(rest_b)
+    for x, y in zip(rest_a, rest_b):
+        for key in x:
+            np.testing.assert_array_equal(x[key], y[key])
+
+
+def test_stream_packer_refuses_spec_mismatch_and_torn_state():
+    """A snapshot from a different geometry — or one torn mid-write (the
+    packing.state failpoint) — must be REFUSED at restore, never
+    silently resumed into a corrupted carry-over."""
+    from petastorm_tpu import failpoints
+    from petastorm_tpu.service.packing_stage import (
+        PackingStateError,
+        StreamPacker,
+    )
+
+    packer = StreamPacker(_spec())
+    packer.add_row({"tokens": np.arange(5, dtype=np.int32)})
+    other = StreamPacker(_spec(slot_len=32))
+    with pytest.raises(PackingStateError, match="geometry|runs"):
+        other.load_state_dict(packer.state_dict())
+
+    schedule = failpoints.FaultSchedule(
+        seed=0, points=("packing.state",),
+        fires={"packing.state": {0: "torn"}})
+    with failpoints.armed(schedule):
+        torn = packer.state_dict()
+    assert schedule.log == [("packing.state", 0, "torn")]
+    fresh = StreamPacker(_spec())
+    with pytest.raises(PackingStateError, match="crc|torn"):
+        fresh.load_state_dict(torn)
+    # The untorn snapshot still restores fine after the failpoint scope.
+    fresh.load_state_dict(packer.state_dict())
+    assert fresh.open_sequences == packer.open_sequences
+
+
+def test_stream_packer_packed_batch_through_flash_equals_reference():
+    """The service stage's layout contract: a StreamPacker-packed batch
+    through ops.flash_attention(segment_ids=...) equals per-sequence
+    attention_reference on the unpacked rows — same pin as the
+    pack_ragged parity test, through the NEW stage."""
+    import jax.numpy as jnp
+
+    from petastorm_tpu.models.sequence_model import attention_reference
+    from petastorm_tpu.ops import flash_attention
+    from petastorm_tpu.service.packing_stage import PackingSpec, StreamPacker
+
+    h, d = 2, 8
+    lengths = [11, 5, 16, 9, 7]
+    rng = np.random.RandomState(4)
+    seqs = [rng.randn(n, h * 3 * d).astype(np.float32) for n in lengths]
+    packer = StreamPacker(PackingSpec(slot_len=16, slots=3,
+                                      sequence_fields=["qkv"]))
+    batches = []
+    for s in seqs:
+        batches.extend(packer.add_row({"qkv": s}))
+    tail = packer.flush()
+    if tail is not None:
+        batches.append(tail)
+    (batch,) = batches
+    seg = jnp.asarray(batch[PACK_SEGMENT_KEY])
+    qkv = batch["qkv"].reshape(3, 16, 3, h, d)
+    q, k, v = (jnp.asarray(qkv[:, :, i]) for i in range(3))
+    out = flash_attention(q, k, v, block_q=8, block_k=16, segment_ids=seg)
+    flat = [(b, sid) for b in range(seg.shape[0])
+            for sid in range(int(seg[b].max()) + 1)
+            if (np.asarray(seg[b]) == sid).any()]
+    for i, s in enumerate(seqs):
+        per = s.reshape(1, lengths[i], 3, h, d)
+        pq, pk, pv = (jnp.asarray(per[:, :, j]) for j in range(3))
+        want = attention_reference(pq, pk, pv)
+        b, sid = flat[i]
+        mask = np.asarray(seg[b]) == sid
+        np.testing.assert_allclose(np.asarray(out)[b][mask],
+                                   np.asarray(want)[0],
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"sequence {i}")
