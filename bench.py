@@ -1814,6 +1814,245 @@ def _llm_weight_reload_subleg(tmp, max_len):
         dispatcher.stop()
 
 
+# --------------------------------------------------------------------------
+# REWRITE_AB leg: graph-rewrite autotuning vs PR 10 knob-only autotuning,
+# interleaved A/B on two workloads the rewrites were built for —
+# predicate-heavy (a majority of rows dropped: the hoist-filter rewrite
+# moves the drop below decode) and transform-heavy (a worker-side batch
+# transform serializing the stream thread: the stage-fusion rewrite moves
+# it into the pool task). Each variant runs PASSES loader iterations over
+# one loopback fleet; rewrite flips are next-iteration, so the topology a
+# pass converges to is carried into the next pass's source explicitly and
+# the full decision trail lands in --json-out (docs/guides/pipeline.md
+# #graph-rewrites).
+# --------------------------------------------------------------------------
+
+REWRITE_AB_ROWS = int(os.environ.get("BENCH_REWRITE_AB_ROWS", "360"))
+REWRITE_AB_PASSES = int(os.environ.get("BENCH_REWRITE_AB_PASSES", "4"))
+
+
+def _rewrite_ab_heavy_transform(batch):
+    """A deliberately compute-heavy collated-batch transform (the
+    transform-heavy workload's stage): a few dense float passes over the
+    payload — enough work that WHERE it runs (one serving thread vs the
+    decode pool, vs the trainer) decides throughput. NB on a single-core
+    host fusion can only RELOCATE this work (the win is parallelizing it
+    across pool workers) — the leg reports host_cores so a core-starved
+    tie is readable as such, the same disclosure convention as the
+    multichip_scaling leg."""
+    x = np.asarray(batch["payload"], dtype=np.float32)
+    for _ in range(8):
+        x = np.tanh(x * 1.0009 + 0.0003)
+    out = dict(batch)
+    out["payload"] = x
+    return out
+
+
+def leg_rewrite_ab(_url):
+    import shutil
+    import tempfile
+
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.predicates import ColumnPredicate
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.telemetry.metrics import WORKER_ROWS_SENT
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_selective_dataset,
+    )
+
+    batch = 32
+    autotune_cfg = {
+        # Snappy windows + minimal hysteresis: the leg's passes are short
+        # and the triggers (selectivity, serving-thread share) are strong
+        # signals, not noise — production defaults are far more patient.
+        "interval_s": 0.05, "hysteresis": 1, "placement_hysteresis": 1,
+        "rewrite_hysteresis": 1, "probe_defer": 1, "tolerance": 0.15,
+    }
+    tmp = tempfile.mkdtemp(prefix="bench-rewrite-ab-")
+
+    def run_workload(url, *, predicate, transform, tag):
+        """Interleaved A/B over one fleet: per round, each variant runs
+        one full pass (one epoch) with its own persistent topology —
+        whatever its planner flipped last pass is what this pass's source
+        is constructed with (rewrites apply next-iteration)."""
+        dispatcher = Dispatcher(port=0, mode="static",
+                                num_epochs=1).start()
+        worker = BatchWorker(
+            url, dispatcher_address=dispatcher.address, batch_size=batch,
+            reader_factory="row", batch_transform=transform,
+            worker_id=f"rewrite-ab-{tag}",
+            reader_kwargs={"workers_count": 2}).start()
+        rows_child = WORKER_ROWS_SENT.labels(f"rewrite-ab-{tag}")
+        variants = {
+            "knob_only": {"rewrites": False, "topology": {}},
+            "rewrite": {"rewrites": True, "topology": {}},
+        }
+
+        def run_pass(variant):
+            topology = variant["topology"]
+            # The topology THIS pass runs under (flips land next pass).
+            used = {"stage_fusion": topology.get("stage_fusion", "off")}
+            if predicate is not None:
+                used["filter_placement"] = topology.get(
+                    "filter_placement", "client")
+            if transform is not None:
+                used["transform_placement"] = topology.get(
+                    "transform_placement", "remote")
+            source = ServiceBatchSource(
+                dispatcher.address, transform=transform,
+                predicate=predicate,
+                filter_placement=topology.get("filter_placement",
+                                              "client"),
+                stage_fusion=topology.get("stage_fusion", "off"),
+                **({"transform_placement":
+                    topology.get("transform_placement", "remote")}
+                   if transform is not None else {}))
+            loader = JaxDataLoader(
+                None, batch, batch_source=source, stage_to_device=False,
+                autotune=dict(autotune_cfg,
+                              rewrites=variant["rewrites"]))
+            rows = 0
+            sent_before = rows_child.value
+            t0 = t_first = time.perf_counter()
+            with loader:
+                for b in loader:
+                    if rows == 0:
+                        # Clock from the first batch: stream dial +
+                        # assignment + reader build are per-pass setup,
+                        # not steady-state throughput.
+                        t_first = time.perf_counter()
+                    rows += len(next(iter(b.values())))
+            wall = max(time.perf_counter() - t_first, 1e-9)
+            diag = loader.diagnostics
+            report = loader.autotune.report()
+            variant.setdefault("trail", []).extend(
+                entry for entry in report["trail"] if entry["decisions"])
+            # Carry the converged topology into the next pass's source
+            # (flips are next-iteration by contract).
+            if predicate is not None:
+                topology["filter_placement"] = source.filter_placement
+            topology["stage_fusion"] = source.stage_fusion
+            if transform is not None:
+                topology["transform_placement"] = \
+                    source.transform_placement
+            return {
+                "rows_delivered": rows,
+                "rows_per_s": rows / wall,
+                "worker_rows_sent": rows_child.value - sent_before,
+                "input_stall_pct": diag["input_stall_pct"],
+                "topology": used,
+            }
+
+        try:
+            best = {}
+            skip_warmup = REWRITE_AB_PASSES > 1
+            for round_index in range(REWRITE_AB_PASSES):
+                for name, variant in variants.items():
+                    result = run_pass(variant)
+                    if round_index == 0 and skip_warmup:
+                        continue  # warmup: page cache + jit + topology
+                    if name not in best or result["rows_per_s"] \
+                            > best[name]["rows_per_s"]:
+                        best[name] = result
+            for name, variant in variants.items():
+                best[name]["rewrite_trail"] = variant.get("trail", [])
+            return best
+        finally:
+            worker.stop()
+            dispatcher.stop()
+
+    def decode_ceiling(url):
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.jax_utils.batcher import batch_iterator
+
+        reader = make_reader(url, reader_pool_type="thread",
+                             workers_count=2, num_epochs=1,
+                             shuffle_row_groups=False)
+        n, t0 = 0, time.perf_counter()
+        with reader:
+            for b in batch_iterator(reader, batch, last_batch="keep"):
+                n += len(next(iter(b.values())))
+        return n / (time.perf_counter() - t0)
+
+    try:
+        # Workload 1: predicate-heavy — 3 of every 4 rows dropped, with a
+        # decode-heavy png payload and big row groups, so WHERE the drop
+        # happens (after decode client-side vs below decode worker-side)
+        # is the wall.
+        url_pred = "file://" + tmp + "/selective"
+        create_test_selective_dataset(url_pred, rows_count=REWRITE_AB_ROWS,
+                                      rows_per_row_group=60, keep_every=4,
+                                      payload_shape=(128, 128, 3))
+        ceiling_pred = decode_ceiling(url_pred)
+        pred = run_workload(url_pred,
+                            predicate=ColumnPredicate("keep", "eq", 1),
+                            transform=None, tag="pred")
+        # Workload 2: transform-heavy — a compute-heavy batch transform
+        # armed worker-side over a cheap-decode payload; the fusion
+        # rewrite moves it (plus serialization) off the single serving
+        # thread into the pool tasks.
+        url_tf = "file://" + tmp + "/transform"
+        create_test_selective_dataset(url_tf, rows_count=REWRITE_AB_ROWS,
+                                      rows_per_row_group=60, keep_every=4)
+        ceiling_tf = decode_ceiling(url_tf)
+        tf = run_workload(url_tf, predicate=None,
+                          transform=_rewrite_ab_heavy_transform, tag="tf")
+
+        def ratio(a, b):
+            return round(a / b, 3) if b else None
+
+        pred_gain = ratio(pred["rewrite"]["rows_per_s"],
+                          pred["knob_only"]["rows_per_s"])
+        tf_gain = ratio(tf["rewrite"]["rows_per_s"],
+                        tf["knob_only"]["rows_per_s"])
+        return {
+            "rows_per_workload": REWRITE_AB_ROWS,
+            "passes": REWRITE_AB_PASSES,
+            # Fusion's transform-heavy win is parallelizing the movable
+            # stages across pool workers: on a 1-core host it can only
+            # tie (same work, same core) — disclosed like multichip.
+            "host_cores": os.cpu_count(),
+            # Headline: the predicate-heavy speedup (the acceptance bar).
+            "images_per_sec": round(pred["rewrite"]["rows_per_s"], 1),
+            "predicate_heavy": {
+                "decode_ceiling_rows_per_s": round(ceiling_pred, 1),
+                "rewrite_vs_knob_only_rows_per_s": pred_gain,
+                "knob_only": _rewrite_ab_variant_block(
+                    pred["knob_only"], ceiling_pred),
+                "rewrite": _rewrite_ab_variant_block(
+                    pred["rewrite"], ceiling_pred),
+            },
+            "transform_heavy": {
+                "decode_ceiling_rows_per_s": round(ceiling_tf, 1),
+                "rewrite_vs_knob_only_rows_per_s": tf_gain,
+                "knob_only": _rewrite_ab_variant_block(
+                    tf["knob_only"], ceiling_tf),
+                "rewrite": _rewrite_ab_variant_block(
+                    tf["rewrite"], ceiling_tf),
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _rewrite_ab_variant_block(result, ceiling):
+    """One variant's --json-out block: throughput, stall, ceiling ratio,
+    the topology it converged to, worker-side rows actually shipped
+    (hoisted runs ship only survivors — the 'dropped rows never decoded'
+    evidence), and the rewrite decision trail."""
+    return {
+        "rows_per_s": round(result["rows_per_s"], 1),
+        "rows_delivered": result["rows_delivered"],
+        "worker_rows_sent": result["worker_rows_sent"],
+        "input_stall_pct": result["input_stall_pct"],
+        "pipeline_vs_decode_ceiling": round(
+            result["rows_per_s"] / ceiling, 3) if ceiling else None,
+        "topology": result["topology"],
+        "rewrite_trail": result["rewrite_trail"],
+    }
+
+
 LEGS = {
     "decode_row": leg_decode_row,
     "decode_columnar": leg_decode_columnar,
@@ -1832,13 +2071,14 @@ LEGS = {
     "multichip_child": leg_multichip_child,
     "multichip_scaling": leg_multichip_scaling,
     "llm_packing": leg_llm_packing,
+    "rewrite_ab": leg_rewrite_ab,
 }
 
 # Legs that measure evidence, not throughput: run ONCE outside the
 # best-of-ROUNDS loop (numerics and OOM ceilings are not host-weather).
 ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
                 "multichip_child", "multichip_scaling", "skewed_service",
-                "autotune", "multi_tenant", "llm_packing")
+                "autotune", "multi_tenant", "llm_packing", "rewrite_ab")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner

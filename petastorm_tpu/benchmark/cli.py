@@ -180,6 +180,20 @@ def _scenario_main(argv):
                              "the canonical seed-tree order so the "
                              "delivered stream (and its stream_digest) is "
                              "byte-identical across runs and fleet shapes")
+    parser.add_argument("--predicate", default=None,
+                        help="service scenario: declared row filter as "
+                             "FIELD:OP:VALUE[:MODULUS] (ops eq/ne/lt/le/"
+                             "gt/ge/in/not-in/mod-eq, e.g. "
+                             "sample_index:mod-eq:0:4 keeps every 4th "
+                             "row) — docs/guides/pipeline.md"
+                             "#graph-rewrites")
+    parser.add_argument("--filter-placement", default=None,
+                        dest="filter_placement",
+                        choices=["client", "worker"],
+                        help="service scenario: where --predicate runs — "
+                             "client (mask received batches, baseline) "
+                             "or worker (hoisted below decode: dropped "
+                             "rows never decode)")
     parser.add_argument("--device-stage", default=None,
                         choices=["on", "off"], dest="device_stage",
                         help="image scenario: run the accelerator-side "
@@ -228,6 +242,9 @@ def _scenario_main(argv):
             ("cache_dir", "--cache-dir", args.cache_dir),
             ("shuffle_seed", "--shuffle-seed", args.shuffle_seed),
             ("ordered", "--ordered", args.ordered),
+            ("predicate", "--predicate", args.predicate),
+            ("filter_placement", "--filter-placement",
+             args.filter_placement),
             ("device_stage", "--device-stage", args.device_stage),
             ("device_prefetch", "--device-prefetch",
              args.device_prefetch)):

@@ -573,6 +573,39 @@ def packed_delivery_scenario(dataset_url=None, docs=2_048, max_len=48,
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _parse_predicate(spec):
+    """``FIELD:OP:VALUE[:MODULUS]`` (or a ColumnPredicate / wire dict) →
+    :class:`~petastorm_tpu.predicates.ColumnPredicate`. VALUE parses as
+    int, then float, then string; ``in``/``not-in`` take a
+    comma-separated VALUE list."""
+    if spec is None:
+        return None
+    from petastorm_tpu.predicates import ColumnPredicate
+
+    if isinstance(spec, ColumnPredicate):
+        return spec
+    if isinstance(spec, dict):
+        return ColumnPredicate.from_wire(spec)
+
+    def scalar(text):
+        for cast in (int, float):
+            try:
+                return cast(text)
+            except ValueError:
+                continue
+        return text
+
+    parts = str(spec).split(":")
+    if len(parts) not in (3, 4):
+        raise ValueError(
+            f"--predicate must be FIELD:OP:VALUE[:MODULUS], got {spec!r}")
+    field, op, value = parts[0], parts[1], parts[2]
+    parsed = ([scalar(v) for v in value.split(",")]
+              if op in ("in", "not-in") else scalar(value))
+    modulus = int(parts[3]) if len(parts) == 4 else None
+    return ColumnPredicate(field, op, parsed, modulus=modulus)
+
+
 # ---------------------------------------------------------------------------
 # Scenario: disaggregated data service, loopback (dispatcher + workers +
 # client all on 127.0.0.1 — the serving tier's overhead vs a local reader)
@@ -590,7 +623,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               trace_out=None, epochs=1, cache="off",
                               cache_mem_mb=256.0, cache_dir=None,
                               sharding=None, shuffle_seed=None,
-                              ordered=False):
+                              ordered=False, predicate=None,
+                              filter_placement="client"):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -700,8 +734,22 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     if mode not in ("static", "fcfs", "dynamic"):
         raise ValueError(
             f"sharding must be static|fcfs|dynamic, got {mode!r}")
+    # --predicate FIELD:OP:VALUE[:MODULUS] — a declared row filter
+    # (docs/guides/pipeline.md#graph-rewrites). --filter-placement picks
+    # the topology: "client" masks received batches trainer-side (the
+    # baseline), "worker" hoists the filter below the workers' decode.
+    predicate_obj = _parse_predicate(predicate)
+    if filter_placement not in ("client", "worker"):
+        raise ValueError(
+            f"filter-placement must be client|worker, got "
+            f"{filter_placement!r}")
     chaos_kinds = ([k.strip() for k in chaos.split(",") if k.strip()]
                    if isinstance(chaos, str) else list(chaos or []))
+    if predicate_obj is not None and chaos:
+        raise ValueError(
+            "--predicate cannot combine with --chaos: the chaos delivery "
+            "invariants assert the FULL row multiset, which a row filter "
+            "deliberately thins")
     for kind in chaos_kinds:
         if kind not in CHAOS_KINDS:
             raise ValueError(
@@ -831,6 +879,9 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         source = ServiceBatchSource(
             dispatcher_holder[0].address, credits=credits, ordered=ordered,
             heartbeat_interval_s=0.3 if chaos_kinds else 2.0,
+            predicate=predicate_obj,
+            filter_placement=(filter_placement if predicate_obj is not None
+                              else "client"),
             # Snappy rebalance loop: steal latency is what the dynamic
             # skew leg measures, and the sync RPC is a tiny control
             # message (drained workers poke the loop anyway). Every 50 ms
@@ -1000,6 +1051,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             "shuffle_seed": shuffle_seed,
             "ordered": ordered,
             "stream_digest": digest.hexdigest(),
+            # Declared row filter in force (None when unfiltered):
+            # placement + the delivered row count make selectivity and
+            # hoist economics readable from the json line.
+            "filter": ({"predicate": predicate_obj.to_wire(),
+                        "placement": filter_placement,
+                        "rows_delivered": served_rows}
+                       if predicate_obj is not None else None),
             "duplicates_dropped":
                 source_diag["recovery"]["duplicates_dropped"],
             "epochs_detail": epochs_detail,

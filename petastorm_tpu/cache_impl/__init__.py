@@ -29,12 +29,16 @@ from __future__ import annotations
 import threading
 
 from petastorm_tpu.cache_impl.batch_cache import BatchCache, CacheConfig
-from petastorm_tpu.cache_impl.fingerprint import batch_fingerprint
+from petastorm_tpu.cache_impl.fingerprint import (
+    batch_fingerprint,
+    predicate_ingredient,
+)
 
 __all__ = [
     "BatchCache",
     "CacheConfig",
     "batch_fingerprint",
+    "predicate_ingredient",
     "register_cache_dir",
     "deregister_cache_dir",
     "live_cache_dirs",

@@ -82,6 +82,24 @@ def _canonical(value):
     return repr(value)
 
 
+def predicate_ingredient(predicate):
+    """Canonical key ingredient for a row predicate.
+
+    Wire-form predicates (:class:`~petastorm_tpu.predicates.ColumnPredicate`
+    — anything with ``to_wire``) canonicalize to their wire dict, which is
+    stable across processes and restarts: the filter-hoisting rewrite
+    ships the predicate on stream requests, and a hoisted stream's warm
+    disk-tier entries must stay warm after a worker restart. Arbitrary
+    predicates fall back to ``repr`` (the seed-parity convention — their
+    reprs are already required to be deterministic)."""
+    if predicate is None:
+        return None
+    to_wire = getattr(predicate, "to_wire", None)
+    if callable(to_wire):
+        return to_wire()
+    return repr(predicate)
+
+
 def batch_fingerprint(dataset_url, pieces, batch_size, fields=None,
                       transform=None, factory=None, extra=None):
     """Hex digest keying a cached batch sequence.

@@ -427,7 +427,8 @@ class JaxDataLoader:
         elif isinstance(autotune, dict):
             allowed = {"interval_s", "bounds", "hysteresis",
                        "placement_hysteresis", "tolerance", "probe_defer",
-                       "classify_kwargs"}
+                       "classify_kwargs", "rewrite_hysteresis", "rewrites",
+                       "rewrite_thresholds"}
             unknown = set(autotune) - allowed
             if unknown:
                 # A misspelled key would otherwise silently fall back to
@@ -440,7 +441,8 @@ class JaxDataLoader:
             raise ValueError(
                 "autotune must be None, True, or a config dict "
                 "(interval_s/bounds/hysteresis/placement_hysteresis/"
-                "tolerance/probe_defer/classify_kwargs)")
+                "tolerance/probe_defer/classify_kwargs/"
+                "rewrite_hysteresis/rewrites/rewrite_thresholds)")
         self.autotune = None  # the AutotuneController once armed
 
     # -- diagnostics (derived from the metrics registry) -------------------
@@ -604,7 +606,14 @@ class JaxDataLoader:
                 placement_hysteresis=cfg.get("placement_hysteresis", 4),
                 tolerance=cfg.get("tolerance", 0.05),
                 probe_defer=cfg.get("probe_defer", 3),
-                classify_kwargs=cfg.get("classify_kwargs"))
+                classify_kwargs=cfg.get("classify_kwargs"),
+                # Graph rewrites (docs/guides/pipeline.md#graph-rewrites):
+                # on by default — triggers gate them, so knob-only
+                # workloads never probe one; rewrites=False pins the
+                # PR 10 knob-only action space.
+                rewrite_hysteresis=cfg.get("rewrite_hysteresis", 6),
+                rewrites=cfg.get("rewrites", True),
+                rewrite_thresholds=cfg.get("rewrite_thresholds"))
             self.autotune = AutotuneController(
                 graph, interval_s=cfg.get("interval_s", 0.5),
                 planner=planner)

@@ -34,10 +34,16 @@ import itertools
 import threading
 import time
 
+from petastorm_tpu.pipeline.rewrites import (
+    REWRITE_KINDS,
+    rewrite_triggered,
+)
 from petastorm_tpu.telemetry.metrics import (
     AUTOTUNE_DECISIONS,
     AUTOTUNE_KNOB_VALUE,
     AUTOTUNE_ROUNDS,
+    REWRITE_ACTIVE,
+    REWRITE_DECISIONS,
 )
 
 #: Bottleneck classes → the ordered knob candidates that attack them.
@@ -46,14 +52,27 @@ from petastorm_tpu.telemetry.metrics import (
 #: stage to the trainer, consumer-bound ones push it back to the
 #: workers. Absent knobs — no transform armed, no packing wrapper — are
 #: skipped, so each class falls through to its next lever.)
+#:
+#: Rewrite knobs (``stage_fusion`` / ``filter_placement`` /
+#: ``cache_placement`` — ``pipeline/rewrites.py``) come FIRST in the
+#: classes whose wall they attack structurally: they change the topology
+#: instead of rebalancing around it, so when their trigger economics fire
+#: they are the primary lever. Untriggered rewrites are skipped outright
+#: (the class falls through to its capacity knobs — knob-only workloads
+#: never pay a rewrite probe).
 _CLASS_KNOBS = {
-    "decode-bound": ("workers_count", "host_prefetch"),
+    "decode-bound": ("filter_placement:worker", "stage_fusion:fused",
+                     "cache_placement:post-decode",
+                     "workers_count", "host_prefetch"),
     "dispatch-bound": ("device_prefetch", "host_prefetch"),
     "credit-bound": ("credits", "ready_queue_depth"),
-    "worker-bound": ("transform_placement:local",
+    "worker-bound": ("filter_placement:worker", "stage_fusion:fused",
+                     "cache_placement:post-decode",
+                     "transform_placement:local",
                      "packing_placement:trainer", "credits"),
     "consumer-bound": ("transform_placement:remote",
-                       "packing_placement:worker"),
+                       "packing_placement:worker",
+                       "cache_placement:post-transform"),
     "balanced": (),
     "idle": (),
 }
@@ -140,11 +159,23 @@ class Planner:
     """
 
     def __init__(self, knobs, hysteresis=2, placement_hysteresis=4,
-                 tolerance=0.05, probe_defer=3, classify_kwargs=None):
+                 tolerance=0.05, probe_defer=3, classify_kwargs=None,
+                 rewrite_hysteresis=6, rewrites=True,
+                 rewrite_thresholds=None):
         self.knobs = dict(knobs)
         self.hysteresis = max(1, int(hysteresis))
         self.placement_hysteresis = max(self.hysteresis,
                                         int(placement_hysteresis))
+        #: Rewrites change the topology, not a buffer depth: they wait out
+        #: the LONGEST hysteresis before the first probe (and their
+        #: trigger economics must hold through it).
+        self.rewrite_hysteresis = max(self.placement_hysteresis,
+                                      int(rewrite_hysteresis))
+        #: ``rewrites=False`` = knob-only planning (the PR 10 action
+        #: space): every rewrite candidate is skipped as if untriggered —
+        #: the bench's A/B control arm.
+        self.rewrites_enabled = bool(rewrites)
+        self.rewrite_thresholds = dict(rewrite_thresholds or {})
         self.tolerance = float(tolerance)
         self.probe_defer = max(0, int(probe_defer))
         self._classify_kwargs = dict(classify_kwargs or {})
@@ -167,9 +198,13 @@ class Planner:
         return (profile.get("rows") or 0) / wall if wall > 0 else 0.0
 
     def _decision(self, knob, direction, prev, target, reason):
-        return {"round": self._round, "knob": knob, "direction": direction,
-                "from": prev, "to": target, "reason": reason,
-                "applies": self.knobs[knob].get("applies", "live")}
+        out = {"round": self._round, "knob": knob, "direction": direction,
+               "from": prev, "to": target, "reason": reason,
+               "applies": self.knobs[knob].get("applies", "live")}
+        rewrite = self.knobs[knob].get("rewrite")
+        if rewrite:
+            out["rewrite"] = rewrite
+        return out
 
     def _next_value(self, name, current):
         """The next hill-climb step for an int knob: geometric (double up,
@@ -229,6 +264,10 @@ class Planner:
                 state["settled"] = True
                 direction = ("flip" if self.knobs[name]["kind"] == "choice"
                              else "revert")
+                if self.knobs[name].get("rewrite"):
+                    # Rewrite rollbacks are journaled as reverts — the
+                    # topology returned to baseline, not "another flip".
+                    direction = "revert"
                 decisions.append(self._decision(
                     name, direction, current, probe["prev"],
                     f"probe regressed throughput {ratio:.2f}x"))
@@ -273,9 +312,36 @@ class Planner:
             current = profile["knobs"].get(name)
             if current is None:
                 continue
+            rewrite = desc.get("rewrite")
             if desc["kind"] == "choice":
                 if current == want:
                     continue
+                if rewrite is not None:
+                    # Graph rewrite: gated on its trigger economics. An
+                    # untriggered (or disabled) rewrite falls through to
+                    # the class's next lever — no wasted probe; a
+                    # TRIGGERED one is the primary lever and holds the
+                    # class until its (longest) hysteresis matures.
+                    if not self.rewrites_enabled:
+                        continue
+                    triggered, why = rewrite_triggered(
+                        rewrite, want, profile,
+                        self.rewrite_thresholds)
+                    if not triggered:
+                        continue
+                    if self._streak < self.rewrite_hysteresis:
+                        self.last_outcome = "noop"
+                        return decisions
+                    decisions.append(self._decision(
+                        name, "flip", current, want, f"{cls}: {why}"))
+                    self._probe = {
+                        "knob": name, "prev": current,
+                        "baseline_rows_s": self._throughput(profile),
+                        "wait": (0 if desc.get("applies",
+                                               "live") == "live"
+                                 else self.probe_defer)}
+                    self.last_outcome = "applied"
+                    return decisions
                 if self._streak < self.placement_hysteresis:
                     # A placement flip is pending but its (longer)
                     # hysteresis has not matured: HOLD rather than fall
@@ -314,6 +380,8 @@ def _release_controller_gauges(controller_id, knob_names):
     counters and stay — Prometheus-idiomatic for counters)."""
     for name in knob_names:
         AUTOTUNE_KNOB_VALUE.remove(controller_id, name)
+    for kind in REWRITE_KINDS:
+        REWRITE_ACTIVE.remove(controller_id, kind)
 
 #: Thread-name prefix the conftest leak guard recognizes: an orphaned
 #: controller thread means an autotuned loader was never stopped.
@@ -424,7 +492,10 @@ class AutotuneController:
                    "knobs": dict(cur["knobs"])}
         for name in ("rows", "stall_s", "queue_wait_s", "decode_s",
                      "dispatch_s", "consumer_s", "recv_stall_s",
-                     "credit_wait_s"):
+                     "credit_wait_s", "worker_decode_s", "handoff_s",
+                     "transform_s", "cache_hits", "cache_misses",
+                     "cache_evictions", "filter_rows_in",
+                     "filter_rows_kept"):
             cur_v = cur["signals"].get(name)
             if cur_v is None:
                 continue
@@ -457,6 +528,18 @@ class AutotuneController:
                                           decision["direction"]).inc()
                 AUTOTUNE_KNOB_VALUE.labels(self._id, decision["knob"]).set(
                     _gauge_value(target))
+                rewrite = decision.get("rewrite")
+                if rewrite:
+                    # Rewrites journal twice: in the shared autotune
+                    # counter above AND in the rewrite-specific family
+                    # (with an in-force gauge), so "what topology is this
+                    # pipeline running" is one scrape away.
+                    REWRITE_DECISIONS.labels(
+                        rewrite, decision["direction"]).inc()
+                    REWRITE_ACTIVE.labels(self._id, rewrite).set(
+                        1.0 if target
+                        == REWRITE_KINDS[rewrite]["applied_value"]
+                        else 0.0)
                 applied.append(decision)
             outcome = self.planner.last_outcome or "noop"
             AUTOTUNE_ROUNDS.labels(outcome).inc()
@@ -503,12 +586,14 @@ class AutotuneController:
 
 
 def _gauge_value(value):
-    """Knob value → gauge float (transform_placement: 0 remote, 1 local;
-    packing_placement: 0 worker, 1 trainer — in both conventions 0 is
-    the service side, 1 the trainer host)."""
-    if value in ("remote", "worker"):
+    """Knob value → gauge float. Placement knobs render 0 = the service
+    side, 1 = the trainer host (transform: remote/local; packing:
+    worker/trainer; filter: worker/client). Rewrite topology knobs render
+    0 = baseline, 1 = rewrite in force (stage_fusion: off/fused;
+    cache_placement: post-transform/post-decode)."""
+    if value in ("remote", "worker", "off", "post-transform"):
         return 0.0
-    if value in ("local", "trainer"):
+    if value in ("local", "trainer", "client", "fused", "post-decode"):
         return 1.0
     try:
         return float(value)

@@ -59,7 +59,7 @@ class StageNode:
     """
 
     def __init__(self, name, side, placement, metric=None, flippable=False,
-                 description="", placement_fn=None):
+                 description="", placement_fn=None, fuse_group=None):
         if side not in ("worker", "client"):
             raise ValueError(f"side must be worker|client, got {side!r}")
         if placement not in PLACEMENTS:
@@ -71,6 +71,13 @@ class StageNode:
         self.metric = metric
         self.flippable = flippable
         self.description = description
+        #: Fuse metadata (the stage-fusion rewrite): the ordered stage
+        #: names this node collapses into ONE pool task with when
+        #: ``stage_fusion`` flips to ``fused`` — the graph keeps the
+        #: individual nodes (cost stays attributed per constituent stage
+        #: via the fused-stage telemetry), the metadata records the fusion
+        #: group they execute as.
+        self.fuse_group = tuple(fuse_group) if fuse_group else None
         #: Flippable stages read their placement live (a
         #: transform_placement flip must show in the next snapshot, not
         #: the build-time value forever).
@@ -114,7 +121,7 @@ class Knob:
     """
 
     def __init__(self, name, get, set, lo=None, hi=None, kind="int",
-                 choices=None, applies="live"):
+                 choices=None, applies="live", rewrite=None):
         if kind not in ("int", "choice"):
             raise ValueError(f"kind must be int|choice, got {kind!r}")
         if kind == "choice" and not choices:
@@ -129,6 +136,10 @@ class Knob:
         self.kind = kind
         self.choices = tuple(choices) if choices else None
         self.applies = applies
+        #: Rewrite-kind tag (``pipeline/rewrites.py``): names the graph
+        #: rewrite this knob applies. The planner gates tagged knobs on
+        #: their trigger economics and the longer ``rewrite_hysteresis``.
+        self.rewrite = rewrite
 
     def clamp(self, value):
         if self.kind == "choice":
@@ -143,6 +154,8 @@ class Knob:
         else:
             out["lo"] = self.lo
             out["hi"] = self.hi
+        if self.rewrite:
+            out["rewrite"] = self.rewrite
         return out
 
 
@@ -198,6 +211,8 @@ class PipelineGraph:
             stages[name] = {"side": side, "placement": node.placement,
                             "count": int(count),
                             "seconds": float(seconds)}
+            if node.fuse_group:
+                stages[name]["fuse_group"] = list(node.fuse_group)
         signals = {}
         for name, fn in self._signals.items():
             try:
@@ -217,6 +232,8 @@ class PipelineGraph:
             "stages": [{"name": node.name, "side": node.side,
                         "placement": node.placement,
                         "flippable": node.flippable,
+                        "fuse_group": (list(node.fuse_group)
+                                       if node.fuse_group else None),
                         "description": node.description}
                        for node in self.nodes.values()],
             "edges": list(self.edges),
@@ -315,6 +332,7 @@ def build_loader_graph(loader, bounds=None):
                          f"{packing_spec['slot_len']}] + segment ids")))
     nodes.append(StageNode(
         "serialize", "worker", worker_placement,
+        metric=(_fused_stage_metric("serialize") if remote else None),
         description="batch → wire frames (service path only)"))
     nodes.append(StageNode(
         "send", "worker", worker_placement,
@@ -327,6 +345,25 @@ def build_loader_graph(loader, bounds=None):
         edges += [("read", "decode"), ("decode", "transform"),
                   ("transform", "collate"), ("collate", "serialize"),
                   ("serialize", "send")]
+    if remote:
+        # Fuse metadata (stage-fusion rewrite): these worker-side stages
+        # collapse into ONE pool task per piece when stage_fusion flips to
+        # "fused". The nodes stay — collate/serialize read their fused
+        # cost from the fused-stage telemetry (per-constituent
+        # attribution), and the metadata names the group they execute as.
+        group = ("decode", "transform", "collate", "serialize") \
+            if packing_spec is None \
+            else ("decode", "transform", "collate", "pack", "serialize")
+        for node in nodes:
+            if node.side == "worker" and node.name in group:
+                node.fuse_group = group
+                # Collate reads the fused task's "collate" segment (which
+                # includes the packing wrapper's work when worker-placed
+                # packing is fused — the pack node's own _packing_metric
+                # stays the precise packing measurement); serialize was
+                # wired above.
+                if node.metric is None and node.name == "collate":
+                    node.metric = _fused_stage_metric("collate")
 
     # -- client side: recv → queue → raw_stage/device_decode → device_put
     #    → consume
@@ -425,6 +462,41 @@ def build_loader_graph(loader, bounds=None):
             set=source.set_packing_placement,
             kind="choice", choices=("worker", "trainer"),
             applies="next-iteration"))
+    # -- graph-rewrite knobs (pipeline/rewrites.py): choice knobs tagged
+    #    with their rewrite kind, so the planner gates them on trigger
+    #    economics and the longer rewrite_hysteresis. Never bound on an
+    #    fcfs-mode source: rewrites run inside the streaming engine
+    #    (tagged/dynamic protocols), so an automated flip there would
+    #    crash the next iteration instead of probing — the graph is built
+    #    after the source's first __call__, so the mode is known.
+    rewritable = remote and getattr(source, "_mode", None) != "fcfs"
+    if rewritable and hasattr(source, "set_stage_fusion"):
+        knobs.append(Knob(
+            "stage_fusion",
+            get=lambda: source.stage_fusion,
+            set=source.set_stage_fusion,
+            kind="choice", choices=("off", "fused"),
+            applies="next-iteration", rewrite="fuse_worker_stages"))
+    if rewritable and getattr(source, "_predicate", None) is not None \
+            and hasattr(source, "set_filter_placement") \
+            and getattr(source, "transform", None) is None:
+        # With a transform armed the filter is PINNED hoisted (a
+        # client-placed filter would see post-transform batches) — no
+        # flippable placement, so no knob to bind.
+        knobs.append(Knob(
+            "filter_placement",
+            get=lambda: source.filter_placement,
+            set=source.set_filter_placement,
+            kind="choice", choices=("client", "worker"),
+            applies="next-iteration", rewrite="hoist_filter"))
+    if rewritable and getattr(source, "transform", None) is not None \
+            and hasattr(source, "set_cache_placement"):
+        knobs.append(Knob(
+            "cache_placement",
+            get=lambda: source.cache_placement,
+            set=source.set_cache_placement,
+            kind="choice", choices=("post-transform", "post-decode"),
+            applies="next-iteration", rewrite="cache_placement"))
 
     signals = {
         "rows": lambda: loader._m_rows.value,
@@ -439,7 +511,78 @@ def build_loader_graph(loader, bounds=None):
     if remote:
         signals["recv_stall_s"] = lambda: _source_recv_stall(source)
         signals["credit_wait_s"] = _process_credit_wait
+        # Rewrite-trigger signals (pipeline/rewrites.py). The worker-side
+        # ones are process-local series — populated in loopback/
+        # in-process deployments (the bench scenario, tests); a remote
+        # fleet's series are not visible here and the untriggerable
+        # rewrites simply never probe.
+        signals["worker_decode_s"] = _process_worker_decode
+        signals["handoff_s"] = _process_handoff
+        signals["transform_s"] = lambda: _transform_metric()[1]
+        signals["cache_hits"] = lambda: _process_cache_counter("hits")
+        signals["cache_misses"] = lambda: _process_cache_counter("misses")
+        signals["cache_evictions"] = \
+            lambda: _process_cache_counter("evictions")
+        signals["filter_rows_in"] = lambda: _client_filter_rows("in")
+        signals["filter_rows_kept"] = lambda: _client_filter_rows("kept")
     return PipelineGraph(nodes, edges, knobs=knobs, signals=signals)
+
+
+def _fused_stage_metric(stage):
+    """Node metric fed from the fused-task per-constituent counters
+    (``petastorm_service_worker_fused_stage_seconds_total{stage}``) —
+    visible in-process (loopback deployments); zero while unfused or
+    remote."""
+
+    def measure():
+        from petastorm_tpu.telemetry.metrics import (
+            WORKER_FUSED_STAGE_SECONDS,
+        )
+
+        child = WORKER_FUSED_STAGE_SECONDS.children().get((stage,))
+        return (0, float(child.value) if child is not None else 0.0)
+
+    return measure
+
+
+def _process_worker_decode():
+    """Cumulative worker decode seconds visible in THIS process's
+    registry (loopback/in-process deployments) — the stage-work
+    denominator of the fusion trigger."""
+    from petastorm_tpu.telemetry.metrics import WORKER_DECODE_SECONDS
+
+    return float(sum(child.sum
+                     for child in WORKER_DECODE_SECONDS.children().values()))
+
+
+def _process_handoff():
+    """Cumulative stream-thread hand-off seconds (collation +
+    serialization of pool outputs) across in-process workers — the cost
+    the stage-fusion rewrite eliminates."""
+    from petastorm_tpu.telemetry.metrics import WORKER_HANDOFF_SECONDS
+
+    return float(sum(child.value
+                     for child in WORKER_HANDOFF_SECONDS.children().values()))
+
+
+def _process_cache_counter(which):
+    """Tier-summed batch-cache counters visible in this process — the
+    cache-placement rewrite's hit-economics signals."""
+    from petastorm_tpu.telemetry.metrics import (
+        CACHE_EVICTIONS,
+        CACHE_HITS,
+        CACHE_MISSES,
+    )
+
+    family = {"hits": CACHE_HITS, "misses": CACHE_MISSES,
+              "evictions": CACHE_EVICTIONS}[which]
+    return float(sum(child.value for child in family.children().values()))
+
+
+def _client_filter_rows(outcome):
+    from petastorm_tpu.telemetry.metrics import CLIENT_FILTER_ROWS
+
+    return float(CLIENT_FILTER_ROWS.labels(outcome).value)
 
 
 def _has_transform(source):

@@ -123,6 +123,173 @@ def _stable_repr(obj):
                 f"unpicklable:{id(obj)}:{_PROCESS_SALT}>")
 
 
+#: The declarative comparison vocabulary of :class:`ColumnPredicate` —
+#: every op has a scalar form (``do_include``), a numpy columnar form
+#: (``do_include_vectorized``), and a pyarrow-compute form (``pa_mask``),
+#: all three bit-equivalent on scalar columns.
+COLUMN_PREDICATE_OPS = ("eq", "ne", "lt", "le", "gt", "ge", "in", "not-in",
+                        "mod-eq")
+
+
+class ColumnPredicate(PredicateBase):
+    """A declarative single-column row filter that can cross the wire.
+
+    Unlike the ``in_lambda``-family predicates (arbitrary Python — only
+    usable in the process that constructed them), a ``ColumnPredicate`` is
+    pure data: ``(field, op, value[, modulus])``. That is what lets the
+    service client ship it on a **stream request** so the filter runs
+    worker-side *below decode* (the filter-hoisting graph rewrite —
+    ``docs/guides/pipeline.md#graph-rewrites``) and what lets cache
+    fingerprints sign it canonically (:meth:`to_wire` is the key
+    ingredient, stable across processes — no reprs of live objects).
+
+    Ops (see :data:`COLUMN_PREDICATE_OPS`): the six comparisons, ``in`` /
+    ``not-in`` (membership in ``value``, a list), and ``mod-eq`` — keep
+    rows where ``field % modulus == value`` (the selectivity-dial used by
+    predicate-heavy benchmarks and tests).
+
+    All three evaluation forms are provided: per-row ``do_include``,
+    columnar ``do_include_vectorized`` (numpy), and ``pa_mask`` (pyarrow
+    compute on the raw Arrow table — what the two-phase predicate read
+    uses to mask a row group without materializing dropped rows). They
+    operate on **stored scalar values**: the reader only takes the
+    column-level fast path for scalar-codec fields, where stored and
+    decoded values compare identically.
+    """
+
+    def __init__(self, field, op, value, modulus=None):
+        if op not in COLUMN_PREDICATE_OPS:
+            raise ValueError(
+                f"op must be one of {COLUMN_PREDICATE_OPS}, got {op!r}")
+        if op == "mod-eq":
+            if modulus is None or int(modulus) <= 0:
+                raise ValueError("op='mod-eq' needs a positive modulus")
+            modulus = int(modulus)
+        elif modulus is not None:
+            raise ValueError(f"modulus only applies to op='mod-eq', "
+                             f"not {op!r}")
+        if op in ("in", "not-in"):
+            value = list(value)
+        self._field = str(field)
+        self._op = op
+        self._value = value
+        self._modulus = modulus
+
+    # -- the PredicateBase contract ---------------------------------------
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        v = values[self._field]
+        op, want = self._op, self._value
+        if op == "eq":
+            return v == want
+        if op == "ne":
+            return v != want
+        if op == "lt":
+            return v < want
+        if op == "le":
+            return v <= want
+        if op == "gt":
+            return v > want
+        if op == "ge":
+            return v >= want
+        if op == "in":
+            return v in want
+        if op == "not-in":
+            return v not in want
+        return v % self._modulus == want  # mod-eq
+
+    def do_include_vectorized(self, columns, num_rows):
+        import numpy as np
+
+        column = np.asarray(columns[self._field])
+        op, want = self._op, self._value
+        if op == "eq":
+            return column == want
+        if op == "ne":
+            return column != want
+        if op == "lt":
+            return column < want
+        if op == "le":
+            return column <= want
+        if op == "gt":
+            return column > want
+        if op == "ge":
+            return column >= want
+        if op in ("in", "not-in"):
+            mask = np.isin(column, np.asarray(want))
+            return ~mask if op == "not-in" else mask
+        return column % self._modulus == want  # mod-eq
+
+    # -- the column-level (pyarrow compute) form ---------------------------
+
+    def pa_mask(self, table):
+        """Boolean keep-mask over ``table`` (which holds this predicate's
+        column), computed with pyarrow compute kernels — no Python-object
+        materialization of any row. The two-phase predicate read uses this
+        to filter BOTH column reads down to survivors before ``to_pylist``
+        (dropped rows never decode, never materialize)."""
+        import numpy as np
+        import pyarrow.compute as pc
+
+        column = table.column(self._field)
+        op, want = self._op, self._value
+        if op == "eq":
+            mask = pc.equal(column, want)
+        elif op == "ne":
+            mask = pc.not_equal(column, want)
+        elif op == "lt":
+            mask = pc.less(column, want)
+        elif op == "le":
+            mask = pc.less_equal(column, want)
+        elif op == "gt":
+            mask = pc.greater(column, want)
+        elif op == "ge":
+            mask = pc.greater_equal(column, want)
+        elif op in ("in", "not-in"):
+            import pyarrow as pa
+
+            mask = pc.is_in(column, value_set=pa.array(want))
+            if op == "not-in":
+                mask = pc.invert(mask)
+        else:  # mod-eq: modulo has no stable pc kernel name across
+            # pyarrow versions — evaluate in numpy, same result.
+            values = np.asarray(column.to_numpy(zero_copy_only=False))
+            return np.asarray(values % self._modulus == want)
+        # Null storage values compare to null; a filter mask must be
+        # definite — nulls drop, matching the row path's False.
+        return np.asarray(mask.combine_chunks().to_numpy(
+            zero_copy_only=False) if hasattr(mask, "combine_chunks")
+            else mask.to_numpy(zero_copy_only=False)) == True  # noqa: E712
+
+    # -- wire form (stream requests, cache-key ingredient) -----------------
+
+    def to_wire(self):
+        """JSON-safe canonical dict — the stream-request field and the
+        cache-fingerprint ingredient (stable across processes)."""
+        out = {"field": self._field, "op": self._op, "value": self._value}
+        if self._modulus is not None:
+            out["modulus"] = self._modulus
+        return out
+
+    @classmethod
+    def from_wire(cls, wire):
+        """Reconstruct from :meth:`to_wire` output (validates shape)."""
+        if not isinstance(wire, dict) or "field" not in wire \
+                or "op" not in wire:
+            raise ValueError(
+                f"ColumnPredicate wire form must be a dict with "
+                f"field/op/value, got {wire!r}")
+        return cls(wire["field"], wire["op"], wire.get("value"),
+                   modulus=wire.get("modulus"))
+
+    def __repr__(self):
+        return (f"ColumnPredicate({self._field!r}, {self._op!r}, "
+                f"{self._value!r}, modulus={self._modulus!r})")
+
+
 class in_set(PredicateBase):
     """Keep rows whose ``predicate_field`` value is in ``inclusion_values``."""
 

@@ -16,7 +16,11 @@ from __future__ import annotations
 
 from collections import deque
 
-from petastorm_tpu.reader_impl.delivery_tracker import PiecePayload, item_key
+from petastorm_tpu.reader_impl.delivery_tracker import (
+    FusedPiecePayload,
+    PiecePayload,
+    item_key,
+)
 from petastorm_tpu.schema.transform import transform_schema
 from petastorm_tpu.utils import decode_row, decode_table
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
@@ -94,7 +98,19 @@ class PyDictReaderWorker(WorkerBase):
         return sorted(self._read_schema.fields)
 
     def _read_with_predicate(self, piece, predicate):
-        """Two-phase read: predicate columns first, the rest only for survivors."""
+        """Two-phase read: predicate columns first, the rest only for survivors.
+
+        The mask is computed **vectorized** when the predicate exposes a
+        column-level form (``pa_mask`` — pyarrow compute on the raw table —
+        or ``do_include_vectorized``) and every predicate field is a
+        scalar-codec column (stored values ARE the decoded values); the
+        per-row ``decode_row`` + ``do_include`` loop remains the fallback,
+        unchanged. Either way both column reads are ``Table.filter``-ed
+        down to survivors before ``to_pylist`` — dropped rows are never
+        materialized into Python objects."""
+        import numpy as np
+        import pyarrow as pa
+
         predicate_fields = sorted(predicate.get_fields())
         unknown = [f for f in predicate_fields if f not in self._schema.fields]
         if unknown:
@@ -103,24 +119,34 @@ class PyDictReaderWorker(WorkerBase):
             [self._schema.fields[f] for f in predicate_fields]
         )
         predicate_table = piece.read(self._filesystem, columns=predicate_fields)
-        predicate_rows = predicate_table.to_pylist()
-        mask = []
-        for row in predicate_rows:
-            decoded = decode_row(row, predicate_view)
-            mask.append(bool(predicate.do_include(decoded)))
-        if not any(mask):
+        mask = self._vectorized_predicate_mask(predicate, predicate_view,
+                                               predicate_table)
+        predicate_rows = None
+        if mask is None:
+            # Per-row fallback: decode each predicate row, ask do_include.
+            # The materialized rows double as the survivor list — no
+            # second to_pylist of the predicate columns.
+            all_rows = predicate_table.to_pylist()
+            mask = np.empty(len(all_rows), dtype=bool)
+            for i, row in enumerate(all_rows):
+                decoded = decode_row(row, predicate_view)
+                mask[i] = bool(predicate.do_include(decoded))
+            predicate_rows = [row for row, kept in zip(all_rows, mask)
+                              if kept]
+        if not mask.any():
             return []
+        keep = pa.array(mask)
+        if predicate_rows is None:
+            predicate_rows = predicate_table.filter(keep).to_pylist()
         other_columns = [c for c in self._needed_columns()
                          if c not in predicate_fields]
         if other_columns:
             other_table = piece.read(self._filesystem, columns=other_columns)
-            other_rows = other_table.to_pylist()
+            other_rows = other_table.filter(keep).to_pylist()
         else:
             other_rows = [{} for _ in predicate_rows]
         result = []
-        for keep, pred_row, other_row in zip(mask, predicate_rows, other_rows):
-            if not keep:
-                continue
+        for pred_row, other_row in zip(predicate_rows, other_rows):
             merged = dict(other_row)
             # keep only predicate fields that are also part of the read schema
             for name in predicate_fields:
@@ -130,6 +156,39 @@ class PyDictReaderWorker(WorkerBase):
                     merged[name] = pred_row[name]
             result.append(merged)
         return result
+
+    def _vectorized_predicate_mask(self, predicate, predicate_view, table):
+        """Column-level mask, or ``None`` to use the per-row path.
+
+        Only scalar-codec fields of NUMERIC/BOOL dtype qualify: for them
+        the stored column value compares exactly as the value
+        ``decode_row`` would hand ``do_include``, so the column forms are
+        bit-equivalent. Decimal (stored as Arrow strings — lexicographic
+        comparison diverges), datetimes, and strings stay on the per-row
+        decode path. Prefers ``pa_mask`` (pyarrow compute, zero
+        Python-object materialization), then the numpy
+        ``do_include_vectorized``."""
+        import numpy as np
+
+        for field in predicate_view.fields.values():
+            codec_name = type(field.codec).__name__ \
+                if field.codec is not None else None
+            if field.shape not in ((), None) or codec_name not in (
+                    None, "ScalarCodec"):
+                return None
+            try:
+                kind = np.dtype(field.numpy_dtype).kind
+            except TypeError:  # Decimal and friends: no numpy dtype
+                return None
+            if kind not in "biuf":
+                return None
+        pa_mask = getattr(predicate, "pa_mask", None)
+        if pa_mask is not None:
+            return np.asarray(pa_mask(table), dtype=bool)
+        columns = {name: table.column(name).to_numpy(zero_copy_only=False)
+                   for name in table.column_names}
+        mask = predicate.do_include_vectorized(columns, table.num_rows)
+        return np.asarray(mask, dtype=bool) if mask is not None else None
 
     def _drop_partition(self, rows, shuffle_row_drop_partition):
         this_partition, num_partitions = shuffle_row_drop_partition
@@ -169,6 +228,19 @@ class PyDictResultsQueueReader:
         kwargs = {} if timeout is None else {"timeout": timeout}
         while not self._buffer:
             rows = pool.get_results(**kwargs)  # raises EmptyResultError at end
+            if isinstance(rows, FusedPiecePayload):
+                # A fused pool task already collated + serialized the whole
+                # piece: hand the payload through UNSPLIT (the engine
+                # routes it), record delivery now — nothing of it is
+                # buffered here. Delivery is counted in ROWS (the payload
+                # holds batches), matching the unfused branch.
+                self.last_item_key = rows.item_key
+                self._pending_item = None
+                if self.delivery_tracker is not None:
+                    self.delivery_tracker.record(
+                        rows.item_key,
+                        sum(fb.rows for fb in rows.payload))
+                return rows
             if isinstance(rows, PiecePayload):
                 # Delivery is recorded only when the payload's LAST row is
                 # handed out (bottom of this method): rows still buffered at

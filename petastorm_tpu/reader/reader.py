@@ -800,6 +800,21 @@ class Reader:
         self._require_dynamic()
         self._ventilator.finish()
 
+    def set_publish_transform(self, fn):
+        """Install ``fn(PiecePayload) -> payload`` on the pool's publish
+        path — it runs ON THE POOL WORKER THREAD, which is how the
+        stage-fusion rewrite collapses collate/transform/serialize into
+        the decode task (``docs/guides/pipeline.md#graph-rewrites``).
+        Returns True when the pool supports it (thread/dummy pools);
+        False otherwise (process pools serialize payloads across a
+        process boundary — a closure cannot ride along)."""
+        self._require_dynamic()
+        pool = self._workers_pool
+        if not hasattr(pool, "publish_transform"):
+            return False
+        pool.publish_transform = fn
+        return True
+
     def set_item_done_hook(self, hook):
         """Install ``hook(item_kwargs)``, fired on the consuming thread as
         it drains a work item's completion marker — strictly after every

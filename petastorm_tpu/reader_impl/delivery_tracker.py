@@ -50,6 +50,47 @@ class PiecePayload:
         return (PiecePayload, (self.item_key, self.payload))
 
 
+class FusedBatch:
+    """One wire-ready batch a fused pool task produced: serialized frames
+    plus (when cache placement wants pre-transform bytes) the
+    pre-transform serialization of the same rows."""
+
+    __slots__ = ("rows", "fmt", "frames", "pre_fmt", "pre_frames")
+
+    def __init__(self, rows, fmt, frames, pre_fmt=None, pre_frames=None):
+        self.rows = rows
+        self.fmt = fmt
+        self.frames = frames
+        self.pre_fmt = pre_fmt
+        self.pre_frames = pre_frames
+
+
+class FusedPiecePayload(PiecePayload):
+    """A whole piece's batches, fully collated/transformed/serialized
+    INSIDE the pool worker task (the stage-fusion graph rewrite —
+    ``docs/guides/pipeline.md#graph-rewrites``): ``payload`` is a list of
+    :class:`FusedBatch`. The consumer-side results-queue readers hand the
+    payload through whole instead of splitting it into rows — the per-row
+    hand-off (queue hops, namedtuple construction, stream-thread
+    collation) this fusion exists to eliminate."""
+
+    __slots__ = ()
+
+    def __reduce__(self):
+        return (FusedPiecePayload, (self.item_key, self.payload))
+
+
+def apply_publish_transform(transform, item):
+    """The pools' shared publish-hook application: a ``publish_transform``
+    (the stage-fusion rewrite's injection point) applies to
+    :class:`PiecePayload` publishes only — bookkeeping messages, worker
+    exceptions, and table payloads pass through untouched. One helper so
+    the thread and dummy pools cannot silently diverge."""
+    if transform is not None and isinstance(item, PiecePayload):
+        return transform(item)
+    return item
+
+
 #: Schema-metadata key carrying the work-item tag on ``pa.Table`` payloads.
 TABLE_ITEM_KEY = b"petastorm_tpu.delivery_item.v1"
 

@@ -52,6 +52,7 @@ from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.metrics import (
     CLIENT_BATCHES,
     CLIENT_DEDUP_DROPPED,
+    CLIENT_FILTER_ROWS,
     CLIENT_READY_QUEUE_DEPTH,
     CLIENT_RECOVERY_EVENTS,
     CLIENT_RECV_STALL,
@@ -103,8 +104,19 @@ class _WorkerStream:
     def __init__(self, worker_id, address, pieces, epoch, connect_timeout,
                  credits=None, auto_replenish=False, tagged=False,
                  starts=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None, recv_timeout=None, packing=None):
+                 job_id=None, recv_timeout=None, packing=None,
+                 predicate=None, projection=None, fused=False,
+                 cache_stage=None):
         self.worker_id = worker_id
+        #: Graph-rewrite stream attributes (frozen per iteration, like the
+        #: transform placement — docs/guides/pipeline.md#graph-rewrites):
+        #: a hoisted row filter (wire dict) + column projection applied
+        #: worker-side below decode, stage fusion, and the cache insertion
+        #: point. ``None``/False = the baseline topology.
+        self.predicate = predicate
+        self.projection = projection
+        self.fused = fused
+        self.cache_stage = cache_stage
         #: Worker-placement sequence packing: the spec's dict form rides
         #: the stream request; the worker packs pre-serialization and
         #: ordinals/watermarks number PACKED batches. ``None`` = no
@@ -186,6 +198,14 @@ class _WorkerStream:
                 request["transform_placement"] = self.transform_placement
             if self.packing is not None:
                 request["packing"] = dict(self.packing)
+            if self.predicate is not None:
+                request["predicate"] = dict(self.predicate)
+            if self.projection is not None:
+                request["projection"] = list(self.projection)
+            if self.fused:
+                request["fused"] = True
+            if self.cache_stage is not None:
+                request["cache_stage"] = self.cache_stage
             if self.tagged:
                 request["tagged"] = True
                 if self.starts:
@@ -497,10 +517,16 @@ class _DynamicStream:
 
     def __init__(self, worker_id, address, pairs, epoch, connect_timeout,
                  credits=None, shuffle_seed=None, transform_placement=None,
-                 job_id=None, recv_timeout=None, packing=None):
+                 job_id=None, recv_timeout=None, packing=None,
+                 predicate=None, projection=None, fused=False,
+                 cache_stage=None):
         self.worker_id = worker_id
         self.job_id = job_id  # see _WorkerStream.job_id
         self.packing = packing  # see _WorkerStream.packing
+        self.predicate = predicate  # see _WorkerStream: rewrite attributes
+        self.projection = projection
+        self.fused = fused
+        self.cache_stage = cache_stage
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
         # delivery watermark, so a (re)opened stream never repeats batches
@@ -539,6 +565,14 @@ class _DynamicStream:
                 request["transform_placement"] = self.transform_placement
             if self.packing is not None:
                 request["packing"] = dict(self.packing)
+            if self.predicate is not None:
+                request["predicate"] = dict(self.predicate)
+            if self.projection is not None:
+                request["projection"] = list(self.projection)
+            if self.fused:
+                request["fused"] = True
+            if self.cache_stage is not None:
+                request["cache_stage"] = self.cache_stage
             if self.credits is not None:
                 request["credits"] = self.credits
             try:
@@ -803,7 +837,9 @@ class ServiceBatchSource:
                  dynamic_sync_interval_s=0.25, ordered=False,
                  transform=None, transform_placement="remote",
                  job_id=None, on_piece_error="fail",
-                 stream_recv_timeout_s=None, packing=None, corpus=""):
+                 stream_recv_timeout_s=None, packing=None, corpus="",
+                 predicate=None, projection=None, filter_placement="client",
+                 stage_fusion="off", cache_placement="post-transform"):
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if on_piece_error not in ("fail", "quarantine"):
@@ -845,6 +881,92 @@ class ServiceBatchSource:
                 "changes the batch vocabulary — apply the transform "
                 "upstream (transform_spec) instead")
         self._iter_packing = self._packing
+        # Declared row filter + column projection (the filter-hoisting
+        # rewrite's operands — docs/guides/pipeline.md#graph-rewrites).
+        # The predicate must be declarative (ColumnPredicate / wire dict):
+        # only pure data can cross to the workers when the planner hoists
+        # it below decode. filter_placement names where it runs THIS
+        # iteration's topology: "client" (the baseline — batches arrive
+        # unfiltered and are masked trainer-side) or "worker" (hoisted —
+        # dropped rows never decode, never cross the wire).
+        self._predicate = None
+        if predicate is not None:
+            from petastorm_tpu.predicates import ColumnPredicate
+
+            if isinstance(predicate, ColumnPredicate):
+                self._predicate = predicate
+            else:
+                self._predicate = ColumnPredicate.from_wire(predicate)
+        if filter_placement not in ("client", "worker"):
+            raise ValueError(
+                "filter_placement must be 'client' or 'worker'")
+        if predicate is None and filter_placement == "worker":
+            raise ValueError(
+                "filter_placement='worker' needs predicate=: there is "
+                "no row filter to hoist")
+        if self._predicate is not None and self._packing is not None:
+            raise ValueError(
+                "predicate= and packing= cannot combine on one source: "
+                "packing changes the batch vocabulary (token slots, not "
+                "rows) — filter upstream (reader predicate) or drop one")
+        if projection and transform is not None \
+                and (predicate is None or filter_placement != "worker"):
+            # A client-side projection would prune AFTER a remote
+            # transform but BEFORE a local one — a placement flip would
+            # change the transform's input. Hoisted projection (rides the
+            # worker-placed filter, pruned below decode) transforms the
+            # projected batch identically under both placements.
+            raise ValueError(
+                "projection= with transform= requires the hoisted filter "
+                "topology (predicate= with filter_placement='worker'): "
+                "client-side pruning would run after a remote transform "
+                "but before a local one, so a transform_placement flip "
+                "would change the transform's input")
+        if self._predicate is not None and transform is not None \
+                and filter_placement != "worker":
+            # A remote transform runs BEFORE a client-placed filter would,
+            # so the filter would evaluate post-transform values (or miss
+            # its column entirely) — a different survivor set than the
+            # hoisted topology, silently. The hoisted placement is the
+            # only one where filter (below decode) and transform (above
+            # collate) compose unambiguously: require it.
+            raise ValueError(
+                "predicate= with transform= requires "
+                "filter_placement='worker': a client-placed filter would "
+                "see post-transform batches (the worker transforms before "
+                "shipping), diverging from the hoisted topology's "
+                "stored-value semantics")
+        self._projection = (sorted(str(f) for f in projection)
+                            if projection else None)
+        self._filter_placement = filter_placement
+        if stage_fusion not in ("off", "fused"):
+            raise ValueError("stage_fusion must be 'off' or 'fused'")
+        self._stage_fusion = stage_fusion
+        if cache_placement not in ("post-transform", "post-decode"):
+            raise ValueError(
+                "cache_placement must be 'post-transform' or 'post-decode'")
+        if cache_placement == "post-decode" and transform is None:
+            raise ValueError(
+                "cache_placement='post-decode' is only meaningful with a "
+                "transform= armed (without one the two placements cache "
+                "identical bytes)")
+        self._cache_placement = cache_placement
+        # Iteration-frozen copies (set at __call__, like the transform
+        # placement): every stream of one iteration — takeover/resync
+        # relaunches included — carries the same rewrite attributes.
+        self._iter_predicate = None
+        self._iter_projection = None
+        self._iter_filter_placement = None
+        self._iter_hoisted = False
+        self._iter_fused = False
+        self._iter_cache_stage = None
+        # Batches the trainer-local filter dropped ENTIRELY this iteration
+        # (every row failed the predicate): breaks the 1:1 received↔
+        # yielded correspondence the prefetch-lag-exact state_dict needs —
+        # tracked so state_dict can refuse loudly instead of silently
+        # mispositioning a resume (hoist the filter for checkpointable
+        # filtered pipelines).
+        self._filter_dropped_batches = 0
         # The dispatcher's fair-share credit scaling for this job (1.0 =
         # full window). Updated from assignment/plan/sync replies; applied
         # to streams opened AFTER the update, like set_credits.
@@ -1151,6 +1273,149 @@ class ServiceBatchSource:
         return (self._iter_packing.to_dict()
                 if self._iter_packing is not None else None)
 
+    # -- graph-rewrite knobs (docs/guides/pipeline.md#graph-rewrites) ------
+
+    @property
+    def filter_placement(self):
+        """Where the declared row filter runs from the NEXT iteration on:
+        ``"client"`` (baseline — batches arrive unfiltered, masked here)
+        or ``"worker"`` (hoisted below the workers' decode)."""
+        return self._filter_placement
+
+    def set_filter_placement(self, placement):
+        """Flip the row filter between trainer-side masking and the
+        hoisted worker-side two-phase read. Next-iteration, like every
+        placement flip — an iteration's streams and its local applier
+        must agree on one topology."""
+        if placement not in ("client", "worker"):
+            raise ValueError(
+                "filter_placement must be 'client' or 'worker'")
+        if self._predicate is None:
+            raise ValueError(
+                "no predicate armed — construct the source with "
+                "predicate= to make filter placement meaningful")
+        if placement == "client" and self.transform is not None:
+            raise ValueError(
+                "filter_placement='client' is unavailable with a "
+                "transform= armed: the workers transform before shipping, "
+                "so the client filter would evaluate post-transform "
+                "values — the filter stays hoisted (worker-placed)")
+        if placement == "worker":
+            self._reject_rewrite_on_fcfs("filter_placement='worker'")
+        self._filter_placement = placement
+
+    @property
+    def stage_fusion(self):
+        """``"off"`` or ``"fused"`` from the next iteration on."""
+        return self._stage_fusion
+
+    def set_stage_fusion(self, mode):
+        """Arm/disarm worker-side stage fusion (collate→transform(→pack)→
+        serialize collapsed into the decode pool task). Next-iteration;
+        byte-identical output either way — fusion only moves where the
+        work runs."""
+        if mode not in ("off", "fused"):
+            raise ValueError("stage_fusion must be 'off' or 'fused'")
+        if mode == "fused":
+            self._reject_rewrite_on_fcfs("stage_fusion='fused'")
+        self._stage_fusion = mode
+
+    @property
+    def cache_placement(self):
+        """The worker cache's insertion point from the next iteration on:
+        ``"post-transform"`` (entries hold post-transform bytes) or
+        ``"post-decode"`` (pre-transform bytes; warm serves re-apply the
+        transform)."""
+        return self._cache_placement
+
+    def set_cache_placement(self, placement):
+        """Move the worker-side batch cache above or below the batch
+        transform. Next-iteration; the two placements' cache keys differ,
+        so a flip RE-FILLS rather than serving the other placement's
+        bytes."""
+        if placement not in ("post-transform", "post-decode"):
+            raise ValueError(
+                "cache_placement must be 'post-transform' or "
+                "'post-decode'")
+        if placement == "post-decode" and self.transform is None:
+            raise ValueError(
+                "cache_placement='post-decode' needs a transform= armed")
+        if placement == "post-decode":
+            self._reject_rewrite_on_fcfs("cache_placement='post-decode'")
+        self._cache_placement = placement
+
+    def _reject_rewrite_on_fcfs(self, what):
+        """Rewrite setters refuse on a known-fcfs source: the flip would
+        not probe, it would crash the NEXT iteration's __call__ — a
+        failure mode the planner's revert machinery cannot see. (The
+        graph also declines to bind rewrite knobs on fcfs sources; this
+        is the direct-setter guard.)"""
+        if self._mode == "fcfs":
+            raise ValueError(
+                f"{what} requires static or dynamic sharding: this "
+                f"source's dispatcher runs fcfs, whose untagged per-split "
+                f"streams bypass the streaming piece engine rewrites run "
+                f"in (docs/guides/pipeline.md#graph-rewrites)")
+
+    def _iter_rewrite_kwargs(self):
+        """The frozen iteration's rewrite attributes as stream kwargs —
+        shared by every tagged/dynamic stream construction site (initial
+        launch, retry, takeover, resync relaunch), so a re-serve can
+        never disagree with the topology the iteration froze."""
+        hoisted = getattr(self, "_iter_hoisted", False)
+        return {
+            "predicate": (self._iter_predicate.to_wire()
+                          if hoisted and self._iter_predicate is not None
+                          else None),
+            "projection": self._iter_projection if hoisted else None,
+            "fused": self._iter_fused,
+            "cache_stage": self._iter_cache_stage,
+        }
+
+    def _apply_filter_local(self, inner):
+        """Trainer-side execution of the declared row filter + projection
+        (the UNREWRITTEN topology): every received batch is masked with
+        the predicate's columnar form and pruned to the projection.
+        Row-stream content and order are identical to the hoisted run;
+        batch boundaries are not (hoisted streams collate survivors into
+        full batches below decode) — which is exactly the overhead the
+        hoist removes: every dropped row here was decoded, serialized,
+        and shipped first. Fully-emptied batches are skipped (and
+        counted: they break prefetch-exact checkpoint positioning — see
+        ``state_dict``)."""
+        import numpy as np
+
+        from petastorm_tpu.predicates import evaluate_predicate_mask
+
+        predicate = self._iter_predicate
+        projection = self._iter_projection
+        m_in = CLIENT_FILTER_ROWS.labels("in")
+        m_kept = CLIENT_FILTER_ROWS.labels("kept")
+        try:
+            for batch in inner:
+                if predicate is not None and batch:
+                    num_rows = len(next(iter(batch.values())))
+                    mask = evaluate_predicate_mask(predicate, batch,
+                                                   num_rows)
+                    kept = int(np.count_nonzero(mask))
+                    m_in.inc(num_rows)
+                    m_kept.inc(kept)
+                    if kept == 0:
+                        with self._lock:
+                            self._filter_dropped_batches += 1
+                        continue
+                    if kept < num_rows:
+                        batch = {name: column[mask]
+                                 for name, column in batch.items()}
+                if projection is not None:
+                    batch = {name: column for name, column in batch.items()
+                             if name in projection}
+                yield batch
+        finally:
+            close = getattr(inner, "close", None)
+            if callable(close):
+                close()
+
     def _effective_credits(self):
         """The configured credit window scaled by this job's fair share
         (``credit_scale`` from the dispatcher): a job granted half the
@@ -1248,19 +1513,53 @@ class ServiceBatchSource:
         # Packing is frozen the same way: an iteration's streams (and
         # their cache keys) all agree on whether the workers pack.
         self._iter_packing = self._packing
+        # Graph-rewrite attributes freeze the same way (the planner's
+        # flips are next-iteration by construction): one topology per
+        # iteration, on every stream and on the local filter applier.
+        hoisted = (self._predicate is not None
+                   and self._filter_placement == "worker")
+        self._iter_filter_placement = (self._filter_placement
+                                       if self._predicate is not None
+                                       else None)
+        self._iter_hoisted = hoisted
+        self._iter_predicate = self._predicate
+        self._iter_projection = self._projection
+        self._iter_fused = self._stage_fusion == "fused"
+        self._iter_cache_stage = (self._cache_placement
+                                  if self._cache_placement != "post-transform"
+                                  else None)
+        self._filter_dropped_batches = 0
+        rewriting = (hoisted or self._iter_fused
+                     or self._iter_cache_stage is not None)
+        if rewriting and info["mode"] == "fcfs":
+            raise ValueError(
+                "graph rewrites (filter_placement='worker', stage_fusion, "
+                "cache_placement='post-decode') require static or dynamic "
+                "sharding: fcfs serves untagged per-split streams outside "
+                "the streaming piece engine, which is where rewrites run "
+                "(docs/guides/pipeline.md#graph-rewrites)")
         local = self._iter_transform_placement == "local"
+        client_filtered = (self._predicate is not None and not hoisted)
+
+        def wrap(it):
+            # Stage order matches the worker side: filter sits BELOW the
+            # batch transform (the worker applies the predicate under
+            # decode, the transform after collation).
+            if client_filtered or (self._projection is not None
+                                   and not hoisted):
+                it = self._apply_filter_local(it)
+            if local:
+                it = self._apply_transform_local(it)
+            return it
+
         if info["mode"] == "static":
             # The multiplexed drain prefetches into its ready-queue behind
             # reader threads — consumers may pull it directly.
-            it = self._iter_static(info)
-            if local:
-                it = self._apply_transform_local(it)
-            return _SourceIterator(it, prefetched=True)
+            return _SourceIterator(wrap(self._iter_static(info)),
+                                   prefetched=True)
         if info["mode"] == "dynamic":
-            it = self._iter_dynamic(info)
-            if local:
-                it = self._apply_transform_local(it)
-            return _SourceIterator(it, prefetched=True)
+            return _SourceIterator(wrap(self._iter_dynamic(info)),
+                                   prefetched=True)
         if self._resumed:
             raise ValueError(
                 "resume_state was supplied but the dispatcher is in fcfs "
@@ -1270,10 +1569,10 @@ class ServiceBatchSource:
                 "Run the dispatcher in static or dynamic mode to resume")
         # fcfs consumes streams sequentially (no reader threads): a
         # prefetching consumer should keep its own producer thread.
-        it = self._iter_fcfs(info)
-        if local:
-            it = self._apply_transform_local(it)
-        return _SourceIterator(it, prefetched=False)
+        # (Rewrites were rejected above; a CLIENT-placed filter is pure
+        # trainer-side post-processing and works on any mode.)
+        return _SourceIterator(wrap(self._iter_fcfs(info)),
+                               prefetched=False)
 
     # -- static mode -------------------------------------------------------
 
@@ -1357,7 +1656,8 @@ class ServiceBatchSource:
                         transform_placement=self._iter_transform_placement,
                         job_id=self.job_id,
                         recv_timeout=self._stream_recv_timeout_s,
-                        packing=self._iter_packing_dict())
+                        packing=self._iter_packing_dict(),
+                        **self._iter_rewrite_kwargs())
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
@@ -1543,7 +1843,8 @@ class ServiceBatchSource:
                     transform_placement=self._iter_transform_placement,
                     job_id=self.job_id,
                     recv_timeout=self._stream_recv_timeout_s,
-                    packing=self._iter_packing_dict()))
+                    packing=self._iter_packing_dict(),
+                    **self._iter_rewrite_kwargs()))
 
         try:
             for sid, stream in list(streams.items()):
@@ -1904,7 +2205,8 @@ class ServiceBatchSource:
                 transform_placement=self._iter_transform_placement,
                 job_id=self.job_id,
                 recv_timeout=self._stream_recv_timeout_s,
-                packing=self._iter_packing_dict())
+                packing=self._iter_packing_dict(),
+                **self._iter_rewrite_kwargs())
             streams[sid] = stream
             sid_by_wid[wid] = sid
             with self._lock:
@@ -2047,7 +2349,8 @@ class ServiceBatchSource:
                         transform_placement=self._iter_transform_placement,
                         job_id=self.job_id,
                         recv_timeout=self._stream_recv_timeout_s,
-                        packing=self._iter_packing_dict())
+                        packing=self._iter_packing_dict(),
+                        **self._iter_rewrite_kwargs())
                     try:
                         fresh._ensure_conn()  # dial + stream request
                     except BaseException:
@@ -2529,7 +2832,8 @@ class ServiceBatchSource:
                 transform_placement=self._iter_transform_placement,
                 job_id=self.job_id,
                 recv_timeout=self._stream_recv_timeout_s,
-                packing=self._iter_packing_dict())
+                packing=self._iter_packing_dict(),
+                **self._iter_rewrite_kwargs())
             try:
                 event = fresh.next_event()  # forces connect + first reply
             except BaseException:
@@ -2623,7 +2927,8 @@ class ServiceBatchSource:
                           transform_placement=self._iter_transform_placement,
                           job_id=self.job_id,
                           recv_timeout=self._stream_recv_timeout_s,
-                          packing=self._iter_packing_dict())
+                          packing=self._iter_packing_dict(),
+                          **self._iter_rewrite_kwargs())
             for wid, pieces in reply["assignments"].items()
         ]
 
@@ -2777,6 +3082,21 @@ class ServiceBatchSource:
                     "handed out first-come-first-served, so a client has no "
                     "deterministic resumable position — use static sharding "
                     "for resumable training")
+            if yielded_batches is not None \
+                    and self._filter_dropped_batches:
+                # The trainer-local filter dropped whole batches (every
+                # row failed the predicate), so the consumer's yielded
+                # count no longer indexes this source's production order —
+                # prefetch-lag-exact positioning would silently land on
+                # the wrong batch. Refuse loudly; the hoisted placement
+                # keeps positioning exact (workers collate survivors, so
+                # nothing is dropped client-side).
+                raise ValueError(
+                    "state_dict(yielded_batches=...) is not supported "
+                    "while the trainer-local row filter has dropped "
+                    "whole batches this iteration — hoist the filter "
+                    "(filter_placement='worker') for prefetch-exact "
+                    "checkpoints of filtered pipelines")
             count = (self._production_count if yielded_batches is None
                      else min(int(yielded_batches), self._production_count))
             epoch, base, base_marks = (self._epoch_starts[0][1],
@@ -2821,7 +3141,29 @@ class ServiceBatchSource:
                 # above number PACKED batches, so a resume must re-arm
                 # the identical spec (validated at restore).
                 "packing": self._iter_packing_dict(),
+                # Hoisted row filter in force: a worker-placed predicate
+                # means pieces collate only SURVIVORS, so the watermarks
+                # above number filtered batches — the same vocabulary
+                # hazard as packing, validated the same way at restore.
+                # None = no hoisted filter (client-placed filtering does
+                # not change what the worker ships), matching legacy
+                # snapshots that lack the key.
+                "filter": self._hoisted_filter_signature(),
             }
+
+    def _hoisted_filter_signature(self, constructed=False):
+        """The watermark-vocabulary ingredient of the hoisted row filter:
+        its canonical wire form when worker-placed, else ``None``.
+        ``constructed=True`` reads the constructor state (resume
+        validation — the next iteration's topology); default reads the
+        iteration in force (snapshot time)."""
+        if constructed:
+            predicate, hoisted = self._predicate, \
+                self._filter_placement == "worker"
+        else:
+            predicate, hoisted = self._iter_predicate, self._iter_hoisted
+        return (predicate.to_wire()
+                if hoisted and predicate is not None else None)
 
     def _validate_resume_state(self, state):
         if state.get("version") not in (1, 2):
@@ -2847,6 +3189,16 @@ class ServiceBatchSource:
                 f"number batches under {saved_packing!r}, this source "
                 f"runs {current_packing!r} — resuming would re-grant at "
                 f"positions in a different batch vocabulary")
+        saved_filter = state.get("filter")
+        current_filter = self._hoisted_filter_signature(constructed=True)
+        if saved_filter != current_filter:
+            raise ValueError(
+                f"resume_state hoisted-filter mismatch: checkpoint "
+                f"watermarks number batches under worker-placed filter "
+                f"{saved_filter!r}, this source runs {current_filter!r} "
+                f"— a hoisted predicate changes each piece's batch "
+                f"vocabulary (pieces collate only survivors), so "
+                f"resuming would re-grant at wrong positions")
 
     @property
     def diagnostics(self):
@@ -2887,6 +3239,17 @@ class ServiceBatchSource:
                 # Placement of the batch-transform stage in force for the
                 # current iteration (None = no transform armed).
                 "transform_placement": self._iter_transform_placement,
+                # Graph-rewrite topology in force this iteration
+                # (docs/guides/pipeline.md#graph-rewrites).
+                "rewrites": {
+                    "filter_placement": self._iter_filter_placement,
+                    "stage_fusion": ("fused" if self._iter_fused
+                                     else "off"),
+                    "cache_placement": (self._iter_cache_stage
+                                        or "post-transform"),
+                    "filter_dropped_batches":
+                        self._filter_dropped_batches,
+                },
                 # Epoch boundaries in production order: the n-th entry says
                 # "epoch `epoch` began at produced-batch `count`" — a
                 # consumer correlating its own per-batch timeline (the

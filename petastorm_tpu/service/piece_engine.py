@@ -42,9 +42,16 @@ from collections import deque
 import numpy as np
 
 from petastorm_tpu import failpoints
+from petastorm_tpu.reader_impl.delivery_tracker import (
+    FusedBatch,
+    FusedPiecePayload,
+)
 from petastorm_tpu.reader_impl.framed_socket import encode_payload
 from petastorm_tpu.telemetry.log import service_logger
-from petastorm_tpu.telemetry.metrics import QUARANTINE_REPORTS
+from petastorm_tpu.telemetry.metrics import (
+    QUARANTINE_REPORTS,
+    WORKER_FUSED_STAGE_SECONDS,
+)
 from petastorm_tpu.workers_pool import (
     EmptyResultError,
     TimeoutWaitingForResultError,
@@ -58,6 +65,18 @@ logger = service_logger(__name__)
 #: quarantined instead of erroring the stream.
 _QUEUED, _DECODING, _SERVING, _DONE, _REVOKED, _FAILED = (
     "queued", "decoding", "serving", "done", "revoked", "failed")
+
+#: Collator-slot sentinel for pieces served through the FUSED pool task
+#: (the pool collates; the engine routes whole-piece payloads).
+_FUSED_PIECE = object()
+
+#: Cache insertion points the planner chooses between
+#: (``docs/guides/pipeline.md#graph-rewrites``): ``post-transform``
+#: (entries hold post-transform bytes — warm serves are zero-work) vs
+#: ``post-decode`` (entries hold pre-transform bytes — smaller when the
+#: transform inflates data and shareable with transformless streams, but
+#: every warm serve re-applies the transform).
+CACHE_STAGES = ("post-transform", "post-decode")
 
 
 class _PieceCollator:
@@ -209,11 +228,16 @@ class StreamingPieceEngine:
     def __init__(self, reader, batch_size, cache=None, cache_key_fn=None,
                  cache_note_fn=None, lookahead=2, permute_fn=None,
                  transform_fn=None, on_piece_error="fail",
-                 packer_factory=None):
+                 packer_factory=None, fused=False,
+                 cache_stage="post-transform", handoff_note_fn=None):
         if on_piece_error not in ("fail", "quarantine"):
             raise ValueError(
                 "on_piece_error must be 'fail' or 'quarantine', got "
                 f"{on_piece_error!r}")
+        if cache_stage not in CACHE_STAGES:
+            raise ValueError(
+                f"cache_stage must be one of {CACHE_STAGES}, got "
+                f"{cache_stage!r}")
         if callable(reader) and not hasattr(reader, "read_next_tagged"):
             self._reader = None
             self._reader_factory = reader
@@ -239,6 +263,23 @@ class StreamingPieceEngine:
         self._permute = permute_fn
         self._transform = transform_fn
         self._packer_factory = packer_factory
+        #: Stage fusion (docs/guides/pipeline.md#graph-rewrites): collapse
+        #: collate→transform(→pack)→serialize into the decode pool task —
+        #: the pool publishes whole-piece FusedPiecePayloads of wire-ready
+        #: frames instead of per-row outputs. Requested here; downgraded
+        #: (with a warning) at reader install time if the reader cannot
+        #: fuse (batched-output families, pools without a publish hook).
+        self._fused = bool(fused)
+        self._cache_stage = cache_stage
+        #: ``fn(seconds)``: hand-off cost accounting — stream-thread time
+        #: spent collating pool outputs and serializing batches, the
+        #: overhead fusion eliminates (the fusion trigger's signal).
+        #: Accumulated locally per piece and flushed at piece completion:
+        #: the counter child takes a lock, and paying it per pool OUTPUT
+        #: (per row on the row family) would inflate the very serial cost
+        #: the metric measures.
+        self._handoff = handoff_note_fn
+        self._handoff_pending = 0.0  # stream-thread only
         if packer_factory is not None and transform_fn is not None:
             raise ValueError(
                 "packer_factory and transform_fn cannot combine: the "
@@ -275,6 +316,21 @@ class StreamingPieceEngine:
             raise ValueError(
                 "StreamingPieceEngine requires a dynamic_ventilation reader")
         reader.set_item_done_hook(self._on_item_done)
+        # getattr: the engine's reader-instance constructor path installs
+        # before the fusion attributes are assigned (fusion requires the
+        # factory form anyway — it is only requested via _make_engine).
+        if getattr(self, "_fused", False):
+            installed = False
+            if not reader.batched_output \
+                    and hasattr(reader, "set_publish_transform"):
+                installed = reader.set_publish_transform(
+                    self._make_fused_transform(reader))
+            if not installed:
+                logger.warning(
+                    "engine: stage fusion requested but this reader cannot "
+                    "fuse (batched-output family, or a pool without a "
+                    "publish hook) — serving unfused, bytes identical")
+                self._fused = False
         self._reader = reader
 
     def _ensure_reader(self):
@@ -289,6 +345,86 @@ class StreamingPieceEngine:
         """The owned reader — ``None`` while lazy construction has not
         been triggered (no piece has missed the cache yet)."""
         return self._reader
+
+    @property
+    def fused(self):
+        """Whether stage fusion is in force (may be downgraded from the
+        requested value at reader install time)."""
+        return self._fused
+
+    def _make_fused_transform(self, reader):
+        """The fused pool task's tail: runs ON THE POOL WORKER THREAD via
+        the pool's publish hook, turning a piece's decoded rows into
+        wire-ready frames — the same namedtuple conversion → collation →
+        transform (→ packing) → serialization the unfused stream thread
+        performs, byte for byte, just executed inside the decode task (and
+        therefore in parallel across pool workers). Constituent-stage cost
+        stays attributable: collate/pack/serialize seconds land in
+        ``petastorm_service_worker_fused_stage_seconds_total{stage}``; the
+        transform keeps its own ``worker_transform_seconds`` family (the
+        worker passes its timed wrapper)."""
+        schema = reader.schema
+        ngram = getattr(reader, "ngram", None)
+        batch_size = self._batch_size
+        transform = self._transform
+        packer_factory = self._packer_factory
+        # post-decode cache placement + transform: the cache wants
+        # PRE-transform bytes while the wire wants post — serialize both.
+        want_pre = (self._cache is not None and transform is not None
+                    and self._cache_stage == "post-decode")
+        # Collation seconds book under "collate" regardless of packing
+        # placement (the graph's collate node reads exactly this label);
+        # with worker-placed packing the segment INCLUDES the packing
+        # wrapper's work — the packing family's own placement-labeled
+        # series stays the precise packing measurement.
+        m_collate = WORKER_FUSED_STAGE_SECONDS.labels("collate")
+        m_serialize = WORKER_FUSED_STAGE_SECONDS.labels("serialize")
+
+        def fuse(payload):
+            rows = payload.payload
+            t0 = time.perf_counter()
+            if ngram is not None:
+                outputs = [ngram.make_namedtuple(schema, row)
+                           for row in rows]
+            else:
+                outputs = schema.make_namedtuples(rows)
+            collator = _PieceCollator(batch_size, False, ngram)
+            if packer_factory is not None:
+                from petastorm_tpu.service.packing_stage import (
+                    PackingCollator,
+                )
+
+                collator = PackingCollator(collator, packer_factory())
+            batches = []
+            for output in outputs:
+                batches.extend(collator.add(output))
+            batches.extend(collator.flush_all())
+            m_collate.inc(time.perf_counter() - t0)
+            fused = []
+            serialize_s = 0.0
+            for batch in batches:
+                pre_fmt = pre_frames = None
+                if want_pre:
+                    ts = time.perf_counter()
+                    pre_fmt, pre_frames = encode_payload(batch)
+                    # Copy NOW: out-of-band frames alias the decoded
+                    # arrays, and an in-place-mutating transform (below)
+                    # would otherwise corrupt the pre-transform bytes
+                    # before the cache fill copies them.
+                    pre_frames = [bytes(f) for f in pre_frames]
+                    serialize_s += time.perf_counter() - ts
+                if transform is not None:
+                    batch = transform(batch)
+                ts = time.perf_counter()
+                fmt, frames = encode_payload(batch)
+                serialize_s += time.perf_counter() - ts
+                n = len(next(iter(batch.values()))) if batch else 0
+                fused.append(FusedBatch(n, fmt, frames, pre_fmt=pre_fmt,
+                                        pre_frames=pre_frames))
+            m_serialize.inc(serialize_s)
+            return FusedPiecePayload(payload.item_key, fused)
+
+        return fuse
 
     # -- queue edits (any thread) -----------------------------------------
 
@@ -530,19 +666,26 @@ class StreamingPieceEngine:
                 self._state[piece] = _DECODING
                 self._inflight.add(piece)
                 self._ordinal[piece] = 0  # fresh decode restarts ordinals
-                collator = _PieceCollator(
-                    self._batch_size, reader.batched_output,
-                    getattr(reader, "ngram", None))
-                if self._packer_factory is not None:
-                    from petastorm_tpu.service.packing_stage import (
-                        PackingCollator,
-                    )
+                if self._fused:
+                    # The fused pool task collates/serializes the piece
+                    # itself; the sentinel keeps the revoked-vs-active
+                    # bookkeeping (and item-done attribution) intact.
+                    collator = _FUSED_PIECE
+                else:
+                    collator = _PieceCollator(
+                        self._batch_size, reader.batched_output,
+                        getattr(reader, "ngram", None))
+                    if self._packer_factory is not None:
+                        from petastorm_tpu.service.packing_stage import (
+                            PackingCollator,
+                        )
 
-                    # One fresh packer per piece: packed batches stay
-                    # piece-aligned and a re-decode of the piece replays
-                    # the identical packed stream (watermark contract).
-                    collator = PackingCollator(collator,
-                                               self._packer_factory())
+                        # One fresh packer per piece: packed batches stay
+                        # piece-aligned and a re-decode of the piece
+                        # replays the identical packed stream (watermark
+                        # contract).
+                        collator = PackingCollator(collator,
+                                                   self._packer_factory())
                 self._collators[piece] = collator
                 self._builders[piece] = (
                     self._cache.begin_fill(self._cache_key_fn(piece))
@@ -564,11 +707,27 @@ class StreamingPieceEngine:
         n = entry.num_batches
         order = (self._permute(piece, n) if self._permute is not None
                  else range(n))
+        # Post-decode cache placement: entries hold PRE-transform bytes,
+        # so warm serves decode → transform → re-encode each served batch
+        # (the measured cost the cache-placement rewrite trades against
+        # smaller/shareable entries — the worker's timed transform wrapper
+        # keeps the economics visible in worker_transform_seconds).
+        serve_transform = (self._transform
+                           if self._cache_stage == "post-decode" else None)
         events, rows = [], 0
         for ordinal, source in enumerate(order):
             if ordinal < start:
                 continue
             cached = entry.batch_at(source)
+            if serve_transform is not None:
+                batch = serve_transform(cached.to_dict())
+                fmt, frames = encode_payload(batch)
+                batch_rows = (len(next(iter(batch.values())))
+                              if batch else 0)
+                events.append(("batch", piece, gen, ordinal, batch_rows,
+                               fmt, frames, 0.0))
+                rows += batch_rows
+                continue
             events.append(("batch", piece, gen, ordinal, cached.rows,
                            cached.fmt, cached.frames, 0.0))
             rows += cached.rows
@@ -589,20 +748,97 @@ class StreamingPieceEngine:
             raise RuntimeError(
                 "streaming engine received an untagged reader output — "
                 "per-piece attribution requires tagged payloads")
+        if isinstance(output, FusedPiecePayload):
+            self._route_fused(output, piece)
+            return
         with self._lock:
             collator = self._collators.get(piece)
             builder = self._builders.get(piece)
             gen = self._gen.get(piece, 0)
-        if collator is None:
-            return  # revoked mid-decode: discard
-        for batch in collator.add(output):
+        if collator is None or collator is _FUSED_PIECE:
+            return  # revoked mid-decode (or a fused stray): discard
+        t0 = time.perf_counter()
+        batches = collator.add(output)
+        self._note_handoff(time.perf_counter() - t0)
+        for batch in batches:
             self._emit_batch(piece, gen, batch, builder)
 
+    def _note_handoff(self, seconds):
+        if self._handoff is not None and seconds > 0:
+            self._handoff_pending += seconds
+
+    def _flush_handoff(self):
+        """Flush the per-piece hand-off accumulation to the counter
+        (stream thread, at piece completion — one locked increment per
+        piece instead of per output)."""
+        if self._handoff is not None and self._handoff_pending > 0:
+            self._handoff(self._handoff_pending)
+            self._handoff_pending = 0.0
+
+    def _route_fused(self, payload, piece):
+        """Route one FUSED piece: the pool task already produced every
+        wire-ready batch, so this is pure bookkeeping — fill the cache
+        canonically (pre- or post-transform frames per ``cache_stage``),
+        then emit events in permuted order past the ``start`` watermark.
+        Byte-identical to the unfused path by construction (same
+        collation, same transform, same serializer)."""
+        with self._lock:
+            if self._collators.get(piece) is not _FUSED_PIECE:
+                return  # revoked between dispatch and publish
+            builder = self._builders.get(piece)
+            gen = self._gen.get(piece, 0)
+            start = self._start.get(piece, 0)
+            revoked = self._state.get(piece) == _REVOKED
+        if revoked:
+            return
+        batches = payload.payload
+        if builder is not None:
+            # The fill gets EVERY batch in canonical order (entries must
+            # stay complete and order-independent); post-decode placement
+            # stores the pre-transform serialization the task carried.
+            for fb in batches:
+                if fb.pre_frames is not None:
+                    builder.add_frames(fb.rows, fb.pre_fmt, fb.pre_frames)
+                else:
+                    builder.add_frames(fb.rows, fb.fmt, fb.frames)
+        n = len(batches)
+        order = (self._permute(piece, n) if self._permute is not None
+                 else range(n))
+        decode_s, self._pull_s = self._pull_s, 0.0
+        events, rows = [], 0
+        for ordinal, source in enumerate(order):
+            if ordinal < start:
+                continue  # below the re-serve watermark: never sent
+            fb = batches[source]
+            events.append(("batch", piece, gen, ordinal, fb.rows, fb.fmt,
+                           fb.frames, decode_s if not events else 0.0))
+            rows += fb.rows
+        with self._lock:
+            if self._state.get(piece) == _REVOKED:
+                return
+            self._rows[piece] = self._rows.get(piece, 0) + rows
+            self._rows_emitted += rows
+            self._out.extend(events)
+
     def _emit_batch(self, piece, gen, batch, builder):
+        pre_filled = False
         if self._transform is not None:
+            if builder is not None and self._cache_stage == "post-decode":
+                # Post-decode cache placement: the fill must receive the
+                # PRE-transform bytes (the untransformed key says so, and
+                # warm serves re-apply the transform). Filled BEFORE the
+                # transform runs — add_batch copies into the builder, so
+                # an in-place-mutating transform cannot corrupt the entry
+                # through aliased arrays. (A fill for a piece revoked
+                # mid-flight is discarded with its builder, never
+                # committed.)
+                t_ser = time.perf_counter()
+                builder.add_batch(batch)
+                self._note_handoff(time.perf_counter() - t_ser)
+                pre_filled = True
             # Placement-flippable transform stage (remote placement): runs
-            # before serialization AND before the cache fill — entries
-            # under the transform-aware key hold post-transform bytes.
+            # before serialization AND — post-transform placement only —
+            # before the cache fill.
             batch = self._transform(batch)
         permuting = self._permute is not None
         with self._lock:
@@ -617,7 +853,17 @@ class StreamingPieceEngine:
         # The cache fill gets EVERY batch (a watermark must never publish
         # a truncated entry); only the emission below honors `start`.
         if builder is not None and not revoked:
-            rows, fmt, frames = builder.add_batch(batch)
+            t_ser = time.perf_counter()
+            if pre_filled:
+                # The entry already holds this batch's pre-transform
+                # bytes; the wire gets the post-transform serialization
+                # (two serializations by design — the documented
+                # post-decode cost).
+                rows = (len(next(iter(batch.values()))) if batch else 0)
+                fmt, frames = encode_payload(batch)
+            else:
+                rows, fmt, frames = builder.add_batch(batch)
+            self._note_handoff(time.perf_counter() - t_ser)
             decode_s, self._pull_s = self._pull_s, 0.0
             if ordinal < start:
                 return  # skip-scan: below the re-serve watermark, not sent
@@ -628,7 +874,9 @@ class StreamingPieceEngine:
                 # revoked mid-decode: either way the batch will never be
                 # sent — drop it before paying the serialization.
                 return
+            t_ser = time.perf_counter()
             fmt, frames = encode_payload(batch)
+            self._note_handoff(time.perf_counter() - t_ser)
             rows = len(next(iter(batch.values()))) if batch else 0
         if permuting:
             # Buffer in canonical decode order; flushed permuted once the
@@ -695,15 +943,21 @@ class StreamingPieceEngine:
             gen = self._gen.get(piece, 0)
         if state not in (_DECODING, _SERVING) or collator is None:
             return  # revoked (or unknown): partial fill discarded, no tail
-        for tail in collator.flush_all():
-            self._emit_batch(piece, gen, tail, builder)
+        if collator is not _FUSED_PIECE:
+            # Fused pieces have no stream-thread collator to flush — the
+            # pool task emitted the whole piece (tail included) already.
+            for tail in collator.flush_all():
+                self._emit_batch(piece, gen, tail, builder)
+            # Tail emitted: the piece's accumulated hand-off seconds are
+            # complete — one locked counter increment per piece.
+            self._flush_handoff()
         if builder is not None:
             try:
                 builder.commit()
             except Exception:
                 logger.warning("cache fill commit failed for piece %d",
                                piece, exc_info=True)
-        if self._permute is not None:
+        if self._permute is not None and collator is not _FUSED_PIECE:
             self._flush_permuted(piece, gen)
         with self._lock:
             if self._state.get(piece) == _REVOKED:
@@ -731,6 +985,7 @@ class StreamingPieceEngine:
                 "engine_pieces_quarantined": self._quarantined_pieces,
                 "engine_rows_emitted": self._rows_emitted,
                 "engine_finished": self._finished,
+                "engine_fused": self._fused,
             })
         return out
 

@@ -40,6 +40,7 @@ from petastorm_tpu.telemetry.metrics import (
     WORKER_BATCHES_SENT,
     WORKER_CREDIT_WAIT,
     WORKER_DECODE_SECONDS,
+    WORKER_HANDOFF_SECONDS,
     WORKER_READERS_CONSTRUCTED,
     WORKER_ROWS_SENT,
     WORKER_STREAMS,
@@ -272,6 +273,7 @@ class BatchWorker:
         self._m_credit_wait = WORKER_CREDIT_WAIT.labels(self.worker_id)
         self._m_active = WORKER_ACTIVE_STREAMS.labels(self.worker_id)
         self._m_decode = WORKER_DECODE_SECONDS.labels(self.worker_id)
+        self._m_handoff = WORKER_HANDOFF_SECONDS.labels(self.worker_id)
         self._m_readers = WORKER_READERS_CONSTRUCTED.labels(self.worker_id)
         self._m_transform = WORKER_TRANSFORM_SECONDS.labels(self.worker_id)
         self._heartbeat_thread = None
@@ -585,6 +587,64 @@ class BatchWorker:
                              "(transform_placement='local') or drop "
                              "--batch-transform"})
                 return
+        # Graph-rewrite stream attributes (docs/guides/pipeline.md
+        # #graph-rewrites) — all engine-path-only (tagged/dynamic, or the
+        # untagged cache-armed engine stream):
+        #
+        # - ``fused``: collapse collate→transform(→pack)→serialize into
+        #   the decode pool task (stage fusion; downgraded with a warning
+        #   when the reader family cannot fuse — bytes identical either
+        #   way);
+        # - ``predicate`` (wire dict) / ``projection`` (field list): the
+        #   hoisted row filter and column pruning, applied BELOW decode in
+        #   the stream's reader — dropped rows never decode, pruned
+        #   columns are never read;
+        # - ``cache_stage``: where the batch cache sits relative to the
+        #   batch transform ("post-transform" default / "post-decode").
+        fused = bool(header.get("fused"))
+        cache_stage = header.get("cache_stage") or "post-transform"
+        stream_predicate = None
+        if header.get("predicate") is not None:
+            from petastorm_tpu.predicates import ColumnPredicate
+
+            try:
+                stream_predicate = ColumnPredicate.from_wire(
+                    header["predicate"])
+            except ValueError as exc:
+                send_framed(sock, {"type": "error",
+                                   "error": f"bad stream predicate: {exc}"})
+                return
+            if self._reader_kwargs.get("predicate") is not None:
+                send_framed(sock, {
+                    "type": "error",
+                    "error": "stream carries a predicate but this worker "
+                             "was constructed with reader_kwargs["
+                             "'predicate'] — one row filter per stream: "
+                             "drop one of the two"})
+                return
+        projection = ([str(f) for f in header["projection"]]
+                      if header.get("projection") else None)
+        if cache_stage not in ("post-transform", "post-decode"):
+            send_framed(sock, {
+                "type": "error",
+                "error": f"unknown cache_stage {cache_stage!r} "
+                         f"(post-transform|post-decode)"})
+            return
+        needs_engine = (fused or stream_predicate is not None
+                        or projection is not None
+                        or cache_stage != "post-transform")
+        if needs_engine and not (
+                (dynamic or tagged or self._batch_cache is not None)
+                and self._engine_supported()):
+            send_framed(sock, {
+                "type": "error",
+                "error": "stream requested a graph rewrite (fused/"
+                         "predicate/projection/cache_stage) but this "
+                         "serving path cannot apply it: rewrites run "
+                         "inside the streaming piece engine (tagged/"
+                         "dynamic protocols, reader_pool_type='thread') "
+                         "— use static or dynamic sharding"})
+            return
         # Placement-flippable batch transform: "local" tells this worker
         # to SKIP its configured batch_transform — the client applies the
         # identical callable trainer-side (docs/guides/pipeline.md).
@@ -650,25 +710,27 @@ class BatchWorker:
         with self._lock:
             self._active[stream_key] = state
         self._m_active.inc()
+        rewrites = {"fused": fused, "predicate": stream_predicate,
+                    "projection": projection, "cache_stage": cache_stage}
         try:
             if dynamic:
                 rows_sent = self._stream_dynamic(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job, packing=packing)
+                    job=job, packing=packing, rewrites=rewrites)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, starts, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job, packing=packing)
+                    job=job, packing=packing, rewrites=rewrites)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
                     sock, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job)
+                    job=job, rewrites=rewrites)
             else:
                 if shuffle_seed is not None:
                     # This serving path cannot compose the serve-time
@@ -869,7 +931,8 @@ class BatchWorker:
             "reader_pool_type", "thread") in ("thread", "dummy")
 
     def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None,
-                     job=None, allow_quarantine=False, packing=None):
+                     job=None, allow_quarantine=False, packing=None,
+                     rewrites=None):
         """ONE dynamic-ventilation reader + engine for a whole stream —
         the piece queue is fed (and edited) afterwards, so a stream (or a
         cold cache fill) over N pieces costs one reader construction, one
@@ -885,12 +948,27 @@ class BatchWorker:
         from petastorm_tpu.service.piece_engine import StreamingPieceEngine
         from petastorm_tpu.service.seedtree import batch_permutation
 
+        rewrites = dict(rewrites or {})
+        stream_predicate = rewrites.get("predicate")
+        projection = rewrites.get("projection")
+        fused = bool(rewrites.get("fused"))
+        cache_stage = rewrites.get("cache_stage") or "post-transform"
+        reader_kwargs = dict(self._reader_kwargs)
+        if stream_predicate is not None:
+            # The hoisted row filter: applied in the reader's two-phase
+            # predicate read, BELOW decode — dropped rows never decode.
+            reader_kwargs["predicate"] = stream_predicate
+        if projection is not None:
+            # Hoisted column pruning: only the projected fields are read
+            # (and decoded) at all; overrides any construction-time view.
+            reader_kwargs["schema_fields"] = list(projection)
+
         def build_reader():
             self._m_readers.inc()
             return self._factory(self.dataset_url, dynamic_ventilation=True,
                                  num_epochs=1, shuffle_row_groups=False,
                                  cur_shard=0, shard_count=1,
-                                 **self._reader_kwargs)
+                                 **reader_kwargs)
 
         permute_fn = None
         if shuffle_seed is not None:
@@ -900,7 +978,12 @@ class BatchWorker:
                 return batch_permutation(seed, epoch_number, piece, n)
 
         cache = self._batch_cache
-        transformed = transform_fn is not None
+        # Post-decode cache placement stores PRE-transform bytes, so the
+        # key must say "untransformed" — which is also exactly why a
+        # placement flip re-fills instead of serving the other placement's
+        # bytes (the two placements' keys differ).
+        transformed = (transform_fn is not None
+                       and cache_stage == "post-transform")
         packer_factory = None
         if packing is not None:
             from petastorm_tpu.service.packing_stage import StreamPacker
@@ -911,13 +994,16 @@ class BatchWorker:
             build_reader, self._batch_size, cache=cache,
             cache_key_fn=(
                 (lambda piece: self._piece_cache_key(
-                    piece, transformed=transformed, packing=packing))
+                    piece, transformed=transformed, packing=packing,
+                    predicate=stream_predicate, projection=projection))
                 if cache is not None else None),
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit, job=job))
                 if cache is not None else None),
             permute_fn=permute_fn, transform_fn=transform_fn,
             packer_factory=packer_factory,
+            fused=fused, cache_stage=cache_stage,
+            handoff_note_fn=self._m_handoff.inc,
             # Quarantine needs a frame vocabulary that can SAY
             # "piece_failed": only the tagged/dynamic protocols have one —
             # a legacy plain/fcfs stream keeps failing loudly.
@@ -940,7 +1026,7 @@ class BatchWorker:
     def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
                               shuffle_seed=None, transform_fn=None,
-                              job=None):
+                              job=None, rewrites=None):
         """Cache-armed serving through the streaming engine: warm pieces
         scatter-gather straight from cache memory, cold pieces decode
         through the stream's ONE shared pipeline and fill the cache — the
@@ -954,12 +1040,13 @@ class BatchWorker:
                                           epoch=epoch, tagged=False,
                                           shuffle_seed=shuffle_seed,
                                           transform_fn=transform_fn,
-                                          job=job)
+                                          job=job, rewrites=rewrites)
 
     def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
                               tagged=True, shuffle_seed=None,
-                              transform_fn=None, job=None, packing=None):
+                              transform_fn=None, job=None, packing=None,
+                              rewrites=None):
         """Exactly-once static serving: piece-aligned batches through the
         streaming engine, every ``batch`` frame tagged with its piece and
         absolute ``ordinal``, every finished piece announced with a
@@ -973,7 +1060,7 @@ class BatchWorker:
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
                                    job=job, allow_quarantine=tagged,
-                                   packing=packing)
+                                   packing=packing, rewrites=rewrites)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -1017,7 +1104,8 @@ class BatchWorker:
 
     def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
                         credits, stream_key, epoch=None, shuffle_seed=None,
-                        transform_fn=None, job=None, packing=None):
+                        transform_fn=None, job=None, packing=None,
+                        rewrites=None):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -1036,7 +1124,7 @@ class BatchWorker:
         collector = tracing.COLLECTOR
         engine = self._make_engine(epoch, shuffle_seed, transform_fn,
                                    job=job, allow_quarantine=True,
-                                   packing=packing)
+                                   packing=packing, rewrites=rewrites)
         with self._lock:
             # The engine is Reader-shaped for lifecycle and snapshots
             # (diagnostics / stop / join): the teardown block stops it,
@@ -1165,8 +1253,12 @@ class BatchWorker:
                              cur_shard=0, shard_count=1,
                              **self._reader_kwargs)
 
-    def _piece_cache_key(self, piece, transformed=False, packing=None):
-        from petastorm_tpu.cache_impl import batch_fingerprint
+    def _piece_cache_key(self, piece, transformed=False, packing=None,
+                         predicate=None, projection=None):
+        from petastorm_tpu.cache_impl import (
+            batch_fingerprint,
+            predicate_ingredient,
+        )
 
         kwargs = self._reader_kwargs
         # Content signature: the piece's (path, row_group) identity, not
@@ -1199,9 +1291,20 @@ class BatchWorker:
             # they can never serve an unpacked stream — or a different
             # slot shape — and vice versa.
             extra["packing"] = packing.key_dict()
+        if predicate is not None:
+            # Hoisted stream-level row filter: entries hold only the
+            # SURVIVING rows, so the filter is part of the content
+            # identity (canonical wire form — stable across worker
+            # restarts, unlike a live object's repr).
+            extra["stream_predicate"] = predicate_ingredient(predicate)
+        fields = kwargs.get("schema_fields")
+        if projection is not None:
+            # Hoisted column pruning: the projected field set supersedes
+            # any construction-time view for this stream's entries.
+            fields = sorted(projection)
         return batch_fingerprint(
             self.dataset_url, [signature], self._batch_size,
-            fields=kwargs.get("schema_fields"),
+            fields=fields,
             transform=kwargs.get("transform_spec"),
             factory=self._factory_name,
             extra=extra)

@@ -235,6 +235,62 @@ AUTOTUNE_ROUNDS = REGISTRY.counter(
     "growth",
     labels=("outcome",))
 
+# -- graph rewrites (pipeline/rewrites.py) -----------------------------------
+
+REWRITE_DECISIONS = REGISTRY.counter(
+    "petastorm_rewrite_decisions_total",
+    "Graph rewrites the autotuner applied or reverted, by rewrite kind "
+    "(fuse_worker_stages / hoist_filter / cache_placement — the catalog in "
+    "docs/guides/pipeline.md#graph-rewrites) and direction (flip = applied "
+    "or moved, revert = a probe that regressed throughput rolled the "
+    "topology back). A subset of petastorm_autotune_decisions_total: every "
+    "rewrite decision counts in both",
+    labels=("rewrite", "direction"))
+REWRITE_ACTIVE = REGISTRY.gauge(
+    "petastorm_rewrite_active",
+    "Whether each graph rewrite is currently in force (1) or at its "
+    "baseline topology (0): stage fusion fused, the row filter hoisted "
+    "worker-side, the cache insertion point moved post-decode. Set by the "
+    "autotune controller on every applied/reverted rewrite decision; "
+    "labeled per controller instance like the knob-value gauge (two "
+    "autotuned loaders must not clobber each other's topology reading — "
+    "a collected controller's series are removed)",
+    labels=("controller", "rewrite"))
+
+# -- fused worker stages (stage-fusion rewrite) ------------------------------
+
+WORKER_HANDOFF_SECONDS = REGISTRY.counter(
+    "petastorm_service_worker_handoff_seconds_total",
+    "Seconds the stream-serving thread spent on per-output hand-off work "
+    "(collation of pool outputs into batches + wire serialization) — the "
+    "overhead the stage-fusion rewrite moves into the pool task. High "
+    "relative to decode seconds is the fusion trigger "
+    "(docs/guides/pipeline.md#graph-rewrites); near zero while fused",
+    labels=("worker",))
+WORKER_FUSED_STAGE_SECONDS = REGISTRY.counter(
+    "petastorm_service_worker_fused_stage_seconds_total",
+    "Seconds spent inside the FUSED pool task, attributed per constituent "
+    "stage — stage fusion collapses the stages into one task but their "
+    "costs stay separately attributable here, feeding the same graph "
+    "nodes the unfused stages would. Labels: collate (includes the "
+    "packing wrapper's work when worker-placed packing is fused; the "
+    "petastorm_packing_* families stay the precise packing measurement) "
+    "and serialize; the transform keeps its own worker_transform_seconds "
+    "family",
+    labels=("stage",))
+
+# -- client-side row filter (filter-hoisting rewrite baseline) ---------------
+
+CLIENT_FILTER_ROWS = REGISTRY.counter(
+    "petastorm_service_client_filter_rows_total",
+    "Rows entering (outcome=in) and surviving (outcome=kept) the "
+    "trainer-local row filter (ServiceBatchSource(predicate=...) with "
+    "filter_placement='client'). The kept/in ratio is the measured "
+    "selectivity the filter-hoisting rewrite triggers on: a low ratio "
+    "means most decoded bytes are dropped after the fact, and hoisting "
+    "the predicate below the workers' decode stops paying for them",
+    labels=("outcome",))
+
 # -- pipeline transform stage (placement-flippable batch transform) ----------
 
 WORKER_TRANSFORM_SECONDS = REGISTRY.histogram(

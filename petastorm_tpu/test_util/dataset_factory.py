@@ -113,6 +113,54 @@ def create_test_token_dataset(dataset_url, rows_count=60,
     return rows
 
 
+#: Predicate-selective layout (the filter-hoisting rewrite's fixture —
+#: docs/guides/pipeline.md#graph-rewrites): a cheap scalar ``keep``
+#: column drives row selection while ``payload`` makes every NON-hoisted
+#: decode expensive enough to measure — dropping a row after decode costs
+#: real work, dropping it in the two-phase predicate read costs none.
+def _selective_schema(payload_shape):
+    return Unischema("SelectiveSchema", [
+        UnischemaField("id", np.int64, (), ScalarCodec(), False),
+        UnischemaField("keep", np.int32, (), ScalarCodec(), False),
+        UnischemaField("payload", np.uint8, tuple(payload_shape),
+                       CompressedImageCodec("png"), False),
+    ])
+
+
+SelectiveSchema = _selective_schema((64, 64, 3))
+
+
+def make_selective_row(index, keep_every=4, payload_shape=(64, 64, 3)):
+    """One deterministic row: ``keep`` is 1 for every ``keep_every``-th
+    row (selectivity = 1/keep_every), payload derived from the index so
+    every byte is reproducible."""
+    rng = np.random.RandomState(1789 + index)
+    return {
+        "id": index,
+        "keep": np.int32(1 if index % keep_every == 0 else 0),
+        "payload": rng.randint(0, 255, payload_shape, dtype=np.uint8),
+    }
+
+
+def create_test_selective_dataset(dataset_url, rows_count=120,
+                                  rows_per_row_group=20, keep_every=4,
+                                  payload_shape=(64, 64, 3),
+                                  **write_kwargs):
+    """Write a predicate-selective petastorm dataset: a majority of rows
+    (``1 - 1/keep_every``) fail ``keep == 1``, and the payload is a real
+    png decode per row, so a hoisted predicate skips most of the decode
+    work a client-side filter pays for. Returns the source rows. Pair
+    with ``ColumnPredicate('keep', 'eq', 1)``
+    (:mod:`petastorm_tpu.predicates`)."""
+    schema = _selective_schema(payload_shape)
+    rows = [make_selective_row(i, keep_every=keep_every,
+                               payload_shape=tuple(payload_shape))
+            for i in range(rows_count)]
+    materialize_rows(dataset_url, schema, rows,
+                     rows_per_row_group=rows_per_row_group, **write_kwargs)
+    return rows
+
+
 ScalarSchema = Unischema("ScalarSchema", [
     UnischemaField("id", np.int64, (), None, False),
     UnischemaField("float_col", np.float64, (), None, False),

@@ -35,6 +35,20 @@ class DummyPool:
         #: ThreadPool: the marker rides the results deque BEHIND the item's
         #: payloads, so the hook fires only after all of them were returned.
         self.item_done_hook = None
+        #: ``fn(payload) -> payload`` applied to published PiecePayloads —
+        #: ThreadPool parity (here it runs inline in :meth:`ventilate`,
+        #: keeping this pool's determinism).
+        self.publish_transform = None
+
+    def _publish(self, item):
+        transform = self.publish_transform
+        if transform is not None:
+            from petastorm_tpu.reader_impl.delivery_tracker import (
+                apply_publish_transform,
+            )
+
+            item = apply_publish_transform(transform, item)
+        self._results.append(item)
 
     @property
     def diagnostics(self):
@@ -50,7 +64,7 @@ class DummyPool:
         }
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
-        self._worker = worker_class(0, self._results.append, worker_setup_args)
+        self._worker = worker_class(0, self._publish, worker_setup_args)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()

@@ -71,6 +71,12 @@ class ThreadPool:
         #: strictly AFTER every payload that item published was returned
         #: (payloads and marker ride the same FIFO queue).
         self.item_done_hook = None
+        #: Optional ``fn(payload) -> payload`` applied to every published
+        #: :class:`PiecePayload` ON THE WORKER THREAD, before it enters the
+        #: results queue — how the stage-fusion rewrite moves collation/
+        #: transform/serialization into the pool task
+        #: (``Reader.set_publish_transform``).
+        self.publish_transform = None
 
     @property
     def workers_count(self):
@@ -95,6 +101,17 @@ class ThreadPool:
         # Worker-facing publish: counts real payloads so results_qsize /
         # diagnostics report result depth, not bookkeeping-message depth
         # (the raw queue also carries DONE markers and exceptions).
+        transform = self.publish_transform
+        if transform is not None:
+            from petastorm_tpu.reader_impl.delivery_tracker import (
+                apply_publish_transform,
+            )
+
+            # Runs on the pool worker thread — that is the point: the
+            # fused task pays collate/transform/serialize here, in
+            # parallel across workers, instead of on the single
+            # stream-serving thread.
+            item = apply_publish_transform(transform, item)
         with self._counter_lock:
             self._results_pending += 1
         POOL_RESULTS_QUEUE_DEPTH.inc()

@@ -66,7 +66,12 @@ _READER_POOL_THREAD_PREFIX = "petastorm-tpu-worker"
 #: The pipeline autotuner's controller thread is a daemon too; one
 #: surviving a test means an autotuned loader was never stopped — it
 #: keeps re-planning (and resizing pools!) against a dead pipeline for
-#: the rest of the session.
+#: the rest of the session. Graph-rewrite rounds (stage fusion, filter
+#: hoisting, cache placement — pipeline/rewrites.py) run on this same
+#: controller thread, so a leaked rewrite controller is caught by this
+#: prefix too — rewrites spawn no threads of their own (the fused pool
+#: task reuses the reader pool's "petastorm-tpu-worker" threads, guarded
+#: above).
 _AUTOTUNE_THREAD_PREFIX = "pipeline-autotune"
 
 #: The fleet autoscaler's controller thread: one surviving a test means a

@@ -488,7 +488,19 @@ def test_autotuned_pipeline_converges_and_stays_bounded(petastorm_dataset):
         for batch in loader:
             rows += len(batch["id"])
     assert rows == 40 * len(petastorm_dataset.rows)
-    report = loader.autotune.report()
+    controller = loader.autotune
+    # Deterministic convergence gate (deflaked): the old assertions rode
+    # the wall clock — on a loaded host the 0.05s window loop could fit
+    # fewer than 4 rounds, or end mid-probe with noop_streak < 2. Gate on
+    # the JOURNAL instead: drive the stopped controller's planning rounds
+    # directly — post-iteration windows are idle (no rows moved), which
+    # by the planner's contract never applies a decision and never resets
+    # settled knobs, so the no-op streak grows deterministically.
+    for _ in range(8):
+        if controller.rounds >= 4 and controller.noop_streak >= 2:
+            break
+        controller.step()
+    report = controller.report()
     assert report["rounds"] >= 4
     # Convergence: the decision trail went quiet — trailing rounds are
     # no-ops (the planner settled every candidate knob for the steady

@@ -57,6 +57,30 @@ def test_every_metric_family_documented():
         f"docs/guides/diagnostics.md: {missing}")
 
 
+def test_every_rewrite_kind_documented_in_pipeline_catalog():
+    """Every graph rewrite the planner can apply must have a row in
+    pipeline.md's rewrite catalog table — same pattern as the
+    metric-family assertion: a new rewrite kind cannot ship
+    undocumented. The check is table-shaped (the kind must appear on a
+    `|`-delimited line), not a substring match anywhere in the file."""
+    from petastorm_tpu.pipeline.rewrites import REWRITE_KINDS
+
+    doc = (DOCS / "guides" / "pipeline.md").read_text()
+    table_rows = [line for line in doc.splitlines()
+                  if line.lstrip().startswith("|")]
+    missing = [kind for kind in REWRITE_KINDS
+               if not any(f"`{kind}`" in row for row in table_rows)]
+    assert not missing, (
+        f"rewrite kinds declared in pipeline.rewrites.REWRITE_KINDS but "
+        f"absent from pipeline.md's rewrite catalog table: {missing}")
+    # The catalog must also name each rewrite's knob so an operator can
+    # pin it.
+    for kind, info in REWRITE_KINDS.items():
+        assert any(f"`{info['knob']}`" in row for row in table_rows), \
+            f"rewrite {kind}'s knob {info['knob']!r} missing from the " \
+            f"pipeline.md catalog table"
+
+
 #: time.time() is wall-clock: NTP steps and DST make it wrong for duration
 #: math — perf_counter/monotonic only. The tree is clean; keep it that way.
 _WALL_CLOCK_RE = re.compile(r"\btime\.time\(\)")

@@ -1,0 +1,850 @@
+"""Graph-rewrite autotuning: correctness invariants + planner goldens.
+
+Covers the three rewrite families (docs/guides/pipeline.md#graph-rewrites):
+
+- stage fusion — fused vs unfused serving byte-identical (same seed,
+  permutation, watermarks), hand-off cost actually eliminated;
+- filter/projection hoisting — hoisted-predicate service run row-stream
+  identical to the client-side-filtered run with strictly less decode/
+  wire work, vectorized two-phase read equivalent to the per-row path;
+- planner-chosen cache placement — a placement flip RE-FILLS instead of
+  serving the other placement's bytes, and both placements deliver
+  identical bytes;
+
+plus canned-profile goldens for every rewrite trigger/hold/revert path
+(pure planner — no threads) and the graph/loader bindings.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.pipeline.autotune import Planner
+from petastorm_tpu.pipeline.rewrites import (
+    DEFAULT_THRESHOLDS,
+    REWRITE_KINDS,
+    rewrite_triggered,
+)
+from petastorm_tpu.predicates import ColumnPredicate, in_lambda
+
+BASE_KNOBS = {
+    "workers_count": {"kind": "int", "lo": 1, "hi": 16, "applies": "live"},
+    "credits": {"kind": "int", "lo": 1, "hi": 64, "applies": "next-stream"},
+}
+
+REWRITE_KNOBS = {
+    "stage_fusion": {"kind": "choice", "choices": ["off", "fused"],
+                     "applies": "next-iteration",
+                     "rewrite": "fuse_worker_stages"},
+    "filter_placement": {"kind": "choice", "choices": ["client", "worker"],
+                         "applies": "next-iteration",
+                         "rewrite": "hoist_filter"},
+    "cache_placement": {"kind": "choice",
+                        "choices": ["post-transform", "post-decode"],
+                        "applies": "next-iteration",
+                        "rewrite": "cache_placement"},
+}
+
+
+def _profile(*, wall=1.0, rows=10000, stall=0.5, knobs=None, **signals):
+    out = {"wall_s": wall, "rows": rows, "stall_s": stall,
+           "queue_wait_s": 0.0, "decode_s": 0.0, "dispatch_s": 0.0,
+           "knobs": dict(knobs or {})}
+    out.update(signals)
+    return out
+
+
+def _hoist_profile(**kw):
+    """A decode-bound window whose client filter drops 75% of rows."""
+    knobs = {"workers_count": 2, "credits": 8,
+             "filter_placement": "client", "stage_fusion": "off"}
+    knobs.update(kw.pop("knobs", {}))
+    return _profile(decode_s=0.9, filter_rows_in=1000.0,
+                    filter_rows_kept=250.0, knobs=knobs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ColumnPredicate: three evaluation forms agree; wire round-trip
+# ---------------------------------------------------------------------------
+
+def test_column_predicate_forms_agree():
+    import pyarrow as pa
+
+    values = np.array([0, 1, 2, 3, 4, 5, 9, 12], dtype=np.int64)
+    table = pa.table({"id": pa.array(values)})
+    cases = [
+        ColumnPredicate("id", "eq", 3),
+        ColumnPredicate("id", "ne", 3),
+        ColumnPredicate("id", "lt", 4),
+        ColumnPredicate("id", "le", 4),
+        ColumnPredicate("id", "gt", 4),
+        ColumnPredicate("id", "ge", 4),
+        ColumnPredicate("id", "in", [1, 9, 77]),
+        ColumnPredicate("id", "not-in", [1, 9, 77]),
+        ColumnPredicate("id", "mod-eq", 0, modulus=3),
+    ]
+    for pred in cases:
+        scalar = [bool(pred.do_include({"id": int(v)})) for v in values]
+        vector = list(pred.do_include_vectorized({"id": values},
+                                                 len(values)))
+        arrow = list(pred.pa_mask(table))
+        assert scalar == vector == arrow, repr(pred)
+        # Wire round-trip preserves behavior (what stream requests carry).
+        clone = ColumnPredicate.from_wire(pred.to_wire())
+        assert [bool(clone.do_include({"id": int(v)}))
+                for v in values] == scalar
+        assert clone.to_wire() == pred.to_wire()
+
+
+def test_column_predicate_validation():
+    with pytest.raises(ValueError, match="op must be"):
+        ColumnPredicate("id", "between", 3)
+    with pytest.raises(ValueError, match="modulus"):
+        ColumnPredicate("id", "mod-eq", 0)
+    with pytest.raises(ValueError, match="modulus"):
+        ColumnPredicate("id", "eq", 0, modulus=3)
+    with pytest.raises(ValueError, match="wire form"):
+        ColumnPredicate.from_wire(["id", "eq", 1])
+
+
+# ---------------------------------------------------------------------------
+# Vectorized two-phase predicate read (satellite: _read_with_predicate)
+# ---------------------------------------------------------------------------
+
+def test_vectorized_predicate_read_matches_row_path(petastorm_dataset):
+    from petastorm_tpu import make_reader
+
+    def rows_with(predicate):
+        reader = make_reader(petastorm_dataset.url,
+                             reader_pool_type="dummy",
+                             shuffle_row_groups=False, num_epochs=1,
+                             predicate=predicate)
+        with reader:
+            return sorted(int(row.id) for row in reader)
+
+    column = rows_with(ColumnPredicate("id", "mod-eq", 0, modulus=3))
+    # in_lambda has no column-level form: the per-row fallback path.
+    row_path = rows_with(in_lambda(["id"], lambda v: v["id"] % 3 == 0))
+    expected = [i for i in range(len(petastorm_dataset.rows)) if i % 3 == 0]
+    assert column == row_path == expected
+
+
+def test_selective_dataset_factory(tmp_path):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.test_util.dataset_factory import (
+        create_test_selective_dataset,
+    )
+
+    url = f"file://{tmp_path}/selective"
+    rows = create_test_selective_dataset(url, rows_count=40,
+                                         rows_per_row_group=10,
+                                         keep_every=4)
+    assert sum(1 for r in rows if r["keep"]) == 10
+    reader = make_reader(url, reader_pool_type="dummy",
+                         shuffle_row_groups=False, num_epochs=1,
+                         predicate=ColumnPredicate("keep", "eq", 1))
+    with reader:
+        got = sorted(int(row.id) for row in reader)
+    assert got == [i for i in range(40) if i % 4 == 0]
+
+
+# ---------------------------------------------------------------------------
+# Service-path invariants: fused byte-identity, hoist equivalence,
+# cache-placement re-fill
+# ---------------------------------------------------------------------------
+
+def _service_run(url, *, shuffle_seed=None, num_epochs=1, batch_size=7,
+                 batch_cache=None, batch_transform=None, **source_kwargs):
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=num_epochs,
+                            shuffle_seed=shuffle_seed).start()
+    worker = BatchWorker(url, dispatcher_address=dispatcher.address,
+                         batch_size=batch_size, batch_cache=batch_cache,
+                         batch_transform=batch_transform,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                    **source_kwargs)
+        digest = StreamDigest()
+        batches = []
+        for batch in source():
+            digest.update(batch)
+            batches.append({k: np.asarray(v) for k, v in batch.items()})
+        return {"digest": digest.hexdigest(), "batches": batches,
+                "worker": worker.diagnostics_snapshot()["metrics"],
+                "cache": worker.cache_stats()}
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_fused_stream_byte_identical_under_shuffle(petastorm_dataset):
+    base = _service_run(petastorm_dataset.url, shuffle_seed=11)
+    fused = _service_run(petastorm_dataset.url, shuffle_seed=11,
+                         stage_fusion="fused")
+    assert fused["digest"] == base["digest"]
+
+
+def test_fused_stream_byte_identical_at_watermarks(petastorm_dataset):
+    """A fused re-serve resumes at the same watermarks the unfused one
+    would: grant pieces with nonzero starts directly against the engine
+    and compare emitted frame bytes, fused vs unfused."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.service.piece_engine import StreamingPieceEngine
+    from petastorm_tpu.service.seedtree import batch_permutation
+
+    def events(fused):
+        def factory():
+            return make_reader(petastorm_dataset.url,
+                               reader_pool_type="thread", workers_count=2,
+                               num_epochs=1, shuffle_row_groups=False,
+                               dynamic_ventilation=True)
+
+        engine = StreamingPieceEngine(
+            factory, 4, fused=fused,
+            permute_fn=lambda piece, n: batch_permutation(5, 0, piece, n))
+        try:
+            engine.enqueue(0, 0, start=1)  # mid-piece watermark re-serve
+            engine.enqueue(1, 0, start=0)
+            engine.finish()
+            out = []
+            while True:
+                event = engine.next_event(timeout=5.0)
+                if event is None:
+                    if engine.finished:
+                        return out
+                    continue
+                if event[0] == "batch":
+                    _, piece, _gen, ordinal, rows, fmt, frames, _ = event
+                    out.append((piece, ordinal, rows, fmt,
+                                [bytes(f) for f in frames]))
+        finally:
+            engine.close()
+
+    # Piece COMPLETION order races across pool workers (both modes);
+    # within a piece the ordinals are total — compare piece-sorted.
+    assert sorted(events(fused=True)) == sorted(events(fused=False))
+
+
+def test_fusion_eliminates_handoff_and_attributes_stages(petastorm_dataset):
+    from petastorm_tpu.telemetry.metrics import (
+        WORKER_FUSED_STAGE_SECONDS,
+        WORKER_HANDOFF_SECONDS,
+    )
+
+    def handoff_total():
+        return sum(child.value
+                   for child in WORKER_HANDOFF_SECONDS.children().values())
+
+    fused_before = {
+        key: child.value
+        for key, child in WORKER_FUSED_STAGE_SECONDS.children().items()}
+
+    before = handoff_total()
+    _service_run(petastorm_dataset.url)
+    unfused_handoff = handoff_total() - before
+
+    before = handoff_total()
+    _service_run(petastorm_dataset.url, stage_fusion="fused")
+    fused_handoff = handoff_total() - before
+
+    assert unfused_handoff > 0
+    # Fused serving does the collation/serialization inside the pool
+    # task: the stream thread's hand-off cost disappears.
+    assert fused_handoff == 0
+    # ... and the fused task's cost stays attributed per constituent
+    # stage (the StageNode fuse-metadata contract).
+    collate = WORKER_FUSED_STAGE_SECONDS.children().get(("collate",))
+    serialize = WORKER_FUSED_STAGE_SECONDS.children().get(("serialize",))
+    assert collate is not None and serialize is not None
+    assert collate.value > fused_before.get(("collate",), 0.0)
+    assert serialize.value > fused_before.get(("serialize",), 0.0)
+
+
+def test_hoisted_filter_equals_client_filter_row_stream(petastorm_dataset):
+    predicate = ColumnPredicate("id", "mod-eq", 0, modulus=3)
+    client = _service_run(petastorm_dataset.url, predicate=predicate,
+                          filter_placement="client")
+    hoisted = _service_run(petastorm_dataset.url, predicate=predicate,
+                           filter_placement="worker")
+    survivors = [i for i in range(len(petastorm_dataset.rows))
+                 if i % 3 == 0]
+
+    def flat_ids(run):
+        return [int(i) for b in run["batches"] for i in b["id"]]
+
+    # Identical surviving row stream (content AND order); batch
+    # boundaries legitimately differ (the hoisted side collates
+    # survivors into full batches below decode).
+    assert flat_ids(client) == flat_ids(hoisted) == survivors
+    for field in petastorm_dataset.schema.fields:
+        flat_client = [row for b in client["batches"] for row in b[field]]
+        flat_hoisted = [row for b in hoisted["batches"]
+                        for row in b[field]]
+        assert len(flat_client) == len(flat_hoisted) == len(survivors)
+        for a, b in zip(flat_client, flat_hoisted):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), field
+    # Dropped rows never cross the wire under the hoist.
+    assert client["worker"]["rows_sent_total"] \
+        == len(petastorm_dataset.rows)
+    assert hoisted["worker"]["rows_sent_total"] == len(survivors)
+
+
+def test_hoisted_projection_prunes_columns(petastorm_dataset):
+    predicate = ColumnPredicate("id", "mod-eq", 0, modulus=5)
+    run = _service_run(petastorm_dataset.url, predicate=predicate,
+                       filter_placement="worker",
+                       projection=["id", "id2"])
+    assert run["batches"]
+    for batch in run["batches"]:
+        assert sorted(batch.keys()) == ["id", "id2"]
+
+
+def _double_ids(batch):
+    out = dict(batch)
+    out["id_double"] = np.asarray(batch["id"]) * 2
+    return out
+
+
+def _bump_id2(batch):
+    """Deliberately NON-idempotent (id2 += 1): applying it twice is
+    visible — the pin that post-decode cache fills hold PRE-transform
+    bytes (a post-transform fill would double-transform on warm serve,
+    which an idempotent transform could never catch)."""
+    out = dict(batch)
+    out["id2"] = np.asarray(batch["id2"]) + 1
+    out["id_double"] = np.asarray(batch["id"]) * 2
+    return out
+
+
+def test_cache_placement_flip_refills_and_serves_identical_bytes(
+        petastorm_dataset):
+    from petastorm_tpu.cache_impl import BatchCache
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    cache = BatchCache(mem_budget_bytes=64 << 20)
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=7, batch_cache=cache,
+                         batch_transform=_bump_id2,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        def run(placement):
+            source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                        transform=_bump_id2,
+                                        cache_placement=placement)
+            digest = StreamDigest()
+            for batch in source():
+                assert np.array_equal(np.asarray(batch["id_double"]),
+                                      np.asarray(batch["id"]) * 2)
+                # Applied exactly ONCE — a post-decode warm serve that
+                # re-transformed post-transform bytes would show id2 + 2.
+                assert np.array_equal(
+                    np.asarray(batch["id2"]),
+                    np.asarray(batch["id"]) % 5 + 1)
+                digest.update(batch)
+            return digest.hexdigest(), dict(worker.cache_stats() or {})
+
+        pieces = worker.num_pieces
+        digest_pt, stats1 = run("post-transform")      # cold fill
+        digest_pd, stats2 = run("post-decode")         # flip: must MISS
+        assert stats2["misses"] == stats1["misses"] + pieces, \
+            "a cache-placement flip must re-fill, not serve the other " \
+            "placement's bytes"
+        digest_pd_warm, stats3 = run("post-decode")    # warm on new key
+        assert stats3["hits"] == stats2["hits"] + pieces
+        assert stats3["misses"] == stats2["misses"]
+        # Placement never changes delivered bytes — post-decode warm
+        # serves re-apply the transform to identical effect.
+        assert digest_pt == digest_pd == digest_pd_warm
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def _inplace_bump_id2(batch):
+    """Mutates the collated id2 array IN PLACE before returning — the
+    adversarial transform for the pre-transform cache fill: aliased
+    frames captured after the transform would hold the mutated data."""
+    arr = np.asarray(batch["id2"])
+    arr += 1
+    out = dict(batch)
+    out["id2"] = arr
+    return out
+
+
+@pytest.mark.parametrize("fusion", ["off", "fused"])
+def test_post_decode_fill_immune_to_inplace_transform(petastorm_dataset,
+                                                      fusion):
+    from petastorm_tpu.cache_impl import BatchCache
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=2).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=7,
+                         batch_cache=BatchCache(mem_budget_bytes=64 << 20),
+                         batch_transform=_inplace_bump_id2,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                    transform=_inplace_bump_id2,
+                                    cache_placement="post-decode",
+                                    stage_fusion=fusion)
+        for batch in source():  # epoch 1 cold-fills, epoch 2 warm-serves
+            # Exactly one application everywhere: a fill that captured
+            # the in-place-mutated arrays would deliver id2 + 2 on warm
+            # serves.
+            assert np.array_equal(np.asarray(batch["id2"]),
+                                  np.asarray(batch["id"]) % 5 + 1)
+        stats = worker.cache_stats()
+        assert stats["hits"] > 0
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_vectorized_mask_guard_excludes_non_numeric_scalars(
+        petastorm_dataset):
+    """Decimal scalars are STORED as Arrow strings — a column-level
+    comparison on the stored values would diverge from the decoded-value
+    row path (lexicographic vs numeric), so only numeric/bool scalar
+    fields take the vectorized mask."""
+    import pyarrow as pa
+
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.reader.py_dict_worker import PyDictReaderWorker
+
+    schema = petastorm_dataset.schema
+    worker = PyDictReaderWorker(
+        0, lambda payload: None,
+        (None, [], schema, schema, None, NullCache(), None))
+    pred_int = ColumnPredicate("id", "ge", 0)
+    view_int = schema.create_schema_view([schema.fields["id"]])
+    mask = worker._vectorized_predicate_mask(
+        pred_int, view_int, pa.table({"id": pa.array([1, 2, 3])}))
+    assert mask is not None and list(mask) == [True, True, True]
+    pred_dec = ColumnPredicate("decimal", "eq", "1.1")
+    view_dec = schema.create_schema_view([schema.fields["decimal"]])
+    assert worker._vectorized_predicate_mask(
+        pred_dec, view_dec,
+        pa.table({"decimal": pa.array(["1.1", "2.2"])})) is None
+    pred_str = ColumnPredicate("string_value", "eq", "string_1")
+    view_str = schema.create_schema_view([schema.fields["string_value"]])
+    assert worker._vectorized_predicate_mask(
+        pred_str, view_str,
+        pa.table({"string_value": pa.array(["a", "b"])})) is None
+
+
+def test_rewrites_rejected_on_fcfs(petastorm_dataset):
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+
+    dispatcher = Dispatcher(port=0, mode="fcfs", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=7,
+                         reader_kwargs={"workers_count": 1}).start()
+    try:
+        source = ServiceBatchSource(dispatcher.address,
+                                    stage_fusion="fused")
+        with pytest.raises(ValueError, match="graph rewrites"):
+            source()
+        # The direct setters refuse too once the mode is known — an
+        # autotuner flip must never arm a topology the next iteration
+        # would crash on (and the graph declines to bind rewrite knobs
+        # on fcfs sources, so the planner never tries).
+        plain = ServiceBatchSource(
+            dispatcher.address,
+            predicate=ColumnPredicate("id", "eq", 1))
+        for batch in plain():
+            break
+        with pytest.raises(ValueError, match="static or dynamic"):
+            plain.set_stage_fusion("fused")
+        with pytest.raises(ValueError, match="static or dynamic"):
+            plain.set_filter_placement("worker")
+        from petastorm_tpu.jax_utils.loader import JaxDataLoader
+        from petastorm_tpu.pipeline import build_loader_graph
+
+        loader = JaxDataLoader(None, 7, batch_source=plain,
+                               stage_to_device=False)
+        with loader:
+            for _ in loader:
+                break
+        graph = build_loader_graph(loader)
+        assert "stage_fusion" not in graph.knobs
+        assert "filter_placement" not in graph.knobs
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_source_validation_errors():
+    from petastorm_tpu.service import ServiceBatchSource
+
+    with pytest.raises(ValueError, match="filter_placement"):
+        ServiceBatchSource(("127.0.0.1", 1), predicate=None,
+                           filter_placement="worker")
+    with pytest.raises(ValueError, match="stage_fusion"):
+        ServiceBatchSource(("127.0.0.1", 1), stage_fusion="on")
+    with pytest.raises(ValueError, match="post-decode"):
+        ServiceBatchSource(("127.0.0.1", 1),
+                           cache_placement="post-decode")
+    source = ServiceBatchSource(("127.0.0.1", 1),
+                                predicate=ColumnPredicate("id", "eq", 1))
+    source.set_filter_placement("worker")
+    assert source.filter_placement == "worker"
+    with pytest.raises(ValueError, match="'client' or 'worker'"):
+        source.set_filter_placement("device")
+    # A transform-armed source pins the filter hoisted: client placement
+    # would evaluate post-transform batches.
+    with pytest.raises(ValueError, match="filter_placement='worker'"):
+        ServiceBatchSource(("127.0.0.1", 1), transform=_double_ids,
+                           predicate=ColumnPredicate("id", "eq", 1))
+    pinned = ServiceBatchSource(("127.0.0.1", 1), transform=_double_ids,
+                                predicate=ColumnPredicate("id", "eq", 1),
+                                filter_placement="worker")
+    with pytest.raises(ValueError, match="unavailable with a"):
+        pinned.set_filter_placement("client")
+    # Projection with a transform must ride the hoisted topology too:
+    # client-side pruning would run after a remote transform but before
+    # a local one, changing the transform's input across a flip.
+    with pytest.raises(ValueError, match="projection= with transform="):
+        ServiceBatchSource(("127.0.0.1", 1), transform=_double_ids,
+                           projection=["id"])
+    ServiceBatchSource(("127.0.0.1", 1), transform=_double_ids,
+                       predicate=ColumnPredicate("id", "eq", 1),
+                       filter_placement="worker", projection=["id"])
+
+
+def test_resume_state_signs_hoisted_filter():
+    from petastorm_tpu.service import ServiceBatchSource
+
+    predicate = ColumnPredicate("keep", "eq", 1)
+    snapshot = {"version": 2, "mode": "static", "client_index": 0,
+                "num_clients": 1, "epoch": 0, "completed_pieces": [],
+                "watermarks": {"3": 2}, "packing": None,
+                "filter": predicate.to_wire()}
+    # Same hoisted filter: accepted.
+    ServiceBatchSource(("127.0.0.1", 1), resume_state=snapshot,
+                       predicate=predicate, filter_placement="worker")
+    # Hoisted → client (or absent): the watermark vocabulary changed.
+    with pytest.raises(ValueError, match="hoisted-filter mismatch"):
+        ServiceBatchSource(("127.0.0.1", 1), resume_state=snapshot,
+                           predicate=predicate,
+                           filter_placement="client")
+    with pytest.raises(ValueError, match="hoisted-filter mismatch"):
+        ServiceBatchSource(("127.0.0.1", 1), resume_state=snapshot)
+    # Legacy snapshot (no filter key) into a hoisted source: refused too.
+    legacy = {key: value for key, value in snapshot.items()
+              if key != "filter"}
+    with pytest.raises(ValueError, match="hoisted-filter mismatch"):
+        ServiceBatchSource(("127.0.0.1", 1), resume_state=legacy,
+                           predicate=predicate,
+                           filter_placement="worker")
+    # Legacy snapshot into an unfiltered source: unaffected.
+    ServiceBatchSource(("127.0.0.1", 1), resume_state=legacy)
+
+
+# ---------------------------------------------------------------------------
+# Planner goldens: trigger / hold / fall-through / revert, per rewrite
+# ---------------------------------------------------------------------------
+
+def _planner(**kw):
+    kw.setdefault("hysteresis", 1)
+    kw.setdefault("placement_hysteresis", 1)
+    kw.setdefault("rewrite_hysteresis", 2)
+    return Planner(dict(BASE_KNOBS, **REWRITE_KNOBS), **kw)
+
+
+def test_planner_hoist_trigger_golden():
+    planner = _planner()
+    profile = _hoist_profile()
+    assert planner.plan(profile) == []          # rewrite hysteresis holds
+    decisions = planner.plan(profile)
+    assert [(d["knob"], d["direction"], d["to"], d["rewrite"])
+            for d in decisions] == \
+        [("filter_placement", "flip", "worker", "hoist_filter")]
+    assert "drops 75%" in decisions[0]["reason"]
+    assert decisions[0]["applies"] == "next-iteration"
+
+
+def test_planner_untriggered_rewrite_falls_through_to_knobs():
+    planner = _planner()
+    # Decode-bound, but no filter signal, no handoff signal, no cache
+    # signal: every rewrite is untriggered — the class's capacity knob is
+    # probed instead, without waiting out rewrite hysteresis.
+    profile = _profile(decode_s=0.9,
+                       knobs={"workers_count": 2, "credits": 8,
+                              "filter_placement": "client",
+                              "stage_fusion": "off",
+                              "cache_placement": "post-transform"})
+    decisions = _plan_until(planner, profile)
+    assert [(d["knob"], d["direction"]) for d in decisions] == \
+        [("workers_count", "up")]
+    assert "rewrite" not in decisions[0]
+
+
+def test_planner_fuse_trigger_golden():
+    planner = _planner()
+    profile = _profile(decode_s=0.9, worker_decode_s=0.5, handoff_s=0.2,
+                       knobs={"workers_count": 2, "credits": 8,
+                              "stage_fusion": "off"})
+    decisions = _plan_until(planner, profile)
+    assert [(d["knob"], d["to"], d["rewrite"]) for d in decisions] == \
+        [("stage_fusion", "fused", "fuse_worker_stages")]
+
+
+def test_planner_fuse_counts_remote_transform_as_movable():
+    # Hand-off alone is below the threshold, but the worker-side
+    # transform rides the same serving thread: together they trigger.
+    profile = _profile(decode_s=0.9, worker_decode_s=1.0, handoff_s=0.05,
+                       transform_s=0.5,
+                       knobs={"workers_count": 2, "credits": 8,
+                              "stage_fusion": "off",
+                              "transform_placement": "remote"})
+    assert rewrite_triggered("fuse_worker_stages", "fused", profile)[0]
+    local = dict(profile)
+    local["knobs"] = dict(profile["knobs"], transform_placement="local")
+    assert not rewrite_triggered("fuse_worker_stages", "fused", local)[0]
+
+
+def test_planner_cache_placement_triggers_both_directions():
+    down = _profile(decode_s=0.9, worker_decode_s=1.0, transform_s=0.1,
+                    cache_hits=5.0, cache_misses=5.0, cache_evictions=3.0,
+                    knobs={"workers_count": 2, "credits": 8,
+                           "cache_placement": "post-transform"})
+    triggered, why = rewrite_triggered("cache_placement", "post-decode",
+                                       down)
+    assert triggered and "eviction pressure" in why
+    planner = _planner()
+    decisions = _plan_until(planner, down)
+    assert [(d["knob"], d["to"]) for d in decisions] == \
+        [("cache_placement", "post-decode")]
+
+    # consumer-bound + hot warm-serve transform: move the cache back up.
+    up = _profile(stall=0.01, queue_wait_s=0.5, transform_s=0.4,
+                  cache_hits=9.0, cache_misses=1.0,
+                  knobs={"workers_count": 2, "credits": 8,
+                         "cache_placement": "post-decode"})
+    planner = _planner()
+    decisions = _plan_until(planner, up)
+    assert [(d["knob"], d["to"]) for d in decisions] == \
+        [("cache_placement", "post-transform")]
+
+
+def test_planner_rewrite_revert_on_regression():
+    planner = _planner(probe_defer=0)
+    profile = _hoist_profile()
+    decisions = _plan_until(planner, profile)
+    assert decisions[0]["knob"] == "filter_placement"
+    # Next window: the flip landed but throughput regressed hard.
+    flipped = _hoist_profile(rows=5000,
+                             knobs={"filter_placement": "worker"})
+    decisions = planner.plan(flipped)
+    assert [(d["knob"], d["direction"], d["to"], d["rewrite"])
+            for d in decisions] == \
+        [("filter_placement", "revert", "client", "hoist_filter")]
+    # Settled: the regressing rewrite is not re-probed while the
+    # bottleneck class persists — the class falls through to its
+    # capacity knobs instead.
+    later = _plan_until(planner, _hoist_profile())
+    assert later and all(d["knob"] != "filter_placement" for d in later)
+    assert later[0]["knob"] == "workers_count"
+
+
+def test_planner_rewrites_disabled_is_knob_only():
+    planner = _planner(rewrites=False)
+    decisions = _plan_until(planner, _hoist_profile())
+    assert decisions and "rewrite" not in decisions[0]
+    assert decisions[0]["knob"] in ("workers_count", "credits")
+
+
+def test_rewrite_thresholds_override():
+    profile = _profile(decode_s=0.9, filter_rows_in=1000.0,
+                       filter_rows_kept=900.0, knobs={})
+    assert not rewrite_triggered("hoist_filter", "worker", profile)[0]
+    assert rewrite_triggered("hoist_filter", "worker", profile,
+                             {"hoist_min_drop_frac": 0.05})[0]
+    assert DEFAULT_THRESHOLDS["hoist_min_drop_frac"] == 0.25
+
+
+def _plan_until(planner, profile, max_rounds=8):
+    for _ in range(max_rounds):
+        decisions = planner.plan(profile)
+        if decisions:
+            return decisions
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Graph + controller bindings
+# ---------------------------------------------------------------------------
+
+def test_graph_binds_rewrite_knobs_and_fuse_metadata(petastorm_dataset):
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.pipeline import build_loader_graph
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=7, batch_transform=_double_ids,
+                         reader_kwargs={"workers_count": 1}).start()
+    try:
+        # Transform-armed source: fusion + cache-placement knobs bind;
+        # the filter is PINNED hoisted (no flippable placement → no
+        # filter knob — a client-placed filter would see post-transform
+        # batches).
+        source = ServiceBatchSource(
+            dispatcher.address, transform=_double_ids,
+            predicate=ColumnPredicate("id", "mod-eq", 0, modulus=2),
+            filter_placement="worker")
+        loader = JaxDataLoader(None, 7, batch_source=source,
+                               stage_to_device=False)
+        with loader:
+            for _ in loader:
+                break
+        graph = build_loader_graph(loader)
+        descriptors = {name: knob.descriptor()
+                       for name, knob in graph.knobs.items()}
+        assert descriptors["stage_fusion"]["rewrite"] \
+            == "fuse_worker_stages"
+        assert descriptors["cache_placement"]["rewrite"] \
+            == "cache_placement"
+        assert "filter_placement" not in descriptors
+        described = {s["name"]: s for s in graph.describe()["stages"]
+                     if s["side"] == "worker"}
+        group = ["decode", "transform", "collate", "serialize"]
+        for name in group:
+            assert described[name]["fuse_group"] == group
+        snapshot = graph.snapshot()
+        assert snapshot["stages"]["collate"]["fuse_group"] == group
+        for signal in ("handoff_s", "worker_decode_s", "transform_s",
+                       "cache_hits", "filter_rows_in"):
+            assert signal in snapshot["signals"]
+        assert snapshot["knobs"]["stage_fusion"] == "off"
+
+        # Transform-free source: the filter placement IS flippable.
+        source2 = ServiceBatchSource(
+            dispatcher.address,
+            predicate=ColumnPredicate("id", "mod-eq", 0, modulus=2))
+        loader2 = JaxDataLoader(None, 7, batch_source=source2,
+                                stage_to_device=False)
+        with loader2:
+            for _ in loader2:
+                break
+        graph2 = build_loader_graph(loader2)
+        assert graph2.knobs["filter_placement"].descriptor()["rewrite"] \
+            == "hoist_filter"
+        assert "cache_placement" not in graph2.knobs  # no transform
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_rewrite_armed_autotune_journals_and_leaks_nothing(
+        petastorm_dataset):
+    """End-to-end: an autotuned loader over a predicate-heavy service
+    stream applies the hoist rewrite, journals it in the rewrite metric
+    families, and leaves no controller thread behind (the conftest leak
+    guard enforces the same — assert it explicitly)."""
+    from petastorm_tpu.jax_utils.loader import JaxDataLoader
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.telemetry.metrics import (
+        REWRITE_ACTIVE,
+        REWRITE_DECISIONS,
+    )
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=5,
+                         reader_kwargs={"workers_count": 1}).start()
+    try:
+        source = ServiceBatchSource(
+            dispatcher.address,
+            predicate=ColumnPredicate("id", "mod-eq", 0, modulus=4))
+        loader = JaxDataLoader(
+            None, 5, batch_source=source, stage_to_device=False,
+            autotune={"interval_s": 60})
+        before = REWRITE_DECISIONS.labels("hoist_filter", "flip").value
+        with loader:
+            for _ in loader:
+                pass
+        controller = loader.autotune
+        assert not controller.running  # stopped with the iteration
+        # Deterministic: drive the stopped controller with canned
+        # hoist-triggering windows instead of racing wall-clock ones —
+        # the apply/journal path under test is the controller's own.
+        controller.planner = _planner(rewrite_hysteresis=1, probe_defer=0)
+        canned = _hoist_profile()
+
+        def canned_window():
+            profile = dict(canned)
+            profile["knobs"] = {name: knob.get()
+                                for name, knob in
+                                controller.graph.knobs.items()}
+            return profile
+
+        controller.window_profile = canned_window
+        applied = []
+        for _ in range(4):
+            applied = controller.step()
+            if applied:
+                break
+        assert applied and applied[0]["rewrite"] == "hoist_filter"
+        assert source.filter_placement == "worker"
+        assert REWRITE_DECISIONS.labels("hoist_filter", "flip").value \
+            == before + 1
+        assert REWRITE_ACTIVE.labels(controller._id,
+                                     "hoist_filter").value == 1.0
+        trail = controller.report()["trail"]
+        assert any(d.get("rewrite") == "hoist_filter"
+                   for entry in trail for d in entry["decisions"])
+        assert not controller.running
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_state_dict_refuses_prefetch_cursor_after_dropped_batches(
+        petastorm_dataset):
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    worker = BatchWorker(petastorm_dataset.url,
+                         dispatcher_address=dispatcher.address,
+                         batch_size=10,
+                         reader_kwargs={"workers_count": 1}).start()
+    try:
+        # id2 == 7 never matches: every batch masks to empty and is
+        # dropped client-side.
+        source = ServiceBatchSource(dispatcher.address,
+                                    predicate=ColumnPredicate("id2", "eq",
+                                                              7),
+                                    filter_placement="client")
+        assert sum(1 for _ in source()) == 0
+        with pytest.raises(ValueError, match="dropped"):
+            source.state_dict(yielded_batches=1)
+        # Production-granularity snapshots stay available.
+        assert source.state_dict()["version"] == 2
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_every_rewrite_kind_has_catalog_entry():
+    for kind, info in REWRITE_KINDS.items():
+        assert info["knob"] and info["applied_value"] in (
+            "fused", "worker", "post-decode")
+        assert info["description"]
