@@ -194,6 +194,14 @@ def _scenario_main(argv):
                              "client (mask received batches, baseline) "
                              "or worker (hoisted below decode: dropped "
                              "rows never decode)")
+    parser.add_argument("--transport", default=None,
+                        choices=["auto", "tcp", "shm"],
+                        help="service scenario: delivery tier for both "
+                             "ends of the fleet — tcp forces the framed "
+                             "sockets, shm/auto negotiate the shared-"
+                             "memory ring per stream (docs/guides/"
+                             "service.md#transport-tiers). Default: "
+                             "PETASTORM_TRANSPORT env var, else auto")
     parser.add_argument("--device-stage", default=None,
                         choices=["on", "off"], dest="device_stage",
                         help="image scenario: run the accelerator-side "
@@ -245,6 +253,7 @@ def _scenario_main(argv):
             ("predicate", "--predicate", args.predicate),
             ("filter_placement", "--filter-placement",
              args.filter_placement),
+            ("transport", "--transport", args.transport),
             ("device_stage", "--device-stage", args.device_stage),
             ("device_prefetch", "--device-prefetch",
              args.device_prefetch)):

@@ -624,7 +624,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               cache_mem_mb=256.0, cache_dir=None,
                               sharding=None, shuffle_seed=None,
                               ordered=False, predicate=None,
-                              filter_placement="client"):
+                              filter_placement="client", transport=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -640,6 +640,14 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     ``per_worker_stall_s``, not in delivery); a blocking round-robin drain
     would serialize every fast batch behind the slow one. ``credits`` is
     the per-worker flow-control window handed to the client.
+
+    ``transport`` pins the delivery tier for both ends of the fleet:
+    ``"tcp"`` forces the framed sockets everywhere, ``"shm"``/``"auto"``
+    negotiate the shared-memory ring per stream (always granted on
+    loopback; ``docs/guides/service.md#transport-tiers``). Delivery
+    semantics are identical across tiers, so two same-seed ``ordered``
+    runs that differ only in ``transport`` must report equal
+    ``stream_digest`` values — the scenario's cheap invariance check.
 
     ``chaos`` arms the fault-injection harness
     (:mod:`petastorm_tpu.service.chaos`): ``"dispatcher-restart"`` (crash +
@@ -743,6 +751,13 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         raise ValueError(
             f"filter-placement must be client|worker, got "
             f"{filter_placement!r}")
+    # --transport auto|tcp|shm pins the delivery tier for BOTH ends of
+    # the loopback fleet (docs/guides/service.md#transport-tiers);
+    # delivery semantics are byte-identical across tiers, so same-seed
+    # ordered digests must compare equal between tcp and shm runs.
+    from petastorm_tpu.service.transport import resolve_mode
+
+    transport = resolve_mode(transport)
     chaos_kinds = ([k.strip() for k in chaos.split(",") if k.strip()]
                    if isinstance(chaos, str) else list(chaos or []))
     if predicate_obj is not None and chaos:
@@ -875,6 +890,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                                   chaos_pace_s),
                 heartbeat_interval_s=0.5 if chaos_kinds else 5.0,
                 batch_cache=cache_config.build(),
+                transport=transport,
                 reader_kwargs={"workers_count": 2}).start())
         source = ServiceBatchSource(
             dispatcher_holder[0].address, credits=credits, ordered=ordered,
@@ -886,7 +902,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             # skew leg measures, and the sync RPC is a tiny control
             # message (drained workers poke the loop anyway). Every 50 ms
             # the straggler commits to ~1 more batch it could have shed.
-            dynamic_sync_interval_s=0.05)
+            dynamic_sync_interval_s=0.05,
+            transport=transport)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False,
                                trace_path=trace_out or None)
@@ -1043,6 +1060,7 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                 (served_rows / service_wall) / (local_rows / local_wall), 2),
             "mode": mode,
             "workers": workers,
+            "transport": transport,
             "skew_ms": skew_ms,
             "credits": credits,
             "epochs": epochs,

@@ -234,7 +234,9 @@ class EntryBuilder:
             raise RuntimeError("EntryBuilder.commit() called twice")
         self._committed = True
         t0 = time.perf_counter()
-        entry = CachedEntry(self._meta, b"".join(self._chunks))
+        entry = CachedEntry(self._meta,
+                            self._cache._materialize(
+                                b"".join(self._chunks)))
         self._chunks = None
         self._cache._publish(self._key, entry)
         CACHE_FILL_SECONDS.observe(self._spent_s
@@ -308,6 +310,32 @@ class BatchCache:
         # the process" contract.
         self._disk_bytes_acct = 0
         self._disk_entries_acct = 0
+        # Optional frame allocator (the shm transport's shared frame
+        # pool): entry buffers materialize through it so warm serves can
+        # travel as (offset, len) references instead of copies.
+        self._frame_allocator = None
+
+    def set_frame_allocator(self, allocate):
+        """Arm (or with ``None`` disarm) an entry-buffer allocator —
+        ``allocate(nbytes) -> writable buffer or None``. The shm
+        transport points this at its shared frame pool so cached frames
+        live in client-attachable memory (mapped serves); ``None`` from
+        the allocator (pool full) falls back to a heap buffer — the
+        cache works identically either way, entries just serve copied
+        instead of mapped."""
+        self._frame_allocator = allocate
+
+    def _materialize(self, blob):
+        """Route one entry's contiguous payload through the armed
+        allocator (identity when disarmed, empty, or the pool is full)."""
+        allocate = self._frame_allocator
+        if allocate is None or not len(blob):
+            return blob
+        view = allocate(len(blob))
+        if view is None:
+            return blob
+        view[:] = blob
+        return view
 
     @property
     def cache_dir(self):
@@ -577,6 +605,11 @@ class BatchCache:
             os.utime(path)  # LRU touch for the shared eviction policy
         except OSError:
             pass
+        # Pool-materialize only AFTER validation: a corrupt entry must
+        # not leak bump-allocated pool bytes it will never serve from.
+        pooled = self._materialize(payload)
+        if pooled is not payload:
+            entry = CachedEntry(entry.meta, pooled)
         return entry
 
     # -- observability / lifecycle -----------------------------------------

@@ -59,6 +59,18 @@ Failpoint vocabulary (point → actions a schedule may choose):
 ``packing.state``      ``torn`` (a sequence packer's checkpointed
                        open-batch state is truncated mid-write — the
                        crc-guarded restore must detect and refuse it)
+``shm-detach``         ``detach`` (the shm ring's producer vanishes
+                       mid-stream: detach flag raised, doorbells
+                       rung, the paired socket reset — the consumer
+                       drains committed records then recovers)
+``torn-doorbell``      ``torn`` (a garbage record header is
+                       committed to the ring — the consumer must
+                       detect the desync as a protocol error, never
+                       deliver bytes from it)
+``stale-arena``        ``stale`` (the arena generation is bumped as
+                       if the mapping were re-issued — every
+                       consumer-side read fences on it and treats
+                       the arena as dead)
 ====================== =============================================
 
 Arming is process-wide and explicitly scoped::
@@ -100,6 +112,14 @@ POINTS = {
     "dispatcher.reply": ("drop", "delay"),
     "worker.heartbeat": ("drop",),
     "packing.state": ("torn",),
+    # Shared-memory ring tier (service/shm_ring.py). All three are
+    # site-specific: the ring producer implements the damage (flags,
+    # garbage record, generation bump) and resets the paired socket so
+    # the fault funnels into the same broken-stream recovery TCP faults
+    # use.
+    "shm-detach": ("detach",),
+    "torn-doorbell": ("torn",),
+    "stale-arena": ("stale",),
 }
 
 #: ``piece.decode`` is separate: it only ever fires for explicitly named
@@ -205,8 +225,9 @@ class FaultSchedule:
         """:meth:`check`, then perform the generic actions in place:
         ``delay`` sleeps, ``enospc``/``oserror`` raise :class:`OSError`,
         ``reset`` raises :class:`ConnectionResetError`. Site-specific
-        actions (``torn``/``partial``/``drop``/``torn_rename``) are
-        returned for the call site to implement."""
+        actions (``torn``/``partial``/``drop``/``torn_rename``/
+        ``detach``/``stale``) are returned for the call site to
+        implement."""
         action = self.check(point)
         if action is None:
             return None

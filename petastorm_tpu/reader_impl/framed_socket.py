@@ -48,16 +48,20 @@ from petastorm_tpu.telemetry.metrics import (
     TRANSPORT_BYTES,
     TRANSPORT_FRAMES,
     TRANSPORT_MESSAGES,
+    TRANSPORT_SYSCALLS,
 )
 
 # Interned label children: one lock-guarded float add per message on the
 # hot path, no dict lookup (docs/guides/diagnostics.md#metrics-and-tracing).
-_TX_MESSAGES = TRANSPORT_MESSAGES.labels("sent")
-_TX_FRAMES = TRANSPORT_FRAMES.labels("sent")
-_TX_BYTES = TRANSPORT_BYTES.labels("sent")
-_RX_MESSAGES = TRANSPORT_MESSAGES.labels("recv")
-_RX_FRAMES = TRANSPORT_FRAMES.labels("recv")
-_RX_BYTES = TRANSPORT_BYTES.labels("recv")
+# This module IS the tcp tier; the shm ring (service/shm_ring.py) interns
+# its own children under transport="shm".
+_TX_MESSAGES = TRANSPORT_MESSAGES.labels("sent", "tcp")
+_TX_FRAMES = TRANSPORT_FRAMES.labels("sent", "tcp")
+_TX_BYTES = TRANSPORT_BYTES.labels("sent", "tcp")
+_RX_MESSAGES = TRANSPORT_MESSAGES.labels("recv", "tcp")
+_RX_FRAMES = TRANSPORT_FRAMES.labels("recv", "tcp")
+_RX_BYTES = TRANSPORT_BYTES.labels("recv", "tcp")
+_TX_SYSCALLS = TRANSPORT_SYSCALLS.labels("tcp")
 
 _LEN = struct.Struct("!Q")
 _FMT = struct.Struct("!B")
@@ -256,13 +260,16 @@ def _sendmsg_all(sock, parts):
     coalescing. Handles short writes by resuming from the first unsent
     byte, and caps each call at IOV_MAX entries."""
     views = [memoryview(p) for p in parts]
+    syscalls = 0
     while views:
         sent = sock.sendmsg(views[:_SENDMSG_IOV_CAP])
+        syscalls += 1
         while views and sent >= views[0].nbytes:
             sent -= views[0].nbytes
             views.pop(0)
         if sent:
             views[0] = views[0][sent:]
+    _TX_SYSCALLS.inc(syscalls)
 
 
 def send_framed(sock, header, payload=None):
@@ -309,6 +316,7 @@ def send_framed_frames(sock, header, fmt, frames):
     else:  # platforms without scatter-gather (rare): field-by-field
         for part in parts:
             sock.sendall(part)
+        _TX_SYSCALLS.inc(len(parts))
     _TX_MESSAGES.inc()
     _TX_FRAMES.inc(len(frames))
     _TX_BYTES.inc(total_bytes)

@@ -149,6 +149,17 @@ def _build_parser():
                            "deterministic weighted mixing "
                            "(docs/guides/llm.md#mixtures). Default: the "
                            "single-dataset corpus")
+    work.add_argument("--transport", default=None,
+                      choices=["auto", "tcp", "shm"],
+                      help="data-plane tier: auto (default — colocated "
+                           "clients negotiate the shared-memory ring, "
+                           "everything else rides TCP), tcp (never "
+                           "negotiate), shm (same negotiation as auto; "
+                           "cross-host peers and setup failures still "
+                           "serve TCP — shm is never required for "
+                           "correctness). Omit to defer to the "
+                           "PETASTORM_TRANSPORT env var "
+                           "(docs/guides/service.md#transport-tiers)")
     work.add_argument("--batch-transform", default=None,
                       help="module:attr of the placement-flippable "
                            "collated-batch transform ({field: ndarray} -> "
@@ -248,6 +259,7 @@ def build_service_node(args):
                                                 None)).build(),
         batch_transform=resolve_batch_transform(
             getattr(args, "batch_transform", None)),
+        transport=getattr(args, "transport", None),
         reader_kwargs={"workers_count": args.workers_count,
                        "reader_pool_type": args.reader_pool_type})
 
@@ -313,7 +325,23 @@ def _worker_totals(sample, wid):
             # the render shows "--" instead of a fake 0% hit rate.
             metrics.get("cache_hits_total"),
             metrics.get("cache_misses_total"),
-            metrics.get("cache_permuted_serves_total"))
+            metrics.get("cache_permuted_serves_total"),
+            # Transport tier attribution (None on pre-transport workers).
+            metrics.get("transport_streams_tcp_total"),
+            metrics.get("transport_streams_shm_total"))
+
+
+def _transport_label(tcp_total, shm_total):
+    """The TRANSPORT column: which tier the worker's streams negotiated
+    so far — ``shm``/``tcp``/``mixed``, ``--`` before any stream (or on
+    a worker predating the column)."""
+    if tcp_total is None or shm_total is None or not (tcp_total + shm_total):
+        return "--"
+    if not tcp_total:
+        return "shm"
+    if not shm_total:
+        return "tcp"
+    return "mixed"
 
 
 def render_fleet_status(prev, cur):
@@ -336,8 +364,8 @@ def render_fleet_status(prev, cur):
     lines = [
         header,
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
-        f"{'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} {'CACHEHIT%':>10} "
-        f"{'PERM/S':>7} {'STEALS':>9} {'BACKLOG':>8}",
+        f"{'TRANSPORT':>9} {'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} "
+        f"{'CACHEHIT%':>10} {'PERM/S':>7} {'STEALS':>9} {'BACKLOG':>8}",
     ]
 
     def steal_cols(wid):
@@ -354,17 +382,19 @@ def render_fleet_status(prev, cur):
         if now is None:
             lines.append(f"{wid:<20} {'unreachable':>10}")
             continue
-        rows1, batches1, wait1, active, hits1, misses1, perm1 = now
+        (rows1, batches1, wait1, active, hits1, misses1, perm1,
+         tcp1, shm1) = now
+        transport = _transport_label(tcp1, shm1)
         before = _worker_totals(prev, wid)
         if before is None:
             # No prior baseline (worker just appeared or was unreachable
             # last poll): totals are real, rates are unknowable.
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
-                f"{'--':>13} {int(rows1):>12} {'--':>10} {'--':>7} "
-                f"{steal_cols(wid)}")
+                f"{transport:>9} {'--':>13} {int(rows1):>12} {'--':>10} "
+                f"{'--':>7} {steal_cols(wid)}")
             continue
-        rows0, batches0, wait0, _, hits0, misses0, perm0 = before
+        rows0, batches0, wait0, _, hits0, misses0, perm0, _, _ = before
         rows_rate = max(0.0, rows1 - rows0) / dt
         batch_rate = max(0.0, batches1 - batches0) / dt
         wait_rate = max(0.0, wait1 - wait0) / dt
@@ -387,8 +417,9 @@ def render_fleet_status(prev, cur):
             perm_rate = f"{max(0.0, perm1 - (perm0 or 0.0)) / dt:.2f}"
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
-            f"{int(active):>8} {wait_rate:>13.3f} {int(rows1):>12} "
-            f"{hit_pct:>10} {perm_rate:>7} {steal_cols(wid)}")
+            f"{int(active):>8} {transport:>9} {wait_rate:>13.3f} "
+            f"{int(rows1):>12} {hit_pct:>10} {perm_rate:>7} "
+            f"{steal_cols(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
     fleet = status.get("fleet") or {}

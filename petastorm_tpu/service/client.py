@@ -106,8 +106,12 @@ class _WorkerStream:
                  starts=None, shuffle_seed=None, transform_placement=None,
                  job_id=None, recv_timeout=None, packing=None,
                  predicate=None, projection=None, fused=False,
-                 cache_stage=None):
+                 cache_stage=None, transport="auto"):
         self.worker_id = worker_id
+        #: Transport tier policy for this stream ("auto"/"tcp"/"shm" —
+        #: docs/guides/service.md#transport-tiers): anything but "tcp"
+        #: advertises shm on the stream request; the worker decides.
+        self.transport = transport
         #: Graph-rewrite stream attributes (frozen per iteration, like the
         #: transform placement — docs/guides/pipeline.md#graph-rewrites):
         #: a hoisted row filter (wire dict) + column projection applied
@@ -179,9 +183,13 @@ class _WorkerStream:
             # covers the opposite failure: a worker HOST dying without
             # FIN/RST surfaces as an OSError within ~2 minutes instead of
             # blocking this timeout-less recv forever.
-            self._conn = FramedConnection.connect(
-                self.address, timeout=self._connect_timeout,
-                stream_timeout=self._recv_timeout, keepalive=True)
+            from petastorm_tpu.service.transport import NegotiatedConnection
+
+            self._conn = NegotiatedConnection(
+                FramedConnection.connect(
+                    self.address, timeout=self._connect_timeout,
+                    stream_timeout=self._recv_timeout, keepalive=True),
+                mode=self.transport)
             if self._closed:
                 # close() raced the dial: tear the fresh socket down
                 # instead of streaming into an abandoned stream object.
@@ -190,6 +198,9 @@ class _WorkerStream:
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "pieces": self.pieces,
                        "epoch": self.epoch}
+            advert = self._conn.advertisement()
+            if advert is not None:
+                request["transport"] = advert
             if self.job_id is not None:
                 request["job_id"] = self.job_id
             if self.shuffle_seed is not None:
@@ -519,8 +530,9 @@ class _DynamicStream:
                  credits=None, shuffle_seed=None, transform_placement=None,
                  job_id=None, recv_timeout=None, packing=None,
                  predicate=None, projection=None, fused=False,
-                 cache_stage=None):
+                 cache_stage=None, transport="auto"):
         self.worker_id = worker_id
+        self.transport = transport  # see _WorkerStream.transport
         self.job_id = job_id  # see _WorkerStream.job_id
         self.packing = packing  # see _WorkerStream.packing
         self.predicate = predicate  # see _WorkerStream: rewrite attributes
@@ -548,15 +560,22 @@ class _DynamicStream:
         with self._send_lock:
             if self._conn is not None:
                 return self._conn
-            conn = FramedConnection.connect(
-                self.address, timeout=self._connect_timeout,
-                stream_timeout=self._recv_timeout, keepalive=True)
+            from petastorm_tpu.service.transport import NegotiatedConnection
+
+            conn = NegotiatedConnection(
+                FramedConnection.connect(
+                    self.address, timeout=self._connect_timeout,
+                    stream_timeout=self._recv_timeout, keepalive=True),
+                mode=self.transport)
             if self._closed:
                 conn.close()
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "dynamic": True,
                        "pieces": [list(t) for t in self.pairs],
                        "epoch": self.epoch}
+            advert = conn.advertisement()
+            if advert is not None:
+                request["transport"] = advert
             if self.job_id is not None:
                 request["job_id"] = self.job_id
             if self.shuffle_seed is not None:
@@ -827,6 +846,15 @@ class ServiceBatchSource:
         a byte then surfaces as an ordinary broken stream and rides the
         shared ``retry_with_backoff`` recovery (same-worker retry →
         takeover), exactly-once throughout.
+    :param transport: data-plane tier — ``"auto"`` (default: streams
+        against a colocated worker negotiate the shared-memory ring,
+        everything else rides TCP), ``"tcp"`` (never negotiate), or
+        ``"shm"`` (same negotiation as auto — still TCP when the worker
+        is cross-host or setup fails; the tier is never required for
+        correctness). ``None`` defers to the ``PETASTORM_TRANSPORT``
+        env var (``docs/guides/service.md#transport-tiers``). Delivery
+        semantics — ordering, watermarks, dedup, fencing — are
+        byte-identical across tiers.
     """
 
     def __init__(self, dispatcher_address, client_index=0, num_clients=1,
@@ -839,7 +867,15 @@ class ServiceBatchSource:
                  job_id=None, on_piece_error="fail",
                  stream_recv_timeout_s=None, packing=None, corpus="",
                  predicate=None, projection=None, filter_placement="client",
-                 stage_fusion="off", cache_placement="post-transform"):
+                 stage_fusion="off", cache_placement="post-transform",
+                 transport=None):
+        from petastorm_tpu.service.transport import resolve_mode
+
+        # Transport tier policy, resolved once (explicit arg >
+        # PETASTORM_TRANSPORT env > "auto") and carried by every stream
+        # this source opens — takeover/resync relaunches included
+        # (docs/guides/service.md#transport-tiers).
+        self._transport = resolve_mode(transport)
         if credits is not None and credits < 1:
             raise ValueError("credits must be a positive integer or None")
         if on_piece_error not in ("fail", "quarantine"):
@@ -1370,6 +1406,9 @@ class ServiceBatchSource:
             "projection": self._iter_projection if hoisted else None,
             "fused": self._iter_fused,
             "cache_stage": self._iter_cache_stage,
+            # Not a rewrite, but frozen the same way: every stream of an
+            # iteration negotiates under the same transport policy.
+            "transport": self._transport,
         }
 
     def _apply_filter_local(self, inner):
@@ -3012,7 +3051,8 @@ class ServiceBatchSource:
                 shuffle_seed=self._shuffle_seed,
                 transform_placement=self._iter_transform_placement,
                 job_id=self.job_id,
-                        recv_timeout=self._stream_recv_timeout_s)
+                recv_timeout=self._stream_recv_timeout_s,
+                transport=self._transport)
             try:
                 yield from self._drain_one(stream)
                 return True

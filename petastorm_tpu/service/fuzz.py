@@ -57,12 +57,17 @@ def default_run_fn(seed, points):
     from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
 
     return service_loopback_scenario(
-        rows=192, days=2, workers=2, batch_size=64,
+        rows=768, days=8, workers=2, batch_size=64,
         chaos="failpoints", chaos_seed=seed, failpoint_points=points,
-        # Narrow fire window: this is a SMALL run (a couple hundred
-        # transport calls), so indices must land where its call counts
-        # actually reach — otherwise many seeds would fire nothing and
-        # trip the scenario's fired-nothing guard.
+        # Narrow fire window, sized against the run's actual call counts.
+        # With the data plane on the shm tier (the loopback default) the
+        # TCP points see only control traffic — credits, piece reports,
+        # dispatcher RPCs — and the shm points count one check per
+        # ring-sent batch, so the geometry must yield enough batches
+        # (12 here) and control round-trips (>24) that seeded indices in
+        # [4, 24) actually land; a run whose counts never reach its
+        # indices fires nothing and trips the scenario's fired-nothing
+        # guard.
         failpoint_window=24,
         shuffle_seed=seed, ordered=True)
 
@@ -137,7 +142,7 @@ def reproducer_command(seed, points):
     return ("python -m petastorm_tpu.benchmark scenario service "
             f"--chaos failpoints --chaos-seed {seed} "
             f"--failpoint-points {','.join(points)} "
-            "--failpoint-window 24 --rows 192 --days 2 --workers 2 "
+            "--failpoint-window 24 --rows 768 --days 8 --workers 2 "
             f"--batch-size 64 --shuffle-seed {seed} --ordered")
 
 

@@ -29,7 +29,6 @@ from petastorm_tpu.reader_impl.framed_socket import (
     ProtocolError,
     encode_payload,
     send_framed,
-    send_framed_frames,
 )
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
@@ -172,6 +171,14 @@ class BatchWorker:
         via ``Dispatcher.admit_worker``) admits it into serving — the
         zero-idle-hosts elasticity pool
         (``docs/guides/service.md#multi-tenancy-and-autoscaling``).
+    :param transport: data-plane tier for this worker's streams —
+        ``"auto"`` (default: negotiate shared memory with colocated
+        clients, TCP otherwise), ``"tcp"`` (never negotiate), or
+        ``"shm"`` (same negotiation as auto; still serves TCP to
+        cross-host or non-advertising clients — shm is never required
+        for correctness). ``None`` defers to the
+        ``PETASTORM_TRANSPORT`` env var
+        (``docs/guides/service.md#transport-tiers``).
     :param on_piece_error: poison-piece policy for streams served through
         the streaming engine (tagged static + dynamic — the exactly-once
         protocols). ``"fail"`` (default): an undecodable piece errors the
@@ -192,7 +199,9 @@ class BatchWorker:
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  batch_cache=None, batch_transform=None, standby=False,
-                 on_piece_error="fail", corpus=""):
+                 on_piece_error="fail", corpus="", transport=None):
+        from petastorm_tpu.service.transport import resolve_mode
+
         if on_piece_error not in ("fail", "quarantine"):
             raise ValueError(
                 "on_piece_error must be 'fail' or 'quarantine', got "
@@ -265,6 +274,11 @@ class BatchWorker:
         self._cache_jobs = {}        # job -> {"hits": n, "misses": n}
         self._standby = bool(standby)
         self._on_piece_error = on_piece_error
+        # Transport tier (docs/guides/service.md#transport-tiers): the
+        # negotiation runs per stream; this is the worker's policy knob.
+        self._transport_mode = resolve_mode(transport)
+        self._frame_pool = None  # armed in start() when shm is possible
+        self._transport_streams = {"tcp": 0, "shm": 0}
         self._log = logger.bind(worker_id=self.worker_id)
         # Interned registry children (telemetry.metrics): typed, scrapeable
         # counters behind the legacy diagnostics snapshots.
@@ -287,6 +301,27 @@ class BatchWorker:
 
     def start(self):
         self.num_pieces = self._count_pieces()
+        if self._transport_mode != "tcp" and self._batch_cache is not None:
+            # Shared frame pool: cache entries materialize INTO it so a
+            # warm piece's frames travel as (offset, len) references —
+            # the zero-copy mapped-serve path. Armed before any fill so
+            # cold epoch 1 already lands entries pool-side. Setup
+            # failure (tmpfs pressure) is a degradation, not an error:
+            # shm streams then serve inline (copied) frames.
+            from petastorm_tpu.service.shm_ring import (
+                FramePool,
+                ShmSetupError,
+            )
+
+            try:
+                self._frame_pool = FramePool()
+            except ShmSetupError as exc:
+                self._log.warning(
+                    "shm frame pool setup failed — warm serves will copy "
+                    "instead of map: %s", exc)
+            else:
+                self._batch_cache.set_frame_allocator(
+                    self._frame_pool.allocate)
         self._server.start()
         if self._dispatcher_address is not None:
             self._register()
@@ -339,6 +374,12 @@ class BatchWorker:
             except Exception:
                 self._log.warning("batch cache cleanup failed",
                                   exc_info=True)
+        if self._frame_pool is not None:
+            # After cache cleanup: entries holding pool-backed buffers
+            # must be dropped before the pool's mapping can unmap.
+            self._batch_cache.set_frame_allocator(None)
+            self._frame_pool.close()
+            self._frame_pool = None
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=drain_timeout_s)
 
@@ -712,22 +753,40 @@ class BatchWorker:
         self._m_active.inc()
         rewrites = {"fused": fused, "predicate": stream_predicate,
                     "projection": projection, "cache_stage": cache_stage}
+        tx = None
+        early_frames = []
         try:
+            # Transport negotiation (transport.py): shm when the client
+            # advertised it AND shares this host AND the arena sets up —
+            # every other case (including mid-negotiation failure) is the
+            # TCP tier on this same request. From here down the serve
+            # paths write to `tx`, never the socket; client->worker
+            # control traffic (credits, dynamic edits) stays on TCP.
+            from petastorm_tpu.service.transport import negotiate_worker_tx
+
+            tx, extra_credits, early_frames = negotiate_worker_tx(
+                sock, conn_reader, header, self._transport_mode,
+                pool=self._frame_pool)
+            if credits is not None and extra_credits:
+                flow["credits_left"] += extra_credits
+            with self._lock:
+                self._transport_streams[tx.transport] += 1
             if dynamic:
                 rows_sent = self._stream_dynamic(
-                    sock, conn_reader, state, pieces, flow, credits,
+                    tx, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
-                    job=job, packing=packing, rewrites=rewrites)
+                    job=job, packing=packing, rewrites=rewrites,
+                    early_frames=early_frames)
             elif tagged and self._engine_supported():
                 rows_sent = self._stream_pieces_tagged(
-                    sock, conn_reader, state, pieces, flow, credits,
+                    tx, conn_reader, state, pieces, flow, credits,
                     stream_key, starts, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
                     job=job, packing=packing, rewrites=rewrites)
             elif self._batch_cache is not None and self._engine_supported():
                 rows_sent = self._stream_pieces_engine(
-                    sock, conn_reader, state, pieces, flow, credits,
+                    tx, conn_reader, state, pieces, flow, credits,
                     stream_key, epoch=header.get("epoch"),
                     shuffle_seed=shuffle_seed, transform_fn=transform_fn,
                     job=job, rewrites=rewrites)
@@ -754,17 +813,17 @@ class BatchWorker:
                         shuffle_seed, reason)
                 if self._batch_cache is not None:
                     rows_sent = self._stream_pieces_cached(
-                        sock, conn_reader, state, pieces, flow, credits,
+                        tx, conn_reader, state, pieces, flow, credits,
                         stream_key, epoch=header.get("epoch"),
                         transform_fn=transform_fn, job=job)
                 else:
                     rows_sent = self._stream_pieces_direct(
-                        sock, conn_reader, state, pieces, flow, credits,
+                        tx, conn_reader, state, pieces, flow, credits,
                         stream_key, transform_fn=transform_fn, job=job)
             if rows_sent is None:
                 return  # worker stopped mid-stream
-            send_framed(sock, {"type": "end", "rows": rows_sent,
-                               "pieces": pieces})
+            tx.send({"type": "end", "rows": rows_sent,
+                     "pieces": pieces})
             outcome = "completed"
         except (ConnectionClosedError, OSError):
             outcome = "disconnected"
@@ -781,8 +840,22 @@ class BatchWorker:
             outcome = "error"
             self._log.exception("stream failed", stream=stream_key,
                                 pieces=pieces)
-            send_framed(sock, {"type": "error", "error": str(exc)})
+            # Through tx: once an shm offer went out, the client reads
+            # the ring — an error frame on the socket would never arrive.
+            if tx is not None:
+                tx.send({"type": "error", "error": str(exc)})
+            else:
+                send_framed(sock, {"type": "error", "error": str(exc)})
         finally:
+            if tx is not None:
+                # The ring arena is per-STREAM: detach (the consumer
+                # drains every committed record first, so a clean `end`
+                # is never lost) and unmap. TCP tx close is a no-op.
+                try:
+                    tx.close()
+                except Exception:
+                    self._log.warning("stream transport close failed",
+                                      exc_info=True)
             with self._lock:
                 self._active.pop(stream_key, None)
                 reader = state["reader"]
@@ -811,7 +884,7 @@ class BatchWorker:
                 reader.stop()
                 reader.join()
 
-    def _stream_pieces_direct(self, sock, conn_reader, state, pieces, flow,
+    def _stream_pieces_direct(self, tx, conn_reader, state, pieces, flow,
                               credits, stream_key, transform_fn=None,
                               job=None):
         """Uncached serving: one reader over the whole piece set, batches
@@ -848,12 +921,12 @@ class BatchWorker:
                 batch = transform_fn(batch)
             n = self._batch_rows(batch)
             fmt, frames = encode_payload(batch)
-            if not self._send_stream_batch(sock, conn_reader, flow, credits,
+            if not self._send_stream_batch(tx, conn_reader, flow, credits,
                                            bid, n, fmt, frames, collector):
                 return None
             rows_sent += n
 
-    def _stream_pieces_cached(self, sock, conn_reader, state, pieces, flow,
+    def _stream_pieces_cached(self, tx, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
                               transform_fn=None, job=None):
         """Cache-armed serving, piece by piece: a warm piece's batches are
@@ -881,7 +954,7 @@ class BatchWorker:
                     bid = (f"{self.worker_id}:{stream_key}:"
                            f"{flow['batches_sent']}")
                     if not self._send_stream_batch(
-                            sock, conn_reader, flow, credits, bid,
+                            tx, conn_reader, flow, credits, bid,
                             cached.rows, cached.fmt, cached.frames,
                             collector):
                         return None
@@ -910,7 +983,7 @@ class BatchWorker:
                         batch = transform_fn(batch)
                     n, fmt, frames = builder.add_batch(batch)
                     if not self._send_stream_batch(
-                            sock, conn_reader, flow, credits, bid, n, fmt,
+                            tx, conn_reader, flow, credits, bid, n, fmt,
                             frames, collector):
                         return None
                     rows_sent += n
@@ -1023,7 +1096,7 @@ class BatchWorker:
             collector.record_span("worker.decode", t_now - decode_s, t_now,
                                   bid=bid)
 
-    def _stream_pieces_engine(self, sock, conn_reader, state, pieces, flow,
+    def _stream_pieces_engine(self, tx, conn_reader, state, pieces, flow,
                               credits, stream_key, epoch=None,
                               shuffle_seed=None, transform_fn=None,
                               job=None, rewrites=None):
@@ -1035,14 +1108,14 @@ class BatchWorker:
         loop as :meth:`_stream_pieces_tagged`, minus the tags (a legacy
         plain stream carries no piece/ordinal headers and no
         ``piece_done`` frames)."""
-        return self._stream_pieces_tagged(sock, conn_reader, state, pieces,
+        return self._stream_pieces_tagged(tx, conn_reader, state, pieces,
                                           flow, credits, stream_key, {},
                                           epoch=epoch, tagged=False,
                                           shuffle_seed=shuffle_seed,
                                           transform_fn=transform_fn,
                                           job=job, rewrites=rewrites)
 
-    def _stream_pieces_tagged(self, sock, conn_reader, state, pieces, flow,
+    def _stream_pieces_tagged(self, tx, conn_reader, state, pieces, flow,
                               credits, stream_key, starts, epoch=None,
                               tagged=True, shuffle_seed=None,
                               transform_fn=None, job=None, packing=None,
@@ -1084,7 +1157,7 @@ class BatchWorker:
                        f"{flow['batches_sent']}")
                 self._note_engine_decode(collector, decode_s, bid)
                 if not self._send_stream_batch(
-                        sock, conn_reader, flow, credits, bid, rows, fmt,
+                        tx, conn_reader, flow, credits, bid, rows, fmt,
                         frames, collector,
                         extra_header=({"piece": piece, "ordinal": ordinal}
                                       if tagged else None)):
@@ -1095,17 +1168,17 @@ class BatchWorker:
                 # policy "fail" on plain streams): the poison piece is
                 # reported in place of its batches; the stream survives.
                 _, piece, _gen, error = event
-                send_framed(sock, {"type": "piece_failed", "piece": piece,
-                                   "error": error})
+                tx.send({"type": "piece_failed", "piece": piece,
+                         "error": error})
             elif tagged:  # piece_done: plain streams carry no such frame
                 _, piece, _gen, rows = event
-                send_framed(sock, {"type": "piece_done", "piece": piece,
-                                   "rows": rows})
+                tx.send({"type": "piece_done", "piece": piece,
+                         "rows": rows})
 
-    def _stream_dynamic(self, sock, conn_reader, state, pieces, flow,
+    def _stream_dynamic(self, tx, conn_reader, state, pieces, flow,
                         credits, stream_key, epoch=None, shuffle_seed=None,
                         transform_fn=None, job=None, packing=None,
-                        rewrites=None):
+                        rewrites=None, early_frames=()):
         """Dynamic-mode serving: the engine's piece queue is the worker's
         deque, edited in-band mid-stream — ``extend`` appends steal
         grants, ``revoke`` removes not-yet-sent pieces (acked with the
@@ -1143,11 +1216,15 @@ class BatchWorker:
             elif kind == "revoke":
                 removed = engine.revoke(
                     int(p) for p in msg.get("pieces", []))
-                send_framed(sock, {"type": "revoked", "pieces": removed,
-                                   "req": msg.get("req")})
+                tx.send({"type": "revoked", "pieces": removed,
+                         "req": msg.get("req")})
             elif kind == "finish_pieces":
                 engine.finish()
 
+        # Queue edits that raced the shm ack (negotiation buffered them
+        # so the credit drain below never sees them out of order).
+        for msg in early_frames:
+            on_frame(msg)
         rows_sent = 0
         while True:
             if self._server.stopped.is_set():
@@ -1169,7 +1246,7 @@ class BatchWorker:
                        f"{flow['batches_sent']}")
                 self._note_engine_decode(collector, decode_s, bid)
                 if not self._send_stream_batch(
-                        sock, conn_reader, flow, credits, bid, rows, fmt,
+                        tx, conn_reader, flow, credits, bid, rows, fmt,
                         frames, collector,
                         extra_header={"piece": piece, "generation": gen,
                                       "ordinal": ordinal},
@@ -1178,12 +1255,12 @@ class BatchWorker:
                 rows_sent += rows
             elif event[0] == "piece_failed":
                 _, piece, gen, error = event
-                send_framed(sock, {"type": "piece_failed", "piece": piece,
-                                   "generation": gen, "error": error})
+                tx.send({"type": "piece_failed", "piece": piece,
+                         "generation": gen, "error": error})
             else:  # piece_done
                 _, piece, gen, rows = event
-                send_framed(sock, {"type": "piece_done", "piece": piece,
-                                   "generation": gen, "rows": rows})
+                tx.send({"type": "piece_done", "piece": piece,
+                         "generation": gen, "rows": rows})
 
     #: Credit-starved streams poll for replenishment on this period so the
     #: wait stays interruptible (stop flag, dead-peer teardown) — TCP
@@ -1309,7 +1386,7 @@ class BatchWorker:
             factory=self._factory_name,
             extra=extra)
 
-    def _send_stream_batch(self, sock, conn_reader, flow, credits, bid,
+    def _send_stream_batch(self, tx, conn_reader, flow, credits, bid,
                            rows, fmt, frames, collector,
                            extra_header=None, on_frame=None):
         # NB ``flow["job"]`` (set by _stream from the request's job_id)
@@ -1365,7 +1442,7 @@ class BatchWorker:
         header = {"type": "batch", "rows": rows, "bid": bid}
         if extra_header:
             header.update(extra_header)
-        send_framed_frames(sock, header, fmt, frames)
+        tx.send_frames(header, fmt, frames)
         if collector.enabled:
             collector.record_span("worker.send", t_send,
                                   time.perf_counter(), bid=bid)
@@ -1415,12 +1492,17 @@ class BatchWorker:
                            for job, counts in self._jobs_served.items()}
             cache_jobs = {job: dict(bucket)
                           for job, bucket in self._cache_jobs.items()}
+            transport_streams = dict(self._transport_streams)
         metrics = {
             "batches_sent_total": self._m_batches.value,
             "rows_sent_total": self._m_rows.value,
             "credit_wait_seconds_total": self._m_credit_wait.value,
             "active_streams": self._m_active.value,
             "readers_constructed_total": self._m_readers.value,
+            # Which tier this worker's streams negotiated (the `service
+            # status --watch` TRANSPORT column renders shm/tcp/mixed).
+            "transport_streams_tcp_total": transport_streams["tcp"],
+            "transport_streams_shm_total": transport_streams["shm"],
         }
         out = {
             "worker_id": self.worker_id,

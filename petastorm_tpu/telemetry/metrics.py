@@ -19,22 +19,64 @@ from __future__ import annotations
 
 from petastorm_tpu.telemetry.registry import REGISTRY
 
-# -- transport (reader_impl/framed_socket.py) --------------------------------
+# -- transport (reader_impl/framed_socket.py, service/shm_ring.py) -----------
 
 TRANSPORT_MESSAGES = REGISTRY.counter(
     "petastorm_transport_messages_total",
-    "Framed messages moved over stream sockets, by direction (sent/recv)",
-    labels=("direction",))
+    "Framed messages moved by the data-plane transports, by direction "
+    "(sent/recv) and transport tier (tcp = stream sockets, shm = the "
+    "shared-memory ring for colocated peers)",
+    labels=("direction", "transport"))
 TRANSPORT_FRAMES = REGISTRY.counter(
     "petastorm_transport_frames_total",
-    "Payload frames inside framed messages, by direction (a wide numpy "
-    "batch is dozens of frames per message)",
-    labels=("direction",))
+    "Payload frames inside framed messages, by direction and transport "
+    "tier (a wide numpy batch is dozens of frames per message)",
+    labels=("direction", "transport"))
 TRANSPORT_BYTES = REGISTRY.counter(
     "petastorm_transport_bytes_total",
-    "Bytes moved by the framed transport, by direction (header + framing "
-    "prefixes + payload frames)",
-    labels=("direction",))
+    "Bytes moved by the framed transports, by direction and transport "
+    "tier (header + framing prefixes + payload frames; shm counts bytes "
+    "made visible through the ring, including pool-mapped frame bytes "
+    "that were never copied)",
+    labels=("direction", "transport"))
+TRANSPORT_SYSCALLS = REGISTRY.counter(
+    "petastorm_transport_syscalls_total",
+    "Send-path kernel crossings per transport tier (tcp = sendmsg calls "
+    "incl. short-write resumes; shm = eventfd doorbell writes + bounded "
+    "waits on the ring). Divide a delta by the matching sent-messages "
+    "delta for syscalls-per-message — the number the shm tier drives "
+    "toward zero (bench.py shm_transport leg)",
+    labels=("transport",))
+TRANSPORT_DOWNGRADES = REGISTRY.counter(
+    "petastorm_transport_downgrades_total",
+    "Stream negotiations that advertised the shm tier but completed over "
+    "TCP, by reason (arena_setup = the worker could not create/pre-fault "
+    "the memfd arena — memfd unavailable or shm exhaustion; client_nack "
+    "= the client could not attach the offered arena, e.g. a container "
+    "boundary between colocated-looking peers). The stream itself "
+    "proceeds on TCP with its credit window intact",
+    labels=("reason",))
+
+# -- shared-memory ring tier (service/shm_ring.py) ---------------------------
+
+SHM_FRAMES = REGISTRY.counter(
+    "petastorm_shm_frames_total",
+    "Payload frames delivered through a shared-memory ring, by path "
+    "(mapped = the frame already lived in the shared frame pool — a warm "
+    "cache hit served as offsets, zero copy; copied = frame bytes "
+    "memcpy'd inline into the ring; spilled = the message exceeded the "
+    "ring's capacity and rode the fallback TCP socket behind an in-ring "
+    "ordering marker). mapped / (mapped + copied + spilled) is the warm "
+    "mapped-serve ratio",
+    labels=("path",))
+SHM_ARENAS = REGISTRY.gauge(
+    "petastorm_shm_arenas",
+    "Live shared-memory mappings in this process, by kind (ring = "
+    "per-stream doorbell'd rings, producer and consumer ends each count "
+    "one; pool = worker-global frame pools backing mapped cache serves). "
+    "Nonzero after every stream and worker is closed means a leaked "
+    "arena — the conftest leak guard fails the test",
+    labels=("kind",))
 
 # -- service: batch worker (service/worker.py) -------------------------------
 
@@ -404,8 +446,8 @@ FAILPOINT_FIRES = REGISTRY.counter(
     "petastorm_failpoint_fires_total",
     "Deterministic fault injections fired by the armed FaultSchedule, by "
     "failpoint name and action (reset/torn/delay/enospc/oserror/partial/"
-    "drop/torn_rename/poison). Zero — and zero overhead beyond one "
-    "branch-on-None per site — when no schedule is armed",
+    "drop/torn_rename/poison/detach/stale). Zero — and zero overhead "
+    "beyond one branch-on-None per site — when no schedule is armed",
     labels=("point", "action"))
 FAILPOINT_ARMED = REGISTRY.gauge(
     "petastorm_failpoint_armed",

@@ -51,6 +51,28 @@ def _open_socket_fds():
     return out
 
 
+def _open_memfd_fds():
+    """Snapshot of this process's open shm-transport memfd fds as
+    (fd, name) pairs. Every arena :mod:`petastorm_tpu.service.shm_ring`
+    creates — ring data regions, frame pools — carries the ``ptshm``
+    memfd name prefix precisely so this scan can spot one surviving a
+    test: an orphaned arena pins its full size in /dev/shm for the rest
+    of the session. Linux-only (/proc); empty elsewhere."""
+    out = set()
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return out
+    for fd in fds:
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue  # fd closed between listdir and readlink
+        if target.startswith("/memfd:ptshm"):
+            out.add((fd, target))
+    return out
+
+
 #: Process-lifetime thread pools libraries create on first use and keep
 #: forever (not per-test leaks): orbax-checkpoint's async machinery.
 _LIBRARY_SINGLETON_THREAD_PREFIXES = ("metadata_store", "base_pytree_ch",
@@ -105,12 +127,15 @@ def _resource_leak_guard(request):
     from petastorm_tpu.cache_impl import live_cache_dirs
     from petastorm_tpu.service.fleet import open_job_registrations
     from petastorm_tpu.service.mixture import open_mixture_passes
+    from petastorm_tpu.service.shm_ring import live_shm_counts
 
     if request.node.get_closest_marker("allow_resource_leaks"):
         yield
         return
     before_threads = set(threading.enumerate())
     before_sockets = _open_socket_fds()
+    before_memfds = _open_memfd_fds()
+    before_shm = live_shm_counts()
     before_cache_dirs = live_cache_dirs()
     before_jobs = open_job_registrations()
     before_mixture_passes = open_mixture_passes()
@@ -139,6 +164,14 @@ def _resource_leak_guard(request):
                                    _FLEET_AUTOSCALE_THREAD_PREFIX,
                                    _FUZZ_THREAD_PREFIX))]
         leaked_sockets = _open_socket_fds() - before_sockets
+        leaked_memfds = _open_memfd_fds() - before_memfds
+        # Live-arena registry deltas: a leaked RingProducer/RingConsumer
+        # or FramePool (or its doorbell eventfds — invisible to the
+        # memfd scan) means a stream transport was never closed.
+        after_shm = live_shm_counts()
+        leaked_shm = {kind: after_shm[kind] - before_shm.get(kind, 0)
+                      for kind in after_shm
+                      if after_shm[kind] > before_shm.get(kind, 0)}
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
         leaked_jobs = open_job_registrations() - before_jobs
         # An abandoned MixedBatchSource pass holds N per-corpus inner
@@ -146,7 +179,8 @@ def _resource_leak_guard(request):
         # analogue of an unstopped Reader.
         leaked_mixture = open_mixture_passes() - before_mixture_passes
         if not leaked_threads and not leaked_pool_threads \
-                and not leaked_sockets and not leaked_cache_dirs \
+                and not leaked_sockets and not leaked_memfds \
+                and not leaked_shm and not leaked_cache_dirs \
                 and not leaked_jobs and leaked_mixture <= 0 \
                 and leaked_schedule is None:
             return
@@ -163,6 +197,10 @@ def _resource_leak_guard(request):
         f"controller was never stopped, a Dispatcher(autoscale=) never "
         f"stopped, or a hung fuzz run), "
         f"sockets {sorted(leaked_sockets)}, "
+        f"shm arenas: memfds {sorted(leaked_memfds)}, live ring/pool/"
+        f"eventfd registry deltas {leaked_shm} (a RingProducer/"
+        f"RingConsumer or FramePool never close()d — an orphaned arena "
+        f"pins its full size in /dev/shm), "
         f"cache dirs {sorted(leaked_cache_dirs)}, "
         f"open job registrations {sorted(leaked_jobs)} (a register_job "
         f"without end_job — use fleet.JobHandle), "

@@ -182,17 +182,20 @@ def _stream_ids(source):
     return [[int(i) for i in batch["id"]] for batch in source()]
 
 
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
 def test_ordered_delivery_byte_identical_across_fleet_shapes(
-        petastorm_dataset):
+        petastorm_dataset, transport):
     """One worker vs two workers, same seed, ordered=True: the yielded
     sequence (not just the multiset) is identical — the contract that
     lets a training run resize its input fleet without changing what the
-    model trains on."""
+    model trains on. Parametrized over the delivery tier: the contract
+    is transport-invariant (docs/guides/service.md#transport-tiers)."""
     sequences, digests = [], []
     for n_workers in (1, 2):
         dispatcher, workers = _fleet(petastorm_dataset.url, n_workers)
         try:
-            source = ServiceBatchSource(dispatcher.address, ordered=True)
+            source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                        transport=transport)
             digest = StreamDigest()
             seq = []
             for batch in source():
@@ -211,6 +214,36 @@ def test_ordered_delivery_byte_identical_across_fleet_shapes(
     assert flat != sorted(flat)
     assert sorted(flat) == sorted(int(r["id"]) for r in
                                   petastorm_dataset.rows)
+
+
+def test_stream_digest_identical_across_transports(petastorm_dataset):
+    """Same seed, ordered=True, one run over TCP and one over the shm
+    ring: byte-identical delivered streams — the transport tier carries
+    bytes, it never gets a say in WHAT is delivered. Also positively
+    asserts the shm run actually rode the ring (a silent downgrade to
+    TCP would make this test vacuous)."""
+    digests, shm_streams = {}, 0
+    for transport in ("tcp", "shm"):
+        dispatcher, workers = _fleet(petastorm_dataset.url, 2)
+        try:
+            source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                        transport=transport)
+            digest = StreamDigest()
+            for batch in source():
+                digest.update(batch)
+            digests[transport] = digest.hexdigest()
+            if transport == "shm":
+                shm_streams = sum(
+                    w.diagnostics_snapshot()["metrics"]
+                    ["transport_streams_shm_total"] for w in workers)
+        finally:
+            for w in workers:
+                w.stop()
+            dispatcher.stop()
+    assert digests["tcp"] == digests["shm"]
+    assert shm_streams >= 2, (
+        "transport='shm' on loopback must negotiate the ring, not "
+        "silently fall back to TCP")
 
 
 def test_ordered_delivery_reshuffles_per_epoch(petastorm_dataset):
@@ -282,12 +315,16 @@ def test_v2_resume_is_bit_identical_from_snapshot_batch(petastorm_dataset):
 # at-least-once, when a worker dies mid-epoch
 # ---------------------------------------------------------------------------
 
-def test_takeover_is_exactly_once_and_reports_zero_duplicates(tmp_path):
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_takeover_is_exactly_once_and_reports_zero_duplicates(
+        tmp_path, transport):
     """Kill one of two workers mid-epoch: survivors re-serve its pieces
     at their watermarks, so the epoch completes with every sample
     delivered exactly once and ``duplicates_dropped == 0`` (the safety
     net never had to fire), with the dedup/watermark telemetry families
-    live."""
+    live. Parametrized over the delivery tier: a kill mid-shm-stream
+    must recover exactly like a TCP disconnect (the ring's detach flag
+    is the EOF)."""
     from petastorm_tpu.telemetry.registry import REGISTRY
     from petastorm_tpu.test_util.dataset_factory import (
         create_test_scalar_dataset,
@@ -305,7 +342,8 @@ def test_takeover_is_exactly_once_and_reports_zero_duplicates(tmp_path):
         for i in range(2)]
     try:
         source = ServiceBatchSource(dispatcher.address, max_retries=2,
-                                    backoff_base=0.02, backoff_max=0.1)
+                                    backoff_base=0.02, backoff_max=0.1,
+                                    transport=transport)
         got, killed = [], False
         for batch in source():
             got.extend(int(i) for i in batch["id"])

@@ -311,16 +311,22 @@ def test_dynamic_loopback_matches_local_reader(scalar_dataset_12pieces):
         _stop_fleet(dispatcher, workers)
 
 
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
 def test_dynamic_steals_rebalance_skewed_worker_zero_dup_zero_loss(
-        scalar_dataset_12pieces):
+        scalar_dataset_12pieces, transport):
     """ISSUE acceptance shape: one of two workers skewed per batch — work
     stealing moves its backlog to the fast worker, every row arrives
-    exactly once, and the straggler ends up serving fewer pieces."""
+    exactly once, and the straggler ends up serving fewer pieces.
+    Parametrized over the delivery tier: the steal handshake (revoke /
+    extend control frames) rides TCP on both tiers, but the revoked and
+    re-served batches ride the negotiated transport — dedup and piece
+    accounting must not notice the difference."""
     url, rows = scalar_dataset_12pieces
     dispatcher, workers = _dynamic_fleet(url, skew_worker_delay_s=0.15)
     try:
         source = ServiceBatchSource(dispatcher.address,
-                                    dynamic_sync_interval_s=0.1)
+                                    dynamic_sync_interval_s=0.1,
+                                    transport=transport)
         got = [int(i) for batch in source() for i in batch["id"]]
         assert sorted(got) == list(range(rows))  # zero dup AND zero loss
         recovery = source.diagnostics["recovery"]

@@ -432,6 +432,35 @@ def test_failpoint_chaos_replay_is_byte_identical():
     json.dumps(first["failpoint_injections"])
 
 
+def test_shm_failpoint_chaos_replay_is_byte_identical():
+    """The shm-tier analogue of the replay pin above: seeded ring faults
+    (producer detach mid-stream, torn doorbell/record, stale-generation
+    arena) fire inside the negotiated shared-memory transport — the
+    client's ordinary broken-stream recovery re-serves at the watermark,
+    so delivery stays exactly-once and two runs of one seed produce
+    byte-identical digests and identical injection logs."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    kwargs = dict(rows=420, days=4, workers=2, batch_size=64,
+                  chaos="failpoints", chaos_seed=23,
+                  failpoint_points=("shm-detach", "torn-doorbell",
+                                    "stale-arena"),
+                  failpoint_window=12,
+                  shuffle_seed=5, ordered=True)
+    first = service_loopback_scenario(**kwargs)
+    second = service_loopback_scenario(**kwargs)
+    for result in (first, second):
+        assert result["lost_rows"] == 0
+        assert result["duplicate_rows"] == 0
+    assert first["failpoint_injections"], (
+        "no shm failpoint fired — the streams are not riding the ring")
+    fired_points = {entry[0] for entry in first["failpoint_injections"]}
+    assert fired_points <= {"shm-detach", "torn-doorbell", "stale-arena"}
+    assert first["stream_digest"] == second["stream_digest"]
+    assert (sorted(map(tuple, first["failpoint_injections"]))
+            == sorted(map(tuple, second["failpoint_injections"])))
+
+
 # ---------------------------------------------------------------------------
 # fuzzer: shrinking + the slow soak
 # ---------------------------------------------------------------------------
@@ -510,7 +539,11 @@ def test_fuzz_hung_run_is_bounded_and_reported():
 @pytest.mark.slow
 def test_fuzz_soak_twenty_seeds_green():
     """The acceptance soak: 20 seeded schedules through the real loopback
-    service, zero-dup/zero-loss and digest-determinism per seed."""
+    service, zero-dup/zero-loss and digest-determinism per seed. The
+    default vocabulary is the FULL ``failpoints.POINTS`` set — including
+    the shm-ring points (``shm-detach``/``torn-doorbell``/``stale-arena``)
+    — and the loopback streams negotiate the shm tier by default, so the
+    soak fires ring faults into live shared-memory streams."""
     from petastorm_tpu.service import fuzz
 
     report = fuzz.fuzz(range(20), check_determinism=True,

@@ -433,14 +433,18 @@ def test_loader_state_dict_still_raises_without_source_support():
         loader.state_dict()
 
 
-def test_resume_skips_completed_pieces(petastorm_dataset):
-    """A snapshot naming completed pieces resumes without re-reading them."""
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_resume_skips_completed_pieces(petastorm_dataset, transport):
+    """A snapshot naming completed pieces resumes without re-reading them
+    — on either delivery tier (watermark resume is transport-invariant;
+    docs/guides/service.md#transport-tiers)."""
     dispatcher, workers = _service_fleet(petastorm_dataset.url)
     try:
         # Dataset has 3 row groups of 10 rows; claim piece 0 completed.
         state = {"version": 1, "mode": "static", "client_index": 0,
                  "num_clients": 1, "epoch": 0, "completed_pieces": [0]}
-        source = ServiceBatchSource(dispatcher.address, resume_state=state)
+        source = ServiceBatchSource(dispatcher.address, resume_state=state,
+                                    transport=transport)
         got = [int(i) for batch in source() for i in batch["id"]]
         expected = [i for i in _local_ids(petastorm_dataset.url) if i >= 10]
         assert sorted(got) == expected

@@ -1,0 +1,309 @@
+"""Unit tests for the shared-memory ring transport (service/shm_ring.py).
+
+These exercise the ring in isolation — a producer and consumer attached
+over a socketpair in one process, no service stack — so the SPSC
+protocol, wrap-around, spill ordering, mapped frames, failpoints, and
+teardown accounting are each pinned before the negotiation layer routes
+every loopback stream through them.
+"""
+
+import errno
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import failpoints
+from petastorm_tpu.reader_impl.framed_socket import (
+    ConnectionClosedError,
+    FramedReader,
+    ProtocolError,
+    encode_payload,
+)
+from petastorm_tpu.service.shm_ring import (
+    FramePool,
+    RingConsumer,
+    RingProducer,
+    ShmSetupError,
+    live_shm_counts,
+)
+
+
+@pytest.fixture
+def sock_pair():
+    a, b = socket.socketpair()
+    yield a, b
+    for s in (a, b):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _make_ring(sock_pair, data_size=1 << 16, pool=None):
+    wsock, csock = sock_pair
+    producer = RingProducer(wsock, pool=pool, data_size=data_size)
+    consumer = RingConsumer(producer.descriptor(), csock,
+                            FramedReader(csock))
+    return producer, consumer
+
+
+def test_inline_roundtrip_preserves_header_and_payload(sock_pair):
+    producer, consumer = _make_ring(sock_pair)
+    try:
+        batch = {"a": np.arange(100, dtype=np.int64),
+                 "b": np.ones((4, 7), dtype=np.float32)}
+        producer.send({"type": "batch", "bid": 1}, batch)
+        producer.send({"type": "end", "rows": 100})
+        header, payload = consumer.recv(timeout=5)
+        assert header == {"type": "batch", "bid": 1}
+        np.testing.assert_array_equal(payload["a"], batch["a"])
+        np.testing.assert_array_equal(payload["b"], batch["b"])
+        header, payload = consumer.recv(timeout=5)
+        assert header == {"type": "end", "rows": 100}
+        assert payload is None
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_delivered_arrays_are_privately_writable(sock_pair):
+    """The TCP tier hands each out-of-band frame its own writable buffer;
+    the ring must preserve that — a trainer mutating a delivered batch in
+    place must never corrupt shared memory."""
+    producer, consumer = _make_ring(sock_pair)
+    try:
+        producer.send({"type": "batch"}, {"x": np.zeros(8, np.int64)})
+        _, payload = consumer.recv(timeout=5)
+        payload["x"] += 7  # must not raise (read-only) nor alias the ring
+        assert payload["x"].sum() == 56
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_wraparound_under_backpressure_preserves_order(sock_pair):
+    """A tiny ring forces wrap-around and producer space-waits; every
+    message still arrives intact and in order."""
+    producer, consumer = _make_ring(sock_pair, data_size=4096)
+    rng = np.random.default_rng(7)
+    sent = [rng.integers(0, 255, size=700, dtype=np.uint8)
+            for _ in range(60)]
+    received = []
+    errors = []
+
+    def consume():
+        try:
+            while True:
+                header, payload = consumer.recv(timeout=20)
+                if header["type"] == "end":
+                    return
+                received.append((header["i"], payload))
+        except Exception as exc:  # surfaced via the errors list
+            errors.append(exc)
+
+    thread = threading.Thread(target=consume)
+    thread.start()
+    try:
+        for i, arr in enumerate(sent):
+            producer.send({"type": "batch", "i": i}, arr)
+        producer.send({"type": "end"})
+        thread.join(timeout=30)
+        assert not thread.is_alive()
+        assert not errors
+        assert [i for i, _ in received] == list(range(len(sent)))
+        for (_, got), want in zip(received, sent):
+            np.testing.assert_array_equal(got, want)
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_oversized_message_spills_to_socket_in_ring_order(sock_pair):
+    """A message bigger than the whole ring rides the paired socket
+    behind an in-ring marker; ordering with inline neighbors holds."""
+    producer, consumer = _make_ring(sock_pair, data_size=4096)
+    big = np.arange(20_000, dtype=np.uint8)
+    received = []
+    errors = []
+
+    def consume():
+        try:
+            for _ in range(3):
+                received.append(consumer.recv(timeout=20))
+        except Exception as exc:
+            errors.append(exc)
+
+    thread = threading.Thread(target=consume)
+    thread.start()
+    try:
+        producer.send({"i": 0}, np.ones(10, np.uint8))
+        producer.send({"i": 1}, big)   # spill
+        producer.send({"i": 2}, np.full(10, 2, np.uint8))
+        thread.join(timeout=30)
+        assert not thread.is_alive()
+        assert not errors
+        assert [h["i"] for h, _ in received] == [0, 1, 2]
+        np.testing.assert_array_equal(received[1][1], big)
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_mapped_frames_roundtrip_from_shared_pool(sock_pair):
+    """Frames whose bytes live in the shared frame pool travel as
+    (offset, len) references — the warm cache-hit path — and decode to
+    the identical payload."""
+    from petastorm_tpu.telemetry.metrics import SHM_FRAMES
+
+    pool = FramePool(size=1 << 20)
+    consumer_pool = None
+    producer, consumer = _make_ring(sock_pair, pool=pool)
+    try:
+        consumer_pool = FramePool.attach(pool.descriptor())
+        consumer.attach_pool(consumer_pool)
+        batch = {"a": np.arange(500, dtype=np.float64)}
+        fmt, frames = encode_payload(batch)
+        blob_parts = [bytes(memoryview(f).cast("B")) for f in frames]
+        blob = b"".join(blob_parts)
+        buf = pool.allocate(len(blob))
+        assert buf is not None
+        buf[:] = blob
+        views, off = [], 0
+        for part in blob_parts:
+            views.append(buf[off:off + len(part)])
+            off += len(part)
+        mapped_before = SHM_FRAMES.labels("mapped").value
+        producer.send_frames({"type": "batch", "bid": 9}, fmt, views)
+        header, payload = consumer.recv(timeout=5)
+        assert header["bid"] == 9
+        np.testing.assert_array_equal(payload["a"], batch["a"])
+        assert SHM_FRAMES.labels("mapped").value \
+            == mapped_before + len(views)
+        del views, buf  # release pool exports so close() unmaps cleanly
+    finally:
+        producer.close()
+        consumer.close()
+        if consumer_pool is not None:
+            consumer_pool.close()
+        pool.close()
+
+
+def test_foreign_frames_fall_back_to_inline_copy(sock_pair):
+    """A pool-armed producer sending heap frames (a cache miss) serves
+    them inline — locate() refuses the mixed/foreign case."""
+    pool = FramePool(size=1 << 20)
+    producer, consumer = _make_ring(sock_pair, pool=pool)
+    try:
+        producer.send({"type": "batch"}, {"x": np.arange(16)})
+        header, payload = consumer.recv(timeout=5)
+        np.testing.assert_array_equal(payload["x"], np.arange(16))
+    finally:
+        producer.close()
+        consumer.close()
+        pool.close()
+
+
+def test_producer_close_lets_consumer_drain_then_signals_closed(sock_pair):
+    """A clean close never loses committed records: the consumer drains
+    everything published (the `end` message), THEN sees the detach."""
+    producer, consumer = _make_ring(sock_pair)
+    try:
+        producer.send({"type": "batch", "i": 0}, np.arange(5))
+        producer.send({"type": "end"})
+        producer.close()
+        assert consumer.recv(timeout=5)[0] == {"type": "batch", "i": 0}
+        assert consumer.recv(timeout=5)[0] == {"type": "end"}
+        with pytest.raises(ConnectionClosedError):
+            consumer.recv(timeout=5)
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_recv_timeout_raises_socket_timeout(sock_pair):
+    producer, consumer = _make_ring(sock_pair)
+    try:
+        with pytest.raises(socket.timeout):
+            consumer.recv(timeout=0.05)
+    finally:
+        producer.close()
+        consumer.close()
+
+
+@pytest.mark.parametrize("point,action,consumer_exc", [
+    ("shm-detach", "detach", ConnectionClosedError),
+    ("torn-doorbell", "torn", ProtocolError),
+    ("stale-arena", "stale", ProtocolError),
+])
+def test_shm_failpoints_break_both_ends(sock_pair, point, action,
+                                        consumer_exc):
+    """Each shm failpoint resets the producer (ConnectionResetError — the
+    serve loop's 'disconnected' outcome) and surfaces on the consumer as
+    the documented exception class, funneling into broken-stream
+    recovery."""
+    producer, consumer = _make_ring(sock_pair)
+    schedule = failpoints.FaultSchedule(
+        seed=1, points=(point,), fires={point: {1: action}})
+    try:
+        with failpoints.armed(schedule):
+            producer.send({"i": 0}, np.arange(4))
+            assert consumer.recv(timeout=5)[0] == {"i": 0}
+            with pytest.raises(ConnectionResetError):
+                producer.send({"i": 1}, np.arange(4))
+            with pytest.raises(consumer_exc):
+                consumer.recv(timeout=5)
+        assert (point, 1, action) in schedule.log
+    finally:
+        producer.close()
+        consumer.close()
+
+
+def test_live_resource_registry_returns_to_baseline(sock_pair):
+    """Every mapping and doorbell fd is registered while live and
+    deregistered on close — the hook the conftest leak guard fails tests
+    through."""
+    base = live_shm_counts()
+    pool = FramePool(size=1 << 16)
+    producer, consumer = _make_ring(sock_pair, pool=pool)
+    during = live_shm_counts()
+    assert during["rings"] == base["rings"] + 2
+    assert during["pools"] == base["pools"] + 1
+    assert during["eventfds"] == base["eventfds"] + 4
+    producer.close()
+    consumer.close()
+    pool.close()
+    assert live_shm_counts() == base
+    # close() is idempotent — a double close must not drive counts
+    # negative (the guard would blame the wrong test).
+    producer.close()
+    consumer.close()
+    pool.close()
+    assert live_shm_counts() == base
+
+
+def test_arena_setup_failure_is_catchable_shm_setup_error(
+        sock_pair, monkeypatch):
+    """tmpfs exhaustion surfaces at creation as ShmSetupError (the
+    negotiation layer's downgrade trigger), never as SIGBUS later."""
+    def full_pwrite(fd, data, offset):
+        raise OSError(errno.ENOSPC, "no space left on device")
+
+    monkeypatch.setattr(os, "pwrite", full_pwrite)
+    wsock, _ = sock_pair
+    with pytest.raises(ShmSetupError):
+        RingProducer(wsock, data_size=1 << 16)
+    with pytest.raises(ShmSetupError):
+        FramePool(size=1 << 16)
+
+
+def test_pool_exhaustion_degrades_to_none():
+    pool = FramePool(size=1 << 12)
+    try:
+        assert pool.allocate(1 << 11) is not None
+        assert pool.allocate(1 << 12) is None   # would overflow
+        assert pool.allocate(0) is None
+    finally:
+        pool.close()

@@ -347,8 +347,14 @@ def test_service_loopback_populates_registry(service_fleet):
         WORKER_ROWS_SENT,
     )
 
+    def sent_total():
+        # Summed over the transport label: the stream may ride TCP or the
+        # negotiated shm ring, either way messages must be counted.
+        return sum(TRANSPORT_MESSAGES.labels("sent", t).value
+                   for t in ("tcp", "shm"))
+
     dispatcher, worker = service_fleet
-    sent_before = TRANSPORT_MESSAGES.labels("sent").value
+    sent_before = sent_total()
     batches_before = WORKER_BATCHES_SENT.labels("tele-worker").value
     rows_before = WORKER_ROWS_SENT.labels("tele-worker").value
     source = ServiceBatchSource(dispatcher.address,
@@ -362,7 +368,7 @@ def test_service_loopback_populates_registry(service_fleet):
     delta_batches = (WORKER_BATCHES_SENT.labels("tele-worker").value
                      - batches_before)
     assert delta_batches >= 3
-    assert TRANSPORT_MESSAGES.labels("sent").value > sent_before
+    assert sent_total() > sent_before
     assert CLIENT_BATCHES.labels("tele-worker").value >= 3
     # worker diagnostics carry the registry totals for status --watch
     snap = worker.diagnostics_snapshot()
@@ -373,7 +379,12 @@ def test_batch_trace_spans_contiguous_across_layers(service_fleet,
                                                     tmp_path):
     """The acceptance contract: one batch id carries spans from worker
     decode through client recv/queue to loader device dispatch, in
-    non-overlapping chronological order, in one Perfetto-loadable file."""
+    non-overlapping chronological order, in one Perfetto-loadable file.
+
+    Pinned to TCP: on the shm ring the consumer maps a committed record
+    the instant the doorbell rings — before the producer's send span has
+    closed — so worker.send and client.recv genuinely overlap and the
+    stage-completion chain below is only a contract of the wire path."""
     from petastorm_tpu.jax_utils.loader import JaxDataLoader
     from petastorm_tpu.service import ServiceBatchSource
     from petastorm_tpu.telemetry import tracing
@@ -382,7 +393,8 @@ def test_batch_trace_spans_contiguous_across_layers(service_fleet,
     trace_path = tmp_path / "trace.json"
     tracing.COLLECTOR.clear()
     source = ServiceBatchSource(dispatcher.address,
-                                heartbeat_interval_s=None)
+                                heartbeat_interval_s=None,
+                                transport="tcp")
     loader = JaxDataLoader(None, 10, batch_source=source,
                            stage_to_device=False,
                            trace_path=str(trace_path))

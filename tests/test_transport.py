@@ -1,0 +1,190 @@
+"""Transport negotiation: shm grant, downgrade-to-TCP paths, mode knobs.
+
+The contract under test (docs/guides/service.md#transport-tiers): shm is
+an optimization the stream setup *negotiates*, never a requirement — any
+failure on the shm path (arena setup, client attach) serves the SAME
+stream request over TCP without erroring the stream or losing the credit
+window, counted in ``petastorm_transport_downgrades_total``. Delivery
+invariance across tiers is covered by the ``transport``-parametrized
+tests in test_determinism / test_service / test_dynamic_sharding; this
+file covers the negotiation machinery itself.
+"""
+
+import pytest
+
+from petastorm_tpu.service import BatchWorker, Dispatcher, ServiceBatchSource
+from petastorm_tpu.service import shm_ring
+from petastorm_tpu.service import transport as transport_mod
+from petastorm_tpu.telemetry.metrics import TRANSPORT_DOWNGRADES
+
+pytestmark = pytest.mark.service
+
+
+def _fleet(url, transport=None):
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=1).start()
+    worker = BatchWorker(url, dispatcher_address=dispatcher.address,
+                         batch_size=7, reader_factory="row", worker_id="w0",
+                         transport=transport,
+                         reader_kwargs={"workers_count": 2}).start()
+    return dispatcher, worker
+
+
+def _stream_all(source):
+    return sorted(int(i) for batch in source() for i in batch["id"])
+
+
+def _expected_ids(dataset):
+    return sorted(int(r["id"]) for r in dataset.rows)
+
+
+# ---------------------------------------------------------------------------
+# mode resolution
+# ---------------------------------------------------------------------------
+
+def test_resolve_mode_precedence(monkeypatch):
+    monkeypatch.delenv("PETASTORM_TRANSPORT", raising=False)
+    assert transport_mod.resolve_mode() == "auto"
+    assert transport_mod.resolve_mode("tcp") == "tcp"
+    monkeypatch.setenv("PETASTORM_TRANSPORT", "tcp")
+    assert transport_mod.resolve_mode() == "tcp"
+    # An explicit argument outranks the env var.
+    assert transport_mod.resolve_mode("shm") == "shm"
+    with pytest.raises(ValueError, match="transport must be one of"):
+        transport_mod.resolve_mode("carrier-pigeon")
+
+
+def test_advertisement_shape():
+    assert transport_mod.advertisement("tcp") is None
+    advert = transport_mod.advertisement("auto")
+    assert advert["modes"] == ["shm"]
+    assert advert["host"] == transport_mod.host_token()
+
+
+# ---------------------------------------------------------------------------
+# the grant path, and forcing TCP
+# ---------------------------------------------------------------------------
+
+def test_loopback_auto_negotiates_shm(petastorm_dataset):
+    """Defaults on both ends, same host: streams ride the ring (the
+    positive check that the rest of the suite isn't silently on TCP)."""
+    dispatcher, worker = _fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        assert _stream_all(source) == _expected_ids(petastorm_dataset)
+        metrics = worker.diagnostics_snapshot()["metrics"]
+        assert metrics["transport_streams_shm_total"] >= 1
+        assert metrics["transport_streams_tcp_total"] == 0
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+@pytest.mark.parametrize("side", ["client", "worker"])
+def test_transport_tcp_on_either_side_forces_tcp(petastorm_dataset, side):
+    """``--transport tcp`` on EITHER end pins the stream to TCP — the
+    escape hatch must not depend on which process got the flag."""
+    before = TRANSPORT_DOWNGRADES.labels("arena_setup").value \
+        + TRANSPORT_DOWNGRADES.labels("client_nack").value
+    dispatcher, worker = _fleet(
+        petastorm_dataset.url,
+        transport="tcp" if side == "worker" else None)
+    try:
+        source = ServiceBatchSource(
+            dispatcher.address,
+            transport="tcp" if side == "client" else None)
+        assert _stream_all(source) == _expected_ids(petastorm_dataset)
+        metrics = worker.diagnostics_snapshot()["metrics"]
+        assert metrics["transport_streams_shm_total"] == 0
+        assert metrics["transport_streams_tcp_total"] >= 1
+    finally:
+        worker.stop()
+        dispatcher.stop()
+    # Choosing TCP is not a downgrade: nothing failed.
+    after = TRANSPORT_DOWNGRADES.labels("arena_setup").value \
+        + TRANSPORT_DOWNGRADES.labels("client_nack").value
+    assert after == before
+
+
+def test_cross_host_peer_serves_tcp_without_counting_a_downgrade(
+        petastorm_dataset, monkeypatch):
+    """A client on another host advertises shm too — the worker's host
+    check routes it to TCP silently (the right tier, not a failure)."""
+    monkeypatch.setattr(
+        transport_mod, "advertisement",
+        lambda mode: None if mode == "tcp" else
+        {"modes": ["shm"], "host": "some-other-host", "pid": 1})
+    before = TRANSPORT_DOWNGRADES.labels("arena_setup").value \
+        + TRANSPORT_DOWNGRADES.labels("client_nack").value
+    dispatcher, worker = _fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address)
+        assert _stream_all(source) == _expected_ids(petastorm_dataset)
+        metrics = worker.diagnostics_snapshot()["metrics"]
+        assert metrics["transport_streams_shm_total"] == 0
+        assert metrics["transport_streams_tcp_total"] >= 1
+    finally:
+        worker.stop()
+        dispatcher.stop()
+    after = TRANSPORT_DOWNGRADES.labels("arena_setup").value \
+        + TRANSPORT_DOWNGRADES.labels("client_nack").value
+    assert after == before
+
+
+# ---------------------------------------------------------------------------
+# downgrade paths: the stream must complete on the SAME request
+# ---------------------------------------------------------------------------
+
+def test_arena_setup_failure_downgrades_same_request(
+        petastorm_dataset, monkeypatch):
+    """/dev/shm exhaustion at ring construction: the worker logs the
+    downgrade, serves this same stream request over TCP, and the client
+    never notices (no stream error, no retry, full delivery)."""
+
+    def exploding_producer(*args, **kwargs):
+        raise shm_ring.ShmSetupError("injected: /dev/shm exhausted")
+
+    monkeypatch.setattr(shm_ring, "RingProducer", exploding_producer)
+    before = TRANSPORT_DOWNGRADES.labels("arena_setup").value
+    dispatcher, worker = _fleet(petastorm_dataset.url)
+    try:
+        # credits=2 doubles as the credit-window check: a window damaged
+        # during the failed negotiation would stall a 2-credit stream
+        # forever, not complete it.
+        source = ServiceBatchSource(dispatcher.address, credits=2)
+        assert _stream_all(source) == _expected_ids(petastorm_dataset)
+        assert source.diagnostics["recovery"]["takeovers"] == 0
+        metrics = worker.diagnostics_snapshot()["metrics"]
+        assert metrics["transport_streams_shm_total"] == 0
+        assert metrics["transport_streams_tcp_total"] >= 1
+    finally:
+        worker.stop()
+        dispatcher.stop()
+    assert TRANSPORT_DOWNGRADES.labels("arena_setup").value > before
+
+
+def test_client_attach_failure_nacks_and_downgrades_same_request(
+        petastorm_dataset, monkeypatch):
+    """The worker's arena is fine but the client cannot attach it: the
+    client nacks, the worker closes the offered ring and serves this
+    same request over TCP — again no stream error and no lost credit."""
+
+    def exploding_consumer(*args, **kwargs):
+        raise shm_ring.ShmAttachError("injected: attach refused")
+
+    monkeypatch.setattr(shm_ring, "RingConsumer", exploding_consumer)
+    before = TRANSPORT_DOWNGRADES.labels("client_nack").value
+    baseline_shm = shm_ring.live_shm_counts()
+    dispatcher, worker = _fleet(petastorm_dataset.url)
+    try:
+        source = ServiceBatchSource(dispatcher.address, credits=2)
+        assert _stream_all(source) == _expected_ids(petastorm_dataset)
+        assert source.diagnostics["recovery"]["takeovers"] == 0
+        metrics = worker.diagnostics_snapshot()["metrics"]
+        assert metrics["transport_streams_shm_total"] == 0
+        assert metrics["transport_streams_tcp_total"] >= 1
+    finally:
+        worker.stop()
+        dispatcher.stop()
+    assert TRANSPORT_DOWNGRADES.labels("client_nack").value > before
+    # The nacked ring (and the worker's frame pool) must not leak.
+    assert shm_ring.live_shm_counts() == baseline_shm
