@@ -125,12 +125,27 @@ class CachedBatch:
 
     def to_dict(self):
         """Rebuild the ``{field: ndarray}`` batch (the loader's hit path).
-        Out-of-band frames are copied out of the shared entry buffer first:
-        protocol-5 reconstruction aliases frame memory into the rebuilt
-        arrays, and a cached entry's buffer must never be writable through
-        a served batch (nor pinned by one after eviction)."""
-        from petastorm_tpu.reader_impl.framed_socket import decode_payload
 
+        PICKLE entries copy their out-of-band frames out of the shared
+        entry buffer first: protocol-5 reconstruction aliases frame memory
+        into WRITABLE rebuilt arrays, and a cached entry's buffer must
+        never be writable through a served batch. COLUMNAR entries skip
+        the copy — ``np.frombuffer`` over the entry's immutable ``bytes``
+        yields read-only column views, so a warm hit is zero-copy and a
+        trainer mutating the delivered batch gets a loud ``ValueError``
+        instead of silently corrupting the cache (the view does pin the
+        entry buffer until the batch is dropped, which is safe: evicting
+        an immutable buffer merely drops the cache's reference)."""
+        from petastorm_tpu.reader_impl.framed_socket import (
+            PAYLOAD_COLUMNAR,
+            decode_payload,
+        )
+
+        if self.fmt == PAYLOAD_COLUMNAR:
+            # toreadonly(): entry buffers routed through the shm FramePool
+            # are writable memoryviews — the served views must not be.
+            return decode_payload(
+                self.fmt, [memoryview(f).toreadonly() for f in self.frames])
         frames = [self.frames[0]] + [bytearray(f) for f in self.frames[1:]]
         return decode_payload(self.fmt, frames)
 

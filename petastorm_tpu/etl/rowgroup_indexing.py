@@ -20,7 +20,7 @@ from petastorm_tpu.etl.metadata import (
     read_dataset_metadata,
 )
 from petastorm_tpu.fs_utils import FilesystemResolver
-from petastorm_tpu.utils import decode_row
+from petastorm_tpu.utils import decode_table
 
 ROWGROUPS_INDEX_KEY = b"dataset-toolkit.rowgroups_index.v1"
 
@@ -43,11 +43,15 @@ def build_rowgroup_index(dataset_url, indexers, hdfs_driver="libhdfs",
 
     from concurrent.futures import ThreadPoolExecutor
 
+    view = schema.create_schema_view([schema.fields[c] for c in columns])
+
     def read_piece(piece_index):
         piece = pieces[piece_index]
         table = piece.read(fs, columns=columns)
-        view = schema.create_schema_view([schema.fields[c] for c in columns])
-        return piece_index, [decode_row(row, view) for row in table.to_pylist()]
+        # Column-wise decode (no per-row to_pylist); ETL-time, but index
+        # builds scan every row group so the decode wall is the same one
+        # the serving path has.
+        return piece_index, decode_table(table, view)
 
     with ThreadPoolExecutor(max_workers=workers_count) as executor:
         for piece_index, rows in executor.map(read_piece, range(len(pieces))):

@@ -50,6 +50,10 @@ Failpoint vocabulary (point → actions a schedule may choose):
                        entry is PUBLISHED; the warm load must
                        detect and degrade)
 ``cache.read``         ``oserror`` (load fails — a miss)
+``decode.columnar``    ``fallback`` (the columnar fast path is
+                       refused for this call — the batch
+                       serializes as pickle / decodes per row
+                       instead, byte-identical output), ``delay``
 ``dispatcher.reply``   ``drop`` (the reply vanishes AFTER the
                        handler mutated state — the client retries
                        and the op is duplicated), ``delay``
@@ -109,6 +113,11 @@ POINTS = {
     "journal.compact": ("torn_rename",),
     "cache.write": ("oserror", "partial"),
     "cache.read": ("oserror",),
+    # Columnar hot path (framed_socket payload encode + the columnar
+    # reader worker's vectorized decode): "fallback" exercises the
+    # row/pickle degradation the path promises is byte-identical — the
+    # soak's digest gate proves it.
+    "decode.columnar": ("fallback", "delay"),
     "dispatcher.reply": ("drop", "delay"),
     "worker.heartbeat": ("drop",),
     "packing.state": ("torn",),

@@ -54,20 +54,21 @@ from petastorm_tpu.telemetry.metrics import (
 #: skipped, so each class falls through to its next lever.)
 #:
 #: Rewrite knobs (``stage_fusion`` / ``filter_placement`` /
-#: ``cache_placement`` — ``pipeline/rewrites.py``) come FIRST in the
+#: ``cache_placement`` / ``reader_family`` — ``pipeline/rewrites.py``)
+#: come FIRST in the
 #: classes whose wall they attack structurally: they change the topology
 #: instead of rebalancing around it, so when their trigger economics fire
 #: they are the primary lever. Untriggered rewrites are skipped outright
 #: (the class falls through to its capacity knobs — knob-only workloads
 #: never pay a rewrite probe).
 _CLASS_KNOBS = {
-    "decode-bound": ("filter_placement:worker", "stage_fusion:fused",
-                     "cache_placement:post-decode",
+    "decode-bound": ("filter_placement:worker", "reader_family:columnar",
+                     "stage_fusion:fused", "cache_placement:post-decode",
                      "workers_count", "host_prefetch"),
     "dispatch-bound": ("device_prefetch", "host_prefetch"),
     "credit-bound": ("credits", "ready_queue_depth"),
-    "worker-bound": ("filter_placement:worker", "stage_fusion:fused",
-                     "cache_placement:post-decode",
+    "worker-bound": ("filter_placement:worker", "reader_family:columnar",
+                     "stage_fusion:fused", "cache_placement:post-decode",
                      "transform_placement:local",
                      "packing_placement:trainer", "credits"),
     "consumer-bound": ("transform_placement:remote",
@@ -590,10 +591,12 @@ def _gauge_value(value):
     side, 1 = the trainer host (transform: remote/local; packing:
     worker/trainer; filter: worker/client). Rewrite topology knobs render
     0 = baseline, 1 = rewrite in force (stage_fusion: off/fused;
-    cache_placement: post-transform/post-decode)."""
-    if value in ("remote", "worker", "off", "post-transform"):
+    cache_placement: post-transform/post-decode; reader_family:
+    row/columnar)."""
+    if value in ("remote", "worker", "off", "post-transform", "row"):
         return 0.0
-    if value in ("local", "trainer", "client", "fused", "post-decode"):
+    if value in ("local", "trainer", "client", "fused", "post-decode",
+                 "columnar"):
         return 1.0
     try:
         return float(value)

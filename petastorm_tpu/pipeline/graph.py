@@ -497,6 +497,18 @@ def build_loader_graph(loader, bounds=None):
             set=source.set_cache_placement,
             kind="choice", choices=("post-transform", "post-decode"),
             applies="next-iteration", rewrite="cache_placement"))
+    if rewritable and hasattr(source, "set_reader_family"):
+        # row_vs_columnar: which decode family the workers serve the
+        # stream through. get() reports "row" for the unset default (the
+        # planner needs a concrete baseline to revert to); a worker whose
+        # constructed family cannot honor the request degrades per stream
+        # (bytes identical), so a probe is always safe.
+        knobs.append(Knob(
+            "reader_family",
+            get=lambda: source.reader_family or "row",
+            set=source.set_reader_family,
+            kind="choice", choices=("row", "columnar"),
+            applies="next-iteration", rewrite="row_vs_columnar"))
 
     signals = {
         "rows": lambda: loader._m_rows.value,

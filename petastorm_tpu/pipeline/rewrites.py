@@ -48,6 +48,17 @@ REWRITE_KINDS = {
             "zero-work) vs post-decode (entries hold smaller/shareable "
             "pre-transform bytes; warm serves re-apply the transform)."),
     },
+    "row_vs_columnar": {
+        "knob": "reader_family",
+        "applied_value": "columnar",
+        "description": (
+            "Serve the stream through the columnar reader family: codec "
+            "decode runs as vectorized per-column kernels over whole "
+            "Arrow batches instead of per-row Python materialization "
+            "(no to_pylist on the hot path). Decoded bytes are "
+            "identical; exotic codecs/readers fall back to the row "
+            "path per piece, still byte-identical."),
+    },
 }
 
 #: Trigger thresholds (override via ``autotune={'rewrite_thresholds':
@@ -73,6 +84,11 @@ DEFAULT_THRESHOLDS = {
     # serve costs at least this fraction of the window wall.
     "cache_hot_transform_frac": 0.20,
     "cache_min_hit_rate": 0.5,
+    # row→columnar: worker decode must dominate the window wall — the
+    # vectorized kernels only move the needle when per-row decode IS the
+    # bottleneck (a transport- or consumer-bound stream gains nothing and
+    # pays a cache re-fill, since the two families key entries apart).
+    "columnar_min_decode_frac": 0.30,
 }
 
 
@@ -144,6 +160,16 @@ def rewrite_triggered(kind, want, profile, thresholds=None):
                 return True, (f"warm serves (hit rate {hit_rate:.0%}) "
                               f"re-pay the transform "
                               f"({transform_s:.3f}s of {wall:.3f}s wall)")
+        return False, ""
+    if kind == "row_vs_columnar":
+        decode_s = _get(profile, "worker_decode_s")
+        wall = _get(profile, "wall_s")
+        if decode_s > 0 and wall > 0 \
+                and decode_s >= t["columnar_min_decode_frac"] * wall:
+            return True, (f"worker decode {decode_s:.3f}s is "
+                          f"{decode_s / wall:.0%} of the {wall:.3f}s "
+                          f"window: vectorized columnar kernels replace "
+                          f"per-row decode")
         return False, ""
     raise ValueError(f"unknown rewrite kind {kind!r}")
 

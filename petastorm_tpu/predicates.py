@@ -229,8 +229,8 @@ class ColumnPredicate(PredicateBase):
         """Boolean keep-mask over ``table`` (which holds this predicate's
         column), computed with pyarrow compute kernels — no Python-object
         materialization of any row. The two-phase predicate read uses this
-        to filter BOTH column reads down to survivors before ``to_pylist``
-        (dropped rows never decode, never materialize)."""
+        to filter BOTH column reads down to survivors while they are still
+        Arrow (dropped rows never decode, never materialize)."""
         import numpy as np
         import pyarrow.compute as pc
 

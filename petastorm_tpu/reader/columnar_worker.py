@@ -20,13 +20,17 @@ any decode work, and ``TransformSpec.func`` operates on the decoded
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu import failpoints as _failpoints
 from petastorm_tpu.reader_impl.delivery_tracker import PiecePayload, item_key
+from petastorm_tpu.schema.codecs import DataframeColumnCodec
 from petastorm_tpu.schema.transform import transform_schema
+from petastorm_tpu.telemetry.metrics import COLUMNAR_KERNEL_SECONDS
 from petastorm_tpu.workers_pool.worker_base import WorkerBase
 
 
@@ -74,12 +78,25 @@ class ColumnarDecodeWorker(WorkerBase):
 
         table = self._drop_partition(table, shuffle_row_drop_partition)
 
+        # The columnar decode boundary: the decode.columnar failpoint's
+        # "fallback" action forces this batch through the base-class
+        # per-row decode loop — the exact row path the vectorized kernels
+        # are proven equal to, so the soak's digest gate holds across it.
+        fp = _failpoints.ACTIVE
+        rowwise = fp is not None and fp.fire("decode.columnar") == "fallback"
         batch = OrderedDict()
         for name in columns:
             field = self._read_schema.fields[name]
             cells = _column_cells(table.column(name))
             if field.codec is not None:
-                batch[name] = field.codec.decode_column(field, cells)
+                if rowwise:
+                    batch[name] = DataframeColumnCodec.decode_column(
+                        field.codec, field, cells)
+                else:
+                    t0 = time.perf_counter()
+                    batch[name] = field.codec.decode_column(field, cells)
+                    COLUMNAR_KERNEL_SECONDS.observe(
+                        time.perf_counter() - t0)
             else:
                 batch[name] = cells
 

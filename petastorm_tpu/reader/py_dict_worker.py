@@ -62,11 +62,26 @@ class PyDictReaderWorker(WorkerBase):
 
     def _load_rows(self, piece, worker_predicate, shuffle_row_drop_partition):
         if worker_predicate is not None:
-            storage_rows = self._read_with_predicate(piece, worker_predicate)
-            storage_rows = self._drop_partition(storage_rows,
-                                                shuffle_row_drop_partition)
-            decoded = [decode_row(row, self._read_schema)
-                       for row in storage_rows]
+            storage = self._read_with_predicate(piece, worker_predicate)
+            if isinstance(storage, list):
+                # Per-row predicate fallback: rows are already python
+                # dicts, decode each.
+                storage = self._drop_partition(storage,
+                                               shuffle_row_drop_partition)
+                decoded = [decode_row(row, self._read_schema)
+                           for row in storage]
+            else:
+                # Vectorized two-phase read: survivors stayed Arrow all
+                # the way — column-wise decode, no to_pylist on scalar
+                # fields.
+                this_partition, num_partitions = shuffle_row_drop_partition
+                if num_partitions > 1:
+                    import numpy as np
+
+                    storage = storage.take(
+                        np.arange(this_partition, storage.num_rows,
+                                  num_partitions))
+                decoded = decode_table(storage, self._read_schema)
         else:
             columns = self._needed_columns()
             table = piece.read(self._filesystem, columns=columns)
@@ -105,9 +120,11 @@ class PyDictReaderWorker(WorkerBase):
         or ``do_include_vectorized``) and every predicate field is a
         scalar-codec column (stored values ARE the decoded values); the
         per-row ``decode_row`` + ``do_include`` loop remains the fallback,
-        unchanged. Either way both column reads are ``Table.filter``-ed
-        down to survivors before ``to_pylist`` — dropped rows are never
-        materialized into Python objects."""
+        unchanged. On the vectorized path the survivors stay Arrow end to
+        end: both column reads are ``Table.filter``-ed and returned as ONE
+        combined ``pa.Table`` for column-wise decode — no ``to_pylist``
+        ever runs. The fallback path (rows already materialized for the
+        mask) still returns merged python row dicts."""
         import numpy as np
         import pyarrow as pa
 
@@ -136,10 +153,32 @@ class PyDictReaderWorker(WorkerBase):
         if not mask.any():
             return []
         keep = pa.array(mask)
-        if predicate_rows is None:
-            predicate_rows = predicate_table.filter(keep).to_pylist()
+        # Predicate fields that belong in the output (the rest were read
+        # only to compute the mask).
+        kept_fields = [
+            name for name in predicate_fields
+            if name in self._read_schema.fields or (
+                self._ngram is not None
+                and name in self._ngram.get_field_names_at_all_timesteps())]
         other_columns = [c for c in self._needed_columns()
                          if c not in predicate_fields]
+        if predicate_rows is None:
+            # Vectorized mask: survivors never become python rows at all —
+            # combine the filtered column reads into one Arrow table and
+            # let the caller decode column-wise.
+            data = {}
+            if other_columns:
+                other_table = piece.read(self._filesystem,
+                                         columns=other_columns)
+                other_table = other_table.filter(keep)
+                for name in other_columns:
+                    data[name] = other_table.column(name)
+            filtered = predicate_table.filter(keep)
+            for name in kept_fields:
+                data[name] = filtered.column(name)
+            return pa.table(data)
+        # Per-row mask fallback: the predicate rows are already python
+        # dicts (the mask needed them) — merge row-wise as before.
         if other_columns:
             other_table = piece.read(self._filesystem, columns=other_columns)
             other_rows = other_table.filter(keep).to_pylist()
@@ -148,12 +187,8 @@ class PyDictReaderWorker(WorkerBase):
         result = []
         for pred_row, other_row in zip(predicate_rows, other_rows):
             merged = dict(other_row)
-            # keep only predicate fields that are also part of the read schema
-            for name in predicate_fields:
-                if name in self._read_schema.fields or (
-                        self._ngram is not None
-                        and name in self._ngram.get_field_names_at_all_timesteps()):
-                    merged[name] = pred_row[name]
+            for name in kept_fields:
+                merged[name] = pred_row[name]
             result.append(merged)
         return result
 

@@ -11,7 +11,7 @@ dicts, Arrow IPC streams for ``pa.Table`` payloads.
 One message is::
 
     !Q header_len | header JSON (utf-8)
-    !B payload_format            # NONE / PICKLE / ARROW
+    !B payload_format            # NONE / PICKLE / ARROW / COLUMNAR
     !I n_frames
     (!Q frame_len | frame bytes) * n_frames
 
@@ -70,6 +70,15 @@ _NFRAMES = struct.Struct("!I")
 PAYLOAD_NONE = 0
 PAYLOAD_PICKLE = 1
 PAYLOAD_ARROW = 2
+#: Columnar batch dicts ({field: ndarray}, the data plane's native shape)
+#: skip pickle entirely: one tiny JSON meta frame (names/dtypes/shapes),
+#: then each column's raw C-contiguous bytes as its own frame. Decode is
+#: ``np.frombuffer`` views over the received frames — zero parse, zero
+#: copy — and the views inherit writability from the frame buffer they
+#: alias (private per-message buffers stay mutable, shared cache entry
+#: buffers come back read-only, so a trainer mutating a delivered batch
+#: can never corrupt a cache or pool buffer).
+PAYLOAD_COLUMNAR = 3
 
 #: Default frame-size cap: refuse to allocate for absurd frame sizes
 #: (corrupt stream / wrong peer / hostile length prefix). Receivers accept a
@@ -185,6 +194,59 @@ def _is_arrow_table(payload):
     return pa is not None and isinstance(payload, pa.Table)
 
 
+def _columnar_frames(payload):
+    """``{field: ndarray}`` batch → COLUMNAR frames, or ``None`` when any
+    column disqualifies the batch from the raw-bytes representation:
+    non-ndarray values, object dtypes (per-element pickles), and
+    extension dtypes (kind ``'V'`` — e.g. bfloat16 — whose ``dtype.str``
+    does not round-trip through ``np.dtype``). Disqualified batches ride
+    the pickle path, byte-identical on arrival."""
+    import sys
+
+    np = sys.modules.get("numpy")
+    if np is None or not payload:
+        return None
+    for value in payload.values():
+        if not isinstance(value, np.ndarray) \
+                or value.dtype.kind not in "biufcSUmM":
+            return None
+    meta = [[str(name), arr.dtype.str, list(arr.shape)]
+            for name, arr in payload.items()]
+    frames = [json.dumps(meta).encode("utf-8")]
+    for arr in payload.values():
+        # cast("B") flattens the (C-contiguous) column to a plain byte
+        # view — sendmsg scatter-gathers it straight from array memory.
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.kind in "mM":
+            # datetime64/timedelta64 refuse the buffer protocol; a uint8
+            # view of the same memory exports fine and ``frombuffer`` on
+            # the receive side reconstitutes the dtype from the meta.
+            arr = arr.view("u1")
+        frames.append(memoryview(arr).cast("B"))
+    return frames
+
+
+def _decode_columnar(frames):
+    """COLUMNAR frames → ``{field: ndarray}``: each column is a
+    ``np.frombuffer`` VIEW over its received frame (no parse, no copy).
+    Writability follows buffer ownership: a private per-message
+    ``bytearray`` (TCP recv, shm inline/pool copies) yields a mutable
+    array, an immutable shared buffer (a cache entry's ``bytes``) yields
+    a read-only one — mutation raises instead of corrupting the cache."""
+    import numpy as np
+
+    meta = json.loads(bytes(frames[0]))
+    if len(frames) != len(meta) + 1:
+        raise ValueError(
+            f"COLUMNAR payload carries {len(frames) - 1} column frames "
+            f"for {len(meta)} declared columns")
+    batch = {}
+    for (name, dtype, shape), frame in zip(meta, frames[1:]):
+        batch[name] = np.frombuffer(frame,
+                                    dtype=np.dtype(dtype)).reshape(shape)
+    return batch
+
+
 def _encode_payload(payload):
     """payload object → (format tag, [frame, ...])."""
     if payload is None:
@@ -195,6 +257,16 @@ def _encode_payload(payload):
         )
 
         return PAYLOAD_ARROW, ArrowTableSerializer().serialize_to_frames(payload)
+    if isinstance(payload, dict):
+        frames = _columnar_frames(payload)
+        if frames is not None:
+            # The columnar serialize boundary: the decode.columnar
+            # failpoint's "fallback" action forces this batch through the
+            # pickle path — the soak's digest gate proves the degradation
+            # is byte-identical (docs/guides/diagnostics.md#failpoints).
+            fp = _failpoints.ACTIVE
+            if fp is None or fp.fire("decode.columnar") != "fallback":
+                return PAYLOAD_COLUMNAR, frames
     return PAYLOAD_PICKLE, PickleSerializer().serialize_to_frames(payload)
 
 
@@ -209,6 +281,8 @@ def _decode_payload(fmt, frames):
         return ArrowTableSerializer().deserialize_from_frames(frames)
     if fmt == PAYLOAD_PICKLE:
         return PickleSerializer().deserialize_from_frames(frames)
+    if fmt == PAYLOAD_COLUMNAR:
+        return _decode_columnar(frames)
     raise ValueError(f"Unknown payload format tag {fmt}")
 
 
@@ -497,9 +571,10 @@ class FramedReader:
             frame_len = _LEN.unpack_from(self._take(_LEN.size))[0]
             _check_frame_len(frame_len, self._max_frame_bytes)
             total_bytes += _LEN.size + frame_len
-            if fmt == PAYLOAD_PICKLE and i == 0:
-                # Pickle head: consumed synchronously by pickle.loads and
-                # never referenced after — pooled, recycled post-decode.
+            if fmt in (PAYLOAD_PICKLE, PAYLOAD_COLUMNAR) and i == 0:
+                # Pickle head / COLUMNAR JSON meta: consumed synchronously
+                # by the decoder and never referenced after — pooled,
+                # recycled post-decode.
                 head_buf = self._pool.acquire(frame_len)
                 view = memoryview(head_buf)[:frame_len]
                 self._read_into(view, frame_len)

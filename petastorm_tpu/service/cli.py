@@ -328,7 +328,10 @@ def _worker_totals(sample, wid):
             metrics.get("cache_permuted_serves_total"),
             # Transport tier attribution (None on pre-transport workers).
             metrics.get("transport_streams_tcp_total"),
-            metrics.get("transport_streams_shm_total"))
+            metrics.get("transport_streams_shm_total"),
+            # row_vs_columnar attribution (None on pre-columnar workers).
+            metrics.get("columnar_batches_total"),
+            metrics.get("row_fallback_batches_total"))
 
 
 def _transport_label(tcp_total, shm_total):
@@ -365,7 +368,8 @@ def render_fleet_status(prev, cur):
         header,
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
         f"{'TRANSPORT':>9} {'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} "
-        f"{'CACHEHIT%':>10} {'PERM/S':>7} {'STEALS':>9} {'BACKLOG':>8}",
+        f"{'CACHEHIT%':>10} {'COL%':>6} {'PERM/S':>7} {'STEALS':>9} "
+        f"{'BACKLOG':>8}",
     ]
 
     def steal_cols(wid):
@@ -383,7 +387,7 @@ def render_fleet_status(prev, cur):
             lines.append(f"{wid:<20} {'unreachable':>10}")
             continue
         (rows1, batches1, wait1, active, hits1, misses1, perm1,
-         tcp1, shm1) = now
+         tcp1, shm1, col1, colfb1) = now
         transport = _transport_label(tcp1, shm1)
         before = _worker_totals(prev, wid)
         if before is None:
@@ -392,9 +396,10 @@ def render_fleet_status(prev, cur):
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
                 f"{transport:>9} {'--':>13} {int(rows1):>12} {'--':>10} "
-                f"{'--':>7} {steal_cols(wid)}")
+                f"{'--':>6} {'--':>7} {steal_cols(wid)}")
             continue
-        rows0, batches0, wait0, _, hits0, misses0, perm0, _, _ = before
+        (rows0, batches0, wait0, _, hits0, misses0, perm0, _, _,
+         col0, colfb0) = before
         rows_rate = max(0.0, rows1 - rows0) / dt
         batch_rate = max(0.0, batches1 - batches0) / dt
         wait_rate = max(0.0, wait1 - wait0) / dt
@@ -409,6 +414,16 @@ def render_fleet_status(prev, cur):
             lookups = hit_delta + max(0.0, misses1 - (misses0 or 0.0))
             if lookups > 0:
                 hit_pct = f"{100.0 * hit_delta / lookups:.1f}"
+        # COL% over the window: share of this window's columnar-requested
+        # batches the vectorized path actually served (delta-based, like
+        # the hit rate). "--" when no stream requested a decode family
+        # this window (or on a pre-columnar worker).
+        col_pct = "--"
+        if col1 is not None and colfb1 is not None:
+            col_delta = max(0.0, col1 - (col0 or 0.0))
+            col_total = col_delta + max(0.0, colfb1 - (colfb0 or 0.0))
+            if col_total > 0:
+                col_pct = f"{100.0 * col_delta / col_total:.1f}"
         # Permuted serves over the window: the shuffle-compatible serving
         # signal — nonzero means warm entries go out through a seed-tree
         # serve-time permutation (cached shuffled epochs are live).
@@ -418,7 +433,7 @@ def render_fleet_status(prev, cur):
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
             f"{int(active):>8} {transport:>9} {wait_rate:>13.3f} "
-            f"{int(rows1):>12} {hit_pct:>10} {perm_rate:>7} "
+            f"{int(rows1):>12} {hit_pct:>10} {col_pct:>6} {perm_rate:>7} "
             f"{steal_cols(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")

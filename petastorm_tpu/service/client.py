@@ -106,7 +106,7 @@ class _WorkerStream:
                  starts=None, shuffle_seed=None, transform_placement=None,
                  job_id=None, recv_timeout=None, packing=None,
                  predicate=None, projection=None, fused=False,
-                 cache_stage=None, transport="auto"):
+                 cache_stage=None, reader_family=None, transport="auto"):
         self.worker_id = worker_id
         #: Transport tier policy for this stream ("auto"/"tcp"/"shm" —
         #: docs/guides/service.md#transport-tiers): anything but "tcp"
@@ -121,6 +121,12 @@ class _WorkerStream:
         self.projection = projection
         self.fused = fused
         self.cache_stage = cache_stage
+        #: Reader family the worker should serve this stream through
+        #: (``row_vs_columnar`` rewrite): ``"columnar"`` asks for
+        #: vectorized per-column codec decode; ``None`` keeps the
+        #: worker's constructed factory. The worker may fall back to the
+        #: row path per stream (exotic codecs/readers) — bytes identical.
+        self.reader_family = reader_family
         #: Worker-placement sequence packing: the spec's dict form rides
         #: the stream request; the worker packs pre-serialization and
         #: ordinals/watermarks number PACKED batches. ``None`` = no
@@ -217,6 +223,8 @@ class _WorkerStream:
                 request["fused"] = True
             if self.cache_stage is not None:
                 request["cache_stage"] = self.cache_stage
+            if self.reader_family is not None:
+                request["reader_family"] = self.reader_family
             if self.tagged:
                 request["tagged"] = True
                 if self.starts:
@@ -530,7 +538,7 @@ class _DynamicStream:
                  credits=None, shuffle_seed=None, transform_placement=None,
                  job_id=None, recv_timeout=None, packing=None,
                  predicate=None, projection=None, fused=False,
-                 cache_stage=None, transport="auto"):
+                 cache_stage=None, reader_family=None, transport="auto"):
         self.worker_id = worker_id
         self.transport = transport  # see _WorkerStream.transport
         self.job_id = job_id  # see _WorkerStream.job_id
@@ -539,6 +547,7 @@ class _DynamicStream:
         self.projection = projection
         self.fused = fused
         self.cache_stage = cache_stage
+        self.reader_family = reader_family
         self.address = tuple(address)
         # initial [(piece, generation, start)] — start = the client's
         # delivery watermark, so a (re)opened stream never repeats batches
@@ -592,6 +601,8 @@ class _DynamicStream:
                 request["fused"] = True
             if self.cache_stage is not None:
                 request["cache_stage"] = self.cache_stage
+            if self.reader_family is not None:
+                request["reader_family"] = self.reader_family
             if self.credits is not None:
                 request["credits"] = self.credits
             try:
@@ -868,7 +879,7 @@ class ServiceBatchSource:
                  stream_recv_timeout_s=None, packing=None, corpus="",
                  predicate=None, projection=None, filter_placement="client",
                  stage_fusion="off", cache_placement="post-transform",
-                 transport=None):
+                 reader_family=None, transport=None):
         from petastorm_tpu.service.transport import resolve_mode
 
         # Transport tier policy, resolved once (explicit arg >
@@ -987,6 +998,18 @@ class ServiceBatchSource:
                 "transform= armed (without one the two placements cache "
                 "identical bytes)")
         self._cache_placement = cache_placement
+        # Reader family the workers serve this source's streams through
+        # (the row_vs_columnar rewrite — docs/guides/pipeline.md#graph-
+        # rewrites): None keeps each worker's constructed factory, "row"
+        # pins per-row decode, "columnar" asks for vectorized per-column
+        # codec kernels. Decoded bytes are identical either way; workers
+        # lacking a columnar path for the stream (exotic codecs, ngram,
+        # batch-family datasets) fall back to the row path per stream.
+        if reader_family not in (None, "row", "columnar"):
+            raise ValueError(
+                "reader_family must be None, 'row', or 'columnar', got "
+                f"{reader_family!r}")
+        self._reader_family = reader_family
         # Iteration-frozen copies (set at __call__, like the transform
         # placement): every stream of one iteration — takeover/resync
         # relaunches included — carries the same rewrite attributes.
@@ -996,6 +1019,7 @@ class ServiceBatchSource:
         self._iter_hoisted = False
         self._iter_fused = False
         self._iter_cache_stage = None
+        self._iter_reader_family = None
         # Batches the trainer-local filter dropped ENTIRELY this iteration
         # (every row failed the predicate): breaks the 1:1 received↔
         # yielded correspondence the prefetch-lag-exact state_dict needs —
@@ -1380,6 +1404,29 @@ class ServiceBatchSource:
             self._reject_rewrite_on_fcfs("cache_placement='post-decode'")
         self._cache_placement = placement
 
+    @property
+    def reader_family(self):
+        """The reader family workers serve this source through from the
+        next iteration on (``None`` = each worker's constructed
+        factory; ``"row"`` / ``"columnar"``)."""
+        return self._reader_family
+
+    def set_reader_family(self, family):
+        """Flip the workers' serving family between per-row codec decode
+        and vectorized columnar kernels (the ``row_vs_columnar``
+        rewrite). Next-iteration; decoded bytes are identical — a worker
+        that cannot serve a stream columnar (exotic codecs, ngram
+        windows, batch-family datasets) falls back to the row path for
+        that stream, still byte-identical. The two families key cache
+        entries apart, so a flip re-fills rather than cross-serving."""
+        if family not in (None, "row", "columnar"):
+            raise ValueError(
+                "reader_family must be None, 'row', or 'columnar', got "
+                f"{family!r}")
+        if family == "columnar":
+            self._reject_rewrite_on_fcfs("reader_family='columnar'")
+        self._reader_family = family
+
     def _reject_rewrite_on_fcfs(self, what):
         """Rewrite setters refuse on a known-fcfs source: the flip would
         not probe, it would crash the NEXT iteration's __call__ — a
@@ -1406,6 +1453,7 @@ class ServiceBatchSource:
             "projection": self._iter_projection if hoisted else None,
             "fused": self._iter_fused,
             "cache_stage": self._iter_cache_stage,
+            "reader_family": self._iter_reader_family,
             # Not a rewrite, but frozen the same way: every stream of an
             # iteration negotiates under the same transport policy.
             "transport": self._transport,
@@ -1567,15 +1615,18 @@ class ServiceBatchSource:
         self._iter_cache_stage = (self._cache_placement
                                   if self._cache_placement != "post-transform"
                                   else None)
+        self._iter_reader_family = self._reader_family
         self._filter_dropped_batches = 0
         rewriting = (hoisted or self._iter_fused
-                     or self._iter_cache_stage is not None)
+                     or self._iter_cache_stage is not None
+                     or self._iter_reader_family is not None)
         if rewriting and info["mode"] == "fcfs":
             raise ValueError(
                 "graph rewrites (filter_placement='worker', stage_fusion, "
-                "cache_placement='post-decode') require static or dynamic "
-                "sharding: fcfs serves untagged per-split streams outside "
-                "the streaming piece engine, which is where rewrites run "
+                "cache_placement='post-decode', reader_family) require "
+                "static or dynamic sharding: fcfs serves untagged "
+                "per-split streams outside the streaming piece engine, "
+                "which is where rewrites run "
                 "(docs/guides/pipeline.md#graph-rewrites)")
         local = self._iter_transform_placement == "local"
         client_filtered = (self._predicate is not None and not hoisted)
@@ -3287,6 +3338,7 @@ class ServiceBatchSource:
                                      else "off"),
                     "cache_placement": (self._iter_cache_stage
                                         or "post-transform"),
+                    "reader_family": self._iter_reader_family,
                     "filter_dropped_batches":
                         self._filter_dropped_batches,
                 },

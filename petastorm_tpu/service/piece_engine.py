@@ -86,9 +86,11 @@ class _PieceCollator:
     stitched carrying remainders), scoped to ONE piece so batch boundaries
     align to piece boundaries."""
 
-    def __init__(self, batch_size, batched_output, ngram):
+    def __init__(self, batch_size, batched_output, ngram,
+                 normalize_object=False):
         self._batch_size = batch_size
         self._batched = batched_output
+        self._normalize_object = normalize_object
         if not batched_output:
             from petastorm_tpu.jax_utils.batcher import (
                 collate_ngram_rows,
@@ -128,7 +130,19 @@ class _PieceCollator:
         for name in self._names:
             chunks = self._pending[name]
             joined = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
-            out[name] = joined[:n]
+            column = joined[:n]
+            if self._normalize_object and column.dtype == object:
+                # Columnar readers decide dense-vs-object per PIECE (any
+                # null in the piece's column makes the whole column an
+                # object array); the row family decides per BATCH
+                # (``_stack_column``). Re-apply the batch-level rule to
+                # each emitted slice so a null-free batch cut from a
+                # nullable column collates dense exactly like the row
+                # path — the family flip stays byte-identical.
+                from petastorm_tpu.jax_utils.batcher import _stack_column
+
+                column = _stack_column(list(column))
+            out[name] = column
             rest[name] = [joined[n:]] if joined.shape[0] > n else []
         self._pending = rest
         self._pending_rows -= n
@@ -209,6 +223,14 @@ class StreamingPieceEngine:
         ``docs/guides/llm.md#packed-layout``). Composes with
         ``permute_fn`` (the permutation is over packed batch counts) and
         ``starts`` re-grants unchanged.
+    :param columnar_collate: the stream serves the COLUMNAR reader family —
+        emitted batch slices re-apply the row family's batch-level
+        dense-vs-object collation rule to object columns (a nullable
+        column makes the whole PIECE object-dtype; a null-free batch cut
+        from it must still collate dense, exactly as the row path's
+        ``_stack_column`` would). Off (default) for the row family (rule
+        already applied at collate) and the batch family (whose raw
+        arrow-column layout must not change).
     :param on_piece_error: the poison-piece policy
         (``docs/guides/service.md#failure-model-and-recovery``).
         ``"fail"`` (default): a piece whose decode raises errors the
@@ -229,7 +251,8 @@ class StreamingPieceEngine:
                  cache_note_fn=None, lookahead=2, permute_fn=None,
                  transform_fn=None, on_piece_error="fail",
                  packer_factory=None, fused=False,
-                 cache_stage="post-transform", handoff_note_fn=None):
+                 cache_stage="post-transform", handoff_note_fn=None,
+                 columnar_collate=False):
         if on_piece_error not in ("fail", "quarantine"):
             raise ValueError(
                 "on_piece_error must be 'fail' or 'quarantine', got "
@@ -257,6 +280,7 @@ class StreamingPieceEngine:
             self._reader_factory = None
             self._install_reader(reader)
         self._batch_size = int(batch_size)
+        self._columnar_collate = bool(columnar_collate)
         self._cache = cache
         self._cache_key_fn = cache_key_fn
         self._cache_note_fn = cache_note_fn
@@ -674,7 +698,8 @@ class StreamingPieceEngine:
                 else:
                     collator = _PieceCollator(
                         self._batch_size, reader.batched_output,
-                        getattr(reader, "ngram", None))
+                        getattr(reader, "ngram", None),
+                        normalize_object=self._columnar_collate)
                     if self._packer_factory is not None:
                         from petastorm_tpu.service.packing_stage import (
                             PackingCollator,

@@ -33,6 +33,7 @@ from petastorm_tpu.reader_impl.framed_socket import (
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
+    COLUMNAR_BATCHES,
     FLEET_JOB_CACHE_LOOKUPS,
     FLEET_JOB_ROWS,
     WORKER_ACTIVE_STREAMS,
@@ -290,6 +291,15 @@ class BatchWorker:
         self._m_handoff = WORKER_HANDOFF_SECONDS.labels(self.worker_id)
         self._m_readers = WORKER_READERS_CONSTRUCTED.labels(self.worker_id)
         self._m_transform = WORKER_TRANSFORM_SECONDS.labels(self.worker_id)
+        # row_vs_columnar accounting: batches served through the columnar
+        # decode path vs batches a columnar request fell back to the row
+        # path for (docs/guides/service.md#columnar-hot-path). Interned
+        # here — the send path must not pay a labels() lookup per batch.
+        self._m_columnar = {
+            "columnar": COLUMNAR_BATCHES.labels(self.worker_id, "columnar"),
+            "row_fallback": COLUMNAR_BATCHES.labels(self.worker_id,
+                                                    "row_fallback"),
+        }
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self._heartbeat_paused = threading.Event()  # test hook: hung worker
@@ -671,6 +681,34 @@ class BatchWorker:
                 "error": f"unknown cache_stage {cache_stage!r} "
                          f"(post-transform|post-decode)"})
             return
+        reader_family = header.get("reader_family")
+        if reader_family not in (None, "row", "columnar"):
+            send_framed(sock, {
+                "type": "error",
+                "error": f"unknown reader_family {reader_family!r} "
+                         f"(row|columnar)"})
+            return
+        # row_vs_columnar rewrite: resolve the requested decode family
+        # against what this worker can serve. Unlike the other rewrites an
+        # unservable request never errors — it degrades to the constructed
+        # family (decoded bytes identical either way) and the degradation
+        # is visible as path="row_fallback" in
+        # petastorm_columnar_batches_total, so the planner's probe sees no
+        # phantom speedup and the operator's COL% column sees the miss.
+        family_swap, effective_family = self._resolve_stream_family(
+            reader_family,
+            engine=((dynamic or tagged or self._batch_cache is not None)
+                    and self._engine_supported()))
+        columnar_path = None
+        if effective_family == "columnar":
+            columnar_path = "columnar"
+        elif reader_family == "columnar":
+            columnar_path = "row_fallback"
+            self._log.warning(
+                "stream requested reader_family='columnar' but this "
+                "serving path cannot vectorize (constructed family %r); "
+                "serving the row path — decoded bytes are identical",
+                self._factory_name)
         needs_engine = (fused or stream_predicate is not None
                         or projection is not None
                         or cache_stage != "post-transform")
@@ -740,6 +778,10 @@ class BatchWorker:
                 "batches_sent": 0, "credit_wait_s": 0.0}
         if job is not None:
             flow["job"] = job
+        if columnar_path is not None:
+            # Read per batch in _send_stream_batch: every batch of this
+            # stream counts under one resolved path label.
+            flow["columnar_path"] = columnar_path
         stream_key = f"{uuid.uuid4().hex[:8]}"
         # The stream's mutable serving state: the cached path swaps
         # per-piece readers through "reader" (None while serving from
@@ -752,7 +794,8 @@ class BatchWorker:
             self._active[stream_key] = state
         self._m_active.inc()
         rewrites = {"fused": fused, "predicate": stream_predicate,
-                    "projection": projection, "cache_stage": cache_stage}
+                    "projection": projection, "cache_stage": cache_stage,
+                    "family": family_swap}
         tx = None
         early_frames = []
         try:
@@ -1003,6 +1046,34 @@ class BatchWorker:
         return self._reader_kwargs.get(
             "reader_pool_type", "thread") in ("thread", "dummy")
 
+    def _resolve_stream_family(self, requested, engine):
+        """Resolve a stream's requested decode family (the
+        ``row_vs_columnar`` rewrite) against what this worker can serve.
+
+        Returns ``(swap, effective)``: ``swap`` is the factory name the
+        engine's per-piece readers must be built with (``None`` when the
+        constructed factory already satisfies the request, or the request
+        cannot be honored), ``effective`` the family that will actually
+        decode this stream. Fallback rules
+        (``docs/guides/service.md#columnar-hot-path``): the swap needs the
+        streaming engine (readers are built per stream there — the
+        direct/cached legacy paths reuse the constructed factory); a
+        "batch"-family worker has no unischema decode contract to
+        vectorize; ngram readers and row-granularity ``transform_spec``
+        callables are per-row by definition, so a columnar request
+        degrades to the row path for them.
+        """
+        constructed = self._factory_name
+        if requested is None or requested == constructed:
+            return None, constructed
+        if not engine or constructed not in ("row", "columnar"):
+            return None, constructed
+        if requested == "columnar" and (
+                self._reader_kwargs.get("ngram") is not None
+                or self._reader_kwargs.get("transform_spec") is not None):
+            return None, constructed
+        return requested, requested
+
     def _make_engine(self, epoch, shuffle_seed=None, transform_fn=None,
                      job=None, allow_quarantine=False, packing=None,
                      rewrites=None):
@@ -1026,6 +1097,14 @@ class BatchWorker:
         projection = rewrites.get("projection")
         fused = bool(rewrites.get("fused"))
         cache_stage = rewrites.get("cache_stage") or "post-transform"
+        # row_vs_columnar: a resolved family swap rebuilds this stream's
+        # per-piece readers through the other factory (vectorized
+        # per-column decode vs per-row) — decoded bytes are identical, but
+        # cache entries are keyed by the EFFECTIVE family below so the two
+        # families never serve each other's frames.
+        family = rewrites.get("family")
+        factory = _resolve_factory(family) if family else self._factory
+        family_name = family or self._factory_name
         reader_kwargs = dict(self._reader_kwargs)
         if stream_predicate is not None:
             # The hoisted row filter: applied in the reader's two-phase
@@ -1038,10 +1117,10 @@ class BatchWorker:
 
         def build_reader():
             self._m_readers.inc()
-            return self._factory(self.dataset_url, dynamic_ventilation=True,
-                                 num_epochs=1, shuffle_row_groups=False,
-                                 cur_shard=0, shard_count=1,
-                                 **reader_kwargs)
+            return factory(self.dataset_url, dynamic_ventilation=True,
+                           num_epochs=1, shuffle_row_groups=False,
+                           cur_shard=0, shard_count=1,
+                           **reader_kwargs)
 
         permute_fn = None
         if shuffle_seed is not None:
@@ -1068,7 +1147,8 @@ class BatchWorker:
             cache_key_fn=(
                 (lambda piece: self._piece_cache_key(
                     piece, transformed=transformed, packing=packing,
-                    predicate=stream_predicate, projection=projection))
+                    predicate=stream_predicate, projection=projection,
+                    family=family_name))
                 if cache is not None else None),
             cache_note_fn=(
                 (lambda hit: self._note_cache_lookup(epoch, hit, job=job))
@@ -1076,6 +1156,7 @@ class BatchWorker:
             permute_fn=permute_fn, transform_fn=transform_fn,
             packer_factory=packer_factory,
             fused=fused, cache_stage=cache_stage,
+            columnar_collate=(family_name == "columnar"),
             handoff_note_fn=self._m_handoff.inc,
             # Quarantine needs a frame vocabulary that can SAY
             # "piece_failed": only the tagged/dynamic protocols have one —
@@ -1331,7 +1412,7 @@ class BatchWorker:
                              **self._reader_kwargs)
 
     def _piece_cache_key(self, piece, transformed=False, packing=None,
-                         predicate=None, projection=None):
+                         predicate=None, projection=None, family=None):
         from petastorm_tpu.cache_impl import (
             batch_fingerprint,
             predicate_ingredient,
@@ -1383,7 +1464,11 @@ class BatchWorker:
             self.dataset_url, [signature], self._batch_size,
             fields=fields,
             transform=kwargs.get("transform_spec"),
-            factory=self._factory_name,
+            # The EFFECTIVE decode family for this stream, not the
+            # constructed one: a row_vs_columnar swap re-keys (and
+            # re-fills) rather than serving frames produced by the other
+            # family's collator.
+            factory=family or self._factory_name,
             extra=extra)
 
     def _send_stream_batch(self, tx, conn_reader, flow, credits, bid,
@@ -1449,6 +1534,11 @@ class BatchWorker:
         flow["batches_sent"] += 1
         self._m_batches.inc()
         self._m_rows.inc(rows)
+        columnar_path = flow.get("columnar_path")
+        if columnar_path is not None:
+            # Resolved once per stream in _stream; children interned at
+            # construction — per-batch cost is one counter inc.
+            self._m_columnar[columnar_path].inc()
         if flow.get("job") is not None:
             # Per-batch: only the registry child's own fine-grained lock
             # (the labels()-per-batch idiom the client counters use).
@@ -1503,6 +1593,12 @@ class BatchWorker:
             # status --watch` TRANSPORT column renders shm/tcp/mixed).
             "transport_streams_tcp_total": transport_streams["tcp"],
             "transport_streams_shm_total": transport_streams["shm"],
+            # row_vs_columnar accounting (the status --watch COL% column):
+            # batches decoded by vectorized columnar kernels vs batches a
+            # columnar request degraded to the row path for.
+            "columnar_batches_total": self._m_columnar["columnar"].value,
+            "row_fallback_batches_total":
+                self._m_columnar["row_fallback"].value,
         }
         out = {
             "worker_id": self.worker_id,

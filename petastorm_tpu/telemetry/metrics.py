@@ -116,6 +116,20 @@ WORKER_READERS_CONSTRUCTED = REGISTRY.counter(
     "ONE construction per stream regardless of piece count; the per-piece "
     "fallback (process pools) pays one per missed piece",
     labels=("worker",))
+COLUMNAR_BATCHES = REGISTRY.counter(
+    "petastorm_columnar_batches_total",
+    "Batches served through the columnar decode path, per worker and path "
+    "(columnar = vectorized per-column codec kernels decoded the batch; "
+    "row_fallback = a stream requested reader_family='columnar' but this "
+    "worker degraded it to the per-row path — bytes identical, speedup "
+    "lost). columnar / (columnar + row_fallback) is the COL%% column of "
+    "`service status --watch`",
+    labels=("worker", "path"))
+COLUMNAR_KERNEL_SECONDS = REGISTRY.histogram(
+    "petastorm_columnar_kernel_seconds",
+    "Per-column vectorized codec decode time inside the columnar reader "
+    "worker (one observation per codec column per row-group batch — the "
+    "decode_column kernels the row_vs_columnar rewrite bets on)")
 
 # -- service: dispatcher (service/dispatcher.py) -----------------------------
 

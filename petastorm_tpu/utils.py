@@ -44,6 +44,14 @@ def _decode_column(col, field):
                 fast = _fast_numeric_column(col, field)
                 if fast is not None:
                     return fast
+            if not col.null_count:
+                # Columnar kernel: one ``decode_column`` call over the raw
+                # cells (imdecode/frombuffer into a preallocated [N, ...]
+                # block) instead of a python ``decode`` per row. Columns
+                # WITH nulls keep the per-cell loop — ``to_numpy`` has no
+                # None representation for them.
+                cells = col.to_numpy(zero_copy_only=False)
+                return list(field.codec.decode_column(field, cells))
             decode = field.codec.decode
             return [None if v is None else decode(field, v)
                     for v in col.to_pylist()]

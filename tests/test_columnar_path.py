@@ -1,0 +1,406 @@
+"""Arrow-native columnar hot path (docs/guides/service.md#columnar-hot-path).
+
+Covers the `row_vs_columnar` rewrite's correctness surface:
+
+- the COLUMNAR wire format: eligibility, roundtrip fidelity, pickle
+  fallback for exotic dtypes, and the decode.columnar failpoint at the
+  serialize boundary;
+- vectorized decode_column vs the per-row base loop, per codec family
+  (scalar, ndarray, jpeg/png, Decimal-as-string) — the kernels the
+  decode.columnar failpoint flips between;
+- zero-copy collate aliasing safety: a warm cache hit serves READ-ONLY
+  column views (mutation raises instead of corrupting the entry), while
+  wire-delivered batches stay writable private buffers;
+- the worker's per-stream family resolution fallback rules (degrade to
+  the row path, never error);
+- service-level digest identity across the family flip, under shuffle
+  and a warm cache (the tier-1 slice of the columnar_ab bench gate).
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import failpoints
+from petastorm_tpu.reader_impl.framed_socket import (
+    PAYLOAD_COLUMNAR,
+    PAYLOAD_PICKLE,
+    _decode_payload,
+    _encode_payload,
+)
+
+
+def _roundtrip(payload):
+    fmt, frames = _encode_payload(payload)
+    return fmt, _decode_payload(fmt, [bytearray(bytes(f)) for f in frames])
+
+
+def _always_fallback_schedule(calls=100_000):
+    """decode.columnar fires "fallback" on EVERY call — the 100%-rate
+    arm of the soak's digest gate."""
+    return failpoints.FaultSchedule(
+        0, points=["decode.columnar"],
+        fires={"decode.columnar": {i: "fallback" for i in range(calls)}})
+
+
+# ---------------------------------------------------------------------------
+# COLUMNAR wire format
+# ---------------------------------------------------------------------------
+
+def test_columnar_payload_roundtrip_and_eligibility():
+    batch = {
+        "ids": np.arange(10, dtype=np.int64),
+        "img": np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+        "f": np.linspace(0, 1, 10, dtype=np.float32),
+        "names": np.array(["a", "bc"], dtype="<U2"),
+        "raw": np.array([b"xy", b"z"], dtype="S2"),
+        "when": np.array(["2026-08-07"], dtype="datetime64[D]"),
+    }
+    fmt, out = _roundtrip(batch)
+    assert fmt == PAYLOAD_COLUMNAR
+    assert sorted(out) == sorted(batch)
+    for name in batch:
+        assert out[name].dtype == batch[name].dtype, name
+        assert out[name].shape == batch[name].shape, name
+        assert np.array_equal(out[name], batch[name]), name
+        # Wire-delivered frames are private buffers → writable (the
+        # established delivery contract; cache views are the read-only
+        # exception, tested below).
+        assert out[name].flags.writeable, name
+
+
+def test_columnar_payload_ineligible_falls_back_to_pickle():
+    import ml_dtypes
+
+    ragged = {"obj": np.array([np.zeros(2), np.zeros(3)], dtype=object)}
+    extension = {"bf16": np.zeros(4, dtype=ml_dtypes.bfloat16)}
+    not_arrays = {"x": np.zeros(3), "n": 7}
+    empty = {}
+    for payload in (ragged, extension, not_arrays, empty):
+        fmt, frames = _encode_payload(payload)
+        assert fmt == PAYLOAD_PICKLE
+        out = _decode_payload(fmt, [bytearray(bytes(f)) for f in frames])
+        assert sorted(out) == sorted(payload)
+
+
+def test_decode_columnar_failpoint_forces_pickle_wire_format():
+    """The serialize-boundary site: under a scheduled "fallback" the
+    qualifying batch rides PAYLOAD_PICKLE — decoded content identical."""
+    batch = {"ids": np.arange(6, dtype=np.int32)}
+    schedule = _always_fallback_schedule()
+    with failpoints.armed(schedule):
+        fmt, frames = _encode_payload(batch)
+    assert fmt == PAYLOAD_PICKLE
+    out = _decode_payload(fmt, [bytearray(bytes(f)) for f in frames])
+    assert np.array_equal(out["ids"], batch["ids"])
+    assert "decode.columnar" in failpoints.POINTS
+
+
+# ---------------------------------------------------------------------------
+# vectorized decode_column ≡ per-row decode, per codec family
+# ---------------------------------------------------------------------------
+
+def _encoded_cells(field, values):
+    return np.array([field.codec.encode(field, v) for v in values],
+                    dtype=object)
+
+
+def _codec_cases():
+    from decimal import Decimal
+
+    from petastorm_tpu.schema.codecs import (CompressedImageCodec,
+                                             NdarrayCodec, ScalarCodec)
+    from petastorm_tpu.schema.unischema import UnischemaField
+
+    rng = np.random.RandomState(7)
+    return [
+        (UnischemaField("s", np.int64, (), ScalarCodec(np.int64), False),
+         [np.int64(v) for v in rng.randint(-5, 5, 8)]),
+        (UnischemaField("f", np.float32, (), ScalarCodec(np.float32), False),
+         [np.float32(v) for v in rng.rand(8)]),
+        (UnischemaField("nd", np.float32, (3, 2), NdarrayCodec(), False),
+         [rng.rand(3, 2).astype(np.float32) for _ in range(8)]),
+        (UnischemaField("png", np.uint8, (8, 6, 3),
+                        CompressedImageCodec("png"), False),
+         [rng.randint(0, 255, (8, 6, 3)).astype(np.uint8)
+          for _ in range(8)]),
+        (UnischemaField("jpg", np.uint8, (16, 16, 3),
+                        CompressedImageCodec("jpeg"), False),
+         [rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+          for _ in range(8)]),
+        (UnischemaField("dec", Decimal, (), ScalarCodec(Decimal), False),
+         [Decimal(f"{i}.{i}5") for i in range(8)]),
+        (UnischemaField("txt", str, (), ScalarCodec(str), False),
+         [f"row {i}" for i in range(8)]),
+    ]
+
+
+@pytest.mark.parametrize("field,values",
+                         _codec_cases(),
+                         ids=lambda v: getattr(v, "name", ""))
+def test_decode_column_matches_per_row_decode(field, values):
+    """The vectorized kernel and the base per-row loop (the
+    decode.columnar "fallback" target) must agree bit-for-bit — this is
+    the equality the soak's digest gate rests on. JPEG is lossy but
+    DETERMINISTIC: both paths run the same imdecode, so equality still
+    holds on the decoded bytes."""
+    from petastorm_tpu.schema.codecs import DataframeColumnCodec
+
+    cells = _encoded_cells(field, values)
+    vectorized = field.codec.decode_column(field, cells)
+    rowwise = DataframeColumnCodec.decode_column(field.codec, field, cells)
+    assert np.asarray(vectorized).dtype == np.asarray(rowwise).dtype
+    assert np.array_equal(np.asarray(vectorized), np.asarray(rowwise))
+
+
+def test_decode_table_columnar_kernels_match_decode_row():
+    """utils.decode_table routes null-free codec columns through
+    decode_column; the result must equal the per-row decode_row path."""
+    import pyarrow as pa
+
+    from petastorm_tpu.schema.unischema import Unischema
+    from petastorm_tpu.utils import decode_row, decode_table
+
+    cases = _codec_cases()
+    schema = Unischema("T", [field for field, _ in cases])
+    data = {}
+    for field, values in cases:
+        cells = [field.codec.encode(field, v) for v in values]
+        if field.name == "dec":
+            cells = [str(c) for c in cells]
+        data[field.name] = cells
+    table = pa.table(data)
+    ref = [decode_row(row, schema) for row in table.to_pylist()]
+    got = decode_table(table, schema)
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+        assert sorted(a) == sorted(b)
+        for name in a:
+            va, vb = np.asarray(a[name]), np.asarray(b[name])
+            assert va.dtype == vb.dtype, name
+            assert np.array_equal(va, vb), name
+
+
+def test_predicate_read_with_row_drop_partitions_matches_row_path(
+        petastorm_dataset):
+    """The vectorized two-phase predicate read now returns Arrow and
+    applies shuffle_row_drop_partitions via table.take — same rows as
+    the per-row reference for every partition."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.predicates import ColumnPredicate, in_lambda
+
+    def ids(predicate, part):
+        got = set()
+        with make_reader(petastorm_dataset.url, reader_pool_type="dummy",
+                         num_epochs=1, shuffle_row_groups=False,
+                         predicate=predicate,
+                         shuffle_row_drop_partitions=part) as reader:
+            for row in reader:
+                got.add(int(row.id))
+        return got
+
+    vectorized = ColumnPredicate("id2", "lt", 3)
+    # in_lambda has no pa_mask/do_include_vectorized → per-row fallback.
+    rowwise = in_lambda(["id2"], lambda values: values["id2"] < 3)
+    for part in (1, 2):
+        assert ids(vectorized, part) == ids(rowwise, part)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy collate aliasing safety
+# ---------------------------------------------------------------------------
+
+def test_warm_cache_hit_serves_read_only_views_and_survives_mutation():
+    from petastorm_tpu.cache_impl.batch_cache import CachedBatch
+    from petastorm_tpu.reader_impl.framed_socket import _encode_payload
+
+    batch = {"x": np.arange(8, dtype=np.int64),
+             "y": np.ones((4, 2), dtype=np.float32)}
+    fmt, frames = _encode_payload(batch)
+    assert fmt == PAYLOAD_COLUMNAR
+    # Entry buffers are writable (they may be shm FramePool memoryviews).
+    entry = CachedBatch(rows=8, fmt=fmt,
+                        frames=[bytearray(bytes(f)) for f in frames])
+    served = entry.to_dict()
+    for name in batch:
+        assert np.array_equal(served[name], batch[name])
+        assert not served[name].flags.writeable, name
+        with pytest.raises(ValueError):
+            served[name][0] = 0
+    # The entry's buffers are untouched: a second serve is identical.
+    again = entry.to_dict()
+    for name in batch:
+        assert np.array_equal(again[name], batch[name])
+
+
+def test_wire_delivered_batch_mutation_does_not_corrupt_source():
+    """Over the wire every frame is received into private buffers —
+    mutating a delivered batch must not reach the sender's copy."""
+    batch = {"x": np.arange(8, dtype=np.int64)}
+    fmt, frames = _encode_payload(batch)
+    received = _decode_payload(fmt, [bytearray(bytes(f)) for f in frames])
+    received["x"][:] = -1
+    assert np.array_equal(batch["x"], np.arange(8, dtype=np.int64))
+    assert np.array_equal(
+        _decode_payload(fmt, [bytearray(bytes(f)) for f in frames])["x"],
+        np.arange(8, dtype=np.int64))
+
+
+# ---------------------------------------------------------------------------
+# worker family resolution: degrade, never error
+# ---------------------------------------------------------------------------
+
+def test_resolve_stream_family_fallback_rules(petastorm_dataset):
+    from petastorm_tpu.service.worker import BatchWorker
+
+    def worker(**kwargs):
+        kwargs.setdefault("reader_factory", "row")
+        return BatchWorker(petastorm_dataset.url, batch_size=8,
+                           heartbeat_interval_s=None, **kwargs)
+
+    row = worker()
+    # No request / request == constructed → no swap.
+    assert row._resolve_stream_family(None, engine=True) == (None, "row")
+    assert row._resolve_stream_family("row", engine=True) == (None, "row")
+    # The honored swap, both directions.
+    assert row._resolve_stream_family("columnar", engine=True) \
+        == ("columnar", "columnar")
+    col = worker(reader_factory="columnar")
+    assert col._resolve_stream_family("row", engine=True) == ("row", "row")
+    # Non-engine serving path → fall back to the constructed family.
+    assert row._resolve_stream_family("columnar", engine=False) \
+        == (None, "row")
+    # Batch-family worker: no unischema decode contract to vectorize.
+    batch = worker(reader_factory="batch")
+    assert batch._resolve_stream_family("columnar", engine=True) \
+        == (None, "batch")
+    # Row-granularity reader options refuse the columnar swap.
+    spec = worker(reader_kwargs={"transform_spec": object()})
+    assert spec._resolve_stream_family("columnar", engine=True) \
+        == (None, "row")
+    ngram = worker(reader_kwargs={"ngram": object()})
+    assert ngram._resolve_stream_family("columnar", engine=True) \
+        == (None, "row")
+
+
+# ---------------------------------------------------------------------------
+# service-level digest identity across the family flip (tier-1 scenario)
+# ---------------------------------------------------------------------------
+
+def _family_run(url, *, reader_family, reader_factory="row",
+                batch_cache=None, num_epochs=1, shuffle_seed=11,
+                batch_size=7):
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+
+    dispatcher = Dispatcher(port=0, mode="static", num_epochs=num_epochs,
+                            shuffle_seed=shuffle_seed).start()
+    worker = BatchWorker(url, dispatcher_address=dispatcher.address,
+                         batch_size=batch_size, reader_factory=reader_factory,
+                         batch_cache=batch_cache,
+                         reader_kwargs={"workers_count": 2}).start()
+    try:
+        source = ServiceBatchSource(dispatcher.address, ordered=True,
+                                    reader_family=reader_family)
+        digest = StreamDigest()
+        rows = 0
+        for batch in source():
+            digest.update(batch)
+            rows += len(next(iter(batch.values())))
+        return {"digest": digest.hexdigest(), "rows": rows,
+                "metrics": worker.diagnostics_snapshot()["metrics"]}
+    finally:
+        worker.stop()
+        dispatcher.stop()
+
+
+def test_family_flip_digest_identical_under_shuffle_and_warm_cache(
+        petastorm_dataset):
+    """The rewrite's acceptance gate: same seed, ordered delivery, two
+    epochs over a mem cache (epoch 2 serves cached frames) — the row and
+    columnar families must deliver byte-identical streams, and the
+    columnar run must actually take the vectorized path. batch_size=8
+    against 10-row pieces cuts null-FREE ragged tails from the nullable
+    column (rows 28/29): the piece-level object column must re-collate
+    dense per batch exactly like the row path's ``_stack_column``."""
+    from petastorm_tpu.cache_impl import CacheConfig
+
+    def run(family):
+        return _family_run(
+            petastorm_dataset.url, reader_family=family, num_epochs=2,
+            batch_size=8,
+            batch_cache=CacheConfig(mode="mem", mem_mb=64.0).build())
+
+    row, col = run("row"), run("columnar")
+    assert row["rows"] == col["rows"] == 2 * len(petastorm_dataset.rows)
+    assert row["digest"] == col["digest"]
+    assert col["metrics"]["columnar_batches_total"] > 0
+    assert col["metrics"]["row_fallback_batches_total"] == 0
+    assert row["metrics"]["columnar_batches_total"] == 0
+
+
+def test_columnar_request_on_batch_worker_degrades_to_row_fallback(
+        petastorm_dataset):
+    """An unservable columnar request degrades (never errors): the
+    batch-family worker serves its constructed path and counts the
+    stream's batches as path="row_fallback"."""
+    from petastorm_tpu.cache_impl import CacheConfig
+
+    plain = _family_run(petastorm_dataset.url, reader_family=None,
+                        reader_factory="batch",
+                        batch_cache=CacheConfig(mode="mem",
+                                                mem_mb=64.0).build())
+    asked = _family_run(petastorm_dataset.url, reader_family="columnar",
+                        reader_factory="batch",
+                        batch_cache=CacheConfig(mode="mem",
+                                                mem_mb=64.0).build())
+    assert asked["digest"] == plain["digest"]
+    assert asked["metrics"]["row_fallback_batches_total"] > 0
+    assert asked["metrics"]["columnar_batches_total"] == 0
+
+
+def test_columnar_decode_failpoint_stream_digest_identical(
+        petastorm_dataset):
+    """decode.columnar "fallback" at 100% rate: every columnar decode and
+    serialize runs the row path — the delivered stream must still be
+    byte-identical to the unperturbed columnar run (the fuzz soak's
+    digest gate for this point, in miniature)."""
+    clean = _family_run(petastorm_dataset.url, reader_family="columnar")
+    schedule = _always_fallback_schedule()
+    with failpoints.armed(schedule):
+        perturbed = _family_run(petastorm_dataset.url,
+                                reader_family="columnar")
+    assert perturbed["digest"] == clean["digest"]
+
+
+# ---------------------------------------------------------------------------
+# COL% rendering
+# ---------------------------------------------------------------------------
+
+def test_fleet_status_renders_columnar_share():
+    from petastorm_tpu.service.cli import render_fleet_status
+
+    status = {"mode": "static", "fencing_epoch": 0, "recovery": {},
+              "workers": {"w0": {"alive": True}}, "clients": {}}
+
+    def sample(t, columnar, fallback):
+        return {"t": t, "status": status,
+                "workers": {"w0": {"metrics": {
+                    "rows_sent_total": 100.0 * t,
+                    "batches_sent_total": 10.0 * t,
+                    "credit_wait_seconds_total": 0.0,
+                    "active_streams": 1,
+                    "columnar_batches_total": columnar,
+                    "row_fallback_batches_total": fallback}}}}
+
+    text = render_fleet_status(sample(0.0, 0.0, 0.0),
+                               sample(2.0, 9.0, 1.0))
+    assert "COL%" in text
+    row = next(line for line in text.splitlines() if line.startswith("w0"))
+    assert "90.0" in row
+    # Workers that never saw a columnar-requested stream render "--".
+    no_col = render_fleet_status(sample(0.0, 0.0, 0.0),
+                                 sample(2.0, 0.0, 0.0))
+    row = next(line for line in no_col.splitlines()
+               if line.startswith("w0"))
+    assert "--" in row

@@ -846,5 +846,5 @@ def test_state_dict_refuses_prefetch_cursor_after_dropped_batches(
 def test_every_rewrite_kind_has_catalog_entry():
     for kind, info in REWRITE_KINDS.items():
         assert info["knob"] and info["applied_value"] in (
-            "fused", "worker", "post-decode")
+            "fused", "worker", "post-decode", "columnar")
         assert info["description"]
