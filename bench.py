@@ -725,6 +725,188 @@ def leg_multi_tenant(_url):
 
 
 # --------------------------------------------------------------------------
+# Overload-tail A/B (docs/guides/service.md#failure-model-and-recovery):
+# ONE fleet with one worker injected slow (a targeted slow-peer failpoint
+# delays its batch sends) under 3-job load, consumed with the resilience
+# layer ON (hedged watermark re-serves + circuit breakers) vs OFF,
+# interleaved. The tail numbers that should move: time-to-half-rows and
+# the p99 inter-batch gap — a hedge re-grants the straggler's in-flight
+# piece at its watermark on a healthy peer, so the tail stops waiting on
+# the slow worker. Exactly-once is asserted in-leg: every job's ordered
+# stream digest must compare EQUAL across arms (hedging must never change
+# delivered bytes, only when they arrive).
+# --------------------------------------------------------------------------
+
+def leg_overload_tail(_url):
+    import shutil
+    import tempfile
+    import threading
+
+    from petastorm_tpu import failpoints
+    from petastorm_tpu.benchmark.scenarios import make_tabular_dataset
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+    from petastorm_tpu.service.fleet import end_job, register_job
+
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_ot_")
+    dataset_url = f"file://{tmp}/ds"
+    rows = make_tabular_dataset(dataset_url, rows=3_072, days=8)
+    jobs = ("ot-job0", "ot-job1", "ot-job2")
+
+    def run_arm(resilience_on):
+        dispatcher = None
+        workers = []
+        try:
+            dispatcher = Dispatcher(port=0, mode="static", num_epochs=1,
+                                    shuffle_seed=7).start()
+            for i in range(2):
+                workers.append(BatchWorker(
+                    dataset_url, dispatcher_address=dispatcher.address,
+                    batch_size=128, reader_factory="batch",
+                    worker_id=f"ot-w{i}",
+                    reader_kwargs={"workers_count": 2}).start())
+            for job in jobs:
+                register_job(dispatcher.address, job, weight=1.0)
+            # The straggler: ot-w0's batch sends stall 0.5 s at seeded
+            # call indices — targeted, so peers' sends never advance the
+            # counter and the slow worker is the same in both arms.
+            schedule = failpoints.FaultSchedule(
+                seed=11, points=("slow-peer",), delay_s=0.5,
+                max_fires_per_point=6, window=14,
+                targets={"slow-peer": "ot-w0"})
+            errors = []
+            out = {}
+
+            def run_job(job):
+                try:
+                    source = ServiceBatchSource(
+                        dispatcher.address, job_id=job,
+                        client_id=f"ot-client-{job}", credits=4,
+                        ordered=True, hedging=resilience_on,
+                        hedge_floor_s=0.2, hedge_min_samples=6,
+                        hedge_quantile=0.5,
+                        # The OFF arm neuters the breaker (threshold it
+                        # can never reach) so the A/B isolates the whole
+                        # resilience layer, not just hedging.
+                        breaker_threshold=(5 if resilience_on
+                                           else 10 ** 9))
+                    got, arrivals, gaps = 0, [], []
+                    digest = StreamDigest()
+                    t0 = prev = time.perf_counter()
+                    for batch in source():
+                        now = time.perf_counter()
+                        gaps.append(now - prev)
+                        prev = now
+                        got += len(next(iter(batch.values())))
+                        digest.update(batch)
+                        arrivals.append((now - t0, got))
+                    wall = time.perf_counter() - t0
+                    half = next((t for t, n in arrivals
+                                 if n >= got / 2), wall)
+                    out[job] = {
+                        "rows": got, "wall_s": wall,
+                        "time_to_half_rows_s": half, "gaps": gaps,
+                        "digest": digest.hexdigest(),
+                        "hedge_counts": dict(
+                            source.diagnostics["resilience"]
+                            ["hedge_counts"]),
+                    }
+                except BaseException as exc:
+                    errors.append((job, exc))
+
+            threads = [threading.Thread(target=run_job, args=(job,))
+                       for job in jobs]
+            with failpoints.armed(schedule):
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            if errors:
+                raise RuntimeError(
+                    f"overload_tail job(s) failed: {errors!r}")
+            all_gaps = sorted(g for job in jobs
+                              for g in out[job]["gaps"])
+            hedges = {"launched": 0, "won": 0, "lost": 0}
+            for job in jobs:
+                for key, n in out[job].pop("hedge_counts").items():
+                    hedges[key] += n
+                out[job].pop("gaps")
+                for key in ("wall_s", "time_to_half_rows_s"):
+                    out[job][key] = round(out[job][key], 3)
+            return {
+                "per_job": out,
+                "time_to_half_rows_s": round(max(
+                    out[job]["time_to_half_rows_s"] for job in jobs), 3),
+                "p99_gap_s": round(
+                    float(np.percentile(all_gaps, 99)), 3)
+                    if all_gaps else None,
+                "hedge_counts": hedges,
+                "injections": schedule.log_snapshot(),
+            }
+        finally:
+            if dispatcher is not None:
+                for job in jobs:
+                    end_job(dispatcher.address, job)
+            for worker in workers:
+                worker.stop()
+            if dispatcher is not None:
+                dispatcher.stop()
+
+    try:
+        # Interleaved best-of-3 rounds (leg_skewed_service idiom): tail
+        # walls are host-weather sensitive; interleaving means drift hits
+        # both arms alike. "Best" per arm = smallest worst-job
+        # time-to-half (the number the leg exists to move).
+        best = {}
+        for _ in range(3):
+            for name, armed in (("resilience_on", True),
+                                ("resilience_off", False)):
+                result = run_arm(armed)
+                if (name not in best
+                        or result["time_to_half_rows_s"]
+                        < best[name]["time_to_half_rows_s"]):
+                    best[name] = result
+                # Exactly-once across EVERY pair of runs, not just the
+                # kept ones: per-job ordered digests are a pure function
+                # of (dataset, shuffle_seed) — hedging must not move them.
+                for job in jobs:
+                    if best[name]["per_job"][job]["digest"] \
+                            != result["per_job"][job]["digest"]:
+                        raise RuntimeError(
+                            "overload_tail determinism violation: two "
+                            f"runs of arm {name!r} disagree on job "
+                            f"{job!r}'s ordered digest")
+        on, off = best["resilience_on"], best["resilience_off"]
+        for job in jobs:
+            if on["per_job"][job]["digest"] \
+                    != off["per_job"][job]["digest"]:
+                raise RuntimeError(
+                    "overload_tail exactly-once violation: hedged and "
+                    f"unhedged arms disagree on job {job!r}'s ordered "
+                    f"digest ({on['per_job'][job]['digest'][:16]}… vs "
+                    f"{off['per_job'][job]['digest'][:16]}…)")
+        return {
+            "rows": rows,
+            "workers": 2,
+            "jobs": list(jobs),
+            "straggler": "ot-w0",
+            "injected_delay_s": 0.5,
+            "resilience_on": on,
+            "resilience_off": off,
+            "digests_match_across_arms": True,
+            "hedged_vs_unhedged_time_to_half": round(
+                on["time_to_half_rows_s"]
+                / max(1e-9, off["time_to_half_rows_s"]), 3),
+            "hedged_vs_unhedged_p99_gap": (
+                round(on["p99_gap_s"] / max(1e-9, off["p99_gap_s"]), 3)
+                if on["p99_gap_s"] and off["p99_gap_s"] else None),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
 # Device decode stage A/B (docs/guides/device_decode.md): the SAME dataset
 # through the same loader + model step, with the last decode stages
 # (cast + normalize) either fused ON-DEVICE over a raw uint8 staging
@@ -2278,6 +2460,7 @@ LEGS = {
     "skewed_service": leg_skewed_service,
     "shm_transport": leg_shm_transport,
     "multi_tenant": leg_multi_tenant,
+    "overload_tail": leg_overload_tail,
     "device_decode": leg_device_decode,
     "autotune": leg_autotune,
     "realstep": leg_realstep,
@@ -2296,7 +2479,7 @@ LEGS = {
 ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
                 "multichip_child", "multichip_scaling", "skewed_service",
                 "shm_transport", "autotune", "multi_tenant", "llm_packing",
-                "rewrite_ab", "columnar_ab")
+                "rewrite_ab", "columnar_ab", "overload_tail")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
@@ -2364,9 +2547,10 @@ def main():
         autotune_ab = _run_leg_subprocess("autotune", url)
         llm_packing = _run_leg_subprocess("llm_packing", url)
         columnar_ab = _run_leg_subprocess("columnar_ab", url)
+        overload_tail = _run_leg_subprocess("overload_tail", url)
         for extra in (flash_numerics, flash_memory, multichip,
                       skewed_service, shm_transport, autotune_ab,
-                      llm_packing, columnar_ab):
+                      llm_packing, columnar_ab, overload_tail):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -2488,6 +2672,13 @@ def main():
             # win and digests_match_across_families_and_transports the
             # decoded-output-identity check (asserted in-leg).
             "columnar_ab": columnar_ab,
+            # Overload-tail A/B (docs/guides/service.md#failure-model-
+            # and-recovery): one straggler worker under 3-job load with
+            # the resilience layer (hedged watermark re-serves + circuit
+            # breakers) ON vs OFF — hedged_vs_unhedged_time_to_half is
+            # the tail-cutting number, digests_match_across_arms the
+            # exactly-once check (asserted in-leg).
+            "overload_tail": overload_tail,
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),

@@ -618,13 +618,19 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               chaos_interval_s=1.5, chaos_max_events=4,
                               chaos_seed=None, failpoint_points=None,
                               failpoint_window=None,
+                              failpoint_delay_s=None,
+                              failpoint_targets=None,
+                              failpoint_max_fires=None,
                               journal_dir=None,
                               metrics_port=None,
                               trace_out=None, epochs=1, cache="off",
                               cache_mem_mb=256.0, cache_dir=None,
                               sharding=None, shuffle_seed=None,
                               ordered=False, predicate=None,
-                              filter_placement="client", transport=None):
+                              filter_placement="client", transport=None,
+                              hedging=False, hedge_floor_s=0.25,
+                              hedge_min_samples=16, hedge_quantile=0.99,
+                              hedge_multiplier=4.0, brownout=None):
     """Rows/sec through the full disaggregated path: dispatcher + ``workers``
     batch workers + one client, all over loopback TCP, streamed into
     ``JaxDataLoader`` via ``ServiceBatchSource`` — against the same dataset
@@ -708,6 +714,22 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
     fleet counted at least one ``cache_corrupt_entries`` while delivery
     stayed intact — corrupt entries degrade to fresh decode, never to bad
     bytes.
+
+    ``hedging`` arms the client's hedged watermark re-serves
+    (``docs/guides/service.md#failure-model-and-recovery``): a stream
+    silent past the fitted inter-batch-gap threshold gets its in-flight
+    piece re-granted at its watermark from a peer worker, first
+    ``piece_done`` wins, duplicates drop through the exactly-once dedup
+    — so a hedged run's ``stream_digest`` must equal the unhedged
+    same-seed run's. ``hedge_floor_s``/``hedge_min_samples``/``hedge_quantile``/
+    ``hedge_multiplier`` tune the trigger for short benchmark epochs
+    (with a few dozen gap samples the p99 IS the injected stall —
+    fitting ``quantile=0.5`` keeps the threshold anchored to the
+    healthy gap scale); the race tallies land in the result as
+    ``hedge_counts``. ``brownout`` arms the dispatcher's
+    journaled overload-shedding state machine (``True`` for defaults or
+    a config dict — see :class:`petastorm_tpu.service.resilience.\
+BrownoutConfig`).
 
     ``shuffle_seed`` arms the dispatcher's seed-tree deterministic
     shuffle; ``ordered`` re-sequences client delivery into the canonical
@@ -854,7 +876,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
         return Dispatcher(host=host, port=port, mode=mode,
                           num_epochs=epochs, journal_dir=journal_dir,
                           lease_timeout_s=lease_timeout_s,
-                          shuffle_seed=shuffle_seed)
+                          shuffle_seed=shuffle_seed,
+                          brownout=brownout)
 
     # Telemetry arming and every node start happen INSIDE the try: a
     # failing dispatcher/worker start must still stop the HTTP server +
@@ -903,7 +926,11 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             # message (drained workers poke the loop anyway). Every 50 ms
             # the straggler commits to ~1 more batch it could have shed.
             dynamic_sync_interval_s=0.05,
-            transport=transport)
+            transport=transport,
+            hedging=hedging, hedge_floor_s=hedge_floor_s,
+            hedge_min_samples=hedge_min_samples,
+            hedge_quantile=hedge_quantile,
+            hedge_multiplier=hedge_multiplier)
         loader = JaxDataLoader(None, batch_size, batch_source=source,
                                stage_to_device=False,
                                trace_path=trace_out or None)
@@ -925,6 +952,17 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
             schedule_kwargs = {"points": failpoint_points}
             if failpoint_window is not None:
                 schedule_kwargs["window"] = int(failpoint_window)
+            # Straggler shaping (the overload_tail leg + hedge tests):
+            # a bigger delay makes "delay" actions real stalls, targets
+            # pin a point to ONE site's key (e.g. one worker id) so the
+            # straggler is deterministic, max_fires sets how often.
+            if failpoint_delay_s is not None:
+                schedule_kwargs["delay_s"] = float(failpoint_delay_s)
+            if failpoint_targets is not None:
+                schedule_kwargs["targets"] = dict(failpoint_targets)
+            if failpoint_max_fires is not None:
+                schedule_kwargs["max_fires_per_point"] = int(
+                    failpoint_max_fires)
             failpoint_schedule = failpoints_mod.arm(
                 failpoints_mod.FaultSchedule(
                     chaos_seed if chaos_seed is not None else 0,
@@ -1078,6 +1116,11 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                        if predicate_obj is not None else None),
             "duplicates_dropped":
                 source_diag["recovery"]["duplicates_dropped"],
+            # Hedged re-serve race tallies (all zero when hedging is off
+            # or no stream ever went silent past the fitted threshold).
+            "hedging": hedging,
+            "hedge_counts": dict(
+                source_diag["resilience"]["hedge_counts"]),
             "epochs_detail": epochs_detail,
             "rows": served_rows,
             "batches": batches,

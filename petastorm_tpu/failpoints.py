@@ -129,6 +129,16 @@ POINTS = {
     "shm-detach": ("detach",),
     "torn-doorbell": ("torn",),
     "stale-arena": ("stale",),
+    # Resilience layer (service/resilience.py + client/worker wiring).
+    # "slow-peer" delays one worker's batch send (the straggler the
+    # hedged re-serve exists for); "breaker-trip" resets a client's
+    # stream reconnect attempt (feeding the per-peer circuit breaker);
+    # "hedge-race" delays the hedge launch so the original and the hedge
+    # finish as close together as the schedule can arrange — hammering
+    # the first-wins/loser-cancelled dedup claim.
+    "slow-peer": ("delay",),
+    "breaker-trip": ("reset",),
+    "hedge-race": ("delay",),
 }
 
 #: ``piece.decode`` is separate: it only ever fires for explicitly named
@@ -177,11 +187,18 @@ class FaultSchedule:
     :param fires: explicit ``{point: {call_index: action}}`` override for
         tests that need a fault at an exact call (bypasses derivation for
         the named points).
+    :param targets: optional ``{point: key}`` pinning a point to one call
+        site: sites pass their identity (e.g. a worker id) as
+        ``check(point, key=...)``, and calls whose key does not match are
+        invisible to the schedule — the counter does not advance, so the
+        targeted site's call indices stay deterministic regardless of how
+        peers interleave. This is how the ``overload_tail`` bench makes
+        exactly one worker the straggler.
     """
 
     def __init__(self, seed, points=None, max_fires_per_point=2,
                  window=400, min_index=4, poison_pieces=None,
-                 delay_s=0.05, fires=None):
+                 delay_s=0.05, fires=None, targets=None):
         self.seed = int(seed)
         self.points = tuple(points) if points is not None \
             else tuple(sorted(POINTS))
@@ -193,6 +210,7 @@ class FaultSchedule:
                 f"{sorted(POINTS)} + [{POISON_POINT!r}]")
         self.poison_pieces = frozenset(
             int(p) for p in (poison_pieces or ()))
+        self.targets = dict(targets or {})
         self.delay_s = float(delay_s)
         self._lock = threading.Lock()
         self._calls = {}    # point -> call counter
@@ -214,10 +232,15 @@ class FaultSchedule:
         for point, plan in (fires or {}).items():
             self._fires[point] = {int(i): a for i, a in plan.items()}
 
-    def check(self, point):
+    def check(self, point, key=None):
         """Advance ``point``'s call counter; return the action firing at
         this call (logged), or ``None``. Pure bookkeeping — the caller
-        (or :meth:`fire`) performs the action."""
+        (or :meth:`fire`) performs the action. When the schedule pins
+        ``point`` to a target, calls from other keys do not even advance
+        the counter (see ``targets``)."""
+        target = self.targets.get(point)
+        if target is not None and key != target:
+            return None
         with self._lock:
             index = self._calls.get(point, 0)
             self._calls[point] = index + 1
@@ -230,14 +253,14 @@ class FaultSchedule:
                            "seed %d)", point, action, index, self.seed)
         return action
 
-    def fire(self, point):
+    def fire(self, point, key=None):
         """:meth:`check`, then perform the generic actions in place:
         ``delay`` sleeps, ``enospc``/``oserror`` raise :class:`OSError`,
         ``reset`` raises :class:`ConnectionResetError`. Site-specific
         actions (``torn``/``partial``/``drop``/``torn_rename``/
         ``detach``/``stale``) are returned for the call site to
         implement."""
-        action = self.check(point)
+        action = self.check(point, key=key)
         if action is None:
             return None
         if action == "delay":

@@ -304,7 +304,15 @@ class Planner:
             self.last_outcome = "noop"
             return decisions
 
-        # 3. Probe the first un-settled candidate knob for this class.
+        # 3. Probe the first un-settled candidate knob for this class —
+        # unless a level-2 brownout is in force: a probe perturbs a knob
+        # to MEASURE, and measurement is optional work a drowning fleet
+        # sheds (the outstanding-probe evaluation above still completes,
+        # so a probe in flight when brownout lands is not stranded).
+        from petastorm_tpu.service.resilience import optional_stages_shed
+        if optional_stages_shed():
+            self.last_outcome = "noop"
+            return decisions
         for entry in _CLASS_KNOBS.get(cls, ()):
             name, _, want = entry.partition(":")
             desc = self.knobs.get(name)

@@ -358,6 +358,8 @@ def render_fleet_status(prev, cur):
     alive = sum(1 for w in workers_state.values() if w.get("alive"))
     dynamic = status.get("dynamic") or {}
     dyn_workers = dynamic.get("per_worker", {})
+    fleet = status.get("fleet") or {}
+    breaker_open = fleet.get("breaker_open") or {}
     header = (f"mode={status.get('mode')} fencing_epoch="
               f"{status.get('fencing_epoch')} workers={alive} alive/"
               f"{len(workers_state) - alive} dead clients="
@@ -369,8 +371,13 @@ def render_fleet_status(prev, cur):
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
         f"{'TRANSPORT':>9} {'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} "
         f"{'CACHEHIT%':>10} {'COL%':>6} {'PERM/S':>7} {'STEALS':>9} "
-        f"{'BACKLOG':>8}",
+        f"{'BACKLOG':>8} {'BREAKER':>8}",
     ]
+
+    def breaker_col(wid):
+        """``open`` while the dispatcher's journaled circuit breaker has
+        the worker excluded from assignments, ``ok`` otherwise."""
+        return f"{'open' if wid in breaker_open else 'ok':>8}"
 
     def steal_cols(wid):
         """Dynamic-mode steal/backlog columns (``in/out`` moves and the
@@ -396,7 +403,7 @@ def render_fleet_status(prev, cur):
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
                 f"{transport:>9} {'--':>13} {int(rows1):>12} {'--':>10} "
-                f"{'--':>6} {'--':>7} {steal_cols(wid)}")
+                f"{'--':>6} {'--':>7} {steal_cols(wid)} {breaker_col(wid)}")
             continue
         (rows0, batches0, wait0, _, hits0, misses0, perm0, _, _,
          col0, colfb0) = before
@@ -434,10 +441,9 @@ def render_fleet_status(prev, cur):
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
             f"{int(active):>8} {transport:>9} {wait_rate:>13.3f} "
             f"{int(rows1):>12} {hit_pct:>10} {col_pct:>6} {perm_rate:>7} "
-            f"{steal_cols(wid)}")
+            f"{steal_cols(wid)} {breaker_col(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
-    fleet = status.get("fleet") or {}
     by_state = fleet.get("workers_by_state") or {}
     if by_state:
         autoscale = fleet.get("autoscale") or {}
@@ -450,6 +456,20 @@ def render_fleet_status(prev, cur):
         if fleet.get("autoscaler_armed"):
             line += " [autoscaler on]"
         lines.append(line)
+    brownout = fleet.get("brownout") or {}
+    if brownout.get("level") or brownout.get("armed") \
+            or any((brownout.get("counts") or {}).values()):
+        counts = brownout.get("counts") or {}
+        parts = [f"brownout: level={brownout.get('level', 0)}",
+                 f"shed={counts.get('shed', 0)}",
+                 f"recover={counts.get('recover', 0)}"]
+        if brownout.get("reason"):
+            parts.append(f"reason={brownout['reason']}")
+        if brownout.get("armed"):
+            parts.append("[armed]")
+        lines.append(" ".join(parts))
+    if breaker_open:
+        lines.append("breaker-open: " + " ".join(sorted(breaker_open)))
     jobs = status.get("jobs") or {}
     if len(jobs) > 1 or any(jid != "default" for jid in jobs):
         # Per-job delivery rates from the workers' job attribution blocks

@@ -41,6 +41,7 @@ import threading
 import time
 import uuid
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedConnection,
@@ -48,6 +49,13 @@ from petastorm_tpu.reader_impl.framed_socket import (
 )
 from petastorm_tpu.telemetry import tracing
 from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.service.resilience import (
+    CircuitBreaker,
+    GapTracker,
+    RetryBudget,
+    attach_deadline,
+    note_brownout_level,
+)
 from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.metrics import (
     CLIENT_BATCHES,
@@ -59,6 +67,9 @@ from petastorm_tpu.telemetry.metrics import (
     CLIENT_TRANSFORM_SECONDS,
     CLIENT_WATERMARK_LAG,
     QUARANTINE_REPORTS,
+    RESILIENCE_BREAKER_STATE,
+    RESILIENCE_HEDGES,
+    RESILIENCE_RETRY_BUDGET,
 )
 from petastorm_tpu.utils import resize_bounded_queue, retry_with_backoff
 
@@ -204,6 +215,13 @@ class _WorkerStream:
                 raise ConnectionClosedError("stream closed")
             request = {"type": "stream", "pieces": self.pieces,
                        "epoch": self.epoch}
+            # Deadline propagation: the stream-open budget is the dial
+            # timeout — a request still sitting unstarted in the
+            # worker's accept backlog past it is refused worker-side
+            # (retryable) instead of building a reader nobody waits for.
+            if self._connect_timeout is not None:
+                attach_deadline(request,
+                                time.monotonic() + self._connect_timeout)
             advert = self._conn.advertisement()
             if advert is not None:
                 request["transport"] = advert
@@ -255,6 +273,14 @@ class _WorkerStream:
             self.close()
             return ("end", None)
         if kind == "error":
+            if header.get("retryable"):
+                # DEADLINE_EXCEEDED and kin: transient by contract —
+                # funnel into the broken-stream retry/takeover path
+                # (ConnectionError ⊂ OSError) instead of the fatal
+                # bad-plan ServiceError.
+                raise ConnectionClosedError(
+                    f"worker {self.worker_id} refused stream (retryable): "
+                    f"{header.get('error')}")
             raise ServiceError(
                 f"worker {self.worker_id} failed streaming pieces "
                 f"{self.pieces}: {header.get('error')}")
@@ -879,7 +905,11 @@ class ServiceBatchSource:
                  stream_recv_timeout_s=None, packing=None, corpus="",
                  predicate=None, projection=None, filter_placement="client",
                  stage_fusion="off", cache_placement="post-transform",
-                 reader_family=None, transport=None):
+                 reader_family=None, transport=None, hedging=False,
+                 hedge_quantile=0.99, hedge_multiplier=4.0,
+                 hedge_min_samples=16, hedge_floor_s=0.25,
+                 breaker_threshold=5, breaker_cooldown_s=5.0,
+                 retry_budget=10.0):
         from petastorm_tpu.service.transport import resolve_mode
 
         # Transport tier policy, resolved once (explicit arg >
@@ -1104,6 +1134,35 @@ class ServiceBatchSource:
         # recovery threads and the heartbeat read them concurrently).
         self._recv_watermarks = {}
         self._resume_watermarks = {}
+        # -- resilience layer (service/resilience.py) ----------------------
+        # Per-peer circuit breakers + retry budgets: consecutive stream
+        # failures against one worker trip its breaker (fail fast, report
+        # to the dispatcher for routing exclusion, take the takeover path
+        # immediately); retries spend its budget and successes refill it,
+        # so a degraded worker gets a bounded retry rate. Guarded by
+        # ``_lock`` (recovery threads race the drain).
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._retry_budget_capacity = float(retry_budget)
+        self._breakers = {}        # worker_id -> CircuitBreaker
+        self._budgets = {}         # worker_id -> RetryBudget
+        self._breakers_reported = set()  # wids reported breaker-open
+        self._dispatcher_budget = RetryBudget(
+            capacity=self._retry_budget_capacity)
+        # Hedged watermark re-serves: when a live stream's inter-batch
+        # gap exceeds the GapTracker's fitted threshold, the drain
+        # launches a duplicate re-grant of its in-flight piece at the
+        # delivery watermark from a peer worker — first batch wins, the
+        # loser is cancelled, sub-watermark duplicates drop through the
+        # existing dedup. OFF by default: identical topology to PR 17.
+        self._hedging = bool(hedging)
+        self._gap_tracker = GapTracker(
+            quantile=hedge_quantile, multiplier=hedge_multiplier,
+            min_samples=hedge_min_samples, floor_s=hedge_floor_s)
+        self._hedge_counts = {"launched": 0, "won": 0, "lost": 0}
+        # Injection point for the fcfs retry loop's backoff sleeps (the
+        # budget-aware analogue of ``retry_with_backoff``'s ``sleep=``).
+        self._retry_sleep = time.sleep
         if resume_state is not None:
             self._validate_resume_state(resume_state)
             self._epoch = int(resume_state["epoch"])
@@ -1179,6 +1238,95 @@ class ServiceBatchSource:
         threading.Thread(target=report, daemon=True,
                          name=f"service-quarantine-{self.client_id}").start()
 
+    # -- circuit breakers + retry budgets (service/resilience.py) ----------
+
+    def _breaker(self, worker_id):
+        """This worker's circuit breaker (created on first touch)."""
+        with self._lock:
+            breaker = self._breakers.get(worker_id)
+            if breaker is None:
+                breaker = self._breakers[worker_id] = CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooldown_s=self._breaker_cooldown_s)
+            return breaker
+
+    def _budget(self, worker_id):
+        """This worker's retry token budget (created on first touch)."""
+        with self._lock:
+            budget = self._budgets.get(worker_id)
+            if budget is None:
+                budget = self._budgets[worker_id] = RetryBudget(
+                    capacity=self._retry_budget_capacity)
+            return budget
+
+    def _note_stream_success(self, worker_id):
+        """A stream delivered (batch or clean end): close/reset the
+        peer's breaker, refill its retry budget, mirror the gauges."""
+        breaker = self._breaker(worker_id)
+        breaker.record_success()
+        budget = self._budget(worker_id)
+        budget.record_success()
+        with self._lock:
+            self._breakers_reported.discard(worker_id)
+        RESILIENCE_BREAKER_STATE.labels(worker_id).set(breaker.state_code)
+        RESILIENCE_RETRY_BUDGET.labels(worker_id).set(budget.balance)
+
+    def _note_stream_failure(self, worker_id):
+        """One stream failure against a peer: feed its breaker; on the
+        trip edge, report the exclusion to the dispatcher (journaled
+        there — new grants route around the worker until its heartbeat
+        probe closes it). Returns the breaker so callers can consult
+        ``allow``."""
+        breaker = self._breaker(worker_id)
+        tripped = breaker.record_failure(time.monotonic())
+        RESILIENCE_BREAKER_STATE.labels(worker_id).set(breaker.state_code)
+        if tripped:
+            self._note_breaker_open(worker_id)
+        return breaker
+
+    def _note_breaker_open(self, worker_id):
+        """Report a tripped breaker to the dispatcher on a helper thread
+        (the quarantine-report pattern): best-effort — if the dispatcher
+        is unreachable the exclusion is only local, which still fails
+        fast, and the next trip re-reports."""
+        with self._lock:
+            if worker_id in self._breakers_reported:
+                return
+            self._breakers_reported.add(worker_id)
+        self._log.warning(
+            "circuit breaker tripped OPEN for worker %s (%d consecutive "
+            "stream failures) — failing fast and reporting for routing "
+            "exclusion", worker_id, self._breaker_threshold)
+
+        def report():
+            try:
+                self._dispatcher_request({
+                    "type": "report_breaker",
+                    "client_id": self.client_id,
+                    "worker_id": worker_id,
+                    "error": f"{self._breaker_threshold} consecutive "
+                             f"stream failures",
+                    "epoch": int(self._epoch)}, retries=1)
+            except (ServiceError, OSError):
+                with self._lock:
+                    self._breakers_reported.discard(worker_id)
+                self._log.warning(
+                    "breaker-open report for worker %s did not reach the "
+                    "dispatcher — exclusion is client-local only",
+                    worker_id)
+
+        threading.Thread(target=report, daemon=True,
+                         name=f"service-breaker-{self.client_id}").start()
+
+    def _note_hedge(self, outcome):
+        """One hedged re-serve outcome (``launched``/``won``/``lost``) —
+        mirrored to telemetry and to the counters ``diagnostics()``
+        reports."""
+        RESILIENCE_HEDGES.labels(outcome).inc()
+        with self._lock:
+            self._hedge_counts[outcome] = (
+                self._hedge_counts.get(outcome, 0) + 1)
+
     # -- dispatcher control channel ---------------------------------------
 
     def _dispatcher_request(self, header, retries=None):
@@ -1199,7 +1347,15 @@ class ServiceBatchSource:
             # source's corpus worker group.
             header = dict(header, corpus=self.corpus)
 
+        # One deadline for the whole request (attempts + backoff), from
+        # the same budget the retry loop enforces — stamped per attempt
+        # so a retry ships its SMALLER remaining budget, and the handler
+        # refuses work this client has already stopped waiting for.
+        deadline = (time.monotonic() + self._rpc_deadline_s
+                    if self._rpc_deadline_s is not None else None)
+
         def once():
+            attach_deadline(header, deadline)
             with FramedConnection.connect(
                     self._dispatcher_address,
                     timeout=self._connect_timeout,
@@ -1224,7 +1380,13 @@ class ServiceBatchSource:
             # the conn is dropped and a fresh dial retries cleanly.
             retry_on=(OSError, ProtocolError),
             no_retry_on=(ServiceError,), deadline_s=self._rpc_deadline_s,
+            # Retry-budget bound: control-plane retries against a
+            # degraded dispatcher spend tokens successes refill, so a
+            # fleet of clients cannot multiply its load into a storm.
+            budget=self._dispatcher_budget,
             description=f"dispatcher request {header.get('type')!r}")
+        RESILIENCE_RETRY_BUDGET.labels("dispatcher").set(
+            self._dispatcher_budget.balance)
         if "fencing_epoch" in reply:
             with self._lock:
                 self._recovery["fencing_epoch"] = max(
@@ -1235,6 +1397,10 @@ class ServiceBatchSource:
             # applied to streams opened after this reply (a live stream's
             # window was negotiated on its request, like set_credits).
             self._credit_scale = float(reply["credit_scale"])
+        if "brownout_level" in reply:
+            # The dispatcher's journaled overload level: ≥ 2 sheds
+            # optional stages (tracing spans) process-wide.
+            note_brownout_level(reply["brownout_level"])
         return reply
 
     # -- runtime knobs (live-adjustable: the autotuner's bindings) ---------
@@ -1751,12 +1917,13 @@ class ServiceBatchSource:
             sequencer = (_OrderedSequencer(
                 piece_order(self._shuffle_seed, epoch, pending_all))
                 if self._ordered else None)
-            yield from self._drain_streams(streams, epoch, sequencer)
+            yield from self._drain_streams(streams, epoch, sequencer,
+                                           workers=reply["workers"])
             epoch += 1
             with self._lock:
                 self._roll_epoch_locked(epoch)
 
-    def _drain_streams(self, streams, epoch, sequencer=None):
+    def _drain_streams(self, streams, epoch, sequencer=None, workers=None):
         """Multiplexed drain: one reader thread per worker stream, all
         feeding a single bounded ready-queue this generator yields from —
         whichever worker is ready is consumed, so a stalled worker never
@@ -1813,12 +1980,29 @@ class ServiceBatchSource:
         readers = []
         retired = set()   # sids closed by a resync: terminal events ignored
         sid_counter = itertools.count(max(streams) + 1)
+        # Hedged watermark re-serves (tail-latency, not fault, recovery —
+        # docs/guides/service.md#failure-model-and-recovery): when a
+        # stream goes silent for longer than the gap tracker's fitted
+        # threshold (a high quantile of this run's OWN inter-batch gaps,
+        # not a magic constant), its in-flight piece is re-granted AT its
+        # watermark from a peer worker. First ``piece_done`` wins, the
+        # losing hedge is cancelled, and any duplicate the race slips
+        # through is dropped by the exactly-once watermark dedup below —
+        # hedging changes WHEN batches arrive, never WHAT is delivered.
+        hedge_armed = bool(self._hedging)
+        hedge_sids = set()       # sids that ARE hedge streams
+        hedges = {}              # hedged piece -> {"primary", "hedge"} sids
+        hedge_won = set()        # pieces a hedge won: late markers dedup
+        last_seen = {}           # sid -> monotonic time of last batch
+        untagged_sids = set()    # legacy streams: no watermarks, no hedging
+        hedge_tick = 0.05
         with self._lock:
             self._ready_queue = ready
             self._live_stream_count = len(streams)
 
         def launch(sid, stream):
             streams[sid] = stream
+            last_seen[sid] = time.monotonic()
             with self._lock:
                 # Keep the live count honest across resync relaunches:
                 # set_credits re-derives the queue bound from it.
@@ -1904,6 +2088,20 @@ class ServiceBatchSource:
                     active.discard(sid)
                     retired.add(sid)
                     stream.close()
+                    if sid in hedge_sids:
+                        # A hedge retired mid-race lost it; clear its pair
+                        # so the piece may hedge again after relaunch.
+                        hedge_sids.discard(sid)
+                        for hp, pair in list(hedges.items()):
+                            if pair["hedge"] == sid:
+                                hedges.pop(hp)
+                        self._note_hedge("lost")
+                    else:
+                        # A retired PRIMARY orphans its hedge pairs — the
+                        # relaunched stream is a fresh race.
+                        for hp, pair in list(hedges.items()):
+                            if pair["primary"] == sid:
+                                hedges.pop(hp)
                     with self._lock:
                         self._recovery_inc("streams_retired")
                     self._log.warning(
@@ -1936,14 +2134,154 @@ class ServiceBatchSource:
                     packing=self._iter_packing_dict(),
                     **self._iter_rewrite_kwargs()))
 
+        def drop_hedge(hsid, outcome, closed=False):
+            """Cancel a live hedge stream and clear its pair so the piece
+            may hedge again later. ``closed=True`` means the reader already
+            posted its terminal event (broken hedge) — nothing left to
+            ignore; otherwise the close provokes one, which ``retired``
+            swallows."""
+            hedge_sids.discard(hsid)
+            stream = streams.pop(hsid, None)
+            active.discard(hsid)
+            if not closed:
+                retired.add(hsid)
+            if stream is not None:
+                stream.close()
+            for piece, pair in list(hedges.items()):
+                if pair["hedge"] == hsid:
+                    hedges.pop(piece)
+            if outcome is not None:
+                self._note_hedge(outcome)
+
+        def settle_hedge(piece, pair, winner_sid):
+            """First ``piece_done`` decides the race. A winning hedge just
+            keeps flowing to its own ``end`` (the slow primary's late
+            batches are sub-watermark and dedup away, its late marker hits
+            the completion guard); a losing hedge is cancelled."""
+            hedges.pop(piece, None)
+            hsid = pair["hedge"]
+            if winner_sid == hsid:
+                self._note_hedge("won")
+                hedge_won.add(piece)
+                hstream = streams.get(hsid)
+                if hstream is not None:
+                    self._note_stream_success(hstream.worker_id)
+            else:
+                drop_hedge(hsid, "lost")
+
+        def pick_peer(primary_wid):
+            """A ``(worker_id, address)`` on a DIFFERENT worker whose
+            breaker admits traffic — a half-open breaker's single probe
+            slot may be spent on the hedge (its win/loss feeds back via
+            the stream-success/failure notes). Prefers the
+            most-recently-active live stream's worker (demonstrably
+            fast); falls back to the assignment's worker map, because in
+            the straggler ENDGAME the fast workers' streams have already
+            ended — exactly when a hedge pays most."""
+            best, best_seen = None, -1.0
+            now = time.monotonic()
+            for osid in active:
+                if osid in hedge_sids:
+                    continue
+                other = streams.get(osid)
+                if other is None or other.worker_id == primary_wid:
+                    continue
+                if not self._breaker(other.worker_id).allow(now):
+                    continue
+                seen = last_seen.get(osid, 0.0)
+                if seen > best_seen:
+                    best = (other.worker_id, other.address)
+                    best_seen = seen
+            if best is not None:
+                return best
+            for wid, address in sorted((workers or {}).items()):
+                if wid == primary_wid:
+                    continue
+                if not self._breaker(wid).allow(now):
+                    continue
+                return (wid, tuple(address))
+            return None
+
+        def maybe_hedge():
+            """Scan active primaries for silence past the fitted gap
+            threshold and hedge the first pending piece of each offender
+            (one live hedge per piece)."""
+            threshold = self._gap_tracker.threshold_s()
+            if threshold is None:
+                return   # not enough gap samples yet to call anything slow
+            now = time.monotonic()
+            with self._lock:
+                completed = set(self._completed)
+                marks = dict(self._recv_watermarks)
+            for sid in list(active):
+                if sid in hedge_sids or sid in untagged_sids:
+                    continue
+                stream = streams.get(sid)
+                if stream is None:
+                    continue
+                silent_s = now - last_seen.get(sid, now)
+                if silent_s <= threshold:
+                    continue
+                pending = [p for p in stream.pieces if p not in completed]
+                if not pending or pending[0] in hedges:
+                    continue
+                peer = pick_peer(stream.worker_id)
+                if peer is None:
+                    continue   # single-worker fleet (or all peers open)
+                peer_wid, peer_addr = peer
+                piece = pending[0]
+                fp = failpoints.ACTIVE
+                if fp is not None:
+                    fp.fire("hedge-race")
+                hsid = next(sid_counter)
+                hedge_sids.add(hsid)
+                hedges[piece] = {"primary": sid, "hedge": hsid}
+                active.add(hsid)
+                self._note_hedge("launched")
+                self._log.warning(
+                    "stream silent %.2fs (threshold %.2fs) — hedging "
+                    "piece %d at watermark %d on peer %s", silent_s,
+                    threshold, piece, marks.get(piece, 0), peer_wid,
+                    worker_id=stream.worker_id)
+                launch(hsid, _WorkerStream(
+                    peer_wid, peer_addr, [piece], epoch,
+                    self._connect_timeout,
+                    credits=self._effective_credits(), tagged=True,
+                    starts={piece: marks.get(piece, 0)},
+                    shuffle_seed=self._shuffle_seed,
+                    transform_placement=self._iter_transform_placement,
+                    job_id=self.job_id,
+                    recv_timeout=self._stream_recv_timeout_s,
+                    packing=self._iter_packing_dict(),
+                    **self._iter_rewrite_kwargs()))
+                # The hedge resets this primary's silence clock: give the
+                # race a full window before hedging its NEXT piece.
+                last_seen[sid] = now
+
         try:
             for sid, stream in list(streams.items()):
                 launch(sid, stream)
             active = set(streams)
             recovering = 0
             fence_deferred = False
+            last_hedge_check = time.monotonic()
             while active or recovering:
-                kind, sid, item = ready.get()
+                if hedge_armed:
+                    # Timed get: silence anywhere must surface even while
+                    # OTHER streams keep the queue busy (and especially
+                    # when it is empty because everything stalled).
+                    try:
+                        kind, sid, item = ready.get(timeout=hedge_tick)
+                    except queue.Empty:
+                        maybe_hedge()
+                        last_hedge_check = time.monotonic()
+                        continue
+                    now = time.monotonic()
+                    if now - last_hedge_check >= hedge_tick:
+                        last_hedge_check = now
+                        maybe_hedge()
+                else:
+                    kind, sid, item = ready.get()
                 if sid is not None and sid in retired:
                     # A batch/terminal event from a stream a resync already
                     # retired: its pieces were relaunched elsewhere, so the
@@ -1955,6 +2293,14 @@ class ServiceBatchSource:
                 if kind == "batch":
                     batch, piece, ordinal, bid, t_enqueued = item
                     stream = streams[sid]
+                    if hedge_armed:
+                        now = time.monotonic()
+                        prev = last_seen.get(sid)
+                        if prev is not None:
+                            self._gap_tracker.observe(now - prev)
+                        last_seen[sid] = now
+                        if piece is None:
+                            untagged_sids.add(sid)
                     # Ack BEFORE yielding: the worker refills its window
                     # while the trainer computes on this batch — also in
                     # ordered mode, where the batch may only be buffered:
@@ -1978,7 +2324,9 @@ class ServiceBatchSource:
                             else:
                                 self._recv_watermarks[piece] = ordinal + 1
                         if duplicate:
-                            CLIENT_DEDUP_DROPPED.labels("takeover").inc()
+                            CLIENT_DEDUP_DROPPED.labels(
+                                "hedge" if piece in hedges
+                                else "takeover").inc()
                             continue
                     elif sequencer is not None:
                         raise ServiceError(
@@ -2010,6 +2358,15 @@ class ServiceBatchSource:
                     stream = streams.get(sid)
                     if stream is None:
                         continue
+                    if piece in hedge_won:
+                        # The slow primary's late marker for a piece its
+                        # hedge already completed — dedup the completion
+                        # like the watermark dedups its batches.
+                        hedge_won.discard(piece)
+                        continue
+                    pair = hedges.get(piece)
+                    if pair is not None:
+                        settle_hedge(piece, pair, sid)
                     if sequencer is not None:
                         released = sequencer.finish_piece(
                             piece, stream.worker_id)
@@ -2021,6 +2378,16 @@ class ServiceBatchSource:
                     piece, failure = item
                     stream = streams.get(sid)
                     if stream is None:
+                        continue
+                    if sid in hedge_sids:
+                        # A hedge is advisory: its failure never
+                        # quarantines (the primary still owns the piece) —
+                        # drop it and let the race re-open.
+                        self._log.warning(
+                            "hedge for piece %d failed on worker %s (%s) "
+                            "— primary continues", piece, stream.worker_id,
+                            failure)
+                        drop_hedge(sid, "lost")
                         continue
                     if self._on_piece_error != "quarantine":
                         raise ServiceError(
@@ -2067,7 +2434,16 @@ class ServiceBatchSource:
                             self._note_pieces_locked(stream.worker_id,
                                                      len(pending))
                     active.discard(sid)
+                    hedge_sids.discard(sid)
                 elif kind == "error":
+                    if sid is not None and sid in hedge_sids:
+                        # A protocol-level hedge failure is still just a
+                        # lost hedge — the primary path is intact.
+                        self._log.warning(
+                            "hedge stream errored (%s) — primary "
+                            "continues", item)
+                        drop_hedge(sid, "lost", closed=True)
+                        continue
                     raise item
                 elif kind == "recovered":
                     recovering -= 1
@@ -2087,6 +2463,16 @@ class ServiceBatchSource:
                     else:
                         resync(active)
                 else:  # "broken" — recover concurrently, keep draining
+                    if sid in hedge_sids:
+                        # A broken hedge never enters recovery: the
+                        # primary still owns the piece; feed the peer's
+                        # breaker and let the race re-open.
+                        broken_hedge = streams.get(sid)
+                        if broken_hedge is not None:
+                            self._note_stream_failure(
+                                broken_hedge.worker_id)
+                        drop_hedge(sid, "lost", closed=True)
+                        continue
                     stream = streams.pop(sid)
                     active.discard(sid)
                     recovering += 1
@@ -2850,10 +3236,18 @@ class ServiceBatchSource:
                          for p, n in self._recv_watermarks.items()
                          if n and p not in self._completed}
                 epoch_now = self._epoch
+                # Overload signal feed: ready-queue fullness (0..1) —
+                # one half of the dispatcher's brownout signals (the
+                # consumer not keeping up with the fleet).
+                ready = self._ready_queue
+                saturation = (round(ready.qsize() / ready.maxsize, 4)
+                              if ready is not None and ready.maxsize > 0
+                              else 0.0)
             try:
                 reply = self._dispatcher_request(
                     {"type": "client_heartbeat", "client_id": self.client_id,
-                     "epoch": epoch_now, "watermarks": marks},
+                     "epoch": epoch_now, "watermarks": marks,
+                     "ready_saturation": saturation},
                     retries=0)
             except (ServiceError, OSError):
                 with self._lock:
@@ -2903,7 +3297,11 @@ class ServiceBatchSource:
         their watermarks (exactly-once; an untagged legacy worker replays
         from the piece start and the drain's dedup cannot help it — that
         path stays at-least-once). ``None`` when the worker stays
-        unreachable."""
+        unreachable — or when its circuit breaker is open (consecutive
+        failures already proved it degraded: fail FAST into the takeover
+        path instead of burning the backoff budget against it again)."""
+        from petastorm_tpu import failpoints
+
         stream.close()
         pending, starts = self._pending_and_starts(stream.pieces)
         if not pending:
@@ -2912,8 +3310,23 @@ class ServiceBatchSource:
             # re-serve — hand back an immediately-ended stream so the
             # drain just closes the sid's bookkeeping.
             return _EndedStream(stream)
+        # The break that brought us here is one failure against the peer;
+        # the trip edge (threshold consecutive breaks) reports the worker
+        # for dispatcher-side routing exclusion.
+        breaker = self._note_stream_failure(stream.worker_id)
+        if not breaker.allow(time.monotonic()):
+            self._log.warning(
+                "circuit breaker %s for worker %s — skipping reconnect, "
+                "taking the takeover path", breaker.state,
+                stream.worker_id)
+            return None
 
         def attempt():
+            fp = failpoints.ACTIVE
+            if fp is not None:
+                # Injected reconnect failure: feeds this peer's breaker
+                # exactly like a real mid-dial reset.
+                fp.fire("breaker-trip")
             fresh = _WorkerStream(
                 stream.worker_id, stream.address, pending, stream.epoch,
                 self._connect_timeout,
@@ -2942,9 +3355,15 @@ class ServiceBatchSource:
                 # class the established-stream readers already recover.
                 retry_on=(OSError, ProtocolError),
                 no_retry_on=(ServiceError,),
+                # Per-peer retry budget: reconnect attempts against a
+                # degraded worker spend tokens its successes refill —
+                # a bounded retry rate, never a storm.
+                budget=self._budget(stream.worker_id),
                 description=f"reconnect to worker {stream.worker_id}")
         except (OSError, ProtocolError):
+            self._note_stream_failure(stream.worker_id)
             return None
+        self._note_stream_success(stream.worker_id)
         # The first event was consumed by the probe; hand it back by
         # buffering it on the stream object.
         if event[0] == "end":
@@ -3083,13 +3502,18 @@ class ServiceBatchSource:
         """Yield one split's batches from one worker, retrying transient
         connection failures on :func:`~petastorm_tpu.utils.backoff_delays`
         — the same schedule ``retry_with_backoff`` sleeps on, used directly
-        because a generator must keep yielding between attempts. Returns
-        ``True`` when the split was fully served, ``False`` when the worker
-        stayed unreachable through the retry budget. A retry restarts the
-        piece from its beginning (at-least-once — batches already yielded
-        from the broken attempt arrive again)."""
+        because a generator must keep yielding between attempts — gated by
+        the worker's shared :class:`RetryBudget` (the same bucket the
+        control RPCs spend from: an exhausted budget stops retrying even
+        when attempts remain, so a degraded worker sees a bounded retry
+        RATE, not a storm). Returns ``True`` when the split was fully
+        served, ``False`` when the worker stayed unreachable through the
+        retry budget. A retry restarts the piece from its beginning
+        (at-least-once — batches already yielded from the broken attempt
+        arrive again)."""
         from petastorm_tpu.utils import backoff_delays
 
+        budget = self._budget(wid)
         delays = backoff_delays(self._max_retries, self._backoff_base,
                                 self._backoff_max)
         for attempt in range(self._max_retries + 1):
@@ -3106,17 +3530,28 @@ class ServiceBatchSource:
                 transport=self._transport)
             try:
                 yield from self._drain_one(stream)
+                budget.record_success()
+                RESILIENCE_RETRY_BUDGET.labels(wid).set(budget.balance)
                 return True
             except (ConnectionClosedError, ConnectionError, OSError,
                     ProtocolError) as exc:
                 if attempt == self._max_retries:
                     return False
+                if not budget.try_spend():
+                    RESILIENCE_RETRY_BUDGET.labels(wid).set(budget.balance)
+                    self._log.warning(
+                        "split %s failed (%s); retry budget for the "
+                        "worker is exhausted — giving up early "
+                        "(%d attempts remained)", piece, exc,
+                        self._max_retries - attempt, worker_id=wid)
+                    return False
+                RESILIENCE_RETRY_BUDGET.labels(wid).set(budget.balance)
                 sleep_s = next(delays)
                 self._log.warning(
                     "split %s failed (%s); retry %d/%d in %.2fs", piece,
                     exc, attempt + 1, self._max_retries, sleep_s,
                     worker_id=wid)
-                time.sleep(sleep_s)
+                self._retry_sleep(sleep_s)
         return False
 
     def _drain_one(self, stream):
@@ -3305,6 +3740,10 @@ class ServiceBatchSource:
           consumed-and-acked);
         - ``epoch_starts``: ``[produced_batch_count, epoch]`` boundaries in
           production order (per-epoch throughput attribution);
+        - ``resilience``: overload-robustness state — per-peer circuit
+          breaker and retry-budget snapshots, whether hedging is armed,
+          the fitted hedge threshold, and the hedge race tallies
+          (``launched``/``won``/``lost``);
         - ``recovery``: control-plane recovery events this client observed
           — ``resyncs`` (fence-triggered assignment refreshes),
           ``streams_retired``, ``takeovers``, ``stale_fencing_retries``,
@@ -3360,6 +3799,20 @@ class ServiceBatchSource:
                 # side account of what the epoch was delivered WITHOUT.
                 "quarantined_pieces": [dict(entry)
                                        for entry in self._quarantined],
+                # Overload-robustness state (service/resilience.py): the
+                # per-peer breaker/budget snapshots and the hedged
+                # re-serve race tallies.
+                "resilience": {
+                    "hedging": self._hedging,
+                    "hedge_counts": dict(self._hedge_counts),
+                    "hedge_threshold_s": self._gap_tracker.threshold_s(),
+                    "breakers": {wid: breaker.snapshot()
+                                 for wid, breaker
+                                 in self._breakers.items()},
+                    "retry_budgets": {wid: budget.snapshot()
+                                      for wid, budget
+                                      in self._budgets.items()},
+                },
                 "recovery": {
                     key: (dict(value) if isinstance(value, dict)
                           else value)

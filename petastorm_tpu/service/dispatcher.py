@@ -86,6 +86,13 @@ from petastorm_tpu.service.fleet import (
     credit_scales,
     plan_fair_shares,
 )
+from petastorm_tpu.service.resilience import (
+    BrownoutConfig,
+    BrownoutPlanner,
+    arrival_deadline,
+    deadline_exceeded_reply,
+    deadline_expired,
+)
 from petastorm_tpu.service.seedtree import piece_order
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
@@ -97,6 +104,7 @@ from petastorm_tpu.telemetry.metrics import (
     DISPATCHER_STEALS,
     DISPATCHER_WORKERS,
     FLEET_AUTOSCALE_DECISIONS,
+    FLEET_BROWNOUT_LEVEL,
     FLEET_JOB_BACKLOG,
     FLEET_JOB_FAIR_SHARE,
     FLEET_JOB_FENCING_EPOCH,
@@ -280,7 +288,8 @@ class Dispatcher:
     def __init__(self, host="127.0.0.1", port=0, mode="static", num_epochs=1,
                  journal_dir=None, lease_timeout_s=DEFAULT_LEASE_TIMEOUT_S,
                  journal_compact_every=256, journal_fsync=False,
-                 max_frame_bytes=None, shuffle_seed=None, autoscale=None):
+                 max_frame_bytes=None, shuffle_seed=None, autoscale=None,
+                 brownout=None, breaker_cooldown_s=10.0):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if num_epochs is not None and num_epochs <= 0:
@@ -386,6 +395,34 @@ class Dispatcher:
             #                               raised (ENOSPC…) → degraded
             "pieces_quarantined": 0,  # poison pieces reported + journaled
         }
+        # Circuit-breaker exclusions (service/resilience.py): worker_id ->
+        # {"client_id", "error", "epoch"} for workers some client's
+        # per-peer breaker tripped on (alive but failing its streams —
+        # the overload analogue of quarantine). Journaled like quarantine
+        # so restarts replay byte-identically; excluded from NEW grants
+        # (assignment, plan, steal receivers, fcfs splits) through
+        # _serving_workers until the worker's own heartbeat — the
+        # half-open probe — closes it after breaker_cooldown_s.
+        self._breaker_open = {}
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        # Runtime-only trip clocks (never persisted — like leases, a
+        # replayed breaker-open starts a fresh cooldown from "now").
+        self._breaker_opened_at = {}
+        # Journaled brownout state (service/resilience.py): the shed
+        # level and the transition counters replay byte-identically; the
+        # planner's hysteresis streaks and the overload signal feeds are
+        # runtime-only (windowed rates are meaningless across restarts).
+        self._brownout_level = 0
+        self._brownout_counts = {"shed": 0, "recover": 0}
+        self._brownout_reason = None
+        self._brownout = (BrownoutConfig.coerce(brownout)
+                          if brownout else None)
+        self._brownout_planner = (BrownoutPlanner(self._brownout)
+                                  if self._brownout else None)
+        self._brownout_last_eval = None
+        self._worker_credit_wait = {}   # wid -> last cumulative wait_s
+        self._credit_wait_window = {}   # wid -> wait_s at last eval
+        self._client_ready_saturation = {}  # cid -> last fullness 0..1
         # Poison-piece quarantine: piece -> {"worker_id", "client_id",
         # "error", "epoch"} — journaled, restored on replay, excluded
         # from every future grant (assignment, plan, takeover
@@ -507,6 +544,11 @@ class Dispatcher:
             "quarantined": {(f"{c}:{p}" if c else str(p)): dict(info)
                             for (c, p), info
                             in self._quarantined.items()},
+            "breaker_open": {wid: dict(info) for wid, info
+                             in self._breaker_open.items()},
+            "brownout": {"level": self._brownout_level,
+                         "counts": dict(self._brownout_counts),
+                         "reason": self._brownout_reason},
             "generation": self._generation,
             # owner maps keyed by int piece → serialized as triplet lists
             # (JSON object keys must be strings).
@@ -614,6 +656,18 @@ class Dispatcher:
             for p, info in (state.get("quarantined") or {}).items()}
         self._quarantined_default = {p for (c, p) in self._quarantined
                                      if not c}
+        now = time.monotonic()
+        self._breaker_open = {str(wid): dict(info) for wid, info
+                              in (state.get("breaker_open") or {}).items()}
+        # Like leases: a restored breaker-open worker starts a fresh
+        # cooldown from "now" — wall-clock trip times don't persist.
+        self._breaker_opened_at = {wid: now for wid in self._breaker_open}
+        brownout = state.get("brownout") or {}
+        self._brownout_level = int(brownout.get("level", 0))
+        counts = brownout.get("counts") or {}
+        for key in self._brownout_counts:
+            self._brownout_counts[key] = int(counts.get(key, 0))
+        self._brownout_reason = brownout.get("reason")
         self._generation = int(state.get("generation", 0))
         self._dyn = {}
         self._mark_dyn_dirty_locked()
@@ -702,6 +756,18 @@ class Dispatcher:
                 dict(record["weights"]),
                 record.get("effective_epoch"),
                 token=record.get("token"))
+        elif op == "breaker":
+            if record.get("state") == "open":
+                info = {"client_id": record.get("client_id"),
+                        "error": record.get("error"),
+                        "epoch": int(record.get("epoch", 0))}
+                self._breaker_open_locked(record["worker_id"], info)
+            else:
+                self._breaker_close_locked(record["worker_id"])
+        elif op == "brownout":
+            self._apply_brownout_locked(record["action"],
+                                        int(record["level"]),
+                                        record.get("reason"))
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
@@ -859,6 +925,165 @@ class Dispatcher:
         return {"type": "ok", "piece": piece, "fresh": fresh,
                 "quarantined": quarantined}
 
+    # -- circuit breakers (service/resilience.py) --------------------------
+
+    def _breaker_open_locked(self, worker_id, info):
+        """One mutation site for a breaker-open exclusion (live handler
+        AND WAL replay). Idempotent — a duplicate report (second client,
+        retried RPC) is a no-op."""
+        if worker_id in self._breaker_open:
+            return False
+        self._breaker_open[worker_id] = dict(info)
+        self._breaker_opened_at[worker_id] = time.monotonic()
+        return True
+
+    def _breaker_close_locked(self, worker_id):
+        self._breaker_opened_at.pop(worker_id, None)
+        return self._breaker_open.pop(worker_id, None) is not None
+
+    def _handle_report_breaker(self, header):
+        """A client's per-peer circuit breaker tripped on a worker
+        (consecutive stream failures — alive but failing): journal the
+        exclusion and stop routing NEW grants and steal-receivers its
+        way. The worker's own heartbeat is the half-open probe: once
+        ``breaker_cooldown_s`` has passed, the next heartbeat closes the
+        breaker (journaled symmetrically) and the worker rejoins the
+        serving set. Idempotent; survives restarts via the journal —
+        exactly the quarantine contract, at worker granularity."""
+        worker_id = str(header["worker_id"])
+        with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            if worker_id not in self._workers:
+                return {"type": "error",
+                        "error": f"unknown worker {worker_id!r}"}
+            info = {"client_id": header.get("client_id"),
+                    "error": str(header.get("error", ""))[:512],
+                    "epoch": int(header.get("epoch", 0))}
+            fresh = self._breaker_open_locked(worker_id, info)
+            if fresh:
+                self._journal_locked(dict(info, op="breaker",
+                                          worker_id=worker_id,
+                                          state="open"))
+            open_now = sorted(self._breaker_open)
+        if fresh:
+            logger.warning(
+                "circuit breaker OPEN for worker %s (%s) — excluded from "
+                "new grants until its heartbeat probe closes it",
+                worker_id, info["error"], client_id=info["client_id"])
+        return {"type": "ok", "worker_id": worker_id, "fresh": fresh,
+                "breaker_open": open_now}
+
+    def _maybe_close_breaker_locked(self, worker_id):
+        """The half-open probe, ridden on the worker's own heartbeat: a
+        breaker-open worker that is still heartbeating after the cooldown
+        gets its exclusion lifted (journaled). Before the cooldown the
+        heartbeat only renews the lease — tripping and instantly closing
+        on the next 2s heartbeat would flap the serving set."""
+        if worker_id not in self._breaker_open:
+            return
+        opened = self._breaker_opened_at.get(worker_id)
+        if opened is not None \
+                and time.monotonic() - opened < self.breaker_cooldown_s:
+            return
+        # Journaled mutation: skip (and retry on a later heartbeat) while
+        # the WAL is degraded read-only.
+        if self._check_writable_locked() is not None:
+            return
+        if self._breaker_close_locked(worker_id):
+            self._journal_locked({"op": "breaker", "worker_id": worker_id,
+                                  "state": "closed"})
+            logger.warning(
+                "circuit breaker CLOSED for worker %s — heartbeat probe "
+                "after %.1fs cooldown; rejoining the serving set",
+                worker_id, self.breaker_cooldown_s)
+
+    # -- brownout (service/resilience.py) ----------------------------------
+
+    def _apply_brownout_locked(self, action, level, reason=None):
+        """The one state machine for brownout transitions (live AND WAL
+        replay): one level at a time, shed up / recover down. An invalid
+        transition (stale decision against a since-moved level) is a
+        no-op, so replays converge — the autoscale-apply discipline."""
+        if action == "shed" and level == self._brownout_level + 1:
+            self._brownout_level = level
+        elif action == "recover" and level == self._brownout_level - 1:
+            self._brownout_level = level
+        else:
+            return False
+        self._brownout_counts[action] += 1
+        self._brownout_reason = reason
+        return True
+
+    def apply_brownout(self, action, level, reason=None):
+        """Apply one brownout transition, journaled (the heartbeat-driven
+        evaluator's — and the chaos harness's — entry point). Level ≥ 1
+        scales low-weight jobs' credit windows down on their next
+        assignment/plan/heartbeat; level ≥ 2 additionally sheds optional
+        stages peer-side (the level rides every heartbeat reply)."""
+        with self._lock:
+            if self._check_writable_locked() is not None:
+                return False
+            applied = self._apply_brownout_locked(action, level, reason)
+            if applied:
+                self._journal_locked({"op": "brownout", "action": action,
+                                      "level": level, "reason": reason})
+                self._sync_telemetry_locked()
+        if applied:
+            logger.warning("brownout: %s to level %d (%s)", action, level,
+                           reason or "operator")
+        return applied
+
+    def _overload_signals_locked(self, now):
+        """One windowed snapshot of the overload signals the brownout
+        planner consumes: the fleet's credit-wait accumulation rate
+        (from worker heartbeats' cumulative counters, diffed per window)
+        and the worst client ready-queue fullness (from client
+        heartbeats)."""
+        elapsed = (now - self._brownout_last_eval
+                   if self._brownout_last_eval is not None else None)
+        wait_delta = 0.0
+        for wid, total in self._worker_credit_wait.items():
+            prev = self._credit_wait_window.get(wid, total)
+            wait_delta += max(0.0, total - prev)
+        self._credit_wait_window = dict(self._worker_credit_wait)
+        rate = (wait_delta / elapsed if elapsed and elapsed > 0 else 0.0)
+        saturation = max(self._client_ready_saturation.values(),
+                         default=0.0)
+        return {"level": self._brownout_level,
+                "credit_wait_rate": rate,
+                "ready_saturation": saturation}
+
+    def _maybe_evaluate_brownout_locked(self):
+        """Brownout evaluation, ridden on client-heartbeat arrivals (no
+        dedicated thread — heartbeats are the fleet's pulse already),
+        rate-limited to the configured interval. Decisions journal
+        through :meth:`_apply_brownout_locked` exactly like autoscale."""
+        if self._brownout_planner is None:
+            return
+        now = time.monotonic()
+        if self._brownout_last_eval is not None \
+                and now - self._brownout_last_eval \
+                < self._brownout.interval_s:
+            return
+        signals = self._overload_signals_locked(now)
+        self._brownout_last_eval = now
+        for decision in self._brownout_planner.plan(signals):
+            if self._check_writable_locked() is not None:
+                return
+            applied = self._apply_brownout_locked(
+                decision["action"], decision["level"],
+                decision.get("reason"))
+            if applied:
+                self._journal_locked({"op": "brownout",
+                                      "action": decision["action"],
+                                      "level": decision["level"],
+                                      "reason": decision.get("reason")})
+                logger.warning("brownout: %s to level %d (%s)",
+                               decision["action"], decision["level"],
+                               decision.get("reason"))
+
     # -- liveness ----------------------------------------------------------
 
     def _lease_loop(self):
@@ -891,6 +1116,8 @@ class Dispatcher:
         worker["alive"] = False
         self._worker_leases.pop(worker_id, None)
         self._last_rates.pop(worker_id, None)  # stale signal, never fed
+        self._worker_credit_wait.pop(worker_id, None)
+        self._credit_wait_window.pop(worker_id, None)
         if reason == "lease_expired":
             self._recovery["evictions"] += 1
         else:
@@ -1104,7 +1331,13 @@ class Dispatcher:
         shares = self._job_shares_locked()
         if len(shares) <= 1:
             return 1.0
-        return round(credit_scales(shares).get(job_id, 1.0), 4)
+        # Brownout level 1+ additionally sheds every job below the top
+        # share (resilience.py's priority order: low-weight/sideband
+        # jobs first). Applied to the pure output, so recovery restores
+        # the exact pre-brownout scales.
+        return round(credit_scales(
+            shares, brownout_level=self._brownout_level).get(job_id, 1.0),
+            4)
 
     # -- dynamic-mode mutations (shared by live handlers and WAL replay) ---
 
@@ -1182,6 +1415,16 @@ class Dispatcher:
             DISPATCHER_REQUESTS.labels("unknown").inc()
             return {"type": "error", "error": f"unknown request {kind!r}"}
         DISPATCHER_REQUESTS.labels(kind).inc()
+        # Deadline propagation (service/resilience.py): a request whose
+        # caller-shipped budget already expired (it sat in the accept
+        # queue / frame reader too long) is refused retryable BEFORE the
+        # handler runs — the caller's retry_with_backoff(deadline_s=)
+        # owns the budget, and work nobody waits for would only deepen
+        # the overload that delayed it.
+        if deadline_expired(arrival_deadline(header)):
+            with self._lock:
+                self._sync_telemetry_locked()
+            return deadline_exceeded_reply(f"dispatcher.{kind}")
         try:
             return handler(header)
         finally:
@@ -1203,6 +1446,7 @@ class Dispatcher:
         for event, count in self._recovery.items():
             DISPATCHER_RECOVERY_EVENTS.labels(event).set(count)
         QUARANTINE_PIECES.set(len(self._quarantined))
+        FLEET_BROWNOUT_LEVEL.set(self._brownout_level)
         for state in ("serving", "standby", "draining"):
             FLEET_WORKERS.labels(state).set(sum(
                 1 for w in self._workers.values()
@@ -1595,13 +1839,36 @@ class Dispatcher:
                         "fencing_epoch": self._fencing_epoch}
             self._worker_leases[worker_id] = (
                 time.monotonic() + (self.lease_timeout_s or 0.0))
-            return {"type": "ok", "fencing_epoch": self._fencing_epoch}
+            # Overload signal feed: the worker's cumulative credit-wait
+            # seconds (time its serve loops sat blocked on client flow
+            # control) — the brownout evaluator diffs these per window.
+            if "credit_wait_s" in header:
+                try:
+                    self._worker_credit_wait[worker_id] = float(
+                        header["credit_wait_s"])
+                except (TypeError, ValueError):
+                    pass
+            # The half-open probe: a breaker-open worker still
+            # heartbeating after the cooldown rejoins the serving set.
+            self._maybe_close_breaker_locked(worker_id)
+            return {"type": "ok", "fencing_epoch": self._fencing_epoch,
+                    "brownout_level": self._brownout_level}
 
     def _handle_client_heartbeat(self, header):
         client_id = header.get("client_id")
         with self._lock:
             known = client_id in self._clients
             self._client_heartbeats[client_id] = time.monotonic()
+            # Overload signal feed: the client's ready-queue fullness
+            # (0..1) — with credit-wait rates, the brownout evaluator's
+            # other saturation signal.
+            if "ready_saturation" in header:
+                try:
+                    self._client_ready_saturation[client_id] = min(
+                        1.0, max(0.0, float(header["ready_saturation"])))
+                except (TypeError, ValueError):
+                    pass
+            self._maybe_evaluate_brownout_locked()
             if "watermarks" in header:
                 # Delivery watermarks ride the heartbeat into the live
                 # `status` view on every change, but they are JOURNALED
@@ -1640,6 +1907,12 @@ class Dispatcher:
                 "fencing_epoch": self._job_fencing_locked(
                     self._client_job_locked(client_id, header)),
                 "recovery": dict(self._recovery),
+                # The brownout level + this job's (possibly shed) credit
+                # scale ride every heartbeat so a mid-run transition
+                # takes effect on live clients, not just new plans.
+                "brownout_level": self._brownout_level,
+                "credit_scale": self._credit_scale_locked(
+                    self._client_job_locked(client_id, header)),
             }
 
     def _alive_workers(self, states=("serving", "draining")):
@@ -1652,14 +1925,24 @@ class Dispatcher:
 
     def _serving_workers(self, corpus=None):
         """Workers eligible to receive NEW grants (assignments, steals,
-        fcfs splits): alive and not standby/draining. ``corpus``
-        restricts to one corpus's worker group (``None`` = no filter,
-        the legacy single-corpus behavior)."""
+        fcfs splits): alive, not standby/draining, and not
+        breaker-open (a client's circuit breaker tripped on it — alive
+        but failing; excluded here, the ONE grant rule, so every path
+        routes around it until its heartbeat probe closes the breaker).
+        ``corpus`` restricts to one corpus's worker group (``None`` = no
+        filter, the legacy single-corpus behavior). Floor: when EVERY
+        candidate is breaker-open the exclusion yields — refusing all
+        grants would turn an overloaded fleet into a dead one."""
         workers = self._alive_workers(("serving",))
-        if corpus is None:
-            return workers
-        return {wid: w for wid, w in workers.items()
-                if w.get("corpus", "") == corpus}
+        if corpus is not None:
+            workers = {wid: w for wid, w in workers.items()
+                       if w.get("corpus", "") == corpus}
+        if self._breaker_open:
+            healthy = {wid: w for wid, w in workers.items()
+                       if wid not in self._breaker_open}
+            if healthy:
+                return healthy
+        return workers
 
     def _handle_list_workers(self, header):
         corpus = str(header.get("corpus") or "")
@@ -2241,6 +2524,16 @@ class Dispatcher:
                         for state in ("serving", "standby", "draining")},
                     "autoscale": dict(self._autoscale_counts),
                     "autoscaler_armed": self._autoscaler is not None,
+                    # Journaled breaker-open exclusions and the brownout
+                    # state machine — the BREAKER/BROWNOUT surfaces of
+                    # `status --watch`.
+                    "breaker_open": {
+                        wid: dict(info) for wid, info
+                        in sorted(self._breaker_open.items())},
+                    "brownout": {"level": self._brownout_level,
+                                 "counts": dict(self._brownout_counts),
+                                 "reason": self._brownout_reason,
+                                 "armed": self._brownout is not None},
                 },
                 "jobs": {
                     jid: {

@@ -106,7 +106,7 @@ def plan_fair_shares(capacity, demands, weights=None, quotas=None):
     return alloc
 
 
-def credit_scales(shares):
+def credit_scales(shares, brownout_level=0, brownout_factor=0.5):
     """Fair shares → per-job flow-control scale factors in ``(0, 1]``.
 
     Normalized so the LARGEST share maps to 1.0 (that job's streams keep
@@ -115,11 +115,23 @@ def credit_scales(shares):
     capacity divides across jobs by the planned ratio instead of by pull
     pressure. Equal shares (the default single-tenant / equal-weight
     case) yield 1.0 for everyone: today's behavior, untouched.
+
+    Under brownout (``brownout_level >= 1`` — the dispatcher's journaled
+    overload state, ``service/resilience.py``) every job BELOW the top
+    share is additionally scaled by ``brownout_factor ** level``: the
+    shed order is low-weight/sideband jobs first, the top-share job's
+    window untouched, and recovery restores the exact pre-brownout
+    scales (the factor is applied to the pure output, never accumulated
+    into state). A sole job is by definition the top share, so
+    single-tenant behavior is brownout-invariant.
     """
     top = max(shares.values(), default=0.0)
     if top <= 0:
         return {job: 1.0 for job in shares}
-    return {job: max(share / top, 1e-3) for job, share in shares.items()}
+    shed = float(brownout_factor) ** max(0, int(brownout_level))
+    return {job: max((share / top) * (1.0 if share >= top else shed),
+                     1e-3)
+            for job, share in shares.items()}
 
 
 class AutoscaleConfig:

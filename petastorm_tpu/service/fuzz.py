@@ -57,18 +57,42 @@ def default_run_fn(seed, points):
     from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
 
     return service_loopback_scenario(
-        rows=768, days=8, workers=2, batch_size=64,
+        rows=1536, days=8, workers=2, batch_size=64,
         chaos="failpoints", chaos_seed=seed, failpoint_points=points,
         # Narrow fire window, sized against the run's actual call counts.
         # With the data plane on the shm tier (the loopback default) the
         # TCP points see only control traffic — credits, piece reports,
-        # dispatcher RPCs — and the shm points count one check per
-        # ring-sent batch, so the geometry must yield enough batches
-        # (12 here) and control round-trips (>24) that seeded indices in
-        # [4, 24) actually land; a run whose counts never reach its
-        # indices fires nothing and trips the scenario's fired-nothing
-        # guard.
+        # dispatcher RPCs — the shm points count one check per ring-sent
+        # batch, and the resilience points (``slow-peer``) one check per
+        # worker batch send — so the geometry must yield enough batches
+        # (24 here, the per-batch points' whole call budget) and control
+        # round-trips (>24) that seeded indices in [4, 24) actually land;
+        # a run whose counts never reach its indices fires nothing and
+        # trips the scenario's fired-nothing guard.
         failpoint_window=24,
+        shuffle_seed=seed, ordered=True)
+
+
+def hedged_run_fn(seed, points):
+    """:func:`default_run_fn` with the resilience layer ARMED: hedged
+    watermark re-serves on (threshold fitted from a short epoch, so the
+    quantile is the median and the floor sits below the injected
+    ``slow-peer`` stalls), breakers and retry budgets live on the client
+    by default. The hedged soak's contract is strictly stronger than the
+    plain one: hedges may launch, win, or lose differently run-to-run
+    (they race wall-clock timing), yet the digest must stay byte-identical
+    — exactly-once delivery is watermark-deduped, not schedule-lucky."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    return service_loopback_scenario(
+        rows=1536, days=8, workers=2, batch_size=64,
+        chaos="failpoints", chaos_seed=seed, failpoint_points=points,
+        failpoint_window=24,
+        # Stretch the generic delay action past the hedge floor so the
+        # injected stalls are hedgeable, not just observable.
+        failpoint_delay_s=0.3,
+        hedging=True, hedge_floor_s=0.2, hedge_min_samples=6,
+        hedge_quantile=0.5,
         shuffle_seed=seed, ordered=True)
 
 
@@ -142,7 +166,7 @@ def reproducer_command(seed, points):
     return ("python -m petastorm_tpu.benchmark scenario service "
             f"--chaos failpoints --chaos-seed {seed} "
             f"--failpoint-points {','.join(points)} "
-            "--failpoint-window 24 --rows 768 --days 8 --workers 2 "
+            "--failpoint-window 24 --rows 1536 --days 8 --workers 2 "
             f"--batch-size 64 --shuffle-seed {seed} --ordered")
 
 

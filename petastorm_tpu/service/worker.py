@@ -22,6 +22,7 @@ import threading
 import time
 import uuid
 
+from petastorm_tpu import failpoints
 from petastorm_tpu.reader_impl.framed_socket import (
     ConnectionClosedError,
     FramedReader,
@@ -502,8 +503,6 @@ class BatchWorker:
         worker's state, or evicted it) triggers re-registration under the
         same ``worker_id``. A dispatcher outage is just a missed tick —
         the loop keeps trying until the dispatcher returns."""
-        from petastorm_tpu import failpoints
-
         while not self._heartbeat_stop.wait(self._heartbeat_interval_s):
             if self._heartbeat_paused.is_set():
                 continue
@@ -513,11 +512,21 @@ class BatchWorker:
                 #   expires and the re-registration path heals)
             try:
                 reply = self._control_rpc(
-                    {"type": "worker_heartbeat", "worker_id": self.worker_id},
+                    {"type": "worker_heartbeat", "worker_id": self.worker_id,
+                     # Overload signal feed: cumulative seconds the serve
+                     # loops sat blocked on client flow control — the
+                     # dispatcher's brownout evaluator diffs it per
+                     # window (service/resilience.py).
+                     "credit_wait_s": round(self._m_credit_wait.value, 4)},
                     description=f"worker {self.worker_id} heartbeat",
                     retries=0)
             except (OSError, ProtocolError):
                 continue  # dispatcher down/desynced: retry next tick
+            if "brownout_level" in reply:
+                from petastorm_tpu.service.resilience import \
+                    note_brownout_level
+
+                note_brownout_level(reply["brownout_level"])
             if reply.get("type") == "unknown_worker" \
                     and not self._heartbeat_stop.is_set():
                 self._log.warning(
@@ -605,6 +614,17 @@ class BatchWorker:
         without per-item completion attribution fall back to the legacy
         untagged serving; the client detects the untagged batches and
         keeps at-least-once bookkeeping for that worker."""
+        from petastorm_tpu.service.resilience import (
+            arrival_deadline, deadline_exceeded_reply, deadline_expired)
+
+        # Deadline propagation (service/resilience.py): a stream request
+        # whose caller-shipped budget expired before we got to it (accept
+        # backlog on an overloaded worker) is refused retryable before a
+        # reader is built — the client's retry/takeover machinery owns
+        # the budget and will re-route.
+        if deadline_expired(arrival_deadline(header)):
+            send_framed(sock, deadline_exceeded_reply("worker.stream"))
+            return
         dynamic = bool(header.get("dynamic"))
         tagged = bool(header.get("tagged"))
         # Worker-placement sequence packing: the stream request names the
@@ -1523,6 +1543,13 @@ class BatchWorker:
                 self._m_credit_wait.inc(waited)
         if self._batch_delay_s:
             time.sleep(self._batch_delay_s)
+        fp = failpoints.ACTIVE
+        if fp is not None:
+            # Straggler injection: "delay" stalls THIS worker's batch
+            # send — the slow-but-alive peer the hedged re-serve exists
+            # for. Keyed by worker_id so a targeted schedule (the
+            # overload_tail bench) pins the slowness to one worker.
+            fp.fire("slow-peer", key=self.worker_id)
         t_send = time.perf_counter()
         header = {"type": "batch", "rows": rows, "bid": bid}
         if extra_header:

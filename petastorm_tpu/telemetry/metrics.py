@@ -482,6 +482,51 @@ QUARANTINE_PIECES = REGISTRY.gauge(
     "quarantine set — excluded from every future assignment, plan, "
     "takeover re-partition, and fcfs split until the journal is reset")
 
+# -- resilience layer: deadlines, retry budgets, breakers, hedging,
+#    brownout (service/resilience.py + dispatcher/worker/client wiring) -------
+
+RESILIENCE_DEADLINE_EXCEEDED = REGISTRY.counter(
+    "petastorm_resilience_deadline_exceeded_total",
+    "Requests a handler refused (retryable DEADLINE_EXCEEDED) because the "
+    "caller's propagated budget (the deadline_left_s header field, stamped "
+    "from retry_with_backoff's remaining deadline) had already expired — "
+    "work nobody would wait for, shed before it started. By handler site "
+    "(dispatcher.<request type> or worker.<request kind>)",
+    labels=("site",))
+RESILIENCE_RETRY_BUDGET = REGISTRY.gauge(
+    "petastorm_resilience_retry_budget",
+    "Remaining tokens in the client's per-peer retry budget (token bucket: "
+    "each retry spends one, each success refills a fraction). Zero means "
+    "retries against that peer are exhausted and failures route straight "
+    "to takeover instead of feeding a retry storm",
+    labels=("peer",))
+RESILIENCE_BREAKER_STATE = REGISTRY.gauge(
+    "petastorm_resilience_breaker_state",
+    "Client-side circuit breaker state per peer worker: 0 closed (healthy), "
+    "1 open (failing fast — consecutive-failure threshold tripped, peer "
+    "routed around and reported to the dispatcher), 2 half-open (one probe "
+    "in flight after the cooldown)",
+    labels=("peer",))
+RESILIENCE_HEDGES = REGISTRY.counter(
+    "petastorm_resilience_hedges_total",
+    "Hedged watermark re-serves, by outcome: launched (a stream's "
+    "inter-batch gap crossed the histogram-fit threshold and a re-grant of "
+    "the in-flight piece was opened at its watermark on a peer), won (the "
+    "hedge finished the piece first; the slow original was cancelled), "
+    "lost (the original finished first; the hedge was cancelled). "
+    "Duplicates from the losing side are dropped by the ordinary "
+    "(piece, generation) + watermark dedup, so every outcome is "
+    "digest-invariant",
+    labels=("outcome",))
+FLEET_BROWNOUT_LEVEL = REGISTRY.gauge(
+    "petastorm_fleet_brownout_level",
+    "The dispatcher's journaled brownout level: 0 normal, 1 shedding "
+    "low-weight/sideband jobs' credit windows (fleet.credit_scales with "
+    "the brownout factor applied), 2 also shedding optional stages "
+    "(tracing spans, autotune probes). Entered under sustained overload "
+    "(credit-wait + ready-queue-saturation streaks), recovered "
+    "symmetrically — every transition is a WAL op")
+
 # -- sequence packing + mixture sampling (service/packing_stage.py,
 #    service/mixture.py) -------------------------------------------------------
 

@@ -132,7 +132,7 @@ def resize_bounded_queue(q, maxsize):
 def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
                        jitter=0.5, retry_on=(Exception,), no_retry_on=(),
                        description=None, sleep=None, rng=None,
-                       deadline_s=None, clock=None):
+                       deadline_s=None, clock=None, budget=None):
     """Call ``fn()`` with bounded retries, exponential backoff and jitter.
 
     The shared transient-failure policy for network-facing control paths:
@@ -162,6 +162,11 @@ def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
         if ``retries`` remain — a caller-facing bound on worst-case latency
         that per-attempt socket timeouts alone cannot give.
     :param clock: injection point for tests (default ``time.monotonic``).
+    :param budget: optional per-peer
+        :class:`petastorm_tpu.service.resilience.RetryBudget`: each retry
+        spends one token (an empty bucket stops retrying even when
+        ``retries`` remain — a degraded peer gets a bounded retry RATE,
+        not a storm), and the eventual success refills it.
     """
     import logging
     import time
@@ -173,7 +178,10 @@ def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
                             rng=rng)
     for attempt in range(retries + 1):
         try:
-            return fn()
+            result = fn()
+            if budget is not None:
+                budget.record_success()
+            return result
         except no_retry_on:
             raise
         except retry_on as exc:
@@ -187,6 +195,13 @@ def retry_with_backoff(fn, retries=3, base_delay=0.1, max_delay=5.0,
                     "%.2fs exhausted, not retrying",
                     description or getattr(fn, "__name__", "call"),
                     attempt + 1, retries + 1, exc, deadline_s)
+                raise
+            if budget is not None and not budget.try_spend():
+                logging.getLogger(__name__).warning(
+                    "%s failed (attempt %d/%d): %s — retry budget "
+                    "exhausted, not retrying",
+                    description or getattr(fn, "__name__", "call"),
+                    attempt + 1, retries + 1, exc)
                 raise
             logging.getLogger(__name__).warning(
                 "%s failed (attempt %d/%d): %s — retrying in %.2fs",

@@ -195,6 +195,48 @@ def test_no_swallowed_errors_in_service_trees():
         "disappear): " + "; ".join(offenders))
 
 
+#: Retry-policy lint: an ad-hoc ``time.sleep()`` inside a retry loop
+#: dodges the shared budget-aware policy (``utils.retry_with_backoff`` /
+#: the client's ``_retry_sleep``) — no deadline propagation, no retry
+#: budget, no jitter — which is exactly the unbounded-retry-storm failure
+#: mode the resilience layer exists to close. New sleeps in these trees
+#: must ride the shared policy or earn a documented allowlist entry.
+_SLEEP_ALLOWED = {
+    # file → why this sleep is NOT a retry (each is a pacing/park point,
+    # not a re-attempt of failed work).
+    "petastorm_tpu/service/cli.py":
+        "status --watch refresh interval (operator-chosen cadence)",
+    "petastorm_tpu/service/worker.py":
+        "skew_ms fault-injection pacing before batch sends (bench knob)",
+    "petastorm_tpu/service/shm_ring.py":
+        "bounded ring-full park inside the doorbell wait loop",
+    "petastorm_tpu/service/chaos.py":
+        "injected downtime window — the fault itself, not a retry",
+}
+
+_SLEEP_DIRS = ("petastorm_tpu/service", "petastorm_tpu/cache_impl",
+               "petastorm_tpu/reader_impl")
+
+
+def test_no_raw_sleep_retry_loops_in_service_trees():
+    offenders = []
+    for root in _SLEEP_DIRS:
+        for py in sorted((REPO / root).rglob("*.py")):
+            rel = str(py.relative_to(REPO))
+            if rel in _SLEEP_ALLOWED:
+                continue
+            for lineno, line in enumerate(py.read_text().splitlines(), 1):
+                code = line.split("#", 1)[0]
+                if "time.sleep(" in code:
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw time.sleep() in the service/cache/reader trees (retries must "
+        "ride the shared budget-aware policy — utils.retry_with_backoff "
+        "with deadline_s / the client's _retry_sleep — or add a documented "
+        "allowlist entry explaining why the sleep is not a retry): "
+        + "; ".join(offenders))
+
+
 def test_documented_apis_exist():
     """Spot-check that names the docs teach are importable."""
     from petastorm_tpu import (  # noqa: F401

@@ -570,7 +570,8 @@ def test_status_watch_renders_steals_and_backlog_columns():
     assert "2/1" in text  # steals in/out
     row = next(line for line in text.splitlines()
                if line.startswith("w0"))
-    assert row.rstrip().endswith("3")  # backlog column
+    # backlog then the breaker column ("ok": no journaled exclusion).
+    assert row.rstrip().endswith("3       ok")
 
 
 # ---------------------------------------------------------------------------

@@ -465,6 +465,41 @@ def test_shm_failpoint_chaos_replay_is_byte_identical():
 # fuzzer: shrinking + the slow soak
 # ---------------------------------------------------------------------------
 
+def test_resilience_failpoint_replay_is_byte_identical():
+    """The resilience-vocabulary replay pin: a targeted ``slow-peer``
+    schedule (plus ``breaker-trip``/``hedge-race``, which ride the same
+    seed) with HEDGING ARMED injects the identical fault sequence across
+    two runs and produces byte-identical digests with 0 lost / 0
+    duplicate rows — hedged re-serves race wall-clock timing run-to-run,
+    but watermark dedup makes the delivered stream seed-pure."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    # The smoke-pinned geometry: stalls targeted at one worker, stretched
+    # past the hedge floor, fire window well under the per-batch call
+    # counts so both runs reach every scheduled index.
+    kwargs = dict(rows=1536, days=8, workers=2, batch_size=64, credits=4,
+                  chaos="failpoints", chaos_seed=11,
+                  failpoint_points=("slow-peer", "breaker-trip",
+                                    "hedge-race"),
+                  failpoint_window=10, failpoint_delay_s=0.6,
+                  failpoint_max_fires=3,
+                  failpoint_targets={"slow-peer": "bench-worker-0"},
+                  hedging=True, hedge_floor_s=0.2, hedge_min_samples=6,
+                  hedge_quantile=0.5,
+                  shuffle_seed=7, ordered=True)
+    first = service_loopback_scenario(**kwargs)
+    second = service_loopback_scenario(**kwargs)
+    for result in (first, second):
+        assert result["lost_rows"] == 0
+        assert result["duplicate_rows"] == 0
+    assert first["failpoint_injections"], "schedule fired nothing"
+    fired_points = {entry[0] for entry in first["failpoint_injections"]}
+    assert fired_points <= {"slow-peer", "breaker-trip", "hedge-race"}
+    assert first["stream_digest"] == second["stream_digest"]
+    assert (sorted(map(tuple, first["failpoint_injections"]))
+            == sorted(map(tuple, second["failpoint_injections"])))
+
+
 def test_fuzz_shrinker_produces_minimal_seed_stamped_reproducer():
     from petastorm_tpu.service import fuzz
 
@@ -547,6 +582,23 @@ def test_fuzz_soak_twenty_seeds_green():
     from petastorm_tpu.service import fuzz
 
     report = fuzz.fuzz(range(20), check_determinism=True,
+                       timeout_s=fuzz.DEFAULT_RUN_TIMEOUT_S)
+    assert report["failures"] == []
+    assert report["runs"] == 40
+
+
+@pytest.mark.slow
+def test_fuzz_soak_twenty_seeds_green_hedged():
+    """The soak with the resilience layer ARMED: same 20 seeds, full
+    vocabulary (now including ``slow-peer``/``breaker-trip``/
+    ``hedge-race``), hedged re-serves live. Strictly stronger than the
+    plain soak: hedges launch/win/lose on wall-clock races run-to-run,
+    yet the digest must stay byte-identical per seed — exactly-once is
+    watermark-deduped, not schedule-lucky."""
+    from petastorm_tpu.service import fuzz
+
+    report = fuzz.fuzz(range(20), run_fn=fuzz.hedged_run_fn,
+                       check_determinism=True,
                        timeout_s=fuzz.DEFAULT_RUN_TIMEOUT_S)
     assert report["failures"] == []
     assert report["runs"] == 40

@@ -1,0 +1,544 @@
+"""Overload-robust serving: the resilience layer's golden tests.
+
+Pure state machines first (``service/resilience.py`` keeps time and I/O
+out, so canned sequences pin every transition exactly — the
+``plan_fair_shares`` discipline): deadline propagation helpers,
+:class:`RetryBudget`, :class:`CircuitBreaker`, :class:`GapTracker`,
+:class:`BrownoutConfig`/:class:`BrownoutPlanner`, and the level-2
+optional-stage shed. Then the journaled wiring: breaker-open and
+brownout transitions are WAL ops replayed byte-identically across a
+dispatcher restart (the quarantine contract, at worker granularity), the
+deadline gate refuses an expired budget retryably on the live socket,
+and a hedged watermark re-serve under a targeted ``slow-peer`` failpoint
+delivers exactly once with a stream digest byte-identical to the
+unhedged same-seed run (docs/guides/service.md#failure-model-and-recovery).
+"""
+
+import json
+
+import pytest
+
+from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+from petastorm_tpu.service import Dispatcher
+from petastorm_tpu.service.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BROWNOUT_MAX_LEVEL,
+    DEADLINE_FIELD,
+    BrownoutConfig,
+    BrownoutPlanner,
+    CircuitBreaker,
+    GapTracker,
+    RetryBudget,
+    arrival_deadline,
+    attach_deadline,
+    brownout_level,
+    deadline_exceeded_reply,
+    deadline_expired,
+    note_brownout_level,
+    optional_stages_shed,
+)
+
+pytestmark = pytest.mark.service
+
+
+def _request(address, header):
+    with FramedConnection.connect(address) as conn:
+        reply, _ = conn.request(header)
+    return reply
+
+
+def _register(dispatcher, worker_id, num_pieces, port=1):
+    return _request(dispatcher.address, {
+        "type": "register_worker", "worker_id": worker_id,
+        "host": "127.0.0.1", "port": port, "num_pieces": num_pieces})
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation helpers (pure; clocks injected)
+# ---------------------------------------------------------------------------
+
+def test_attach_deadline_stamps_remaining_budget():
+    header = {"type": "get_assignment"}
+    attach_deadline(header, deadline=12.5, clock=lambda: 10.0)
+    assert header[DEADLINE_FIELD] == 2.5
+
+
+def test_attach_deadline_restamps_smaller_budget_per_attempt():
+    """A retry after backoff ships the SMALLER remaining budget — the
+    header is derived from the one deadline the retry loop enforces,
+    never reset to the original budget."""
+    header = {}
+    attach_deadline(header, deadline=13.0, clock=lambda: 10.0)
+    assert header[DEADLINE_FIELD] == 3.0
+    attach_deadline(header, deadline=13.0, clock=lambda: 12.0)
+    assert header[DEADLINE_FIELD] == 1.0
+
+
+def test_attach_deadline_clamps_expired_budget_to_zero():
+    header = {}
+    attach_deadline(header, deadline=9.0, clock=lambda: 10.0)
+    assert header[DEADLINE_FIELD] == 0.0
+
+
+def test_attach_deadline_none_is_a_no_op():
+    header = {"type": "heartbeat"}
+    attach_deadline(header, deadline=None, clock=lambda: 10.0)
+    assert DEADLINE_FIELD not in header
+
+
+def test_arrival_deadline_reanchors_locally():
+    """The wire field is RELATIVE (monotonic clocks do not transfer
+    across hosts); the handler re-anchors it on its own clock."""
+    assert arrival_deadline({DEADLINE_FIELD: 2.0},
+                            clock=lambda: 100.0) == 102.0
+    assert arrival_deadline({}, clock=lambda: 100.0) is None
+
+
+def test_arrival_deadline_tolerates_unparseable_values():
+    # An old or foreign caller must not be refused over an optional field.
+    assert arrival_deadline({DEADLINE_FIELD: "soon"},
+                            clock=lambda: 0.0) is None
+    assert arrival_deadline({DEADLINE_FIELD: None},
+                            clock=lambda: 0.0) is None
+
+
+def test_deadline_expired():
+    assert not deadline_expired(None, clock=lambda: 99.0)
+    assert not deadline_expired(100.0, clock=lambda: 99.0)
+    assert deadline_expired(100.0, clock=lambda: 100.0)
+    assert deadline_expired(100.0, clock=lambda: 101.0)
+
+
+def test_deadline_exceeded_reply_is_retryable():
+    reply = deadline_exceeded_reply("dispatcher.get_assignment")
+    assert reply["type"] == "error"
+    assert reply["retryable"] is True
+    assert reply["error"].startswith(
+        "DEADLINE_EXCEEDED: dispatcher.get_assignment")
+
+
+# ---------------------------------------------------------------------------
+# retry budget (token bucket: retries spend, successes refill)
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_spends_and_denies():
+    budget = RetryBudget(capacity=2.0)
+    assert budget.try_spend()
+    assert budget.try_spend()
+    assert not budget.try_spend()       # bucket empty: retry refused
+    assert budget.denied == 1
+    assert budget.balance == 0.0
+
+
+def test_retry_budget_refills_on_success_capped_at_capacity():
+    budget = RetryBudget(capacity=2.0, refill_per_success=0.5)
+    assert budget.try_spend()
+    budget.record_success()
+    assert budget.balance == 1.5
+    for _ in range(10):
+        budget.record_success()
+    assert budget.balance == 2.0        # never above capacity
+
+
+def test_retry_budget_bounds_retry_rate_against_failing_peer():
+    """After the initial burst, the sustained retry rate is
+    refill_per_success retries per success — a degraded peer sees a
+    bounded ratio, never a storm."""
+    budget = RetryBudget(capacity=3.0, refill_per_success=0.5, initial=0.0)
+    granted = 0
+    for _ in range(10):                 # 10 successes interleaved...
+        budget.record_success()
+        if budget.try_spend():          # ...each tried to fund a retry
+            granted += 1
+    assert granted == 5                 # exactly 0.5 retries per success
+
+
+def test_retry_budget_rejects_nonpositive_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        RetryBudget(capacity=0)
+
+
+def test_retry_budget_snapshot():
+    budget = RetryBudget(capacity=4.0, initial=1.25)
+    assert not budget.try_spend(cost=2.0)
+    assert budget.snapshot() == {"capacity": 4.0, "balance": 1.25,
+                                 "denied": 1}
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (closed -> open -> half-open; time is an argument)
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_on_consecutive_failures_exactly_at_threshold():
+    breaker = CircuitBreaker(threshold=3, cooldown_s=5.0)
+    assert breaker.state == BREAKER_CLOSED
+    assert not breaker.record_failure(now=0.0)
+    assert not breaker.record_failure(now=0.1)
+    assert breaker.record_failure(now=0.2)      # True ONLY on the trip edge
+    assert breaker.state == BREAKER_OPEN
+    # Further failures while open are not fresh trips (no re-journal).
+    assert not breaker.record_failure(now=0.3)
+
+
+def test_breaker_success_resets_streak_so_flapping_never_trips():
+    breaker = CircuitBreaker(threshold=2, cooldown_s=5.0)
+    for i in range(10):                 # fail, succeed, fail, succeed...
+        assert not breaker.record_failure(now=float(i))
+        breaker.record_success()
+    assert breaker.state == BREAKER_CLOSED
+    assert breaker.consecutive_failures == 0
+
+
+def test_breaker_open_refuses_until_cooldown():
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0)
+    assert breaker.record_failure(now=10.0)
+    assert not breaker.allow(now=10.0)
+    assert not breaker.allow(now=14.9)
+    assert breaker.allow(now=15.0)      # cooldown elapsed: half-open probe
+    assert breaker.state == BREAKER_HALF_OPEN
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    breaker = CircuitBreaker(threshold=1, cooldown_s=1.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.allow(now=2.0)       # the probe
+    assert not breaker.allow(now=2.0)   # concurrent calls refused
+    assert not breaker.allow(now=3.0)   # ...until the probe resolves
+
+
+def test_breaker_probe_failure_reopens_and_restarts_cooldown():
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.allow(now=5.0)                   # probe admitted
+    assert not breaker.record_failure(now=5.0)      # probe fails: not a
+    assert breaker.state == BREAKER_OPEN            # fresh trip edge
+    assert not breaker.allow(now=9.9)               # cooldown RESTARTED
+    assert breaker.allow(now=10.0)
+
+
+def test_breaker_probe_success_closes():
+    breaker = CircuitBreaker(threshold=1, cooldown_s=5.0)
+    breaker.record_failure(now=0.0)
+    assert breaker.allow(now=5.0)
+    breaker.record_success()
+    assert breaker.state == BREAKER_CLOSED
+    assert breaker.allow(now=5.0)
+    assert breaker.snapshot() == {"state": "closed",
+                                  "consecutive_failures": 0}
+
+
+def test_breaker_rejects_threshold_below_one():
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# gap tracker (hedge threshold fit from the gap histogram)
+# ---------------------------------------------------------------------------
+
+BUCKETS = (0.1, 0.2, 0.4, 0.8, 1.6)
+
+
+def test_gap_tracker_disarmed_below_min_samples():
+    tracker = GapTracker(min_samples=4, buckets=BUCKETS)
+    for _ in range(3):
+        tracker.observe(0.05)
+    assert tracker.threshold_s() is None
+    tracker.observe(0.05)
+    assert tracker.threshold_s() is not None
+    assert tracker.count == 4
+
+
+def test_gap_tracker_threshold_is_clamped_multiple_of_quantile():
+    # All 20 gaps in the first bucket; q=1.0 interpolates to its upper
+    # bound (0.1), multiplier 4 -> 0.4, above the 0.25 floor.
+    tracker = GapTracker(quantile=1.0, multiplier=4.0, min_samples=16,
+                         floor_s=0.25, cap_s=30.0, buckets=BUCKETS)
+    for _ in range(20):
+        tracker.observe(0.05)
+    assert tracker.threshold_s() == pytest.approx(0.4)
+
+
+def test_gap_tracker_floor_clamps_fast_fleets():
+    # A fast fleet's fitted p99 would hedge on micro-jitter; the floor
+    # keeps the trigger at a humane minimum.
+    tracker = GapTracker(quantile=1.0, multiplier=1.0, min_samples=4,
+                         floor_s=0.25, cap_s=30.0, buckets=BUCKETS)
+    for _ in range(8):
+        tracker.observe(0.01)
+    assert tracker.threshold_s() == 0.25
+
+
+def test_gap_tracker_cap_clamps_slow_fleets():
+    # Overflow-bucket gaps fit to the last bound; the cap bounds how long
+    # a stream may stay silent before the hedge fires regardless.
+    tracker = GapTracker(quantile=1.0, multiplier=100.0, min_samples=4,
+                         floor_s=0.25, cap_s=30.0, buckets=BUCKETS)
+    for _ in range(8):
+        tracker.observe(50.0)
+    assert tracker.threshold_s() == 30.0
+
+
+def test_gap_tracker_rejects_bad_params():
+    with pytest.raises(ValueError, match="quantile"):
+        GapTracker(quantile=0.0)
+    with pytest.raises(ValueError, match="multiplier"):
+        GapTracker(multiplier=0.0)
+
+
+# ---------------------------------------------------------------------------
+# brownout planner (shed order, hysteresis, symmetric recovery)
+# ---------------------------------------------------------------------------
+
+def _cfg(**overrides):
+    base = dict(interval_s=0.0, enter_credit_wait_s=0.5,
+                enter_ready_saturation=0.9, exit_fraction=0.5,
+                up_windows=2, down_windows=2, cooldown_windows=1,
+                max_level=2)
+    base.update(overrides)
+    return BrownoutConfig(**base)
+
+
+OVERLOADED = {"credit_wait_rate": 1.0, "ready_saturation": 0.0}
+CALM = {"credit_wait_rate": 0.0, "ready_saturation": 0.0}
+
+
+def test_brownout_sheds_after_up_windows_one_level_at_a_time():
+    planner = BrownoutPlanner(_cfg())
+    assert planner.plan(dict(OVERLOADED, level=0)) == []
+    actions = planner.plan(dict(OVERLOADED, level=0))
+    assert actions == [{"action": "shed", "level": 1,
+                        "reason": actions[0]["reason"]}]
+    assert "overload for 2 windows" in actions[0]["reason"]
+
+
+def test_brownout_cooldown_window_emits_nothing():
+    planner = BrownoutPlanner(_cfg())
+    planner.plan(dict(OVERLOADED, level=0))
+    assert planner.plan(dict(OVERLOADED, level=0))  # shed to 1
+    # The transition started a cooldown: this round accumulates nothing.
+    assert planner.plan(dict(OVERLOADED, level=1)) == []
+    # Streaks then rebuild from zero toward level 2.
+    assert planner.plan(dict(OVERLOADED, level=1)) == []
+    actions = planner.plan(dict(OVERLOADED, level=1))
+    assert actions[0] == {"action": "shed", "level": 2,
+                          "reason": actions[0]["reason"]}
+
+
+def test_brownout_saturation_alone_is_overload():
+    planner = BrownoutPlanner(_cfg(up_windows=1, cooldown_windows=0))
+    actions = planner.plan({"level": 0, "credit_wait_rate": 0.0,
+                            "ready_saturation": 0.95})
+    assert actions[0]["action"] == "shed"
+
+
+def test_brownout_never_sheds_past_max_level():
+    planner = BrownoutPlanner(_cfg(up_windows=1, cooldown_windows=0))
+    for _ in range(5):
+        assert planner.plan(dict(OVERLOADED, level=2)) == []
+    assert BROWNOUT_MAX_LEVEL == 2
+
+
+def test_brownout_recovers_symmetrically_after_down_windows():
+    planner = BrownoutPlanner(_cfg())
+    assert planner.plan(dict(CALM, level=2)) == []
+    actions = planner.plan(dict(CALM, level=2))
+    assert actions == [{"action": "recover", "level": 1,
+                        "reason": actions[0]["reason"]}]
+    assert "calm for 2 windows" in actions[0]["reason"]
+    assert planner.plan(dict(CALM, level=1)) == []      # cooldown
+    assert planner.plan(dict(CALM, level=1)) == []
+    assert planner.plan(dict(CALM, level=1))[0]["level"] == 0
+
+
+def test_brownout_exit_bar_is_strictly_below_entry():
+    # Hovering just under the enter threshold is NOT calm (exit needs
+    # both signals below exit_fraction x enter) — the level cannot flap.
+    planner = BrownoutPlanner(_cfg())
+    hover = {"credit_wait_rate": 0.4, "ready_saturation": 0.0}
+    for _ in range(6):
+        assert planner.plan(dict(hover, level=1)) == []
+
+
+def test_brownout_mixed_round_resets_both_streaks():
+    planner = BrownoutPlanner(_cfg())
+    planner.plan(dict(OVERLOADED, level=0))             # up streak = 1
+    hover = {"credit_wait_rate": 0.4, "ready_saturation": 0.0}
+    assert planner.plan(dict(hover, level=0)) == []     # resets streaks
+    assert planner.plan(dict(OVERLOADED, level=0)) == []  # restarts at 1
+    assert planner.plan(dict(OVERLOADED, level=0))[0]["action"] == "shed"
+
+
+def test_brownout_config_coerce():
+    assert BrownoutConfig.coerce(True).up_windows == 3
+    cfg = BrownoutConfig.coerce({"up_windows": 7})
+    assert cfg.up_windows == 7
+    assert BrownoutConfig.coerce(cfg) is cfg
+    with pytest.raises(TypeError, match="brownout"):
+        BrownoutConfig.coerce("on")
+    with pytest.raises(ValueError, match="exit_fraction"):
+        BrownoutConfig(exit_fraction=1.0)
+    with pytest.raises(ValueError, match="max_level"):
+        BrownoutConfig(max_level=0)
+
+
+def test_note_brownout_level_sheds_and_restores_tracing():
+    """Level 2 sheds the trace collector; recovery restores it ONLY if
+    the brownout disabled it — an operator's own disable is respected."""
+    from petastorm_tpu.telemetry import tracing
+
+    prior = tracing.COLLECTOR.enabled
+    try:
+        tracing.COLLECTOR.enabled = True
+        note_brownout_level(2)
+        assert brownout_level() == 2
+        assert optional_stages_shed()
+        assert tracing.COLLECTOR.enabled is False
+        note_brownout_level(1)
+        assert not optional_stages_shed()
+        assert tracing.COLLECTOR.enabled is True        # restored
+        # Operator disabled it themselves: a brownout cycle leaves it off.
+        tracing.COLLECTOR.enabled = False
+        note_brownout_level(2)
+        note_brownout_level(0)
+        assert tracing.COLLECTOR.enabled is False
+    finally:
+        note_brownout_level(0)
+        tracing.COLLECTOR.enabled = prior
+
+
+# ---------------------------------------------------------------------------
+# journaled wiring: breaker + brownout WAL ops replay byte-identically
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_replays_byte_identical_across_restart(tmp_path):
+    journal_dir = str(tmp_path / "journal")
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir,
+                    breaker_cooldown_s=600.0).start() as disp:
+        _register(disp, "w0", 6)
+        _register(disp, "w1", 6)
+        reply = _request(disp.address, {
+            "type": "report_breaker", "worker_id": "w1",
+            "client_id": "c0", "error": "5 consecutive stream failures",
+            "epoch": 0})
+        assert reply["fresh"] is True
+        assert reply["breaker_open"] == ["w1"]
+        # Idempotent: a second client's report journals nothing new.
+        again = _request(disp.address, {
+            "type": "report_breaker", "worker_id": "w1",
+            "client_id": "c1", "error": "timeout", "epoch": 0})
+        assert again["fresh"] is False
+        status = _request(disp.address, {"type": "status"})
+        assert sorted(status["fleet"]["breaker_open"]) == ["w1"]
+        before = disp.state_snapshot()
+
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir,
+                    breaker_cooldown_s=600.0).start() as restarted:
+        after = restarted.state_snapshot()
+        volatile = ("fencing_epoch", "recovery")
+        plan_before = {k: v for k, v in before.items() if k not in volatile}
+        plan_after = {k: v for k, v in after.items() if k not in volatile}
+        assert (json.dumps(plan_before, sort_keys=True)
+                == json.dumps(plan_after, sort_keys=True))
+        assert after["breaker_open"]["w1"]["client_id"] == "c0"
+        assert after["recovery"]["journal_replays"] == 1
+
+
+def test_report_breaker_unknown_worker_rejected(tmp_path):
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 3)
+        reply = _request(disp.address, {
+            "type": "report_breaker", "worker_id": "ghost",
+            "client_id": "c0", "error": "x"})
+        assert reply["type"] == "error"
+        assert "unknown worker" in reply["error"]
+
+
+def test_brownout_transitions_replay_byte_identical_across_restart(
+        tmp_path):
+    journal_dir = str(tmp_path / "journal")
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir).start() as disp:
+        _register(disp, "w0", 6)
+        assert disp.apply_brownout("shed", 1, reason="credit_wait 1.2s/s")
+        assert disp.apply_brownout("shed", 2, reason="still overloaded")
+        assert disp.apply_brownout("recover", 1, reason="calm")
+        # Out-of-order transitions are refused, live and on replay alike.
+        assert not disp.apply_brownout("shed", 3, reason="skip a level")
+        status = _request(disp.address, {"type": "status"})
+        assert status["fleet"]["brownout"]["level"] == 1
+        assert status["fleet"]["brownout"]["counts"] == {"shed": 2,
+                                                         "recover": 1}
+        before = disp.state_snapshot()
+
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir).start() as restarted:
+        after = restarted.state_snapshot()
+        volatile = ("fencing_epoch", "recovery")
+        plan_before = {k: v for k, v in before.items() if k not in volatile}
+        plan_after = {k: v for k, v in after.items() if k not in volatile}
+        assert (json.dumps(plan_before, sort_keys=True)
+                == json.dumps(plan_after, sort_keys=True))
+        assert after["brownout"] == {"level": 1,
+                                     "counts": {"shed": 2, "recover": 1},
+                                     "reason": "calm"}
+        assert after["recovery"]["journal_replays"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live deadline gate (the wire contract, one round-trip)
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_refuses_expired_deadline_retryably():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        _register(disp, "w0", 3)
+        reply = _request(disp.address, {"type": "status",
+                                        DEADLINE_FIELD: 0.0})
+        assert reply["type"] == "error"
+        assert reply["retryable"] is True
+        assert "DEADLINE_EXCEEDED: dispatcher.status" in reply["error"]
+        # Without the field there is no gate.
+        assert _request(disp.address, {"type": "status"})["type"] == "status"
+
+
+# ---------------------------------------------------------------------------
+# hedged watermark re-serve: exactly-once, digest-invariant
+# ---------------------------------------------------------------------------
+
+def test_hedged_reserve_exactly_once_and_digest_invariant(tmp_path):
+    """A targeted ``slow-peer`` failpoint stalls one worker's sends past
+    the hedge floor; the client hedges the in-flight piece at its
+    watermark from the peer. The contract: hedges LAUNCH (the trigger
+    fired), zero lost and zero duplicate rows (first-wins + watermark
+    dedup), and the delivered stream digest is byte-identical to the
+    unhedged same-seed run — hedging changes tail latency, never
+    content."""
+    from petastorm_tpu.benchmark.scenarios import service_loopback_scenario
+
+    geometry = dict(
+        rows=1536, days=8, workers=2, batch_size=64, credits=4,
+        ordered=True, shuffle_seed=7, chaos="failpoints", chaos_seed=11,
+        failpoint_points=("slow-peer",), failpoint_window=10,
+        failpoint_delay_s=0.6, failpoint_max_fires=3,
+        failpoint_targets={"slow-peer": "bench-worker-0"})
+    plain = service_loopback_scenario(**geometry)
+    hedged = service_loopback_scenario(
+        **geometry, hedging=True, hedge_floor_s=0.2, hedge_min_samples=6,
+        # Short epoch: the injected stalls ARE the tail, so the median —
+        # not the p99 — is the honest baseline to hedge against.
+        hedge_quantile=0.5)
+
+    for result in (plain, hedged):
+        assert result["lost_rows"] == 0
+        assert result["duplicate_rows"] == 0
+    assert [tuple(e) for e in plain["failpoint_injections"]] \
+        == [tuple(e) for e in hedged["failpoint_injections"]]
+    counts = hedged["hedge_counts"]
+    assert counts["launched"] >= 1
+    assert counts["won"] + counts["lost"] <= counts["launched"]
+    assert plain["hedge_counts"]["launched"] == 0
+    assert hedged["stream_digest"] == plain["stream_digest"]
