@@ -2450,6 +2450,81 @@ def leg_columnar_ab(url):
     }
 
 
+# --------------------------------------------------------------------------
+# Observability-overhead leg: tracing armed vs off on the image loader
+# --------------------------------------------------------------------------
+
+def leg_observability_overhead(url):
+    """The cost of the observability plane: the image decode+load loop
+    with the span collector ARMED (trace_path exporting every batch's
+    spans) vs tracing OFF, interleaved best-of so host drift hits both
+    arms alike. The armed run's exported trace is then fed through the
+    critical-path engine (telemetry/critical_path.py) so the leg also
+    reports how much of its measured input stall `diagnose` attributes
+    to named stages. Asserts the armed arm costs < 2% throughput — the
+    always-on budget docs/guides/diagnostics.md promises."""
+    from petastorm_tpu.jax_utils import make_jax_dataloader
+    from petastorm_tpu.telemetry import critical_path
+
+    trace_file = os.path.join(tempfile.gettempdir(),
+                              f"bench-obs-trace-{os.getpid()}.json")
+
+    def one(trace_path):
+        reader = _columnar_reader(url)
+        loader = make_jax_dataloader(reader, BATCH, last_batch="drop",
+                                     non_tensor_policy="drop",
+                                     host_prefetch=6,
+                                     trace_path=trace_path)
+        n, t0 = 0, time.perf_counter()
+        with loader:
+            for _ in loader:
+                n += BATCH
+        return {"images_per_sec": n / (time.perf_counter() - t0),
+                "input_stall_pct": loader.diagnostics["input_stall_pct"]}
+
+    # Interleaved best-of: alternate arms inside each round so a noisy
+    # host window penalizes both equally instead of sinking one.
+    off = on = None
+    one(None)  # shared warmup
+    for _ in range(max(3, REPEATS)):
+        r_off = one(None)
+        r_on = one(trace_file)
+        if off is None or r_off["images_per_sec"] > off["images_per_sec"]:
+            off = r_off
+        if on is None or r_on["images_per_sec"] > on["images_per_sec"]:
+            on = r_on
+    overhead_pct = 100.0 * (off["images_per_sec"] - on["images_per_sec"]) \
+        / off["images_per_sec"]
+    with open(trace_file, encoding="utf-8") as f:
+        events = (json.load(f) or {}).get("traceEvents") or []
+    os.unlink(trace_file)
+    report = critical_path.diagnose(
+        events, measured_stall_pct=on["input_stall_pct"])
+    if overhead_pct >= 2.0:
+        raise RuntimeError(
+            f"tracing overhead {overhead_pct:.2f}% breaches the <2% "
+            f"budget (armed {on['images_per_sec']:.1f} vs off "
+            f"{off['images_per_sec']:.1f} images/s)")
+    return {
+        "images_per_sec": off["images_per_sec"],
+        "tracing_off_images_per_sec": round(off["images_per_sec"], 1),
+        "tracing_on_images_per_sec": round(on["images_per_sec"], 1),
+        "tracing_overhead_pct": round(overhead_pct, 2),
+        "overhead_budget_pct": 2.0,
+        "input_stall_pct": on["input_stall_pct"],
+        "trace_events": len(events),
+        # The acceptance number: how much of the measured stall the
+        # critical-path engine pins on named stages.
+        "stall_attribution_coverage_pct": (
+            round(report["coverage_pct"], 1)
+            if report["coverage_pct"] is not None else None),
+        "stall_bottlenecks": [
+            {"stage": row["stage"], "peer": row["peer"],
+             "share_pct": round(row["share_pct"], 1)}
+            for row in report["bottlenecks"][:5]],
+    }
+
+
 LEGS = {
     "decode_row": leg_decode_row,
     "decode_columnar": leg_decode_columnar,
@@ -2472,6 +2547,7 @@ LEGS = {
     "llm_packing": leg_llm_packing,
     "rewrite_ab": leg_rewrite_ab,
     "columnar_ab": leg_columnar_ab,
+    "observability_overhead": leg_observability_overhead,
 }
 
 # Legs that measure evidence, not throughput: run ONCE outside the
@@ -2479,7 +2555,8 @@ LEGS = {
 ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
                 "multichip_child", "multichip_scaling", "skewed_service",
                 "shm_transport", "autotune", "multi_tenant", "llm_packing",
-                "rewrite_ab", "columnar_ab", "overload_tail")
+                "rewrite_ab", "columnar_ab", "overload_tail",
+                "observability_overhead")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
@@ -2548,9 +2625,11 @@ def main():
         llm_packing = _run_leg_subprocess("llm_packing", url)
         columnar_ab = _run_leg_subprocess("columnar_ab", url)
         overload_tail = _run_leg_subprocess("overload_tail", url)
+        observability = _run_leg_subprocess("observability_overhead", url)
         for extra in (flash_numerics, flash_memory, multichip,
                       skewed_service, shm_transport, autotune_ab,
-                      llm_packing, columnar_ab, overload_tail):
+                      llm_packing, columnar_ab, overload_tail,
+                      observability):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -2679,6 +2758,13 @@ def main():
             # the tail-cutting number, digests_match_across_arms the
             # exactly-once check (asserted in-leg).
             "overload_tail": overload_tail,
+            # Observability-overhead A/B (docs/guides/diagnostics.md):
+            # span tracing armed vs off on the image loader —
+            # tracing_overhead_pct must stay under the <2% budget
+            # (asserted in-leg), and stall_attribution_coverage_pct is
+            # how much of the measured input stall `diagnose`'s
+            # critical-path engine pins on named stages.
+            "observability_overhead": observability,
             "decode_only_images_per_sec": round(ceiling, 1),
             "decode_only_row_path_images_per_sec": round(
                 results["decode_row"]["images_per_sec"], 1),

@@ -39,6 +39,22 @@ DEFAULT_TABULAR_DAYS = 8
 DEFAULT_NGRAM_FRAMES = 2_000
 
 
+def _invariant_failure(message):
+    """Build the chaos-invariant RuntimeError AND dump the flight
+    recorder first (telemetry/flight.py): the violation's postmortem —
+    the ring of control-plane events right up to the failed check — is
+    written to disk and its path appended to the error, so a red chaos
+    run (or the fuzzer's shrunk reproducer) always ships its own
+    evidence."""
+    from petastorm_tpu.telemetry.flight import RECORDER
+
+    RECORDER.note("scenario.invariant_violation", error=message[:200])
+    path = RECORDER.dump("invariant-violation")
+    if path:
+        message += f"; flight recorder dump: {path}"
+    return RuntimeError(message)
+
+
 # ---------------------------------------------------------------------------
 # Scenario: wide-schema tabular with predicate pushdown (config #3)
 # ---------------------------------------------------------------------------
@@ -869,6 +885,15 @@ BrownoutConfig`).
     chaos_pace_s = 0.03 if timed_kinds else 0.0
     lease_timeout_s = 2.0 if chaos_kinds else 30.0
 
+    # Flight-recorder breadcrumb (telemetry/flight.py): a chaos run that
+    # dies mid-flight dumps a ring whose FIRST useful entry says what
+    # configuration was running.
+    from petastorm_tpu.telemetry.flight import RECORDER as _FLIGHT
+
+    _FLIGHT.note("scenario.start", scenario="service", sharding=mode,
+                 chaos=",".join(chaos_kinds) or None,
+                 chaos_seed=chaos_seed, epochs=epochs)
+
     def make_dispatcher(host="127.0.0.1", port=0):
         # The restart factory passes the SAME shuffle_seed: the journal
         # guard refuses a seed change mid-run (it would silently shift
@@ -1218,7 +1243,7 @@ BrownoutConfig`).
                 "client_recovery": source.diagnostics.get("recovery", {}),
             })
             if not invariants["ok"]:
-                raise RuntimeError(
+                raise _invariant_failure(
                     f"chaos run violated delivery invariants: "
                     f"{invariants['lost_rows']} lost rows, "
                     f"{invariants['duplicate_rows']} duplicates "
@@ -1227,7 +1252,7 @@ BrownoutConfig`).
                     f"failpoints: {injection_log}")
             if "failpoints" in chaos_kinds and failpoint_points is None \
                     and not injection_log:
-                raise RuntimeError(
+                raise _invariant_failure(
                     "failpoints chaos ran but the schedule fired nothing "
                     "— the run proved no robustness (too-short epoch "
                     "never reached the seeded fire indices, or the "
@@ -1235,12 +1260,12 @@ BrownoutConfig`).
             if "dispatcher-restart" in chaos_kinds and (
                     recovery.get("journal_replays", 0) < 1
                     or recovery.get("fencing_bumps", 0) < 1):
-                raise RuntimeError(
+                raise _invariant_failure(
                     f"dispatcher-restart chaos recorded no recovery: "
                     f"{recovery} (events: {chaos_events})")
             if "cache-corrupt" in chaos_kinds and (
                     result["cache"]["corrupt_entries"] < 1):
-                raise RuntimeError(
+                raise _invariant_failure(
                     "cache-corrupt chaos ran but no worker counted a "
                     "corrupt entry: either no injection landed on an "
                     "entry a warm epoch later loaded, or — the bug this "

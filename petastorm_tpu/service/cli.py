@@ -190,6 +190,45 @@ def _build_parser():
                            "knob gauges and decision counters under the "
                            "fleet table (docs/guides/pipeline.md)")
 
+    trace = sub.add_parser(
+        "trace", help="fleet tracing: arm every process's span collector "
+                      "through the dispatcher's heartbeat beacon, collect "
+                      "the clock-aligned merged trace, or disarm "
+                      "(docs/guides/diagnostics.md#fleet-tracing)")
+    trace.add_argument("action", nargs="?", default="collect",
+                       choices=["arm", "collect", "disarm"],
+                       help="arm: start fleet-wide span recording; "
+                            "collect: merge every peer's ring into one "
+                            "Perfetto-loadable trace; disarm: stop")
+    trace.add_argument("--dispatcher", required=True,
+                       help="dispatcher address host:port")
+    trace.add_argument("--out", default="fleet-trace.json",
+                       help="collect: where the merged trace JSON lands "
+                            "(open it at https://ui.perfetto.dev)")
+
+    diag = sub.add_parser(
+        "diagnose", help="stall attribution: decompose the consumer's "
+                         "measured input stall into a ranked per-stage/"
+                         "per-peer bottleneck report from a fleet trace "
+                         "(docs/guides/diagnostics.md#stall-attribution)")
+    diag.add_argument("--dispatcher", default=None,
+                      help="collect the trace live from this dispatcher "
+                           "(must be armed) and journal the computed "
+                           "stage profile back to it")
+    diag.add_argument("--trace", default=None,
+                      help="diagnose an already-collected trace JSON "
+                           "file instead of collecting live")
+    diag.add_argument("--stall-pct", type=float, default=None,
+                      help="the bench's measured input_stall_pct — each "
+                           "bottleneck row then shows its decomposed "
+                           "share of it")
+    diag.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the full report as JSON instead of the "
+                           "ranked table")
+    diag.add_argument("--no-post", action="store_true",
+                      help="skip journaling the stage profile to the "
+                           "dispatcher")
+
     mix = sub.add_parser(
         "set-mixture-weights",
         help="journal a mixture weight change at the dispatcher — the "
@@ -617,6 +656,97 @@ def run_status(address, watch=False, interval_s=2.0, out=None,
             return 0
 
 
+# -- fleet tracing / stall attribution --------------------------------------
+
+def _collect_fleet_trace(address, timeout=15.0):
+    """One ``trace collect`` RPC → the clock-aligned merged trace doc
+    (``telemetry/clockalign.py``). Raises ``RuntimeError`` on a
+    dispatcher-side error reply."""
+    from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+    from petastorm_tpu.telemetry.clockalign import assemble_fleet_trace
+
+    with FramedConnection.connect(address, timeout=timeout) as conn:
+        reply, payload = conn.request({"type": "trace",
+                                       "action": "collect"})
+    if reply.get("type") == "error":
+        raise RuntimeError(reply.get("error", "trace collect failed"))
+    payload = payload or {}
+    local = payload.get("local") or {}
+    peers = {str(name): {"events": buf.get("events") or [],
+                         "offset_us": buf.get("offset_us"),
+                         "dropped": int(buf.get("dropped") or 0),
+                         "min_rtt_us": buf.get("min_rtt_us")}
+             for name, buf in (payload.get("peers") or {}).items()}
+    return assemble_fleet_trace(local.get("events") or [], peers,
+                                local_dropped=int(local.get("dropped")
+                                                  or 0))
+
+
+def run_trace(address, action, out=None):
+    """The ``trace`` subcommand: arm/disarm print the dispatcher's
+    acknowledgment; collect writes the merged Perfetto-loadable trace."""
+    if action != "collect":
+        from petastorm_tpu.reader_impl.framed_socket import (
+            FramedConnection,
+        )
+
+        with FramedConnection.connect(address, timeout=10.0) as conn:
+            reply, _ = conn.request({"type": "trace", "action": action})
+        print(json.dumps(reply), flush=True)
+        return 0 if reply.get("type") != "error" else 1
+    doc = _collect_fleet_trace(address)
+    path = out or "fleet-trace.json"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    print(json.dumps({
+        "trace": path,
+        "events": len(doc["traceEvents"]),
+        "clock_alignment": doc["otherData"].get("clock_alignment"),
+    }), flush=True)
+    return 0
+
+
+def run_diagnose(address=None, trace_path=None, as_json=False,
+                 stall_pct=None, post=True, out=None):
+    """The ``diagnose`` subcommand: critical-path stall attribution over a
+    fleet trace — live-collected from an armed dispatcher, or read from
+    an already-collected ``--trace`` file. Unless ``--no-post``, the
+    computed per-stage profile is journaled back to the dispatcher (the
+    fleet planner's training feed)."""
+    from petastorm_tpu.telemetry import critical_path
+
+    out = out if out is not None else sys.stdout
+    if trace_path is not None:
+        with open(trace_path, encoding="utf-8") as f:
+            events = (json.load(f) or {}).get("traceEvents") or []
+    elif address is not None:
+        events = _collect_fleet_trace(address).get("traceEvents") or []
+    else:
+        print("diagnose needs --dispatcher (live collect) or --trace "
+              "(a collected trace file)", file=sys.stderr, flush=True)
+        return 2
+    report = critical_path.diagnose(events, measured_stall_pct=stall_pct)
+    if post and address is not None:
+        from petastorm_tpu.reader_impl.framed_socket import (
+            FramedConnection,
+        )
+
+        try:
+            with FramedConnection.connect(address, timeout=10.0) as conn:
+                conn.request({"type": "stage_profile",
+                              "profile": report["stage_profile"],
+                              "coverage_pct": report["coverage_pct"],
+                              "source": "diagnose"})
+        except (ConnectionError, OSError) as exc:
+            print(f"stage profile not journaled: {exc}",
+                  file=sys.stderr, flush=True)
+    if as_json:
+        print(json.dumps(report), file=out, flush=True)
+    else:
+        print(critical_path.render(report), file=out, flush=True)
+    return 0
+
+
 def main(argv=None, run_seconds=None, stop_event=None):
     """Entry point. ``run_seconds`` bounds the serve loop and
     ``stop_event`` stops it early (both for tests — an embedding test must
@@ -643,14 +773,37 @@ def main(argv=None, run_seconds=None, stop_event=None):
                                   if args.trainer_metrics else None))
         except KeyboardInterrupt:
             return 0
+    if args.role == "trace":
+        return run_trace(parse_address(args.dispatcher), args.action,
+                         out=args.out)
+    if args.role == "diagnose":
+        return run_diagnose(
+            address=(parse_address(args.dispatcher)
+                     if args.dispatcher else None),
+            trace_path=args.trace, as_json=args.as_json,
+            stall_pct=args.stall_pct, post=not args.no_post)
+    # Crash-safe flight recorder (telemetry/flight.py): every service
+    # process dumps its recent-event ring on an unhandled service-thread
+    # exception or SIGUSR2.
+    from petastorm_tpu.telemetry import flight
+
+    flight.install()
     node = build_service_node(args)
-    node.start()
     metrics_server = None
     if getattr(args, "metrics_port", None) is not None:
         from petastorm_tpu.telemetry.http import MetricsServer
 
+        # Bound BEFORE node.start(): with --metrics-port 0 the kernel
+        # picks the port, and a worker's registration must advertise the
+        # CHOSEN one (the dispatcher's `status` is how an operator finds
+        # every scrape endpoint).
         metrics_server = MetricsServer(host=args.host,
                                        port=args.metrics_port).start()
+        if args.role == "worker":
+            node.metrics_port = metrics_server.address[1]
+        else:
+            node.metrics_address = list(metrics_server.address)
+    node.start()
     host, port = node.address
     print(json.dumps({"role": args.role, "host": host, "port": port,
                       **({"worker_id": node.worker_id}

@@ -48,6 +48,8 @@ from petastorm_tpu.reader_impl.framed_socket import (
     ProtocolError,
 )
 from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.clockalign import OffsetEstimator
+from petastorm_tpu.telemetry.flight import RECORDER as FLIGHT
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.service.resilience import (
     CircuitBreaker,
@@ -1160,6 +1162,13 @@ class ServiceBatchSource:
             quantile=hedge_quantile, multiplier=hedge_multiplier,
             min_samples=hedge_min_samples, floor_s=hedge_floor_s)
         self._hedge_counts = {"launched": 0, "won": 0, "lost": 0}
+        # Fleet-clock alignment + tracing beacon state, mirroring the
+        # worker's (docs/guides/diagnostics.md#clock-alignment): NTP-style
+        # offset samples around each heartbeat, and whether the
+        # dispatcher's heartbeat replies currently arm fleet tracing.
+        self._clock = OffsetEstimator()
+        self._trace_armed_remote = False
+        FLIGHT.set_context(role="client", client_id=self.client_id)
         # Injection point for the fcfs retry loop's backoff sleeps (the
         # budget-aware analogue of ``retry_with_backoff``'s ``sleep=``).
         self._retry_sleep = time.sleep
@@ -1320,9 +1329,16 @@ class ServiceBatchSource:
 
     def _note_hedge(self, outcome):
         """One hedged re-serve outcome (``launched``/``won``/``lost``) —
-        mirrored to telemetry and to the counters ``diagnostics()``
-        reports."""
+        mirrored to telemetry, to the counters ``diagnostics()`` reports,
+        and (when tracing is armed) to the fleet trace as an instant so a
+        hedge race is visible against the batch spans it raced."""
         RESILIENCE_HEDGES.labels(outcome).inc()
+        collector = tracing.COLLECTOR
+        if collector.enabled:
+            collector.instant(f"client.hedge_{outcome}",
+                              time.perf_counter(),
+                              args={"client_id": self.client_id})
+        FLIGHT.note(f"client.hedge_{outcome}")
         with self._lock:
             self._hedge_counts[outcome] = (
                 self._hedge_counts.get(outcome, 0) + 1)
@@ -1346,6 +1362,14 @@ class ServiceBatchSource:
             # re-partitions, and quarantine reports all scope to this
             # source's corpus worker group.
             header = dict(header, corpus=self.corpus)
+        if "trace" not in header:
+            # Propagated trace context: the dispatcher's RPC span records
+            # who called (and which job), joining this client's data-plane
+            # batch spans in the merged fleet trace.
+            ctx = {"peer": self.client_id}
+            if self.job_id is not None:
+                ctx["job_id"] = self.job_id
+            header = dict(header, trace=ctx)
 
         # One deadline for the whole request (attempts + backoff), from
         # the same budget the retry loop enforces — stamped per attempt
@@ -3244,15 +3268,27 @@ class ServiceBatchSource:
                               if ready is not None and ready.maxsize > 0
                               else 0.0)
             try:
+                # retries=0 → one dial, so [t0, t1] brackets one round
+                # trip: the NTP-style clock sample (offset = dispatcher
+                # clock − RTT midpoint) that aligns this client's spans
+                # in the merged fleet trace.
+                t0 = time.perf_counter()
                 reply = self._dispatcher_request(
                     {"type": "client_heartbeat", "client_id": self.client_id,
                      "epoch": epoch_now, "watermarks": marks,
                      "ready_saturation": saturation},
                     retries=0)
+                t1 = time.perf_counter()
             except (ServiceError, OSError):
                 with self._lock:
                     self._recovery_inc("heartbeat_failures")
                 continue
+            remote_us = reply.get("dispatcher_time_us")
+            if remote_us is not None:
+                self._clock.add(
+                    tracing.COLLECTOR.ts_us((t0 + t1) / 2.0),
+                    float(remote_us), (t1 - t0) * 1e6)
+            self._sync_trace_arming(bool(reply.get("trace")))
             fencing = int(reply.get("fencing_epoch", 0))
             with self._lock:
                 self._recovery["dispatcher"] = dict(
@@ -3261,6 +3297,49 @@ class ServiceBatchSource:
                          or not reply.get("known", True))
             if stale:
                 self._post_fence(fencing)
+        if self._trace_armed_remote:
+            # Drain teardown while the fleet is still armed: ship the
+            # ring one final time (spans recorded since the last tick
+            # would otherwise vanish with this thread), then balance the
+            # beacon's acquire.
+            self._trace_armed_remote = False
+            self._push_trace_ring()
+            tracing.COLLECTOR.release()
+
+    def _sync_trace_arming(self, armed):
+        """Follow the dispatcher's heartbeat-borne tracing beacon (the
+        client half of the worker's ``_sync_trace_arming``): arm the
+        local collector when the fleet arms, push the accumulated ring
+        each armed tick, release on disarm."""
+        if armed and not self._trace_armed_remote:
+            self._trace_armed_remote = True
+            tracing.COLLECTOR.acquire()
+            FLIGHT.note("client.trace_armed")
+            self._log.info("fleet tracing armed by dispatcher beacon")
+        elif not armed and self._trace_armed_remote:
+            self._trace_armed_remote = False
+            tracing.COLLECTOR.release()
+            self._log.info("fleet tracing disarmed")
+            return
+        if self._trace_armed_remote:
+            self._push_trace_ring()
+
+    def _push_trace_ring(self):
+        """Ship-and-clear the local span ring to the dispatcher with the
+        current clock offset. Best-effort: a failed push loses that
+        tick's spans; heartbeat cadence bounds the exposure."""
+        events, dropped = tracing.COLLECTOR.ship()
+        if not events and not dropped:
+            return
+        try:
+            self._dispatcher_request(
+                {"type": "trace_push", "peer": self.client_id,
+                 "events": events, "dropped": dropped,
+                 "offset_us": self._clock.offset_us(),
+                 "min_rtt_us": self._clock.min_rtt_us()},
+                retries=0)
+        except (ServiceError, OSError):
+            pass  # best-effort: the next tick ships the new ring
 
     def _post_fence(self, fencing_epoch):
         """Hand the drain a ``fence`` event (dedup'd: one outstanding at a

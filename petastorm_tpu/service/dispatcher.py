@@ -94,8 +94,11 @@ from petastorm_tpu.service.resilience import (
     deadline_expired,
 )
 from petastorm_tpu.service.seedtree import piece_order
+from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.flight import RECORDER as FLIGHT
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
+    CLOCK_OFFSET_US,
     DISPATCHER_BACKLOG_PIECES,
     DISPATCHER_FENCING_EPOCH,
     DISPATCHER_GENERATION,
@@ -112,11 +115,16 @@ from petastorm_tpu.telemetry.metrics import (
     FLEET_WORKERS,
     QUARANTINE_PIECES,
     QUARANTINE_REPORTS,
+    TRACE_SHIP_EVENTS,
 )
 
 logger = service_logger(__name__)
 
 MODES = ("static", "fcfs", "dynamic")
+
+#: How many journaled ``stage_profile`` records ``status`` keeps in its
+#: in-memory head (the full history stays in the WAL for the planner).
+STAGE_PROFILES_KEPT = 8
 
 #: Dynamic mode: a worker whose delivery rate falls below this fraction of
 #: the fleet median (while it still holds stealable backlog) is treated as
@@ -446,6 +454,24 @@ class Dispatcher:
             self._journal = Journal(journal_dir,
                                     compact_every=journal_compact_every,
                                     fsync=journal_fsync)
+        # Fleet tracing (docs/guides/diagnostics.md#fleet-tracing): armed
+        # by the `trace` RPC; while armed, heartbeat replies tell peers
+        # to record spans and push their rings here. Buffers are keyed by
+        # peer name and bounded; offsets are the peers' own NTP-style
+        # estimates against this dispatcher's trace timebase.
+        self._trace_armed = False
+        self._trace_buffers = {}  # peer -> {events, dropped, offset_us,
+        #                           min_rtt_us}
+        # Journaled per-stage profiles (`diagnose` posts them): the
+        # last few, replayed like every other WAL op — the feed the
+        # future fleet planner fits its throughput model on.
+        self._stage_profiles = []
+        # The dispatcher's own metrics endpoint (set by the CLI when
+        # --metrics-port is given), surfaced through `status` so
+        # operators can find the scrape target without out-of-band
+        # knowledge — the same advertisement workers make through
+        # registration.
+        self.metrics_address = None
         self._lease_thread = None
         self._autoscaler = None
         if autoscale:
@@ -694,7 +720,8 @@ class Dispatcher:
                 int(record["num_pieces"]),
                 re_register=bool(record.get("re_register")),
                 standby=bool(record.get("standby")),
-                corpus=record.get("corpus", ""))
+                corpus=record.get("corpus", ""),
+                metrics_port=record.get("metrics_port"))
         elif op == "worker_dead":
             self._mark_worker_dead_locked(record["worker_id"],
                                           record.get("reason", "reported"),
@@ -771,6 +798,12 @@ class Dispatcher:
         elif op == "fencing":
             self._fencing_epoch = int(record["fencing_epoch"])
             self._recovery["fencing_bumps"] += 1
+        elif op == "stage_profile":
+            self._stage_profiles.append(
+                {"profile": record.get("profile") or {},
+                 "coverage_pct": record.get("coverage_pct"),
+                 "source": record.get("source", "diagnose")})
+            del self._stage_profiles[:-STAGE_PROFILES_KEPT]
         elif op == "replayed":
             self._recovery["journal_replays"] += 1
         else:
@@ -849,6 +882,10 @@ class Dispatcher:
         self._journal_locked({"op": "fencing",
                               "fencing_epoch": self._fencing_epoch,
                               "reason": reason})
+        self._trace_instant("dispatcher.fencing_bump",
+                            fencing_epoch=self._fencing_epoch,
+                            reason=reason)
+        FLIGHT.set_context(fencing_epoch=self._fencing_epoch)
         logger.info("fencing epoch bumped",
                     fencing_epoch=self._fencing_epoch, reason=reason)
 
@@ -935,11 +972,17 @@ class Dispatcher:
             return False
         self._breaker_open[worker_id] = dict(info)
         self._breaker_opened_at[worker_id] = time.monotonic()
+        self._trace_instant("dispatcher.breaker_open", worker=worker_id,
+                            error=info.get("error"))
         return True
 
     def _breaker_close_locked(self, worker_id):
         self._breaker_opened_at.pop(worker_id, None)
-        return self._breaker_open.pop(worker_id, None) is not None
+        closed = self._breaker_open.pop(worker_id, None) is not None
+        if closed:
+            self._trace_instant("dispatcher.breaker_close",
+                                worker=worker_id)
+        return closed
 
     def _handle_report_breaker(self, header):
         """A client's per-peer circuit breaker tripped on a worker
@@ -1014,6 +1057,8 @@ class Dispatcher:
             return False
         self._brownout_counts[action] += 1
         self._brownout_reason = reason
+        self._trace_instant("dispatcher.brownout", action=action,
+                            level=level, reason=reason)
         return True
 
     def apply_brownout(self, action, level, reason=None):
@@ -1118,6 +1163,8 @@ class Dispatcher:
         self._last_rates.pop(worker_id, None)  # stale signal, never fed
         self._worker_credit_wait.pop(worker_id, None)
         self._credit_wait_window.pop(worker_id, None)
+        self._trace_instant("dispatcher.worker_dead", worker=worker_id,
+                            reason=reason)
         if reason == "lease_expired":
             self._recovery["evictions"] += 1
         else:
@@ -1131,7 +1178,7 @@ class Dispatcher:
 
     def _install_worker_locked(self, worker_id, address, num_pieces,
                                re_register=False, standby=False,
-                               corpus=""):
+                               corpus="", metrics_port=None):
         known = worker_id in self._workers
         # Preserve the lifecycle state of a worker the autoscaler already
         # placed (a heartbeat-healed re-registration must not silently
@@ -1155,6 +1202,11 @@ class Dispatcher:
         }
         if corpus:
             self._workers[worker_id]["corpus"] = corpus
+        if metrics_port is not None:
+            # Advertised at registration (satellite: --metrics-port 0
+            # binds an ephemeral port only the worker knows) so `status`
+            # can point an operator at every scrape endpoint.
+            self._workers[worker_id]["metrics_port"] = int(metrics_port)
         if known or re_register:
             self._recovery["re_registrations"] += 1
         self._worker_leases[worker_id] = (
@@ -1365,6 +1417,9 @@ class Dispatcher:
         self._generation = max(self._generation, generation)
         self._steal_counts_locked(state, from_wid)["out"] += 1
         self._steal_counts_locked(state, to_wid)["in"] += 1
+        self._trace_instant("dispatcher.steal", piece=piece,
+                            src=from_wid, dst=to_wid,
+                            generation=generation)
 
     def _apply_steal_failed_locked(self, client_id, piece, kept_wid,
                                    generation):
@@ -1415,25 +1470,62 @@ class Dispatcher:
             DISPATCHER_REQUESTS.labels("unknown").inc()
             return {"type": "error", "error": f"unknown request {kind!r}"}
         DISPATCHER_REQUESTS.labels(kind).inc()
-        # Deadline propagation (service/resilience.py): a request whose
-        # caller-shipped budget already expired (it sat in the accept
-        # queue / frame reader too long) is refused retryable BEFORE the
-        # handler runs — the caller's retry_with_backoff(deadline_s=)
-        # owns the budget, and work nobody waits for would only deepen
-        # the overload that delayed it.
-        if deadline_expired(arrival_deadline(header)):
-            with self._lock:
-                self._sync_telemetry_locked()
-            return deadline_exceeded_reply(f"dispatcher.{kind}")
+        t_rpc = time.perf_counter()
         try:
+            # Deadline propagation (service/resilience.py): a request
+            # whose caller-shipped budget already expired (it sat in the
+            # accept queue / frame reader too long) is refused retryable
+            # BEFORE the handler runs — the caller's
+            # retry_with_backoff(deadline_s=) owns the budget, and work
+            # nobody waits for would only deepen the overload that
+            # delayed it.
+            if deadline_expired(arrival_deadline(header)):
+                return deadline_exceeded_reply(f"dispatcher.{kind}")
             return handler(header)
         finally:
+            # Every control RPC — ANY handler, present or future — lands
+            # in the span collector through this single wrap point
+            # (tests/test_docs.py's coverage lint pins it), carrying the
+            # caller's propagated trace context so a batch's control
+            # history joins its data-plane spans in one fleet trace.
+            self._record_rpc_span(kind, header, t_rpc)
             # Control-plane rates are a few requests/second at most, so
             # re-deriving the scrapeable gauges (fencing epoch, worker
             # liveness, recovery counters) after every request keeps them
             # exact without littering each mutation site.
             with self._lock:
                 self._sync_telemetry_locked()
+
+    @staticmethod
+    def _record_rpc_span(kind, header, t_start):
+        """One ``dispatcher.<kind>`` span per handled control RPC, with
+        the caller-propagated trace context (``header["trace"]`` —
+        peer identity and optionally the batch id the request acts for)
+        attached as span args. One ``enabled`` read when tracing is off."""
+        collector = tracing.COLLECTOR
+        if not collector.enabled:
+            return
+        ctx = header.get("trace")
+        args = {}
+        if isinstance(ctx, dict):
+            args = {k: v for k, v in ctx.items()
+                    if k in ("peer", "job_id")}
+        bid = ctx.get("bid") if isinstance(ctx, dict) else None
+        collector.record_span(f"dispatcher.{kind}", t_start,
+                              time.perf_counter(), bid=bid,
+                              args=args or None)
+
+    @staticmethod
+    def _trace_instant(name, **args):
+        """A control-plane lifecycle decision as a zero-duration trace
+        marker (+ a flight-recorder note — decisions are exactly the
+        events a postmortem ring must hold). Span emission costs one
+        ``enabled`` read when tracing is off; the flight note is
+        unconditional by design (bounded ring, control-plane rates)."""
+        collector = tracing.COLLECTOR
+        if collector.enabled:
+            collector.instant(name, time.perf_counter(), args=args)
+        FLIGHT.note(name, **args)
 
     def _sync_telemetry_locked(self):
         """Mirror control-plane state into the registry gauges (recovery
@@ -1620,6 +1712,8 @@ class Dispatcher:
             return False
         self._autoscale_counts[action] += 1
         self._mark_dyn_dirty_locked()
+        self._trace_instant("dispatcher.autoscale", action=action,
+                            worker=worker_id)
         return True
 
     def apply_autoscale(self, action, worker_id, reason=None):
@@ -1679,10 +1773,11 @@ class Dispatcher:
                     f"plan has {known_pieces} — all of a corpus's workers "
                     f"must read the same dataset with the same planning "
                     f"config")}
+            metrics_port = header.get("metrics_port")
             self._install_worker_locked(
                 worker_id, [header["host"], int(header["port"])],
                 num_pieces, re_register=re_register, standby=standby,
-                corpus=corpus)
+                corpus=corpus, metrics_port=metrics_port)
             record = {
                 "op": "register_worker", "worker_id": worker_id,
                 "host": header["host"], "port": int(header["port"]),
@@ -1690,6 +1785,8 @@ class Dispatcher:
                 "standby": standby}
             if corpus:
                 record["corpus"] = corpus
+            if metrics_port is not None:
+                record["metrics_port"] = int(metrics_port)
             self._journal_locked(record)
             fencing = self._fencing_epoch
             state = self._workers[worker_id]["state"]
@@ -1852,7 +1949,15 @@ class Dispatcher:
             # heartbeating after the cooldown rejoins the serving set.
             self._maybe_close_breaker_locked(worker_id)
             return {"type": "ok", "fencing_epoch": self._fencing_epoch,
-                    "brownout_level": self._brownout_level}
+                    "brownout_level": self._brownout_level,
+                    # Clock-alignment beacon: this dispatcher's trace-
+                    # timebase "now". The worker wraps the RPC with two
+                    # perf_counter reads and feeds (midpoint, this, RTT)
+                    # to its NTP-style offset estimator.
+                    "dispatcher_time_us": tracing.COLLECTOR.now_us(),
+                    # Fleet-trace arming rides the heartbeat: peers arm
+                    # their collectors and push span rings while true.
+                    "trace": self._trace_armed}
 
     def _handle_client_heartbeat(self, header):
         client_id = header.get("client_id")
@@ -1913,6 +2018,10 @@ class Dispatcher:
                 "brownout_level": self._brownout_level,
                 "credit_scale": self._credit_scale_locked(
                     self._client_job_locked(client_id, header)),
+                # Clock-alignment beacon + fleet-trace arming (same
+                # contract as the worker heartbeat reply).
+                "dispatcher_time_us": tracing.COLLECTOR.now_us(),
+                "trace": self._trace_armed,
             }
 
     def _alive_workers(self, states=("serving", "draining")):
@@ -2463,6 +2572,155 @@ class Dispatcher:
                     out[wid] = payload
         return {"type": "diagnostics", "workers": sorted(workers)}, out
 
+    # -- fleet tracing + stall attribution ---------------------------------
+
+    def _handle_trace(self, header):
+        """The fleet-trace control RPC (``docs/guides/diagnostics.md``):
+
+        - ``arm`` — arm this process's collector and start telling peers
+          (via heartbeat replies) to arm theirs and push span rings;
+        - ``collect`` — return the dispatcher's own ring plus every
+          peer buffer pushed so far, topped up by one live pull from
+          each registered worker (peers that have not heartbeated since
+          their last production). The caller (CLI) merges them with the
+          shipped clock offsets into one Perfetto-loadable trace;
+        - ``disarm`` — release the collector and stop the fleet arming.
+
+        Runtime-only state: tracing never touches the journal — a
+        restarted dispatcher comes back disarmed, peers notice on their
+        next heartbeat."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from petastorm_tpu.reader_impl.framed_socket import (
+            FramedConnection,
+        )
+
+        action = str(header.get("action", "collect"))
+        if action == "arm":
+            with self._lock:
+                fresh = not self._trace_armed
+                if fresh:
+                    self._trace_armed = True
+                    self._trace_buffers = {}
+            if fresh:
+                tracing.COLLECTOR.acquire()
+                logger.info("fleet tracing ARMED — peers arm on their "
+                            "next heartbeat")
+            return {"type": "ok", "armed": True, "fresh": fresh}
+        if action == "disarm":
+            with self._lock:
+                was = self._trace_armed
+                self._trace_armed = False
+            if was:
+                tracing.COLLECTOR.release()
+                logger.info("fleet tracing disarmed")
+            return {"type": "ok", "armed": False}
+        if action != "collect":
+            return {"type": "error",
+                    "error": f"unknown trace action {action!r}"}
+        timeout = self._probe_timeout(header)
+        with self._lock:
+            workers = {
+                wid: tuple(w["address"])
+                for wid, w in self._alive_workers(
+                    ("serving", "draining", "standby")).items()}
+            buffers = {peer: {"events": list(buf["events"]),
+                              "dropped": buf["dropped"],
+                              "offset_us": buf.get("offset_us"),
+                              "min_rtt_us": buf.get("min_rtt_us")}
+                       for peer, buf in self._trace_buffers.items()}
+            armed = self._trace_armed
+
+        def scoop(address):
+            """One live pull of a worker's not-yet-pushed span ring (the
+            worker ships-and-clears, so pushes and scoops never hand the
+            same event over twice)."""
+            try:
+                with FramedConnection.connect(address,
+                                              timeout=timeout) as conn:
+                    reply, _ = conn.request({"type": "trace"})
+                return reply
+            except (ConnectionError, OSError) as exc:
+                return {"error": f"unreachable: {exc}"}
+
+        if workers:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(workers))) as pool:
+                for wid, reply in zip(workers,
+                                      pool.map(scoop, workers.values())):
+                    if not isinstance(reply, dict) or "error" in reply:
+                        continue
+                    buf = buffers.setdefault(
+                        wid, {"events": [], "dropped": 0,
+                              "offset_us": None, "min_rtt_us": None})
+                    buf["events"].extend(reply.get("events") or [])
+                    buf["dropped"] += int(reply.get("dropped") or 0)
+                    if reply.get("offset_us") is not None:
+                        buf["offset_us"] = reply["offset_us"]
+                    if reply.get("min_rtt_us") is not None:
+                        buf["min_rtt_us"] = reply["min_rtt_us"]
+        local = tracing.COLLECTOR.events()
+        shipped = len(local) + sum(len(b["events"])
+                                   for b in buffers.values())
+        TRACE_SHIP_EVENTS.labels("collect").inc(shipped)
+        return ({"type": "trace", "armed": armed},
+                {"local": {"events": local,
+                           "dropped": tracing.COLLECTOR.dropped},
+                 "peers": buffers})
+
+    def _handle_trace_push(self, header):
+        """An armed peer shipping its span ring (heartbeat-paced,
+        ship-and-clear peer-side, so no event arrives twice). The buffer
+        is bounded per peer by the collector's own ring budget; overflow
+        counts into the peer's ``dropped`` so the assembled trace admits
+        the gap instead of hiding it."""
+        peer = str(header.get("peer") or "?")
+        events = header.get("events") or []
+        offset_us = header.get("offset_us")
+        with self._lock:
+            if not self._trace_armed:
+                # Raced a disarm (or a dispatcher restart): drop the
+                # batch and tell the peer to stand down.
+                return {"type": "ok", "trace": False, "accepted": 0}
+            buf = self._trace_buffers.setdefault(
+                peer, {"events": [], "dropped": 0, "offset_us": None,
+                       "min_rtt_us": None})
+            room = tracing.DEFAULT_MAX_EVENTS - len(buf["events"])
+            accepted = events[:max(0, room)]
+            buf["events"].extend(accepted)
+            buf["dropped"] += (int(header.get("dropped") or 0)
+                               + len(events) - len(accepted))
+            if offset_us is not None:
+                buf["offset_us"] = float(offset_us)
+                CLOCK_OFFSET_US.labels(peer).set(float(offset_us))
+            if header.get("min_rtt_us") is not None:
+                buf["min_rtt_us"] = float(header["min_rtt_us"])
+        TRACE_SHIP_EVENTS.labels("push").inc(len(accepted))
+        return {"type": "ok", "trace": True, "accepted": len(accepted)}
+
+    def _handle_stage_profile(self, header):
+        """``diagnose`` posting its computed per-stage profile: journaled
+        (a WAL op like every durable mutation) and kept in a bounded
+        in-memory head — the replayable feed ROADMAP's model-based fleet
+        planner fits its throughput model on."""
+        profile = header.get("profile")
+        if not isinstance(profile, dict):
+            return {"type": "error",
+                    "error": "stage_profile requires a profile dict"}
+        entry = {"profile": profile,
+                 "coverage_pct": header.get("coverage_pct"),
+                 "source": str(header.get("source", "diagnose"))}
+        with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            self._stage_profiles.append(entry)
+            del self._stage_profiles[:-STAGE_PROFILES_KEPT]
+            self._journal_locked(dict(entry, op="stage_profile"))
+        logger.info("stage profile journaled (%d stages, coverage %s)",
+                    len(profile), entry["coverage_pct"])
+        return {"type": "ok", "kept": len(self._stage_profiles)}
+
     @staticmethod
     def _probe_timeout(header):
         """Clamp the client-supplied per-probe timeout to a sane range: a
@@ -2506,10 +2764,21 @@ class Dispatcher:
                     wid: {"address": w["address"],
                           "alive": w["alive"],
                           "state": w.get("state", "serving"),
+                          "metrics_port": w.get("metrics_port"),
                           "lease_expires_in_s": (
                               round(self._worker_leases[wid] - now, 3)
                               if wid in self._worker_leases else None)}
                     for wid, w in self._workers.items()},
+                # The observability plane's own state: whether fleet
+                # tracing is armed, where THIS process's scrape endpoint
+                # landed (ephemeral --metrics-port 0 included), and how
+                # many journaled stage profiles the planner can read.
+                "observability": {
+                    "trace_armed": self._trace_armed,
+                    "trace_peers": sorted(self._trace_buffers),
+                    "metrics_address": self.metrics_address,
+                    "stage_profiles": list(self._stage_profiles),
+                },
                 "clients": {cid: dict(c) for cid, c in self._clients.items()},
                 # Fleet tier: job objects with scoped fencing, fair
                 # shares, per-job recovery breakout, and the autoscaler's

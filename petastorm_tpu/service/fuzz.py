@@ -230,6 +230,14 @@ def fuzz(seeds, run_fn=None, shrink=True, check_determinism=True,
                                    timeout_s=timeout_s)
         failure = {"seed": seed, "error": error, "points": points,
                    "reproducer": reproducer_command(seed, points)}
+        # Attach the flight recorder's ring (telemetry/flight.py): the
+        # scenario's structured event log right up to the violation —
+        # what the fleet was DOING when the shrunk reproducer fails,
+        # correlated by fencing epoch + batch id.
+        from petastorm_tpu.telemetry.flight import RECORDER
+
+        failure["flight_dump"] = RECORDER.dump(
+            f"fuzz-seed-{seed}")
         report["failures"].append(failure)
         logger.error("FUZZ REPRODUCER: %s (%s)", failure["reproducer"],
                      error)

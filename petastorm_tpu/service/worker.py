@@ -32,6 +32,8 @@ from petastorm_tpu.reader_impl.framed_socket import (
     send_framed,
 )
 from petastorm_tpu.telemetry import tracing
+from petastorm_tpu.telemetry.clockalign import OffsetEstimator
+from petastorm_tpu.telemetry.flight import RECORDER as FLIGHT
 from petastorm_tpu.telemetry.log import service_logger
 from petastorm_tpu.telemetry.metrics import (
     COLUMNAR_BATCHES,
@@ -201,7 +203,8 @@ class BatchWorker:
                  batch_delay_s=0.0, heartbeat_interval_s=5.0,
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  batch_cache=None, batch_transform=None, standby=False,
-                 on_piece_error="fail", corpus="", transport=None):
+                 on_piece_error="fail", corpus="", transport=None,
+                 metrics_port=None):
         from petastorm_tpu.service.transport import resolve_mode
 
         if on_piece_error not in ("fail", "quarantine"):
@@ -301,6 +304,22 @@ class BatchWorker:
             "row_fallback": COLUMNAR_BATCHES.labels(self.worker_id,
                                                     "row_fallback"),
         }
+        # Scrape-endpoint advertisement (satellite: --metrics-port 0 binds
+        # ephemerally; the CLI hands the CHOSEN port here before start()
+        # so registration carries it and `status` can surface it).
+        self.metrics_port = (int(metrics_port)
+                             if metrics_port is not None else None)
+        # Fleet-clock alignment: NTP-style offset samples taken around
+        # each heartbeat RPC (docs/guides/diagnostics.md#clock-alignment),
+        # shipped with pushed trace rings so the dispatcher merges spans
+        # onto one timeline.
+        self._clock = OffsetEstimator()
+        # True while the dispatcher's heartbeat replies say fleet tracing
+        # is armed — this worker's collector records and its ring is
+        # shipped-and-cleared (push or live scoop). Local-only arming
+        # (an in-process scenario exporting its own trace) leaves this
+        # False and the ring is then read without clearing.
+        self._trace_armed_remote = False
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self._heartbeat_paused = threading.Event()  # test hook: hung worker
@@ -393,6 +412,11 @@ class BatchWorker:
             self._frame_pool = None
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=drain_timeout_s)
+        if self._trace_armed_remote:
+            # Balance the beacon's acquire — an in-process worker must
+            # not leave the shared collector armed past its lifetime.
+            self._trace_armed_remote = False
+            tracing.COLLECTOR.release()
 
     def kill(self):
         """Abrupt failure injection (tests): drop every open connection
@@ -448,7 +472,7 @@ class BatchWorker:
 
     def _register(self, re_register=False, retries=None):
         host, port = self.address
-        reply = self._control_rpc({
+        payload = {
             "type": "register_worker",
             "worker_id": self.worker_id,
             "host": host,
@@ -457,12 +481,20 @@ class BatchWorker:
             "re_register": re_register,
             "standby": self._standby,
             "corpus": self.corpus,
-        }, description=f"worker {self.worker_id} registration",
+        }
+        if self.metrics_port is not None:
+            payload["metrics_port"] = self.metrics_port
+        reply = self._control_rpc(
+            payload, description=f"worker {self.worker_id} registration",
             retries=retries)
         if reply.get("type") != "ok":
             raise RuntimeError(
                 f"dispatcher rejected registration: "
                 f"{reply.get('error', reply)}")
+        FLIGHT.set_context(role="worker", worker_id=self.worker_id,
+                           fencing_epoch=reply.get("fencing_epoch"))
+        FLIGHT.note("worker.registered", re_register=re_register,
+                    state=reply.get("state"))
         return reply
 
     def _control_rpc(self, header, description, retries=None):
@@ -473,6 +505,11 @@ class BatchWorker:
         not wait out a backoff budget against a dead dispatcher."""
         from petastorm_tpu.reader_impl.framed_socket import FramedConnection
         from petastorm_tpu.utils import retry_with_backoff
+
+        # Propagated trace context: the dispatcher's RPC span records who
+        # called, joining this worker's data-plane spans in the fleet
+        # trace (docs/guides/diagnostics.md#fleet-tracing).
+        header.setdefault("trace", {"peer": self.worker_id})
 
         def attempt():
             with FramedConnection.connect(self._dispatcher_address,
@@ -511,6 +548,12 @@ class BatchWorker:
                 continue  # injected lost tick: the lease absorbs it (or
                 #   expires and the re-registration path heals)
             try:
+                # retries=0 → exactly one dial, so [t0, t1] brackets one
+                # request/reply round trip: the NTP-style clock sample
+                # (offset = dispatcher clock − RTT midpoint, error ≤
+                # RTT/2) that aligns this worker's spans in the merged
+                # fleet trace.
+                t0 = time.perf_counter()
                 reply = self._control_rpc(
                     {"type": "worker_heartbeat", "worker_id": self.worker_id,
                      # Overload signal feed: cumulative seconds the serve
@@ -520,8 +563,15 @@ class BatchWorker:
                      "credit_wait_s": round(self._m_credit_wait.value, 4)},
                     description=f"worker {self.worker_id} heartbeat",
                     retries=0)
+                t1 = time.perf_counter()
             except (OSError, ProtocolError):
                 continue  # dispatcher down/desynced: retry next tick
+            remote_us = reply.get("dispatcher_time_us")
+            if remote_us is not None:
+                self._clock.add(
+                    tracing.COLLECTOR.ts_us((t0 + t1) / 2.0),
+                    float(remote_us), (t1 - t0) * 1e6)
+            self._sync_trace_arming(bool(reply.get("trace")))
             if "brownout_level" in reply:
                 from petastorm_tpu.service.resilience import \
                     note_brownout_level
@@ -540,6 +590,59 @@ class BatchWorker:
                     self._register(re_register=True, retries=0)
                 except (OSError, RuntimeError, ProtocolError):
                     continue  # registration retried on the next tick
+
+    # -- fleet tracing -----------------------------------------------------
+
+    def _sync_trace_arming(self, armed):
+        """Follow the dispatcher's heartbeat-borne tracing beacon: arm the
+        local span collector when the fleet arms, push the accumulated
+        ring (ship-and-clear, so nothing is ever sent twice) with the
+        current clock offset each armed tick, release on disarm.
+        Shipping is best-effort — a failed push loses that tick's spans,
+        which the assembled trace's per-peer ``dropped`` does NOT count
+        (the dispatcher never saw them); heartbeat cadence keeps the
+        exposure to one tick."""
+        if armed and not self._trace_armed_remote:
+            self._trace_armed_remote = True
+            tracing.COLLECTOR.acquire()
+            FLIGHT.note("worker.trace_armed")
+            self._log.info("fleet tracing armed by dispatcher beacon")
+        elif not armed and self._trace_armed_remote:
+            self._trace_armed_remote = False
+            tracing.COLLECTOR.release()
+            self._log.info("fleet tracing disarmed")
+            return
+        if not self._trace_armed_remote:
+            return
+        events, dropped = tracing.COLLECTOR.ship()
+        if not events and not dropped:
+            return
+        try:
+            self._control_rpc(
+                {"type": "trace_push", "peer": self.worker_id,
+                 "events": events, "dropped": dropped,
+                 "offset_us": self._clock.offset_us(),
+                 "min_rtt_us": self._clock.min_rtt_us()},
+                description=f"worker {self.worker_id} trace push",
+                retries=0)
+        except (OSError, ProtocolError):
+            pass  # best-effort: next tick ships the new ring
+
+    def _trace_snapshot(self):
+        """One live pull of this worker's span ring, for the dispatcher's
+        ``trace collect`` scoop. Remote-armed: ship-and-clear (a later
+        heartbeat push must not re-send these events). Only locally
+        armed (a scenario exporting its own trace): read WITHOUT
+        clearing — the scoop must not steal the local exporter's ring."""
+        if self._trace_armed_remote:
+            events, dropped = tracing.COLLECTOR.ship()
+        else:
+            events = tracing.COLLECTOR.events()
+            dropped = tracing.COLLECTOR.dropped
+        return {"type": "trace", "worker_id": self.worker_id,
+                "events": events, "dropped": dropped,
+                "offset_us": self._clock.offset_us(),
+                "min_rtt_us": self._clock.min_rtt_us()}
 
     # -- serving -----------------------------------------------------------
 
@@ -560,6 +663,8 @@ class BatchWorker:
                 send_framed(sock, {"type": "diagnostics",
                                    "worker_id": self.worker_id},
                             self.diagnostics_snapshot())
+            elif kind == "trace":
+                send_framed(sock, self._trace_snapshot())
             elif kind == "ping":
                 send_framed(sock, {"type": "pong",
                                    "worker_id": self.worker_id})

@@ -576,6 +576,37 @@ MIXTURE_WEIGHT_RELOADS = REGISTRY.counter(
     "(journaled set_mixture_weights entries + reweight-policy "
     "exhaustions)")
 
+# -- fleet observability: trace shipping, clock alignment, flight
+#    recorder (telemetry/tracing.py, clockalign.py, flight.py) ----------------
+
+TRACE_SHIP_EVENTS = REGISTRY.counter(
+    "petastorm_trace_ship_events_total",
+    "Trace events moved by the fleet trace-assembly protocol, by "
+    "direction (push = a peer shipped its span ring to the dispatcher "
+    "on a heartbeat tick; collect = events handed to a `trace collect` "
+    "caller, the dispatcher's own ring included)",
+    labels=("direction",))
+CLOCK_OFFSET_US = REGISTRY.gauge(
+    "petastorm_clock_offset_us",
+    "Each peer's estimated clock offset against the dispatcher's trace "
+    "timebase (NTP-style midpoint over heartbeat RTTs, median of the "
+    "lowest-RTT samples; microseconds, applied to the peer's events at "
+    "fleet-trace merge). Error bound is ±min-RTT/2 — see "
+    "docs/guides/diagnostics.md#clock-alignment",
+    labels=("peer",))
+FLIGHT_EVENTS = REGISTRY.counter(
+    "petastorm_flight_events_total",
+    "Structured events noted into this process's flight-recorder ring "
+    "(always on, bounded; the ring holds only the most recent ones — "
+    "this counter is the lifetime total)")
+FLIGHT_DUMPS = REGISTRY.counter(
+    "petastorm_flight_dumps_total",
+    "Flight-recorder rings dumped to disk, by reason (invariant "
+    "violation, thread-crash, sigusr2, fuzz failure attachment; "
+    "write_failed counts dumps that could not be persisted). Nonzero "
+    "outside a chaos run means a real incident left a postmortem file",
+    labels=("reason",))
+
 # -- reader / worker pools / ventilator --------------------------------------
 
 READER_READERS = REGISTRY.counter(

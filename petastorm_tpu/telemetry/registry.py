@@ -430,7 +430,12 @@ class SnapshotRing:
         old = self._series_value(oldest, name, labels)
         if new is None or old is None:
             return None
-        return (new - old) / (t1 - t0)
+        # Counters only move up within one process lifetime; a NEGATIVE
+        # delta means the producer restarted and its counter reset to
+        # zero mid-window. Clamp instead of reporting a negative fleet
+        # rate in `status --watch` — the restart window's rate is
+        # unknowable, and 0 is the honest floor.
+        return max(0.0, new - old) / (t1 - t0)
 
 
 #: The process-default registry every family in

@@ -95,6 +95,19 @@ class TraceCollector:
     def _ts_us(self, t):
         return (self._epoch + t) * 1e6
 
+    def ts_us(self, t):
+        """A ``time.perf_counter()`` reading in this collector's trace
+        timebase (wall-anchored microseconds) — the unit every event's
+        ``ts`` is denominated in. Public so clock alignment can convert
+        RPC midpoints into the same axis the merged trace renders on."""
+        return self._ts_us(t)
+
+    def now_us(self):
+        """The current instant in the trace timebase. Shipped in control
+        replies (``dispatcher_time_us``) so peers can estimate their
+        offset against the dispatcher's axis NTP-style."""
+        return self._ts_us(time.perf_counter())
+
     def record_span(self, name, t_start, t_end, bid=None, args=None,
                     tid=None):
         """One completed span as a B/E event pair. ``t_start``/``t_end``
@@ -120,14 +133,19 @@ class TraceCollector:
             self._events.append(begin)
             self._events.append(end)
 
-    def instant(self, name, t, bid=None):
-        """A zero-duration marker (``ph: i``) — queue handoffs, fences."""
+    def instant(self, name, t, bid=None, args=None):
+        """A zero-duration marker (``ph: i``) — queue handoffs, fences,
+        control-plane lifecycle decisions (breaker trips, brownout
+        stages, fencing bumps carry their detail in ``args``)."""
         if not self.enabled:
             return
+        event_args = dict(args or {})
+        if bid is not None:
+            event_args["bid"] = bid
         event = {"name": name, "cat": "petastorm", "ph": "i", "s": "t",
                  "ts": self._ts_us(t), "pid": os.getpid(),
                  "tid": threading.get_ident() % 1_000_000,
-                 "args": ({"bid": bid} if bid is not None else {})}
+                 "args": event_args}
         with self._lock:
             if len(self._events) >= self._max_events:
                 self._dropped += 1
@@ -137,6 +155,17 @@ class TraceCollector:
     def events(self):
         with self._lock:
             return list(self._events)
+
+    def ship(self):
+        """Atomically take-and-clear the buffered events (with the drop
+        count) — the trace-shipping primitive: an armed peer pushes its
+        ring to the dispatcher on each heartbeat tick and keeps
+        recording into an empty buffer, so no event is ever shipped
+        twice and the ring never grows past one tick's production."""
+        with self._lock:
+            events, self._events = self._events, []
+            dropped, self._dropped = self._dropped, 0
+        return events, dropped
 
     @property
     def dropped(self):
@@ -174,3 +203,11 @@ def record_span(name, t_start, t_end, bid=None, args=None):
 
 def export(path):
     return COLLECTOR.export(path)
+
+
+def wall_us():
+    """The process's current wall-anchored trace timestamp (µs) from the
+    default collector — the one sanctioned wall-clock read outside this
+    module (the flight recorder stamps its ring entries with it so dumps
+    from different processes correlate on one axis)."""
+    return COLLECTOR.now_us()

@@ -237,6 +237,51 @@ def test_no_raw_sleep_retry_loops_in_service_trees():
         + "; ".join(offenders))
 
 
+def test_dispatcher_rpc_span_coverage():
+    """Observability-coverage lint: EVERY dispatcher control-RPC handler
+    must land in the span collector. The dispatcher achieves that with a
+    single wrap point — ``_handle``'s ``finally`` calls
+    ``_record_rpc_span`` around whatever ``_handle_<kind>`` ran — so the
+    lint pins two facts: (1) the wrap point exists, and (2) no code path
+    invokes a ``self._handle_xyz(...)`` handler directly, bypassing the
+    wrap. A future handler then cannot ship unspanned, because the only
+    route to it runs through ``_handle``."""
+    src = (REPO / "petastorm_tpu" / "service"
+           / "dispatcher.py").read_text()
+    handle_body = re.search(
+        r"\n    def _handle\(self, header\):\n(.*?)\n    (?:@|def )",
+        src, re.DOTALL)
+    assert handle_body is not None, "_handle not found in dispatcher.py"
+    assert "finally:" in handle_body.group(1) \
+        and "_record_rpc_span" in handle_body.group(1), (
+            "_handle must record the RPC span in a finally block — the "
+            "single wrap point every control RPC's span rides through")
+    bypasses = []
+    for lineno, line in enumerate(src.splitlines(), 1):
+        code = line.split("#", 1)[0]
+        if re.search(r"\bself\._handle_\w+\s*\(", code):
+            bypasses.append(f"dispatcher.py:{lineno}: {line.strip()}")
+    assert not bypasses, (
+        "direct self._handle_<kind>(...) calls bypass _handle's span "
+        "wrap — route the request through _handle so its RPC span (and "
+        "telemetry sync) still fire: " + "; ".join(bypasses))
+
+
+def test_new_telemetry_modules_covered_by_wall_clock_lint():
+    """The observability plane's new modules must stay inside the
+    wall-clock ban's scan (they are timestamp-heavy — exactly where a
+    stray ``time.time()`` would creep in). ``tracing.wall_us()`` is the
+    one sanctioned wall-clock read; everything else derives timestamps
+    from it or from ``perf_counter``."""
+    for rel in ("petastorm_tpu/telemetry/flight.py",
+                "petastorm_tpu/telemetry/clockalign.py",
+                "petastorm_tpu/telemetry/critical_path.py"):
+        assert (REPO / rel).is_file(), f"{rel} missing"
+        assert rel not in _WALL_CLOCK_ALLOWED, (
+            f"{rel} must not be allow-listed from the wall-clock lint — "
+            f"route wall-clock needs through tracing.wall_us()")
+
+
 def test_documented_apis_exist():
     """Spot-check that names the docs teach are importable."""
     from petastorm_tpu import (  # noqa: F401

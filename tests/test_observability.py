@@ -1,0 +1,497 @@
+"""Observability plane tests: NTP-style clock alignment, fleet-trace
+assembly (schema golden), critical-path stall attribution, the crash-safe
+flight recorder, the dispatcher's trace/stage-profile RPCs, and the
+``trace`` / ``diagnose`` CLI surfaces
+(docs/guides/diagnostics.md#fleet-tracing)."""
+
+import glob
+import json
+import os
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+from petastorm_tpu.service import Dispatcher
+from petastorm_tpu.telemetry import critical_path, flight
+from petastorm_tpu.telemetry.clockalign import (
+    OffsetEstimator,
+    assemble_fleet_trace,
+    process_name_metadata,
+    shift_events,
+)
+from petastorm_tpu.telemetry.flight import FlightRecorder
+from petastorm_tpu.telemetry.registry import MetricsRegistry, SnapshotRing
+
+
+def _request(address, header):
+    with FramedConnection.connect(address) as conn:
+        reply, _ = conn.request(header)
+    return reply
+
+
+def _request_with_payload(address, header):
+    with FramedConnection.connect(address) as conn:
+        return conn.request(header)
+
+
+def _span(name, pid, ts, dur, tid=1, bid=None):
+    """One fabricated B/E pair in Chrome trace_event form."""
+    args = {"bid": bid} if bid is not None else {}
+    return [
+        {"name": name, "ph": "B", "pid": pid, "tid": tid, "ts": ts,
+         "args": args},
+        {"name": name, "ph": "E", "pid": pid, "tid": tid, "ts": ts + dur},
+    ]
+
+
+# --- clock alignment (telemetry/clockalign.py) -----------------------------
+
+def test_offset_estimator_empty_and_window_bound():
+    est = OffsetEstimator(max_samples=16)
+    assert est.offset_us() is None
+    assert est.min_rtt_us() is None
+    for i in range(100):
+        est.add(0.0, 1000.0, 50.0 + i)
+    assert len(est) == 16
+
+
+def test_offset_estimator_converges_under_jitter():
+    """Seeded jitter: the true skew is 5 ms; low-RTT samples carry small
+    symmetric noise, high-RTT samples (queueing) carry error up to
+    ±RTT/2. The best-k median must land on the true offset within the
+    low-RTT population's noise, not the jittery average."""
+    rng = random.Random(7)
+    true_offset = 5000.0
+    est = OffsetEstimator()
+    for _ in range(50):
+        if rng.random() < 0.3:
+            rtt = rng.uniform(80.0, 120.0)       # tight round-trips
+            noise = rng.uniform(-10.0, 10.0)
+        else:
+            rtt = rng.uniform(500.0, 5000.0)     # congested: asymmetric
+            noise = rng.uniform(-rtt / 2.0, rtt / 2.0)
+        est.add(local_mid_us=0.0, remote_us=true_offset + noise,
+                rtt_us=rtt)
+    assert est.offset_us() == pytest.approx(true_offset, abs=15.0)
+    assert est.min_rtt_us() < 150.0
+
+
+def test_offset_estimator_median_rejects_low_rtt_outlier():
+    est = OffsetEstimator(best_k=5)
+    for i in range(4):
+        est.add(0.0, 1000.0 + i, 50.0 + i)
+    est.add(0.0, 99999.0, 49.0)  # tightest RTT, wild offset
+    assert est.offset_us() < 2000.0  # median of best-5 ignores the wild one
+
+
+def test_shift_events_and_process_name_metadata():
+    events = _span("worker.decode", pid=7, ts=100.0, dur=50.0)
+    shifted = shift_events(events, 1000.0)
+    assert [e["ts"] for e in shifted] == [1100.0, 1150.0]
+    assert [e["ts"] for e in events] == [100.0, 150.0]  # copies, not moves
+    assert shift_events(events, None) == events
+    assert shift_events(events, 0) == events
+    meta = process_name_metadata(events, "worker-a")
+    assert meta == [{"name": "process_name", "ph": "M", "pid": 7,
+                     "args": {"name": "worker-a"}}]
+
+
+def test_assemble_fleet_trace_schema_golden():
+    """The collected document's shape is a contract (Perfetto loads it,
+    ``diagnose --trace`` re-reads it): top-level keys, sorted events,
+    per-pid process_name metadata, per-peer clock_alignment, and summed
+    dropped counts."""
+    local = _span("dispatcher.status", pid=1, ts=500.0, dur=10.0)
+    peers = {
+        "worker-a": {"events": _span("worker.decode", 2, 100.0, 40.0),
+                     "offset_us": 1000.0, "dropped": 2,
+                     "min_rtt_us": 80.0},
+        "client-b": {"events": _span("client.recv", 3, 600.0, 5.0),
+                     "offset_us": None, "dropped": 0,
+                     "min_rtt_us": None},
+    }
+    doc = assemble_fleet_trace(local, peers, local_dropped=1)
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert doc["displayTimeUnit"] == "ms"
+    ts = [e.get("ts", 0.0) for e in doc["traceEvents"]]
+    assert ts == sorted(ts)
+    # worker-a's events were shifted onto the local axis by its offset.
+    decode = [e for e in doc["traceEvents"]
+              if e.get("name") == "worker.decode" and e.get("ph") == "B"]
+    assert decode[0]["ts"] == 1100.0
+    # client-b (no offset estimate yet) passes through unshifted.
+    recv = [e for e in doc["traceEvents"]
+            if e.get("name") == "client.recv" and e.get("ph") == "B"]
+    assert recv[0]["ts"] == 600.0
+    names = critical_path.process_names(doc["traceEvents"])
+    assert names == {1: "dispatcher", 2: "worker-a", 3: "client-b"}
+    other = doc["otherData"]
+    assert other["dropped_events"] == 3
+    assert other["clock_alignment"] == {
+        "worker-a": {"offset_us": 1000.0, "min_rtt_us": 80.0},
+        "client-b": {"offset_us": None, "min_rtt_us": None},
+    }
+    json.dumps(doc)  # must be directly serializable
+
+
+# --- critical-path stall attribution ---------------------------------------
+
+def test_pair_spans_drops_unbalanced_begins():
+    events = _span("worker.decode", 2, 0.0, 10.0)
+    events.append({"name": "worker.send", "ph": "B", "pid": 2, "tid": 1,
+                   "ts": 5.0})  # still open at export
+    spans = critical_path.pair_spans(events)
+    assert [s["name"] for s in spans] == ["worker.decode"]
+    assert spans[0]["dur"] == 10.0
+
+
+def test_attribution_latest_started_span_wins():
+    """While the consumer waits, both decode (started earlier) and send
+    (started later) are active — the wait is pinned behind the
+    latest-started stage for the sub-window where both overlap."""
+    events = []
+    events += _span("loader.wait", 1, 100.0, 100.0)
+    events += _span("worker.decode", 2, 0.0, 300.0)
+    events += _span("worker.send", 2, 150.0, 100.0)
+    out = critical_path.attribute_stalls(events)
+    assert out["wait_total_us"] == 100.0
+    assert out["unattributed_us"] == 0.0
+    assert out["coverage_pct"] == pytest.approx(100.0)
+    assert out["charges"] == {("worker.decode", 2): pytest.approx(50.0),
+                              ("worker.send", 2): pytest.approx(50.0)}
+
+
+def test_attribution_non_causal_stages_and_residue():
+    """The training step (loader.consumer) and the wait itself are never
+    charged; wait time with nothing causal active is honest residue."""
+    events = []
+    events += _span("loader.wait", 1, 0.0, 100.0)
+    events += _span("loader.consumer", 1, 0.0, 100.0, tid=2)
+    events += _span("worker.decode", 2, 80.0, 50.0)
+    out = critical_path.attribute_stalls(events)
+    assert out["charges"] == {("worker.decode", 2): pytest.approx(20.0)}
+    assert out["unattributed_us"] == pytest.approx(80.0)
+    assert out["coverage_pct"] == pytest.approx(20.0)
+
+
+def test_diagnose_ranks_and_decomposes_measured_stall():
+    events = []
+    events += process_name_metadata(
+        _span("worker.decode", 2, 0.0, 1.0), "worker-a")
+    events += _span("loader.wait", 1, 0.0, 100.0)
+    events += _span("worker.decode", 2, 0.0, 60.0)
+    events += _span("client.queue", 3, 60.0, 30.0)
+    report = critical_path.diagnose(events, measured_stall_pct=50.0)
+    assert [r["stage"] for r in report["bottlenecks"]] == [
+        "worker.decode", "client.queue"]
+    assert report["bottlenecks"][0]["peer"] == "worker-a"
+    assert report["bottlenecks"][1]["peer"] == "pid:3"
+    # shares decompose the measured stall: 60% and 30% of 50.
+    assert report["bottlenecks"][0]["stall_pct"] == pytest.approx(30.0)
+    assert report["bottlenecks"][1]["stall_pct"] == pytest.approx(15.0)
+    assert report["coverage_pct"] == pytest.approx(90.0)
+    profile = report["stage_profile"]
+    assert profile["worker.decode"]["count"] == 1
+    assert profile["loader.wait"]["mean_us"] == pytest.approx(100.0)
+    rendered = critical_path.render(report)
+    assert "worker.decode" in rendered and "worker-a" in rendered
+    assert "(unattributed)" in rendered
+    assert "90.0% attributed" in rendered
+
+
+# --- flight recorder (telemetry/flight.py) ---------------------------------
+
+def test_flight_ring_bounded_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path))
+    rec = FlightRecorder(capacity=8)
+    rec.set_context(role="worker", worker_id="w0", fencing_epoch=3)
+    for i in range(20):
+        rec.note("tick", i=i)
+    assert len(rec.snapshot()) == 8
+    path = rec.dump("invariant: lost rows")
+    assert path is not None and os.path.dirname(path) == str(tmp_path)
+    # reason is sanitized into the filename (no colons/spaces).
+    assert os.path.basename(path) == \
+        f"flight-{os.getpid()}-invariant--lost-rows.json"
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["reason"] == "invariant: lost rows"
+    assert doc["context"] == {"role": "worker", "worker_id": "w0",
+                              "fencing_epoch": 3}
+    assert doc["total_events"] == 20  # how much rolled off is visible
+    assert [e["i"] for e in doc["events"]] == list(range(12, 20))
+    assert all("t_us" in e for e in doc["events"])
+
+
+def test_flight_dump_never_raises_on_write_failure(tmp_path):
+    rec = FlightRecorder()
+    rec.note("x")
+    missing = tmp_path / "no-such-dir" / "dump.json"
+    assert rec.dump("crash", path=str(missing)) is None
+
+
+def test_flight_set_context_none_removes():
+    rec = FlightRecorder()
+    rec.set_context(role="client", job_id="j1")
+    rec.set_context(job_id=None)
+    rec.note("x")
+    path = rec.dump("ctx", path=os.devnull)
+    assert path == os.devnull  # context merge exercised via dump doc above
+
+
+def test_unhandled_thread_exception_dumps_ring(tmp_path, monkeypatch):
+    """The chained threading.excepthook: a service thread dying
+    unhandled leaves a postmortem on disk, named after the thread."""
+    monkeypatch.setenv(flight.DUMP_DIR_ENV, str(tmp_path))
+    rec = flight.install(capture_signals=False)
+    assert flight.install(capture_signals=False) is rec  # idempotent
+    rec.note("before_crash", marker="obs-test")
+
+    def boom():
+        raise ValueError("deliberate")
+
+    thread = threading.Thread(target=boom, name="obs-crash-thread")
+    # Silence the default hook's traceback spew while keeping the chain.
+    monkeypatch.setattr(flight, "_prev_excepthook", lambda a: None)
+    thread.start()
+    thread.join(timeout=10)
+    dumps = glob.glob(str(tmp_path / "flight-*obs-crash-thread*.json"))
+    assert len(dumps) == 1
+    with open(dumps[0], encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["reason"].startswith("thread-crash")
+    events = [e["event"] for e in doc["events"]]
+    assert "unhandled_thread_exception" in events
+
+
+# --- snapshot-ring restart clamp (telemetry/registry.py) -------------------
+
+def test_snapshot_ring_rate_clamps_counter_restart():
+    """A producer restart resets its counters to zero mid-window; the
+    fleet rate must clamp to 0, never go negative."""
+    reg = MetricsRegistry()
+    g = reg.gauge("remote_rows_total", "mirrored remote counter")
+    g.set(100_000)
+    ring = SnapshotRing(reg, interval_s=60.0, capacity=8)
+    ring.take()
+    g.set(50)  # the worker restarted and re-registered
+    time.sleep(0.01)
+    ring.take()
+    assert ring.rate("remote_rows_total") == 0.0
+
+
+# --- dispatcher trace / stage-profile RPCs ---------------------------------
+
+@pytest.mark.service
+def test_trace_arm_push_collect_disarm_cycle():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        addr = disp.address
+        try:
+            reply = _request(addr, {"type": "trace", "action": "arm"})
+            assert reply == {"type": "ok", "armed": True, "fresh": True}
+            # Re-arming is idempotent and keeps the accumulated buffers.
+            reply = _request(addr, {"type": "trace", "action": "arm"})
+            assert reply == {"type": "ok", "armed": True, "fresh": False}
+
+            events = _span("client.recv", pid=9, ts=10.0, dur=5.0)
+            reply = _request(addr, {
+                "type": "trace_push", "peer": "client-x",
+                "trace": {"peer": "client-x"},  # what _control_rpc stamps
+                "events": events, "dropped": 1,
+                "offset_us": 1234.5, "min_rtt_us": 80.0})
+            assert reply == {"type": "ok", "trace": True, "accepted": 2}
+
+            header, payload = _request_with_payload(
+                addr, {"type": "trace", "action": "collect"})
+            assert header == {"type": "trace", "armed": True}
+            buf = payload["peers"]["client-x"]
+            assert buf["events"] == events
+            assert buf["dropped"] == 1
+            assert buf["offset_us"] == 1234.5
+            assert buf["min_rtt_us"] == 80.0
+            # The dispatcher's own armed collector recorded the push RPC
+            # as a control-plane span carrying the peer's trace context.
+            local = payload["local"]["events"]
+            push_spans = [e for e in local
+                          if e.get("name") == "dispatcher.trace_push"
+                          and e.get("ph") == "B"]
+            assert push_spans and \
+                push_spans[0]["args"]["peer"] == "client-x"
+        finally:
+            reply = _request(addr, {"type": "trace", "action": "disarm"})
+        assert reply == {"type": "ok", "armed": False}
+        # A push racing the disarm is refused and tells the peer to
+        # stand down (trace: False) — nothing buffered.
+        reply = _request(addr, {"type": "trace_push", "peer": "client-x",
+                                "events": [], "dropped": 0})
+        assert reply == {"type": "ok", "trace": False, "accepted": 0}
+        reply = _request(addr, {"type": "trace", "action": "bogus"})
+        assert reply["type"] == "error"
+
+
+@pytest.mark.service
+def test_heartbeat_carries_clock_beacon_and_trace_arming():
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        addr = disp.address
+        _request(addr, {"type": "register_worker", "worker_id": "w0",
+                        "host": "127.0.0.1", "port": 1, "num_pieces": 2})
+        reply = _request(addr, {"type": "worker_heartbeat",
+                                "worker_id": "w0"})
+        assert isinstance(reply["dispatcher_time_us"], float)
+        assert reply["trace"] is False
+        try:
+            _request(addr, {"type": "trace", "action": "arm"})
+            reply = _request(addr, {"type": "worker_heartbeat",
+                                    "worker_id": "w0"})
+            assert reply["trace"] is True
+        finally:
+            _request(addr, {"type": "trace", "action": "disarm"})
+
+
+@pytest.mark.service
+def test_trace_collect_skips_unreachable_worker():
+    """The live scoop is best-effort: a registered-but-dead worker
+    costs a connect error, never a failed collect."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()  # nothing listens here now
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        addr = disp.address
+        _request(addr, {"type": "register_worker", "worker_id": "w0",
+                        "host": "127.0.0.1", "port": dead_port,
+                        "num_pieces": 2})
+        try:
+            _request(addr, {"type": "trace", "action": "arm"})
+            header, payload = _request_with_payload(
+                addr, {"type": "trace", "action": "collect",
+                       "timeout": 0.5})
+            assert header["type"] == "trace"
+            assert "w0" not in payload["peers"]  # skipped, not an error
+        finally:
+            _request(addr, {"type": "trace", "action": "disarm"})
+
+
+@pytest.mark.service
+def test_metrics_port_and_stage_profiles_survive_restart(tmp_path):
+    """Satellite plumbing end-to-end: an advertised ephemeral metrics
+    port rides registration into status, and journaled stage profiles
+    replay across a dispatcher restart (tracing arming does NOT)."""
+    journal_dir = str(tmp_path / "journal")
+    profile = {"worker.decode": {"count": 4, "total_us": 400.0,
+                                 "mean_us": 100.0}}
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir).start() as disp:
+        addr = disp.address
+        _request(addr, {"type": "register_worker", "worker_id": "w0",
+                        "host": "127.0.0.1", "port": 1, "num_pieces": 2,
+                        "metrics_port": 9123})
+        try:
+            _request(addr, {"type": "trace", "action": "arm"})
+            reply = _request(addr, {"type": "stage_profile",
+                                    "profile": profile,
+                                    "coverage_pct": 87.5,
+                                    "source": "diagnose"})
+            assert reply == {"type": "ok", "kept": 1}
+            status = _request(addr, {"type": "status"})
+            assert status["workers"]["w0"]["metrics_port"] == 9123
+            obs = status["observability"]
+            assert obs["trace_armed"] is True
+            assert obs["stage_profiles"] == [
+                {"profile": profile, "coverage_pct": 87.5,
+                 "source": "diagnose"}]
+        finally:
+            _request(addr, {"type": "trace", "action": "disarm"})
+    with Dispatcher(port=0, mode="static", num_epochs=1,
+                    journal_dir=journal_dir).start() as restarted:
+        status = _request(restarted.address, {"type": "status"})
+        assert status["workers"]["w0"]["metrics_port"] == 9123
+        obs = status["observability"]
+        assert obs["trace_armed"] is False  # runtime-only, never replayed
+        assert obs["stage_profiles"][0]["profile"] == profile
+        reply = _request(restarted.address, {"type": "stage_profile",
+                                             "profile": "not-a-dict"})
+        assert reply["type"] == "error"
+
+
+# --- CLI: trace collect / diagnose -----------------------------------------
+
+@pytest.mark.service
+def test_cli_trace_collect_writes_perfetto_doc(tmp_path, capsys):
+    from petastorm_tpu.service.cli import run_trace
+
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        addr = disp.address
+        try:
+            assert run_trace(addr, "arm") == 0
+            _request(addr, {
+                "type": "trace_push", "peer": "worker-a",
+                "events": _span("worker.decode", 2, 10.0, 5.0),
+                "dropped": 0, "offset_us": 250.0, "min_rtt_us": 40.0})
+            out = str(tmp_path / "fleet.json")
+            assert run_trace(addr, "collect", out=out) == 0
+        finally:
+            assert run_trace(addr, "disarm") == 0
+    lines = [json.loads(line)
+             for line in capsys.readouterr().out.splitlines()]
+    assert lines[0]["armed"] is True
+    assert lines[1]["trace"] == out
+    assert lines[1]["clock_alignment"]["worker-a"]["offset_us"] == 250.0
+    assert lines[2]["armed"] is False
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["displayTimeUnit"] == "ms"
+    decode = [e for e in doc["traceEvents"]
+              if e.get("name") == "worker.decode" and e.get("ph") == "B"]
+    assert decode[0]["ts"] == 260.0  # shifted by the shipped offset
+    assert any(e.get("ph") == "M" and
+               (e.get("args") or {}).get("name") == "worker-a"
+               for e in doc["traceEvents"])
+
+
+def test_cli_diagnose_offline_trace_file(tmp_path, capsys):
+    from petastorm_tpu.service.cli import run_diagnose
+
+    events = (_span("loader.wait", 1, 0.0, 100.0)
+              + _span("worker.decode", 2, 0.0, 90.0))
+    trace = tmp_path / "t.json"
+    trace.write_text(json.dumps({"traceEvents": events}))
+    assert run_diagnose(trace_path=str(trace), as_json=True,
+                        stall_pct=40.0) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["coverage_pct"] == pytest.approx(90.0)
+    assert report["bottlenecks"][0]["stage"] == "worker.decode"
+    assert report["bottlenecks"][0]["stall_pct"] == pytest.approx(36.0)
+    # human rendering on the same file
+    assert run_diagnose(trace_path=str(trace)) == 0
+    assert "worker.decode" in capsys.readouterr().out
+    # neither a dispatcher nor a trace file is an argument error
+    assert run_diagnose() == 2
+
+
+@pytest.mark.service
+def test_cli_diagnose_live_posts_stage_profile(capsys):
+    from petastorm_tpu.service.cli import run_diagnose
+
+    with Dispatcher(port=0, mode="static", num_epochs=1).start() as disp:
+        addr = disp.address
+        try:
+            _request(addr, {"type": "trace", "action": "arm"})
+            _request(addr, {
+                "type": "trace_push", "peer": "worker-a",
+                "events": (_span("loader.wait", 1, 0.0, 50.0)
+                           + _span("worker.decode", 2, 0.0, 45.0)),
+                "dropped": 0, "offset_us": 0.0})
+            assert run_diagnose(address=addr, as_json=True) == 0
+        finally:
+            _request(addr, {"type": "trace", "action": "disarm"})
+        report = json.loads(capsys.readouterr().out)
+        assert report["stage_profile"]["worker.decode"]["count"] == 1
+        status = _request(addr, {"type": "status"})
+        profiles = status["observability"]["stage_profiles"]
+        assert profiles and profiles[-1]["source"] == "diagnose"
+        assert profiles[-1]["coverage_pct"] == report["coverage_pct"]
