@@ -725,6 +725,429 @@ def leg_multi_tenant(_url):
 
 
 # --------------------------------------------------------------------------
+# Fleet cache tier A/B (docs/guides/caching.md#fleet-cache-tier): a
+# 16-worker 3-job soak with the consistent-hash cache tier armed, drained
+# 3 workers mid-soak WITH warm handoff vs WITHOUT (handoff no-op'd on the
+# same code path). The claims measured: zero cold re-decodes across the
+# drains with handoff (vs nonzero without), per-job ordered digests
+# byte-identical across arms AND across a dispatcher crash+journal-replay
+# restart mid-handoff, remote-warm vs local-warm serve-path rows/s, and
+# the model planner's converged fleet size with its what-if prediction
+# checked against the measured soak throughput (tolerance printed).
+# --------------------------------------------------------------------------
+
+def leg_fleet_cache(_url):
+    import shutil
+    import tempfile
+    import threading
+
+    from petastorm_tpu.benchmark.scenarios import make_tabular_dataset
+    from petastorm_tpu.cache_impl import CacheConfig
+    from petastorm_tpu.service import (BatchWorker, Dispatcher,
+                                       ServiceBatchSource)
+    from petastorm_tpu.service.chaos import StreamDigest
+    from petastorm_tpu.service.fleet import end_job, register_job
+    from petastorm_tpu.service.fleet_model import (WHATIF_TOLERANCE,
+                                                   ModelPlanner,
+                                                   fit_throughput_model,
+                                                   whatif_replay)
+
+    FLEET = 16
+    DRAINS = 3
+    PIECES = 64
+    tmp = tempfile.mkdtemp(prefix="petastorm_tpu_fc_")
+    dataset_url = f"file://{tmp}/ds"
+    rows = make_tabular_dataset(dataset_url, rows=8_000, days=PIECES)
+    jobs = ("fc-job0", "fc-job1", "fc-job2")
+
+    def run_arm(handoff_enabled, restart_mid_handoff):
+        journal_dir = tempfile.mkdtemp(prefix="petastorm_tpu_fc_wal_")
+        holder = []
+        workers = []
+
+        def make_dispatcher(host="127.0.0.1", port=0):
+            return Dispatcher(host=host, port=port, mode="dynamic",
+                              num_epochs=1, journal_dir=journal_dir)
+
+        try:
+            holder.append(make_dispatcher().start())
+            for i in range(FLEET):
+                workers.append(BatchWorker(
+                    dataset_url, dispatcher_address=holder[0].address,
+                    batch_size=256, reader_factory="batch",
+                    worker_id=f"fc-w{i:02d}",
+                    # Snappy heartbeats: the peer ring and the drain-edge
+                    # handoff both ride them.
+                    heartbeat_interval_s=0.25,
+                    batch_cache=CacheConfig(mode="mem",
+                                            mem_mb=256.0).build(),
+                    fleet_cache=True,
+                    reader_kwargs={"workers_count": 1}).start())
+            if not handoff_enabled:
+                # The A/B knob: same fleet, same drains, but the drain
+                # edge ships nothing — the drained workers' warmth dies
+                # with them, exactly what the tier exists to prevent.
+                for worker in workers:
+                    worker._fleet_tier.handoff = lambda: {
+                        "entries": 0, "bytes": 0, "peers": {},
+                        "errors": 0, "torn": False}
+
+            def await_ring(expected):
+                deadline = time.monotonic() + 20.0
+                alive = [w for w in workers
+                         if w.worker_id in expected]
+                while time.monotonic() < deadline:
+                    if all(set(w._fleet_tier.ring_peers()) == expected
+                           for w in alive):
+                        return
+                    time.sleep(0.05)
+                raise RuntimeError(
+                    f"fleet cache ring did not converge on "
+                    f"{sorted(expected)} within 20s")
+
+            await_ring({w.worker_id for w in workers})
+            for job in jobs:
+                register_job(holder[0].address, job, weight=1.0)
+
+            def run_pass(label):
+                results, errors = {}, []
+
+                def one(job):
+                    try:
+                        digest = StreamDigest()
+                        source = ServiceBatchSource(
+                            holder[0].address, job_id=job, ordered=True,
+                            client_id=f"fc-{label}-{job}",
+                            dynamic_sync_interval_s=0.1)
+                        got = 0
+                        for batch in source():
+                            got += len(next(iter(batch.values())))
+                            digest.update(batch)
+                        results[job] = {"rows": got,
+                                        "digest": digest.hexdigest()}
+                    except BaseException as exc:
+                        errors.append((job, exc))
+
+                threads = [threading.Thread(target=one, args=(job,))
+                           for job in jobs]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                if errors:
+                    raise RuntimeError(
+                        f"fleet_cache {label} pass failed: {errors!r}")
+                agg = sum(r["rows"] for r in results.values())
+                return {"digests": {j: results[j]["digest"]
+                                    for j in jobs},
+                        "rows": agg, "wall_s": round(wall, 3),
+                        "rows_per_s": round(agg / wall, 1)}
+
+            def fleet_totals():
+                out = {}
+                for worker in workers:
+                    stats = worker.cache_stats()
+                    for key in ("fills", "remote_hits", "remote_misses",
+                                "remote_errors", "pushes_sent",
+                                "handoff_entries_sent",
+                                "handoff_entries_received"):
+                        out[key] = out.get(key, 0) + stats.get(key, 0)
+                return out
+
+            cold = run_pass("cold")
+            warm = run_pass("warm")
+            warm_stats = fleet_totals()
+
+            # Drain DRAINS workers; with handoff each drain edge ships
+            # the victim's mem tier to the survivors inheriting its ring
+            # segments before its state settles.  A short warm pass after
+            # every drain gives the fleet model one throughput sample per
+            # fleet size under COMPARABLE conditions (all warm, all
+            # post-redistribution) — fitting across the pre-drain pass
+            # would conflate fleet size with the serve-path mix shift.
+            before_drain = fleet_totals()
+            victims = workers[:DRAINS]
+            restarted = False
+            drain_passes = []  # [(n_serving, pass result)]
+            for idx, victim in enumerate(victims):
+                holder[0].drain_worker(victim.worker_id,
+                                       reason="bench fleet_cache")
+                if (restart_mid_handoff and handoff_enabled
+                        and idx == 0):
+                    # Crash the dispatcher while the first handoff is
+                    # IN FLIGHT (entries already moving peer-to-peer)
+                    # and journal-replay it on the same port: warmth
+                    # movement is worker-to-worker, so the control-plane
+                    # crash must not change a single delivered byte.
+                    deadline = time.monotonic() + 20.0
+                    tier = victim._fleet_tier
+                    while (time.monotonic() < deadline
+                           and tier.handoff_entries_sent == 0):
+                        time.sleep(0.005)
+                    host, port = holder[0].address
+                    holder[0].stop()
+                    holder[0] = make_dispatcher(host, port).start()
+                    restarted = True
+                # The handoff thread exists once the victim's heartbeat
+                # sees the drain edge; gone-again means it finished
+                # (no-op arm included — the thread still runs to post
+                # the journal record).
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    thread = victim._handoff_thread
+                    if thread is not None and not thread.is_alive():
+                        break
+                    time.sleep(0.01)
+                else:
+                    raise RuntimeError(
+                        f"drain handoff of {victim.worker_id} did not "
+                        "complete within 20s")
+                survivor_ids = {w.worker_id
+                                for w in workers[idx + 1:]}
+                await_ring(survivor_ids)
+                # Three repeats per fleet size: the passes are short, so
+                # single-pass throughput is noisy — the model fit
+                # averages repeats at the same n, which is what keeps
+                # the what-if gate meaningful instead of judging the
+                # model against scheduler jitter.
+                n_alive = len(survivor_ids)
+                for rep in range(3):
+                    drain_passes.append(
+                        (n_alive,
+                         run_pass(f"post-drain-{n_alive}-{rep}")))
+
+            post = drain_passes[-1][1]
+            after = fleet_totals()
+            cold_refills = after["fills"] - before_drain["fills"]
+
+            # Serve-path microbench on the warm fleet (after the passes,
+            # so promotions here cannot pollute the measured arms):
+            # local-warm = memory-tier re-serves of held entries,
+            # remote-warm = ring fetches of peer-held entries.
+            survivors = workers[DRAINS:]
+            held = {w.worker_id: [k for k, _ in
+                                  w._fleet_tier.local.hot_entries()]
+                    for w in survivors}
+            local_rows, local_s = 0, 0.0
+            for worker in survivors[:4]:
+                tier = worker._fleet_tier
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    for key in held[worker.worker_id]:
+                        entry, _tier = tier.get_tiered(key)
+                        local_rows += entry.rows
+                local_s += time.perf_counter() - t0
+            remote_rows, remote_s = 0, 0.0
+            for worker in survivors[:4]:
+                tier = worker._fleet_tier
+                mine = set(held[worker.worker_id])
+                for peer in survivors[4:8]:
+                    for key in held[peer.worker_id]:
+                        if key in mine or tier._ring.owner(key) \
+                                != peer.worker_id:
+                            continue
+                        t0 = time.perf_counter()
+                        entry, got_tier = tier.get_tiered(key)
+                        remote_s += time.perf_counter() - t0
+                        if got_tier == "remote":
+                            remote_rows += entry.rows
+
+            return {
+                "handoff": handoff_enabled,
+                "dispatcher_restarted_mid_handoff": restarted,
+                "cold": cold, "warm": warm, "post_drain": post,
+                "drain_passes": [
+                    [n, p] for n, p in drain_passes],
+                "cold_refills_across_drains": cold_refills,
+                "fleet_stats": after,
+                "warm_remote_hits": warm_stats["remote_hits"],
+                "serve_path_rows_per_s": {
+                    "local_warm": (round(local_rows / local_s, 1)
+                                   if local_s else None),
+                    "remote_warm": (round(remote_rows / remote_s, 1)
+                                    if remote_s and remote_rows
+                                    else None),
+                },
+                # Live handles, stripped before the leg returns JSON.
+                "_holder": holder, "_workers": workers,
+                "_journal_dir": journal_dir,
+            }
+        except BaseException:
+            if holder:
+                for job in jobs:
+                    end_job(holder[0].address, job)
+            for worker in workers:
+                worker.stop()
+            if holder:
+                holder[0].stop()
+            shutil.rmtree(journal_dir, ignore_errors=True)
+            raise
+
+    def teardown(arm):
+        for job in jobs:
+            end_job(arm["_holder"][0].address, job)
+        for worker in arm["_workers"]:
+            worker.stop()
+        arm["_holder"][0].stop()
+        shutil.rmtree(arm["_journal_dir"], ignore_errors=True)
+
+    with_handoff = None
+    without_handoff = None
+    try:
+        with_handoff = run_arm(handoff_enabled=True,
+                               restart_mid_handoff=True)
+
+        # Planner: fit the throughput model from the soak's real
+        # samples (16 serving warm, 16-DRAINS post-drain), then let the
+        # ModelPlanner converge the fleet size from 16 — every decision
+        # journaled as a fleet_plan WAL record through the live
+        # dispatcher, like the controller would.
+        dispatcher = with_handoff["_holder"][0]
+        # Fit the fleet model from the post-drain passes only: every
+        # drain was followed by a short warm pass, so each sample is a
+        # (fleet size, rows/s) point under comparable conditions (all
+        # warm, all post-redistribution).  Mixing in the pre-drain warm
+        # pass would conflate fleet size with the serve-path mix shift
+        # that the first drain introduces.
+        samples = [(n, p["rows_per_s"])
+                   for n, p in with_handoff["drain_passes"]]
+        planner = ModelPlanner(probe_windows=1)
+        for n, rate in samples:
+            planner.observe(n, rate)
+        model = fit_throughput_model(planner.samples)
+        serving = [f"fc-w{i:02d}" for i in range(FLEET)]
+        standby = ["fc-standby"]
+        journaled = 0
+        for _ in range(32):
+            # rates={} keeps the simulation from feeding synthetic
+            # throughput back into the planner's sample set — only the
+            # measured soak samples above drive the fitted model.
+            decisions = planner.plan(
+                {"serving": serving, "standby": standby,
+                 "draining": [], "backlog": {},
+                 "rates": {}})
+            acted = False
+            for decision in decisions:
+                dispatcher.record_fleet_plan(decision)
+                journaled += 1
+                if decision["action"] == "admit":
+                    standby.remove(decision["worker_id"])
+                    serving.append(decision["worker_id"])
+                    acted = True
+                elif decision["action"] == "drain":
+                    serving.remove(decision["worker_id"])
+                    standby.append(decision["worker_id"])
+                    acted = True
+            if (not acted and planner._probe is None
+                    and planner._cooldown == 0):
+                break
+        converged = len(serving)
+        predicted = model.predict(converged)
+        # Judge the model at the nearest fleet size the soak actually
+        # ran, against the MEAN over that size's repeat passes (the same
+        # aggregation the fit uses); gate the leg on the what-if
+        # replay's median relative error — the planner's own validation
+        # mechanism — so one jittery pass can't fail the bench while a
+        # genuinely mis-fit model still does.
+        rate_means = {}
+        for n, rate in samples:
+            rate_means.setdefault(n, []).append(rate)
+        rate_means = {n: sum(v) / len(v) for n, v in rate_means.items()}
+        measured_n = min(rate_means, key=lambda n: abs(n - converged))
+        measured = rate_means[measured_n]
+        prediction_error = (abs(model.predict(measured_n) - measured)
+                            / measured)
+        whatif_error, whatif_ok = whatif_replay(model, planner.samples)
+        if not whatif_ok:
+            raise RuntimeError(
+                f"what-if replay rejects the fitted model: median "
+                f"relative error {whatif_error:.1%} > "
+                f"{WHATIF_TOLERANCE:.0%} over {len(planner.samples)} "
+                "samples")
+        # Leg-level acceptance: the prediction for the chosen fleet
+        # size must land within a stated tolerance of the measured soak
+        # throughput.  Looser than the model's median-error gate above
+        # because it judges a SINGLE point against short noisy passes.
+        prediction_tolerance = 0.40
+        if prediction_error > prediction_tolerance:
+            raise RuntimeError(
+                f"planner prediction {model.predict(measured_n):.1f} "
+                f"rows/s at fleet size {measured_n} misses the "
+                f"measured {measured:.1f} rows/s by "
+                f"{prediction_error:.1%} > {prediction_tolerance:.0%}")
+        teardown(with_handoff)
+        for key in ("_holder", "_workers", "_journal_dir"):
+            with_handoff.pop(key, None)
+
+        without_handoff = run_arm(handoff_enabled=False,
+                                  restart_mid_handoff=False)
+        teardown(without_handoff)
+        for key in ("_holder", "_workers", "_journal_dir"):
+            without_handoff.pop(key, None)
+
+        # The headline asserts, in-leg (a bench that records a broken
+        # fleet is worse than one that fails):
+        if with_handoff["cold_refills_across_drains"] != 0:
+            raise RuntimeError(
+                "warm handoff leaked cold re-decodes: "
+                f"{with_handoff['cold_refills_across_drains']} fills "
+                "after the drains (expected 0)")
+        if without_handoff["cold_refills_across_drains"] <= 0:
+            raise RuntimeError(
+                "handoff-disabled arm re-decoded nothing after the "
+                "drains — the A/B measured no treatment effect")
+        for job in jobs:
+            digests = {arm[phase]["digests"][job]
+                       for arm in (with_handoff, without_handoff)
+                       for phase in ("cold", "warm", "post_drain")}
+            digests |= {p["digests"][job]
+                        for arm in (with_handoff, without_handoff)
+                        for _, p in arm["drain_passes"]}
+            if len(digests) != 1:
+                raise RuntimeError(
+                    f"per-job digest divergence for {job}: drains, "
+                    "handoff, and the mid-handoff dispatcher restart "
+                    f"must never change delivered bytes ({digests})")
+
+        return {
+            "rows": rows, "workers": FLEET, "jobs": list(jobs),
+            "pieces": PIECES, "drains": DRAINS,
+            "with_handoff": with_handoff,
+            "without_handoff": without_handoff,
+            "cold_refills_with_handoff":
+                with_handoff["cold_refills_across_drains"],
+            "cold_refills_without_handoff":
+                without_handoff["cold_refills_across_drains"],
+            "digests_match_across_arms_and_restart": True,
+            "planner": {
+                "samples": samples,
+                "model": model.to_dict(),
+                "converged_fleet_size": converged,
+                "decisions_journaled": journaled,
+                "predicted_rows_per_s": round(predicted, 1),
+                "measured_rows_per_s": round(measured, 1),
+                "measured_at_fleet_size": measured_n,
+                "prediction_error": round(prediction_error, 4),
+                "prediction_tolerance": prediction_tolerance,
+                "whatif_error": (round(whatif_error, 4)
+                                 if whatif_error is not None else None),
+                "whatif_ok": whatif_ok,
+                "whatif_tolerance": WHATIF_TOLERANCE,
+            },
+        }
+    finally:
+        for arm in (with_handoff, without_handoff):
+            if arm is not None and "_holder" in arm:
+                try:
+                    teardown(arm)
+                except Exception:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
 # Overload-tail A/B (docs/guides/service.md#failure-model-and-recovery):
 # ONE fleet with one worker injected slow (a targeted slow-peer failpoint
 # delays its batch sends) under 3-job load, consumed with the resilience
@@ -2535,6 +2958,7 @@ LEGS = {
     "skewed_service": leg_skewed_service,
     "shm_transport": leg_shm_transport,
     "multi_tenant": leg_multi_tenant,
+    "fleet_cache": leg_fleet_cache,
     "overload_tail": leg_overload_tail,
     "device_decode": leg_device_decode,
     "autotune": leg_autotune,
@@ -2556,7 +2980,7 @@ ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
                 "multichip_child", "multichip_scaling", "skewed_service",
                 "shm_transport", "autotune", "multi_tenant", "llm_packing",
                 "rewrite_ab", "columnar_ab", "overload_tail",
-                "observability_overhead")
+                "fleet_cache", "observability_overhead")
 
 
 # Per-leg subprocess deadlines: the memsweep leg alone runs up to ~12 inner
@@ -2565,6 +2989,9 @@ ONESHOT_LEGS = ("flash_oracle", "flash_numerics", "flash_memsweep",
 # long.
 _LEG_TIMEOUT_S = {"flash_memsweep": 12000, "flash_numerics": 2400,
                   "multichip_scaling": 3000,
+                  # Two sequential 16-worker fleets, 3 ordered passes
+                  # each, plus drains and a dispatcher replay restart.
+                  "fleet_cache": 2400,
                   # 9 full AUTOTUNE_EPOCHS training passes + 2 ceiling
                   # passes in one subprocess — the heaviest default leg.
                   "autotune": 3600}
@@ -2625,11 +3052,12 @@ def main():
         llm_packing = _run_leg_subprocess("llm_packing", url)
         columnar_ab = _run_leg_subprocess("columnar_ab", url)
         overload_tail = _run_leg_subprocess("overload_tail", url)
+        fleet_cache = _run_leg_subprocess("fleet_cache", url)
         observability = _run_leg_subprocess("observability_overhead", url)
         for extra in (flash_numerics, flash_memory, multichip,
                       skewed_service, shm_transport, autotune_ab,
                       llm_packing, columnar_ab, overload_tail,
-                      observability):
+                      fleet_cache, observability):
             extra.pop("images_per_sec", None)
 
         # The framework offers both consumption modes (overlapped loader and
@@ -2758,6 +3186,14 @@ def main():
             # the tail-cutting number, digests_match_across_arms the
             # exactly-once check (asserted in-leg).
             "overload_tail": overload_tail,
+            # Fleet cache tier A/B (docs/guides/caching.md#fleet-cache-
+            # tier): 16 workers, 3 jobs, 3 drains with warm handoff ON
+            # vs OFF — cold_refills_with_handoff must be 0 (vs nonzero
+            # without), digests byte-identical across arms and across a
+            # mid-handoff dispatcher restart, and the model planner's
+            # converged fleet size with its what-if prediction judged
+            # against the measured soak (all asserted in-leg).
+            "fleet_cache": fleet_cache,
             # Observability-overhead A/B (docs/guides/diagnostics.md):
             # span tracing armed vs off on the image loader —
             # tracing_overhead_pct must stay under the <2% budget

@@ -168,6 +168,20 @@ def _scenario_main(argv):
                         help="shared disk-tier directory for "
                              "--cache mem+disk (default: a scenario-owned "
                              "tempdir)")
+    parser.add_argument("--fleet-cache", action="store_true", default=None,
+                        dest="fleet_cache",
+                        help="service scenario: promote the per-worker "
+                             "--cache to the consistent-hash fleet tier — "
+                             "warm entries are served from ring peers "
+                             "before falling back to a local cold fill "
+                             "(docs/guides/caching.md#fleet-cache-tier)")
+    parser.add_argument("--fleet-cache-drain-after", type=int, default=None,
+                        dest="fleet_cache_drain_after",
+                        help="service scenario: drain bench-worker-0 after "
+                             "this many consumed batches, exercising the "
+                             "warm handoff at a deterministic stream "
+                             "position (needs --fleet-cache and >=2 "
+                             "workers)")
     parser.add_argument("--shuffle-seed", type=int, default=None,
                         dest="shuffle_seed",
                         help="service scenario: dispatcher-side seed-tree "
@@ -248,6 +262,9 @@ def _scenario_main(argv):
             ("cache", "--cache", args.cache),
             ("cache_mem_mb", "--cache-mem-mb", args.cache_mem_mb),
             ("cache_dir", "--cache-dir", args.cache_dir),
+            ("fleet_cache", "--fleet-cache", args.fleet_cache),
+            ("fleet_cache_drain_after", "--fleet-cache-drain-after",
+             args.fleet_cache_drain_after),
             ("shuffle_seed", "--shuffle-seed", args.shuffle_seed),
             ("ordered", "--ordered", args.ordered),
             ("predicate", "--predicate", args.predicate),

@@ -641,6 +641,8 @@ def service_loopback_scenario(dataset_url=None, rows=DEFAULT_TABULAR_ROWS,
                               metrics_port=None,
                               trace_out=None, epochs=1, cache="off",
                               cache_mem_mb=256.0, cache_dir=None,
+                              fleet_cache=False,
+                              fleet_cache_drain_after=None,
                               sharding=None, shuffle_seed=None,
                               ordered=False, predicate=None,
                               filter_placement="client", transport=None,
@@ -843,6 +845,25 @@ BrownoutConfig`).
 
     from petastorm_tpu.cache_impl import CacheConfig
 
+    # Fleet cache tier (docs/guides/caching.md#fleet-cache-tier): every
+    # worker joins the consistent-hash ring; --fleet-cache-drain-after N
+    # drains the first worker after the client has consumed N batches —
+    # a call-count trigger (not a timer), so the drain (and the warm
+    # handoff it kicks off) lands at the same stream position on every
+    # run of a seeded schedule.
+    if fleet_cache and cache == "off":
+        raise ValueError(
+            "--fleet-cache places decoded-batch cache entries on the "
+            "peer ring: it needs --cache mem or mem+disk")
+    if fleet_cache_drain_after is not None and not fleet_cache:
+        raise ValueError(
+            "--fleet-cache-drain-after drives the warm-handoff path: "
+            "arm --fleet-cache with it")
+    if fleet_cache_drain_after is not None and workers < 2:
+        raise ValueError(
+            "--fleet-cache-drain-after needs >= 2 workers: a drained "
+            "worker's entries must have a surviving peer to land on")
+
     if epochs < 1:
         raise ValueError("epochs must be >= 1")
     if epochs > 1 and mode == "fcfs":
@@ -936,10 +957,31 @@ BrownoutConfig`).
                 worker_id=f"bench-worker-{i}",
                 batch_delay_s=max(skew_ms / 1000.0 if i == 0 else 0.0,
                                   chaos_pace_s),
-                heartbeat_interval_s=0.5 if chaos_kinds else 5.0,
+                # Fleet-cache runs need snappy heartbeats too: the peer
+                # ring and the drain-edge handoff both ride them.
+                heartbeat_interval_s=(0.5 if (chaos_kinds or fleet_cache)
+                                      else 5.0),
                 batch_cache=cache_config.build(),
+                fleet_cache=fleet_cache,
                 transport=transport,
                 reader_kwargs={"workers_count": 2}).start())
+        if fleet_cache:
+            # Stream only after every worker's placement ring converged
+            # on the full fleet: registration seeds each joiner's ring,
+            # but earlier joiners learn of later ones via heartbeat — a
+            # short run racing that first tick would fill every entry
+            # against a partial ring and never exercise the warm paths.
+            expected = {w.worker_id for w in fleet}
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if all(set(w._fleet_tier.ring_peers()) == expected
+                       for w in fleet):
+                    break
+                time.sleep(0.02)
+            else:
+                raise RuntimeError(
+                    "fleet cache ring did not converge on "
+                    f"{sorted(expected)} within 10s")
         source = ServiceBatchSource(
             dispatcher_holder[0].address, credits=credits, ordered=ordered,
             heartbeat_interval_s=0.3 if chaos_kinds else 2.0,
@@ -1042,6 +1084,29 @@ BrownoutConfig`).
                 if chaos_kinds and "sample_index" in batch:
                     got_ids.extend(int(i) for i in batch["sample_index"])
                 arrivals.append((time.perf_counter() - t0, served_rows))
+                if fleet_cache_drain_after is not None \
+                        and batches == int(fleet_cache_drain_after):
+                    # Deterministic mid-stream drain: triggered by the
+                    # consumed-batch count, so seeded replays drain at
+                    # the identical stream position. The worker's next
+                    # heartbeat sees "draining" and ships its warm
+                    # entries to the peers inheriting its ring segments.
+                    dispatcher_holder[0].drain_worker(
+                        "bench-worker-0",
+                        reason="fleet-cache scenario drain")
+                    # Post-drain barrier: the handoff launches on the
+                    # drained worker's next heartbeat and journals its
+                    # cache_handoff record AFTER the entries shipped —
+                    # waiting for the record means everything consumed
+                    # from here on measures the handed-off (warm)
+                    # fleet, not a race against the shipping thread.
+                    # Bounded and best-effort: a handoff that never
+                    # reports just leaves the rest of the stream to
+                    # cold-fill, which the per-run counters expose.
+                    barrier = time.monotonic() + 10.0
+                    while time.monotonic() < barrier \
+                            and not dispatcher_holder[0].cache_handoffs():
+                        time.sleep(0.02)
         service_wall = time.perf_counter() - t0
         epoch_starts = [(int(count), int(epoch_num)) for count, epoch_num
                         in source.diagnostics["epoch_starts"]]
@@ -1198,6 +1263,30 @@ BrownoutConfig`).
                 "version_evicted": sum(s.get("version_evicted", 0)
                                        for s in per_worker_stats if s),
             }
+            if fleet_cache:
+                # Fleet-tier attribution: remote warmth movement (peer
+                # fetches, placement pushes, drain handoffs) summed
+                # across the fleet — cold re-decodes avoided by the
+                # ring show up here, not in the local hit counters.
+                result["cache"]["fleet"] = {
+                    "remote_hits": sum(s.get("remote_hits", 0)
+                                       for s in per_worker_stats if s),
+                    "remote_misses": sum(s.get("remote_misses", 0)
+                                         for s in per_worker_stats if s),
+                    "remote_errors": sum(s.get("remote_errors", 0)
+                                         for s in per_worker_stats if s),
+                    "breaker_skips": sum(s.get("breaker_skips", 0)
+                                         for s in per_worker_stats if s),
+                    "pushes_sent": sum(s.get("pushes_sent", 0)
+                                       for s in per_worker_stats if s),
+                    "handoff_entries_sent": sum(
+                        s.get("handoff_entries_sent", 0)
+                        for s in per_worker_stats if s),
+                    "handoff_entries_received": sum(
+                        s.get("handoff_entries_received", 0)
+                        for s in per_worker_stats if s),
+                    "drained_after_batches": fleet_cache_drain_after,
+                }
         # Final registry snapshot + per-stage latency quantiles: BENCH
         # artifacts capture distributions (p50/p99), not just means.
         from petastorm_tpu.telemetry import REGISTRY as _registry

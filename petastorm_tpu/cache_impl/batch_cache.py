@@ -364,11 +364,16 @@ class BatchCache:
         tier (it is about to be hot)."""
         return self.get_tiered(key)[0]
 
-    def get_tiered(self, key):
+    def get_tiered(self, key, count_miss=True):
         """``(entry, tier)`` — the entry plus which tier answered
         (``"mem"``/``"disk"``), or ``(None, None)`` on a miss. Serve-time
         permutation callers use the tier to attribute their
-        ``cache_permuted_serves_total`` bumps."""
+        ``cache_permuted_serves_total`` bumps.
+
+        ``count_miss=False`` suppresses the miss accounting on the empty
+        result — the fleet tier probes the local tiers first and only
+        counts a miss once the remote tier also comes up empty (a remote
+        warm hit must not read as a local miss in ``CACHEHIT%``)."""
         t0 = time.perf_counter()
         with self._lock:
             entry = self._entries.get(key)
@@ -388,10 +393,17 @@ class BatchCache:
                 self._m_hits_disk.inc()
                 CACHE_SERVE_SECONDS.observe(time.perf_counter() - t0)
                 return entry, "disk"
+        if count_miss:
+            self.note_miss()
+        return None, None
+
+    def note_miss(self):
+        """Count one lookup that no tier answered (split out of
+        :meth:`get_tiered` so the fleet tier can defer the bump until its
+        remote probe also misses)."""
         with self._lock:
             self.misses += 1
         CACHE_MISSES.inc()
-        return None, None
 
     def note_permuted_serve(self, tier):
         """One entry was served through a serve-time permutation (shuffle-
@@ -401,6 +413,13 @@ class BatchCache:
         with self._lock:
             self.permuted_serves += 1
         CACHE_PERMUTED_SERVES.labels(tier or "mem").inc()
+
+    def peek(self, key):
+        """Memory-tier probe without LRU touch or hit/miss accounting —
+        the fleet tier's peer-serve path: a peer asking for an entry must
+        not perturb this worker's own hit statistics or eviction order."""
+        with self._lock:
+            return self._entries.get(key)
 
     def get_batches(self, key):
         """The decoded ``[{field: ndarray}, ...]`` sequence, or ``None``."""
@@ -429,6 +448,37 @@ class BatchCache:
         for batch in batches:
             builder.add_batch(batch)
         return builder.commit()
+
+    def put_entry(self, key, meta, blob):
+        """Adopt an already-framed entry — the fleet tier's ingest path
+        for peer-shipped entries (remote fetch promotion, drain handoff).
+
+        ``meta`` is the entry's ``[(rows, fmt, [frame_len, ...]), ...]``
+        and ``blob`` the matching contiguous payload.  The frames are
+        adopted as-is (zero re-serialization, routed through the armed
+        frame allocator exactly like a local fill); a meta/payload length
+        disagreement raises ``ValueError`` — a torn transfer must never
+        be published as a complete entry."""
+        meta = [(int(rows), int(fmt), [int(l) for l in lens])
+                for rows, fmt, lens in meta]
+        expected = sum(length for _, _, lens in meta for length in lens)
+        if expected != len(blob):
+            raise ValueError(
+                "entry payload is %d bytes but meta frames sum to %d"
+                % (len(blob), expected))
+        entry = CachedEntry(meta, self._materialize(bytes(blob)))
+        self._publish(key, entry)
+        return entry
+
+    def hot_entries(self):
+        """Snapshot of the memory tier as ``[(key, entry), ...]``,
+        hottest (most recently used) first — what a draining worker ships
+        to the peers inheriting its pieces.  Entries are immutable, so
+        the snapshot stays valid after the lock drops even if eviction
+        races the handoff."""
+        with self._lock:
+            return [(key, entry)
+                    for key, entry in reversed(self._entries.items())]
 
     def _publish(self, key, entry):
         if self._disk:

@@ -1,0 +1,452 @@
+"""Fleet cache tier: consistent-hash placement + remote warm serves.
+
+Wraps one worker's local :class:`~petastorm_tpu.cache_impl.batch_cache.
+BatchCache` into a horizontally scalable tier (``docs/guides/caching.md``
+"Fleet cache tier"):
+
+- **Placement**: every entry key (an order-independent fingerprint from
+  :mod:`~petastorm_tpu.cache_impl.fingerprint`) has one *owner* on a
+  consistent-hash ring over the serving cache peers
+  (:mod:`~petastorm_tpu.cache_impl.hash_ring`).  Freshly-filled entries
+  are written through to their owner; a local miss probes the owner
+  before falling back to a cold decode.
+- **Remote warm serves**: a peer answers ``cache_fetch`` with the
+  entry's per-batch meta plus its ONE contiguous frame buffer, shipped
+  as a raw COLUMNAR payload — the cached bytes are the wire bytes (no
+  decode, no re-serialization at either end), and adoption routes
+  through the receiving cache's frame allocator so colocated (shm)
+  clients get mapped serves, not copies.
+- **Warm handoff**: a draining worker ships its memory tier to the peers
+  inheriting its keyspace (the ring without it), so an autoscale drain
+  causes zero cold re-decode fleet-wide.
+- **Degradation**: every remote failure — dead peer, torn transfer,
+  protocol error — feeds a per-peer circuit breaker and degrades to a
+  local fill.  The fleet tier can make a stream *faster*, never broken.
+
+The tier exposes the local cache's interface (``get_tiered`` /
+``begin_fill`` / ``note_permuted_serve`` / ``stats`` / ``cleanup`` /
+attribute delegation for the rest), so the worker's piece engine works
+unchanged; remote hits surface as the new ``"remote"`` tier label.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from petastorm_tpu import failpoints
+from petastorm_tpu.cache_impl.hash_ring import HashRing
+from petastorm_tpu.telemetry.log import service_logger
+from petastorm_tpu.telemetry.metrics import (
+    CACHE_HITS,
+    CACHE_PEER_FETCHES,
+    CACHE_PEER_HANDOFF_ENTRIES,
+    CACHE_PEER_PUSHES,
+    CACHE_PEER_SERVES,
+)
+
+logger = service_logger(__name__)
+
+#: Bounded write-through push queue: placement is best-effort (the
+#: remote-fetch path covers anything dropped here), so a slow peer must
+#: back-pressure into drops, not into the decode path.
+PUSH_QUEUE_DEPTH = 64
+
+#: Dial/request timeout for peer RPCs. Short on purpose: a peer that
+#: cannot answer in this budget is slower than the cold decode it is
+#: meant to save, and the breaker needs failures to count quickly.
+PEER_TIMEOUT_S = 5.0
+
+
+def entry_wire_meta(entry):
+    """JSON-able ``[[rows, fmt, frame_lens], ...]`` for a cache entry —
+    the header half of the peer wire format (the payload half is the
+    entry's contiguous buffer, shipped as one raw frame)."""
+    return [[rows, fmt, list(lens)] for rows, fmt, lens in entry.meta]
+
+
+def entry_wire_payload(entry):
+    """The entry's contiguous buffer as a uint8 ndarray view (zero-copy):
+    rides the COLUMNAR payload path, so ``sendmsg`` scatter-gathers the
+    cached bytes straight onto the socket."""
+    import numpy as np
+
+    return {"buf": np.frombuffer(entry.buf, dtype=np.uint8)}
+
+
+class _FleetEntryBuilder:
+    """Wraps the local cache's :class:`EntryBuilder`: ``commit()`` also
+    hands the frozen entry to the tier for write-through placement."""
+
+    def __init__(self, tier, key, builder):
+        self._tier = tier
+        self._key = key
+        self._builder = builder
+
+    def add_batch(self, batch, rows=None):
+        return self._builder.add_batch(batch, rows=rows)
+
+    def add_frames(self, rows, fmt, frames):
+        return self._builder.add_frames(rows, fmt, frames)
+
+    def commit(self):
+        entry = self._builder.commit()
+        self._tier._note_fill(self._key, entry)
+        return entry
+
+
+class FleetCacheTier:
+    """See the module docstring.
+
+    :param local: the worker's :class:`BatchCache` (owns the tiers).
+    :param worker_id: this worker's id — its name on the ring.
+    :param clock: monotonic-seconds source for the per-peer breakers
+        (injectable for tests).
+    """
+
+    def __init__(self, local, worker_id, clock=time.monotonic,
+                 peer_timeout_s=PEER_TIMEOUT_S):
+        self._local = local
+        self._worker_id = str(worker_id)
+        self._clock = clock
+        self._peer_timeout_s = peer_timeout_s
+        self._ring = HashRing()
+        self._lock = threading.Lock()
+        self._addresses = {}   # peer id -> (host, port)
+        self._breakers = {}    # peer id -> CircuitBreaker
+        # Tier-level counters (stats() merges them over the local ones).
+        self.remote_hits = 0
+        self.remote_misses = 0
+        self.remote_errors = 0
+        self.breaker_skips = 0
+        self.fills = 0
+        self.pushes_sent = 0
+        self.pushes_dropped = 0
+        self.handoff_entries_sent = 0
+        self.handoff_bytes_sent = 0
+        self.handoff_entries_received = 0
+        self._m_hits_remote = CACHE_HITS.labels("remote")
+        self._stop = threading.Event()
+        self._push_queue = queue.Queue(maxsize=PUSH_QUEUE_DEPTH)
+        self._push_thread = threading.Thread(
+            target=self._push_loop, daemon=True,
+            name=f"cache-peer-push-{self._worker_id}")
+        self._push_thread.start()
+
+    # Everything the tier does not override (contains/retained/peek/
+    # set_frame_allocator/put_entry/instance counters/...) is the local
+    # cache's, so the tier is a drop-in wherever a BatchCache goes.
+    def __getattr__(self, name):
+        return getattr(self._local, name)
+
+    @property
+    def local(self):
+        return self._local
+
+    @property
+    def worker_id(self):
+        return self._worker_id
+
+    # -- membership --------------------------------------------------------
+
+    def update_peers(self, peers):
+        """Adopt the dispatcher-published peer list (``[[peer_id, host,
+        port], ...]``, this worker included when serving). Idempotent;
+        breakers persist across updates so a flapping peer's history is
+        not amnestied by every heartbeat."""
+        addresses = {str(p): (str(h), int(port)) for p, h, port in peers}
+        with self._lock:
+            self._addresses = addresses
+            for gone in [p for p in self._breakers if p not in addresses]:
+                del self._breakers[gone]
+        self._ring.replace(addresses)
+
+    def ring_peers(self):
+        return list(self._ring.peers)
+
+    def _breaker(self, peer_id):
+        from petastorm_tpu.service.resilience import CircuitBreaker
+
+        with self._lock:
+            breaker = self._breakers.get(peer_id)
+            if breaker is None:
+                breaker = self._breakers[peer_id] = CircuitBreaker()
+            return breaker
+
+    def _address(self, peer_id):
+        with self._lock:
+            return self._addresses.get(peer_id)
+
+    # -- lookup ------------------------------------------------------------
+
+    def get(self, key):
+        return self.get_tiered(key)[0]
+
+    def get_tiered(self, key, count_miss=True):
+        """Local tiers first; on a local miss, probe the ring owner.
+        A remote hit is promoted into the local memory tier (it is about
+        to be hot) and reported as tier ``"remote"``; a fleet-wide miss
+        counts as ONE miss (the deferred local bump)."""
+        entry, tier = self._local.get_tiered(key, count_miss=False)
+        if entry is not None:
+            return entry, tier
+        entry = self._fetch_remote(key)
+        if entry is not None:
+            return entry, "remote"
+        if count_miss:
+            self._local.note_miss()
+        return None, None
+
+    def _fetch_remote(self, key):
+        owner = self._ring.owner(key)
+        if owner is None or owner == self._worker_id:
+            return None
+        breaker = self._breaker(owner)
+        if not breaker.allow(self._clock()):
+            with self._lock:
+                self.breaker_skips += 1
+            CACHE_PEER_FETCHES.labels("breaker_open").inc()
+            return None
+        try:
+            header, payload = self._peer_request(
+                owner, {"type": "cache_fetch", "key": str(key),
+                        "peer": self._worker_id})
+            if header.get("type") == "error":
+                raise PeerError(header.get("error", "peer error"))
+            if not header.get("hit"):
+                breaker.record_success()
+                with self._lock:
+                    self.remote_misses += 1
+                CACHE_PEER_FETCHES.labels("miss").inc()
+                return None
+            entry = self._local.put_entry(key, header["meta"],
+                                          payload["buf"])
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            if breaker.record_failure(self._clock()):
+                logger.warning(
+                    "cache peer %s breaker opened after repeated fetch "
+                    "failures — degrading its keys to local fills", owner)
+            with self._lock:
+                self.remote_errors += 1
+            CACHE_PEER_FETCHES.labels("error").inc()
+            logger.debug("cache peer %s fetch failed (%s) — local fill",
+                         owner, exc)
+            return None
+        breaker.record_success()
+        with self._lock:
+            self.remote_hits += 1
+        self._m_hits_remote.inc()
+        CACHE_PEER_FETCHES.labels("hit").inc()
+        return entry
+
+    def _peer_request(self, peer_id, header, payload=None):
+        """One request/reply RPC to a peer's framed server. A fresh dial
+        per call: peer RPCs are entry-grained (amortized over a piece's
+        worth of batches), and holding no sockets between calls means a
+        vanished peer costs one failed dial, never a leaked fd."""
+        from petastorm_tpu.reader_impl.framed_socket import FramedConnection
+
+        fp = failpoints.ACTIVE
+        if fp is not None and fp.fire("cache-peer-gone") == "gone":
+            raise ConnectionRefusedError(
+                "failpoint cache-peer-gone: peer dial refused")
+        address = self._address(peer_id)
+        if address is None:
+            raise ConnectionRefusedError(
+                f"cache peer {peer_id!r} has no published address")
+        with FramedConnection.connect(
+                address, timeout=self._peer_timeout_s) as conn:
+            return conn.request(header, payload)
+
+    # -- fill + write-through placement ------------------------------------
+
+    def begin_fill(self, key):
+        return _FleetEntryBuilder(self, key, self._local.begin_fill(key))
+
+    def put_batches(self, key, batches):
+        builder = self.begin_fill(key)
+        for batch in batches:
+            builder.add_batch(batch)
+        return builder.commit()
+
+    def _note_fill(self, key, entry):
+        with self._lock:
+            self.fills += 1
+        owner = self._ring.owner(key)
+        if owner is None or owner == self._worker_id \
+                or self._stop.is_set():
+            return
+        try:
+            self._push_queue.put_nowait((key, entry, owner))
+        except queue.Full:
+            with self._lock:
+                self.pushes_dropped += 1
+            CACHE_PEER_PUSHES.labels("dropped").inc()
+
+    def _push_loop(self):
+        while not self._stop.is_set():
+            try:
+                item = self._push_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            key, entry, owner = item
+            self._push_entry(key, entry, owner, origin="placement")
+
+    def _push_entry(self, key, entry, owner, origin):
+        """Ship one entry to ``owner`` via ``cache_put``. Best-effort:
+        failures count (and feed the breaker) but never propagate — the
+        remote-fetch path simply misses for this key."""
+        breaker = self._breaker(owner)
+        if not breaker.allow(self._clock()):
+            CACHE_PEER_PUSHES.labels("dropped").inc()
+            with self._lock:
+                self.pushes_dropped += 1
+            return False
+        try:
+            header, _ = self._peer_request(
+                owner,
+                {"type": "cache_put", "key": str(key),
+                 "meta": entry_wire_meta(entry), "peer": self._worker_id,
+                 "origin": origin},
+                entry_wire_payload(entry))
+            if header.get("type") != "ok":
+                raise ProtocolError(header.get("error", "peer refused put"))
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            breaker.record_failure(self._clock())
+            with self._lock:
+                self.remote_errors += 1
+            CACHE_PEER_PUSHES.labels("error").inc()
+            logger.debug("cache peer %s put failed (%s)", owner, exc)
+            return False
+        breaker.record_success()
+        with self._lock:
+            self.pushes_sent += 1
+        CACHE_PEER_PUSHES.labels("sent").inc()
+        return True
+
+    # -- peer-serving side (the worker's RPC handlers call these) ----------
+
+    def serve_fetch(self, key):
+        """Answer a peer's ``cache_fetch``: ``(header, payload)``. Memory
+        tier only (what "warm" means), without touching this worker's own
+        hit statistics or LRU order."""
+        entry = self._local.peek(key)
+        if entry is None:
+            CACHE_PEER_SERVES.labels("miss").inc()
+            return {"type": "cache_entry", "hit": False, "key": key}, None
+        CACHE_PEER_SERVES.labels("hit").inc()
+        return ({"type": "cache_entry", "hit": True, "key": key,
+                 "meta": entry_wire_meta(entry)},
+                entry_wire_payload(entry))
+
+    def adopt(self, key, meta, blob, origin="placement"):
+        """Adopt a peer-shipped entry (the ``cache_put`` handler).
+        Raises ``ValueError`` on a meta/payload disagreement — a torn
+        transfer must be refused, not published."""
+        entry = self._local.put_entry(key, meta, blob)
+        if origin == "handoff":
+            with self._lock:
+                self.handoff_entries_received += 1
+            CACHE_PEER_HANDOFF_ENTRIES.labels("received").inc()
+        return entry
+
+    # -- warm handoff ------------------------------------------------------
+
+    def handoff(self):
+        """Ship this worker's memory tier to the peers inheriting its
+        keyspace — the ring WITHOUT this worker, i.e. exactly where each
+        key lands after the drain completes.  Synchronous (the caller
+        runs it on the drain path, off the serve threads); returns a
+        summary dict the worker journals through the dispatcher.
+
+        The ``handoff-torn`` failpoint aborts mid-list: shipped entries
+        stay shipped, the rest stay local (and die with the worker) —
+        the inheriting peers cold-fill them, which is the degraded-but-
+        correct outcome the digests gate proves."""
+        survivors = [p for p in self.ring_peers() if p != self._worker_id]
+        summary = {"entries": 0, "bytes": 0, "peers": {}, "errors": 0,
+                   "torn": False}
+        if not survivors:
+            return summary
+        ring = HashRing(survivors, vnodes=self._ring.vnodes)
+        fp = failpoints.ACTIVE
+        for key, entry in self._local.hot_entries():
+            if fp is not None and fp.fire("handoff-torn") == "torn":
+                summary["torn"] = True
+                logger.warning(
+                    "failpoint handoff-torn: aborting warm handoff after "
+                    "%d entries — the rest cold-fill on the survivors",
+                    summary["entries"])
+                break
+            owner = ring.owner(key)
+            if not self._push_entry(key, entry, owner, origin="handoff"):
+                summary["errors"] += 1
+                continue
+            summary["entries"] += 1
+            summary["bytes"] += entry.nbytes
+            summary["peers"][owner] = summary["peers"].get(owner, 0) + 1
+            with self._lock:
+                self.handoff_entries_sent += 1
+                self.handoff_bytes_sent += entry.nbytes
+            CACHE_PEER_HANDOFF_ENTRIES.labels("sent").inc()
+        return summary
+
+    # -- observability / lifecycle -----------------------------------------
+
+    def note_permuted_serve(self, tier):
+        self._local.note_permuted_serve(tier)
+
+    def stats(self):
+        stats = self._local.stats()
+        with self._lock:
+            remote_hits = self.remote_hits
+            stats.update({
+                "tier": "fleet",
+                "peers": len(self._addresses),
+                "remote_hits": remote_hits,
+                "remote_misses": self.remote_misses,
+                "remote_errors": self.remote_errors,
+                "breaker_skips": self.breaker_skips,
+                "breakers_open": sum(
+                    1 for b in self._breakers.values()
+                    if b.state != "closed"),
+                "fills": self.fills,
+                "pushes_sent": self.pushes_sent,
+                "pushes_dropped": self.pushes_dropped,
+                "handoff_entries_sent": self.handoff_entries_sent,
+                "handoff_bytes_sent": self.handoff_bytes_sent,
+                "handoff_entries_received": self.handoff_entries_received,
+            })
+        stats["hits"] = stats["hits"] + remote_hits
+        stats["hit_rate"] = round(
+            stats["hits"] / max(1, stats["hits"] + stats["misses"]), 4)
+        return stats
+
+    def cleanup(self):
+        # Stop-then-drain-then-sentinel: pending placement pushes are
+        # best-effort by contract (the remote-fetch path covers what is
+        # dropped), and put() on a full queue must never block the stop.
+        self._stop.set()
+        try:
+            while True:
+                self._push_queue.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            self._push_queue.put_nowait(None)
+        except queue.Full:
+            pass  # the stop event still ends the loop within its poll
+        self._push_thread.join(timeout=5)
+        self._local.cleanup()
+
+
+class PeerError(ValueError):
+    """A peer answered with an error or an unintelligible reply.
+
+    A ``ValueError`` subclass on purpose: the fetch/push paths catch
+    ``ValueError`` for every malformed-reply shape (including the framed
+    transport's own ``ProtocolError``), so peer refusals degrade through
+    the same local-fill path."""

@@ -1,0 +1,127 @@
+"""Consistent-hash ring for fleet-scale cache entry placement.
+
+Decoded-batch cache entries are keyed by the order-independent
+fingerprints from :mod:`petastorm_tpu.cache_impl.fingerprint`; the ring
+maps each fingerprint to the *owner* peer that holds (or should hold)
+the warm entry.  Properties the rest of the fleet tier leans on:
+
+- **Stability**: placement is a pure function of ``(peers, vnodes,
+  key)`` — no process state, no RNG, no clock.  The golden placement
+  vectors in ``tests/test_fleet_cache.py`` pin it; changing the hash or
+  vnode scheme is a cache-invalidation event and must be deliberate.
+- **Minimal churn**: adding or removing one peer relocates at most
+  ``~1/N`` of the keyspace (the classic consistent-hashing bound); a
+  property test asserts ``<= 1/N + eps`` and that no key moves in
+  *both* directions across a single rebalance.
+- **Determinism across processes**: every worker computes the same ring
+  from the same peer list (sorted by peer id), so owners agree without
+  coordination beyond the dispatcher-published membership list.
+
+blake2b is used (not ``hash()``) because Python's string hash is
+per-process salted; digest_size=8 keeps point comparison cheap while
+making vnode collisions across realistic fleet sizes negligible.
+"""
+
+import bisect
+import hashlib
+
+DEFAULT_VNODES = 64
+
+
+def _point(data):
+    """64-bit ring coordinate for ``data`` (bytes)."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class HashRing(object):
+    """Consistent-hash ring over peer ids with virtual nodes.
+
+    ``peers`` is any iterable of string peer ids (worker ids).  The ring
+    is immutable-by-convention: membership changes go through
+    :meth:`replace` (used by workers when the dispatcher publishes a new
+    peer list) which returns nothing but atomically swaps the point
+    table, so a concurrent ``owner()`` sees either the old or the new
+    ring, never a half-built one.
+    """
+
+    def __init__(self, peers=(), vnodes=DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1, got %r" % (vnodes,))
+        self._vnodes = int(vnodes)
+        self._peers = ()
+        self._table = ([], [])  # (sorted points, owner per point)
+        self.replace(peers)
+
+    @property
+    def peers(self):
+        """Current membership, sorted."""
+        return self._peers
+
+    @property
+    def vnodes(self):
+        return self._vnodes
+
+    def __len__(self):
+        return len(self._peers)
+
+    def __contains__(self, peer_id):
+        return peer_id in self._peers
+
+    def replace(self, peers):
+        """Swap membership to ``peers`` (idempotent, order-insensitive)."""
+        members = tuple(sorted(set(str(p) for p in peers)))
+        if members == self._peers:
+            return
+        pairs = []
+        for peer in members:
+            for vnode in range(self._vnodes):
+                pairs.append((_point(("%s#%d" % (peer, vnode)).encode()),
+                              peer))
+        pairs.sort()
+        # Two parallel lists (not one list of tuples) so owner() is a
+        # bisect over plain ints; swapped as ONE attribute so a reader on
+        # another thread sees the old table or the new, never a torn mix.
+        self._peers = members
+        self._table = ([p for p, _ in pairs], [w for _, w in pairs])
+
+    def owner(self, key):
+        """Owner peer id for ``key`` (a fingerprint hex string), or None
+        when the ring is empty."""
+        points, owners = self._table
+        if not points:
+            return None
+        h = _point(key.encode() if isinstance(key, str) else key)
+        idx = bisect.bisect_right(points, h)
+        if idx == len(points):
+            idx = 0
+        return owners[idx]
+
+    def owners(self, key, n=2):
+        """First ``n`` distinct peers clockwise from ``key`` — the owner
+        followed by its successor(s), used as fallback fetch targets."""
+        points, owners = self._table
+        if not points:
+            return []
+        h = _point(key.encode() if isinstance(key, str) else key)
+        idx = bisect.bisect_right(points, h)
+        out = []
+        total = len(points)
+        for step in range(total):
+            peer = owners[(idx + step) % total]
+            if peer not in out:
+                out.append(peer)
+                if len(out) >= n:
+                    break
+        return out
+
+
+def placement(keys, peers, vnodes=DEFAULT_VNODES):
+    """Pure helper: map each key to its owner under ``peers``.
+
+    Used by the golden-placement tests and by the drain handoff path to
+    compute, in one pass, where a draining worker's entries land on the
+    ring *without* it.
+    """
+    ring = HashRing(peers, vnodes=vnodes)
+    return {key: ring.owner(key) for key in keys}

@@ -139,6 +139,14 @@ POINTS = {
     "slow-peer": ("delay",),
     "breaker-trip": ("reset",),
     "hedge-race": ("delay",),
+    # Fleet cache tier (cache_impl/fleet_tier.py). "handoff-torn" aborts
+    # a drain's warm handoff mid-entry-list (some entries shipped, the
+    # rest left behind — the inheriting peer must cold-fill them, never
+    # serve a torn one); "cache-peer-gone" makes a remote fetch/push see
+    # a dead peer (feeding the per-peer breaker: the stream degrades to
+    # a local fill, never an error).
+    "handoff-torn": ("torn",),
+    "cache-peer-gone": ("gone",),
 }
 
 #: ``piece.decode`` is separate: it only ever fires for explicitly named

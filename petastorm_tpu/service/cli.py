@@ -86,6 +86,17 @@ def _build_parser():
                            "service.md#multi-tenancy-and-autoscaling)")
     disp.add_argument("--autoscale-interval", type=float, default=1.0,
                       help="autoscaler planning tick, seconds")
+    disp.add_argument("--autoscale-planner", default="streak",
+                      choices=["streak", "model"],
+                      help="autoscale decision policy: 'streak' (backlog "
+                           "hysteresis streaks) or 'model' — fit a "
+                           "per-worker throughput model from measured "
+                           "fleet samples + journaled stage profiles, "
+                           "admit/drain on predicted marginal rows/s, "
+                           "validate by what-if replay, and journal every "
+                           "decision as an auditable fleet_plan record "
+                           "(docs/guides/service.md"
+                           "#model-based-fleet-planner)")
 
     work = sub.add_parser("worker", help="run a batch worker")
     work.add_argument("--dispatcher", default=None,
@@ -125,6 +136,16 @@ def _build_parser():
     work.add_argument("--cache-disk-mb", type=float, default=None,
                       help="optional disk-tier budget (LRU eviction of "
                            "spill files beyond it); default unlimited")
+    work.add_argument("--fleet-cache", action="store_true",
+                      dest="fleet_cache",
+                      help="join the fleet cache tier: decoded-batch "
+                           "entries place on a consistent-hash ring "
+                           "across every --fleet-cache worker, warm "
+                           "misses fetch from the owning peer instead of "
+                           "re-decoding, and a drain ships this worker's "
+                           "hot entries to the peers inheriting its ring "
+                           "segments (requires --cache; "
+                           "docs/guides/caching.md#fleet-cache-tier)")
     work.add_argument("--standby", action="store_true",
                       help="register as pooled standby capacity: leased "
                            "and observable but granted nothing until the "
@@ -275,9 +296,12 @@ def build_service_node(args):
                           lease_timeout_s=args.lease_timeout or None,
                           journal_fsync=args.journal_fsync,
                           shuffle_seed=args.shuffle_seed,
-                          autoscale=({"interval_s": args.autoscale_interval}
-                                     if getattr(args, "autoscale", False)
-                                     else None))
+                          autoscale=(
+                              {"interval_s": args.autoscale_interval,
+                               "planner": getattr(args, "autoscale_planner",
+                                                  "streak")}
+                              if getattr(args, "autoscale", False)
+                              else None))
     from petastorm_tpu.cache_impl import CacheConfig
     from petastorm_tpu.service.worker import BatchWorker
 
@@ -299,6 +323,7 @@ def build_service_node(args):
         batch_transform=resolve_batch_transform(
             getattr(args, "batch_transform", None)),
         transport=getattr(args, "transport", None),
+        fleet_cache=getattr(args, "fleet_cache", False),
         reader_kwargs={"workers_count": args.workers_count,
                        "reader_pool_type": args.reader_pool_type})
 
@@ -370,7 +395,21 @@ def _worker_totals(sample, wid):
             metrics.get("transport_streams_shm_total"),
             # row_vs_columnar attribution (None on pre-columnar workers).
             metrics.get("columnar_batches_total"),
-            metrics.get("row_fallback_batches_total"))
+            metrics.get("row_fallback_batches_total"),
+            # Cache-tier attribution (None on cache-off or pre-fleet
+            # workers): which tier the worker runs and how warm it is —
+            # remote vs local warmth visible per worker.
+            metrics.get("cache_tier"),
+            metrics.get("cache_entries_mem"))
+
+
+def _cache_label(tier, entries_mem):
+    """The CACHE column: the worker's cache tier and live memory-tier
+    entry count (``fleet:12`` / ``local:3``), ``--`` when no batch cache
+    is armed (or on a worker predating the column)."""
+    if tier is None:
+        return "--"
+    return f"{tier}:{int(entries_mem or 0)}"
 
 
 def _transport_label(tcp_total, shm_total):
@@ -409,8 +448,8 @@ def render_fleet_status(prev, cur):
         header,
         f"{'WORKER':<20} {'ROWS/S':>10} {'BATCH/S':>8} {'STREAMS':>8} "
         f"{'TRANSPORT':>9} {'CREDITWAIT/S':>13} {'ROWS_TOTAL':>12} "
-        f"{'CACHEHIT%':>10} {'COL%':>6} {'PERM/S':>7} {'STEALS':>9} "
-        f"{'BACKLOG':>8} {'BREAKER':>8}",
+        f"{'CACHEHIT%':>10} {'CACHE':>10} {'COL%':>6} {'PERM/S':>7} "
+        f"{'STEALS':>9} {'BACKLOG':>8} {'BREAKER':>8}",
     ]
 
     def breaker_col(wid):
@@ -433,8 +472,9 @@ def render_fleet_status(prev, cur):
             lines.append(f"{wid:<20} {'unreachable':>10}")
             continue
         (rows1, batches1, wait1, active, hits1, misses1, perm1,
-         tcp1, shm1, col1, colfb1) = now
+         tcp1, shm1, col1, colfb1, tier1, entries1) = now
         transport = _transport_label(tcp1, shm1)
+        cache = _cache_label(tier1, entries1)
         before = _worker_totals(prev, wid)
         if before is None:
             # No prior baseline (worker just appeared or was unreachable
@@ -442,22 +482,27 @@ def render_fleet_status(prev, cur):
             lines.append(
                 f"{wid:<20} {'--':>10} {'--':>8} {int(active):>8} "
                 f"{transport:>9} {'--':>13} {int(rows1):>12} {'--':>10} "
-                f"{'--':>6} {'--':>7} {steal_cols(wid)} {breaker_col(wid)}")
+                f"{cache:>10} {'--':>6} {'--':>7} {steal_cols(wid)} "
+                f"{breaker_col(wid)}")
             continue
         (rows0, batches0, wait0, _, hits0, misses0, perm0, _, _,
-         col0, colfb0) = before
+         col0, colfb0, _, _) = before
         rows_rate = max(0.0, rows1 - rows0) / dt
         batch_rate = max(0.0, batches1 - batches0) / dt
         wait_rate = max(0.0, wait1 - wait0) / dt
         fleet_rows += rows_rate
         fleet_batches += batch_rate
         hit_pct = "--"
-        if hits1 is not None and misses1 is not None:
+        if hits1 is not None and misses1 is not None \
+                and hits0 is not None and misses0 is not None:
             # Hit rate over THIS window (delta-based, like the rates): the
             # decode-bypass signal for the epoch currently streaming, not
             # a lifetime average that dilutes a cold first epoch forever.
-            hit_delta = max(0.0, hits1 - (hits0 or 0.0))
-            lookups = hit_delta + max(0.0, misses1 - (misses0 or 0.0))
+            # A None BASELINE (the cache appeared mid-watch) renders "--"
+            # too: diffing lifetime totals against an implicit zero would
+            # pass a lifetime average off as one window's hit rate.
+            hit_delta = max(0.0, hits1 - hits0)
+            lookups = hit_delta + max(0.0, misses1 - misses0)
             if lookups > 0:
                 hit_pct = f"{100.0 * hit_delta / lookups:.1f}"
         # COL% over the window: share of this window's columnar-requested
@@ -479,8 +524,8 @@ def render_fleet_status(prev, cur):
         lines.append(
             f"{wid:<20} {rows_rate:>10.1f} {batch_rate:>8.2f} "
             f"{int(active):>8} {transport:>9} {wait_rate:>13.3f} "
-            f"{int(rows1):>12} {hit_pct:>10} {col_pct:>6} {perm_rate:>7} "
-            f"{steal_cols(wid)} {breaker_col(wid)}")
+            f"{int(rows1):>12} {hit_pct:>10} {cache:>10} {col_pct:>6} "
+            f"{perm_rate:>7} {steal_cols(wid)} {breaker_col(wid)}")
     lines.append(f"{'fleet':<20} {fleet_rows:>10.1f} "
                  f"{fleet_batches:>8.2f}")
     by_state = fleet.get("workers_by_state") or {}
@@ -495,6 +540,29 @@ def render_fleet_status(prev, cur):
         if fleet.get("autoscaler_armed"):
             line += " [autoscaler on]"
         lines.append(line)
+    plans = fleet.get("fleet_plans") or []
+    if plans:
+        # The model planner's newest journaled decision: the audited
+        # why (prediction + what-if error) behind the last resize.
+        last = plans[-1]
+        parts = [f"fleet-plan: {last.get('action')}",
+                 f"worker={last.get('worker_id')}"]
+        if last.get("predicted_rows_s") is not None:
+            parts.append(
+                f"predicted_rows/s={last['predicted_rows_s']:.1f}")
+        if last.get("whatif_error") is not None:
+            parts.append(f"whatif_err={100.0 * last['whatif_error']:.1f}%")
+        lines.append(" ".join(parts))
+    handoffs = fleet.get("cache_handoffs") or []
+    if handoffs:
+        last = handoffs[-1]
+        lines.append(
+            f"cache-handoff: {last.get('worker_id')} shipped "
+            f"{last.get('entries', 0)} entries "
+            f"({last.get('bytes', 0)} bytes) to "
+            f"{len(last.get('peers') or {})} peers, "
+            f"{last.get('errors', 0)} errors"
+            + (" [TORN]" if last.get("torn") else ""))
     brownout = fleet.get("brownout") or {}
     if brownout.get("level") or brownout.get("armed") \
             or any((brownout.get("counts") or {}).values()):

@@ -108,6 +108,7 @@ from petastorm_tpu.telemetry.metrics import (
     DISPATCHER_WORKERS,
     FLEET_AUTOSCALE_DECISIONS,
     FLEET_BROWNOUT_LEVEL,
+    FLEET_MODEL_DECISIONS,
     FLEET_JOB_BACKLOG,
     FLEET_JOB_FAIR_SHARE,
     FLEET_JOB_FENCING_EPOCH,
@@ -125,6 +126,13 @@ MODES = ("static", "fcfs", "dynamic")
 #: How many journaled ``stage_profile`` records ``status`` keeps in its
 #: in-memory head (the full history stays in the WAL for the planner).
 STAGE_PROFILES_KEPT = 8
+
+#: Bounded heads for the fleet cache tier's journaled records: drain
+#: handoff summaries (one per drained worker) and the model planner's
+#: decisions (each carries the fitted model + what-if error that
+#: justified it, so an operator can audit WHY the fleet resized).
+CACHE_HANDOFFS_KEPT = 8
+FLEET_PLANS_KEPT = 32
 
 #: Dynamic mode: a worker whose delivery rate falls below this fraction of
 #: the fleet median (while it still holds stealable backlog) is treated as
@@ -466,6 +474,11 @@ class Dispatcher:
         # last few, replayed like every other WAL op — the feed the
         # future fleet planner fits its throughput model on.
         self._stage_profiles = []
+        # Fleet cache tier (docs/guides/caching.md#fleet-cache-tier):
+        # journaled drain-handoff summaries and model-planner decisions,
+        # bounded heads of the WAL ops that carry them.
+        self._cache_handoffs = []
+        self._fleet_plans = []
         # The dispatcher's own metrics endpoint (set by the CLI when
         # --metrics-port is given), surfaced through `status` so
         # operators can find the scrape target without out-of-band
@@ -553,6 +566,12 @@ class Dispatcher:
                              for jid, r in self._job_recovery.items()},
             "job_fence_floor": self._job_fence_floor,
             "autoscale": dict(self._autoscale_counts),
+            # Fleet cache tier heads ride the snapshot (unlike the
+            # advisory stage-profile head) so a compaction between a
+            # drain's handoff and the restart cannot lose the record
+            # the zero-cold-refill acceptance audit reads.
+            "cache_handoffs": [dict(h) for h in self._cache_handoffs],
+            "fleet_plans": [dict(p) for p in self._fleet_plans],
             "client_watermarks": {
                 cid: {"epoch": entry["epoch"],
                       "watermarks": {str(p): n for p, n
@@ -669,6 +688,12 @@ class Dispatcher:
         autoscale = state.get("autoscale") or {}
         for key in self._autoscale_counts:
             self._autoscale_counts[key] = int(autoscale.get(key, 0))
+        self._cache_handoffs = []
+        for entry in state.get("cache_handoffs") or ():
+            self._install_cache_handoff_locked(entry)
+        self._fleet_plans = []
+        for entry in state.get("fleet_plans") or ():
+            self._install_fleet_plan_locked(entry)
         self._fcfs_epoch = int(state.get("fcfs_epoch", 0))
         queue = state.get("fcfs_queue")
         self._fcfs_queue = deque(queue) if queue is not None else None
@@ -721,7 +746,8 @@ class Dispatcher:
                 re_register=bool(record.get("re_register")),
                 standby=bool(record.get("standby")),
                 corpus=record.get("corpus", ""),
-                metrics_port=record.get("metrics_port"))
+                metrics_port=record.get("metrics_port"),
+                cache_fleet=bool(record.get("cache_fleet")))
         elif op == "worker_dead":
             self._mark_worker_dead_locked(record["worker_id"],
                                           record.get("reason", "reported"),
@@ -804,6 +830,10 @@ class Dispatcher:
                  "coverage_pct": record.get("coverage_pct"),
                  "source": record.get("source", "diagnose")})
             del self._stage_profiles[:-STAGE_PROFILES_KEPT]
+        elif op == "cache_handoff":
+            self._install_cache_handoff_locked(record)
+        elif op == "fleet_plan":
+            self._install_fleet_plan_locked(record)
         elif op == "replayed":
             self._recovery["journal_replays"] += 1
         else:
@@ -822,6 +852,29 @@ class Dispatcher:
                 self._fcfs_queue.remove(piece)
             except ValueError:
                 pass
+
+    def _install_cache_handoff_locked(self, record):
+        """One mutation site for a drain-handoff summary (live handler
+        AND WAL replay): append to the bounded head."""
+        self._cache_handoffs.append({
+            "worker_id": record.get("worker_id"),
+            "entries": int(record.get("entries", 0)),
+            "bytes": int(record.get("bytes", 0)),
+            "peers": {str(p): int(n) for p, n
+                      in (record.get("peers") or {}).items()},
+            "errors": int(record.get("errors", 0)),
+            "torn": bool(record.get("torn"))})
+        del self._cache_handoffs[:-CACHE_HANDOFFS_KEPT]
+
+    def _install_fleet_plan_locked(self, record):
+        """One mutation site for a model-planner decision (live path AND
+        WAL replay): everything but the WAL framing (op tag, journal seq)
+        is kept verbatim, so a replayed head compares byte-identical to
+        the live one."""
+        self._fleet_plans.append(
+            {k: record[k] for k in sorted(record)
+             if k not in ("op", "seq")})
+        del self._fleet_plans[:-FLEET_PLANS_KEPT]
 
     def _journal_locked(self, record):
         if self._journal is None:
@@ -1178,7 +1231,8 @@ class Dispatcher:
 
     def _install_worker_locked(self, worker_id, address, num_pieces,
                                re_register=False, standby=False,
-                               corpus="", metrics_port=None):
+                               corpus="", metrics_port=None,
+                               cache_fleet=False):
         known = worker_id in self._workers
         # Preserve the lifecycle state of a worker the autoscaler already
         # placed (a heartbeat-healed re-registration must not silently
@@ -1207,6 +1261,11 @@ class Dispatcher:
             # binds an ephemeral port only the worker knows) so `status`
             # can point an operator at every scrape endpoint.
             self._workers[worker_id]["metrics_port"] = int(metrics_port)
+        if cache_fleet:
+            # Journaled with registration so the heartbeat-published
+            # cache-peer ring (and a replayed dispatcher's view of it)
+            # never has to guess which workers run the fleet cache tier.
+            self._workers[worker_id]["cache_fleet"] = True
         if known or re_register:
             self._recovery["re_registrations"] += 1
         self._worker_leases[worker_id] = (
@@ -1676,6 +1735,10 @@ class Dispatcher:
                 # "zero backlog" as "idle fleet" and drain busy workers.
                 "backlog_known": self.mode == "dynamic",
                 "rates": dict(self._last_rates),
+                # The model planner's training feed: journaled per-stage
+                # profiles (diagnose posts them) for the cold-start
+                # throughput prior when no fleet samples exist yet.
+                "stage_profiles": [dict(p) for p in self._stage_profiles],
             }
 
     def _apply_autoscale_locked(self, action, worker_id):
@@ -1737,6 +1800,32 @@ class Dispatcher:
                         reason or "operator", worker_id=worker_id)
         return applied
 
+    def record_fleet_plan(self, decision):
+        """Journal one model-planner decision (the controller's entry
+        point, called BEFORE the autoscale action applies so the WAL
+        reads cause-then-effect). The decision dict carries the fitted
+        model, predicted rows/s, and what-if error — `fleet status` and
+        the bench audit read these back; replay restores the identical
+        head."""
+        record = {"op": "fleet_plan"}
+        for key, value in decision.items():
+            record[str(key)] = value
+        with self._lock:
+            if self._check_writable_locked() is not None:
+                return False
+            self._install_fleet_plan_locked(record)
+            self._journal_locked(record)
+        FLEET_MODEL_DECISIONS.labels(
+            str(decision.get("action", "hold"))).inc()
+        return True
+
+    def cache_handoffs(self):
+        """Journaled warm-handoff summaries (newest last) — the bench's
+        zero-cold-refill audit and the loopback scenario's post-drain
+        barrier read these."""
+        with self._lock:
+            return [dict(h) for h in self._cache_handoffs]
+
     def admit_worker(self, worker_id, reason="manual"):
         """Promote a standby (or draining) worker into serving."""
         return self.apply_autoscale("admit", worker_id, reason=reason)
@@ -1774,10 +1863,12 @@ class Dispatcher:
                     f"must read the same dataset with the same planning "
                     f"config")}
             metrics_port = header.get("metrics_port")
+            cache_fleet = bool(header.get("cache_fleet"))
             self._install_worker_locked(
                 worker_id, [header["host"], int(header["port"])],
                 num_pieces, re_register=re_register, standby=standby,
-                corpus=corpus, metrics_port=metrics_port)
+                corpus=corpus, metrics_port=metrics_port,
+                cache_fleet=cache_fleet)
             record = {
                 "op": "register_worker", "worker_id": worker_id,
                 "host": header["host"], "port": int(header["port"]),
@@ -1787,14 +1878,25 @@ class Dispatcher:
                 record["corpus"] = corpus
             if metrics_port is not None:
                 record["metrics_port"] = int(metrics_port)
+            if cache_fleet:
+                record["cache_fleet"] = True
             self._journal_locked(record)
             fencing = self._fencing_epoch
             state = self._workers[worker_id]["state"]
+            # Seed the registrant's placement ring immediately — its
+            # first heartbeat is up to an interval away, and a late
+            # joiner filling entries against an empty ring would push
+            # nothing to its owners in the meantime.
+            cache_peers = (self._cache_peers_locked() if cache_fleet
+                           else None)
         logger.info("worker %sregistered at %s:%s (%d pieces, %s)",
                     "re-" if re_register else "",
                     header["host"], header["port"], num_pieces, state,
                     worker_id=worker_id, fencing_epoch=fencing)
-        return {"type": "ok", "fencing_epoch": fencing, "state": state}
+        reply = {"type": "ok", "fencing_epoch": fencing, "state": state}
+        if cache_peers is not None:
+            reply["cache_peers"] = cache_peers
+        return reply
 
     def _handle_register_job(self, header):
         """Register (or restart) a first-class trainer job. Multi-job
@@ -1950,6 +2052,15 @@ class Dispatcher:
             self._maybe_close_breaker_locked(worker_id)
             return {"type": "ok", "fencing_epoch": self._fencing_epoch,
                     "brownout_level": self._brownout_level,
+                    # Fleet cache tier: the worker's own lifecycle state
+                    # (its drain-edge detector triggers the warm handoff)
+                    # and the serving cache-peer membership every tier
+                    # rebuilds its consistent-hash ring from. Draining
+                    # peers are excluded so placement — and the drain
+                    # handoff's survivor ring — converge on the same
+                    # target set without coordination.
+                    "worker_state": worker.get("state", "serving"),
+                    "cache_peers": self._cache_peers_locked(),
                     # Clock-alignment beacon: this dispatcher's trace-
                     # timebase "now". The worker wraps the RPC with two
                     # perf_counter reads and feeds (midpoint, this, RTT)
@@ -1958,6 +2069,17 @@ class Dispatcher:
                     # Fleet-trace arming rides the heartbeat: peers arm
                     # their collectors and push span rings while true.
                     "trace": self._trace_armed}
+
+    def _cache_peers_locked(self):
+        """The cache-peer membership published on every worker heartbeat:
+        alive, SERVING workers that registered with the fleet cache tier
+        armed, as sorted ``[worker_id, host, port]`` triplets (sorted so
+        every peer — and the golden placement tests — derive the same
+        ring from the same reply)."""
+        return [[wid, w["address"][0], int(w["address"][1])]
+                for wid, w in sorted(self._workers.items())
+                if w["alive"] and w.get("cache_fleet")
+                and w.get("state", "serving") == "serving"]
 
     def _handle_client_heartbeat(self, header):
         client_id = header.get("client_id")
@@ -2721,6 +2843,37 @@ class Dispatcher:
                     len(profile), entry["coverage_pct"])
         return {"type": "ok", "kept": len(self._stage_profiles)}
 
+    def _handle_cache_handoff(self, header):
+        """A draining worker reporting its warm-handoff summary: how many
+        decoded-batch cache entries (and bytes) it shipped to the peers
+        inheriting its ring segments. Journaled like steals — the record
+        is the audit trail the zero-cold-refill acceptance check (and a
+        post-incident operator) reads, and it replays byte-identically."""
+        worker_id = header.get("worker_id")
+        if not worker_id:
+            return {"type": "error",
+                    "error": "cache_handoff requires a worker_id"}
+        record = {"op": "cache_handoff", "worker_id": str(worker_id),
+                  "entries": int(header.get("entries", 0)),
+                  "bytes": int(header.get("bytes", 0)),
+                  "peers": {str(p): int(n) for p, n
+                            in (header.get("peers") or {}).items()},
+                  "errors": int(header.get("errors", 0)),
+                  "torn": bool(header.get("torn"))}
+        with self._lock:
+            blocked = self._check_writable_locked()
+            if blocked is not None:
+                return blocked
+            self._install_cache_handoff_locked(record)
+            self._journal_locked(record)
+            kept = len(self._cache_handoffs)
+        logger.info(
+            "cache handoff journaled: %d entries (%d bytes) to %d peers, "
+            "%d errors%s", record["entries"], record["bytes"],
+            len(record["peers"]), record["errors"],
+            " [TORN]" if record["torn"] else "", worker_id=worker_id)
+        return {"type": "ok", "kept": kept}
+
     @staticmethod
     def _probe_timeout(header):
         """Clamp the client-supplied per-probe timeout to a sane range: a
@@ -2765,6 +2918,7 @@ class Dispatcher:
                           "alive": w["alive"],
                           "state": w.get("state", "serving"),
                           "metrics_port": w.get("metrics_port"),
+                          "cache_fleet": bool(w.get("cache_fleet")),
                           "lease_expires_in_s": (
                               round(self._worker_leases[wid] - now, 3)
                               if wid in self._worker_leases else None)}
@@ -2803,6 +2957,14 @@ class Dispatcher:
                                  "counts": dict(self._brownout_counts),
                                  "reason": self._brownout_reason,
                                  "armed": self._brownout is not None},
+                    # Fleet cache tier: the journaled heads — drain
+                    # handoff summaries and the model planner's audited
+                    # decisions (model + predicted rows/s + what-if
+                    # error per action).
+                    "cache_peers": self._cache_peers_locked(),
+                    "cache_handoffs": [dict(h)
+                                       for h in self._cache_handoffs],
+                    "fleet_plans": [dict(p) for p in self._fleet_plans],
                 },
                 "jobs": {
                     jid: {

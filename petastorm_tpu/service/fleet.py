@@ -147,11 +147,17 @@ class AutoscaleConfig:
         further admit/drain is planned (retires still happen — they only
         complete an in-flight drain).
     :param min_serving: never drain below this many serving workers.
+    :param planner: ``"streak"`` (the backlog-streak heuristics above) or
+        ``"model"`` — the fitted-throughput-model planner
+        (:class:`~petastorm_tpu.service.fleet_model.ModelPlanner`), which
+        decides from predicted marginal rows/s, validates every decision
+        by what-if replay, and journals each as a ``fleet_plan`` WAL
+        record (``docs/guides/service.md#model-based-fleet-planner``).
     """
 
     def __init__(self, interval_s=1.0, scale_up_backlog=4.0,
                  scale_down_backlog=0.5, up_windows=2, down_windows=3,
-                 cooldown_windows=2, min_serving=1):
+                 cooldown_windows=2, min_serving=1, planner="streak"):
         if min_serving < 1:
             raise ValueError("min_serving must be >= 1")
         if scale_down_backlog >= scale_up_backlog:
@@ -159,6 +165,9 @@ class AutoscaleConfig:
                 "scale_down_backlog must be < scale_up_backlog "
                 "(equal/inverted thresholds would flap admit against "
                 "drain on every window)")
+        if planner not in ("streak", "model"):
+            raise ValueError(
+                f"planner must be 'streak' or 'model', got {planner!r}")
         self.interval_s = float(interval_s)
         self.scale_up_backlog = float(scale_up_backlog)
         self.scale_down_backlog = float(scale_down_backlog)
@@ -166,6 +175,7 @@ class AutoscaleConfig:
         self.down_windows = int(down_windows)
         self.cooldown_windows = int(cooldown_windows)
         self.min_serving = int(min_serving)
+        self.planner = str(planner)
 
     @classmethod
     def coerce(cls, value):
@@ -314,7 +324,14 @@ class AutoscaleController:
 
     def __init__(self, dispatcher, config=None):
         self._dispatcher = dispatcher
-        self.planner = AutoscalePlanner(config)
+        config = config or AutoscaleConfig()
+        if getattr(config, "planner", "streak") == "model":
+            from petastorm_tpu.service.fleet_model import ModelPlanner
+
+            self.planner = ModelPlanner(config)
+        else:
+            self.planner = AutoscalePlanner(config)
+        self._config = config
         self._stop = threading.Event()
         self._thread = None
 
@@ -336,13 +353,36 @@ class AutoscaleController:
         signals = self._dispatcher.fleet_signals()
         decisions = self.planner.plan(signals)
         for decision in decisions:
+            if "model" in decision:
+                # A model-planner decision: journal the full audit record
+                # (model + prediction + what-if error) BEFORE the action
+                # so the WAL reads cause-then-effect, and export the
+                # prediction the decision was made on.
+                self._dispatcher.record_fleet_plan(decision)
             self._dispatcher.apply_autoscale(decision["action"],
                                              decision["worker_id"],
                                              reason=decision.get("reason"))
+        self._sync_model_gauges(signals)
         return decisions
 
+    def _sync_model_gauges(self, signals):
+        """Export the model planner's latest fit (no-op under streak)."""
+        model = getattr(self.planner, "last_model", None)
+        if model is None:
+            return
+        from petastorm_tpu.telemetry.metrics import (
+            FLEET_MODEL_PREDICTED_ROWS,
+            FLEET_MODEL_WHATIF_ERROR,
+        )
+
+        FLEET_MODEL_PREDICTED_ROWS.set(
+            model.predict(len(signals.get("serving", ()))))
+        error = getattr(self.planner, "last_whatif_error", None)
+        if error is not None:
+            FLEET_MODEL_WHATIF_ERROR.set(100.0 * error)
+
     def _run(self):
-        interval = self.planner.config.interval_s
+        interval = self._config.interval_s
         while not self._stop.wait(interval):
             try:
                 self.tick()

@@ -1,0 +1,306 @@
+"""Model-based fleet planner: fitted throughput model + what-if replay.
+
+Replaces the streak heuristics of :class:`~petastorm_tpu.service.fleet.
+AutoscalePlanner` with the tf.data-service-style model (PAPERS.md,
+2210.14826): fit a per-worker throughput model from observed
+``(serving_count, fleet rows/s)`` samples plus the journaled
+``stage_profile`` records (PR 19), predict the *marginal* rows/s of the
+next admit/drain, and only apply a decision after a **what-if replay**
+over the sample history validates the model against what was actually
+measured.  Every decision is journaled through the dispatcher's
+``fleet_plan`` WAL op so scaling history replays byte-identically
+(2604.21275's reproducibility framing).
+
+Pure model + planner: no threads, no clocks, no sockets.  The
+:class:`~petastorm_tpu.service.fleet.AutoscaleController` drives
+``plan()`` once per interval with the dispatcher's ``fleet_signals()``
+and journals what comes back.
+"""
+
+from .fleet import AutoscaleConfig
+
+#: Throughput samples kept for fitting/what-if replay.  Small on purpose:
+#: the model must track the *current* workload, not ancient history.
+SAMPLES_KEPT = 64
+
+#: A fleet-size decision must be predicted to change fleet throughput by
+#: at least this fraction of one worker's modeled rate, otherwise the
+#: planner holds (hysteresis against model noise).
+MIN_MARGINAL_FRACTION = 0.5
+
+#: What-if replay gate: median relative error of predict(n) vs the
+#: measured samples must stay under this before any decision is applied.
+WHATIF_TOLERANCE = 0.25
+
+
+def fit_throughput_model(samples, stage_profiles=()):
+    """Fit ``predict(n) = min(n * per_worker, ceiling)`` from samples.
+
+    ``samples`` is an iterable of ``(serving_count, fleet_rows_per_s)``.
+    The per-worker rate is taken from the *least saturated* fleet sizes
+    (smallest n observed), where the linear regime holds; the ceiling is
+    the best fleet throughput ever measured once adding workers stops
+    paying (sublinear scaling detected).  ``stage_profiles`` (journaled
+    ``stage_profile`` WAL records) provide a prior for the per-worker
+    rate when samples are sparse: the reciprocal of the mean per-row
+    critical-path time.
+    """
+    by_n = {}
+    for n, rows_s in samples:
+        n = int(n)
+        if n <= 0 or rows_s is None or rows_s <= 0:
+            continue
+        by_n.setdefault(n, []).append(float(rows_s))
+    means = {n: sum(v) / len(v) for n, v in by_n.items()}
+
+    per_worker = None
+    if means:
+        n_min = min(means)
+        per_worker = means[n_min] / n_min
+
+    if per_worker is None:
+        per_worker = _profile_rate_prior(stage_profiles)
+    if per_worker is None or per_worker <= 0:
+        return None
+
+    ceiling = None
+    if means:
+        best = max(means.values())
+        n_max = max(means)
+        # Saturation: at the largest observed fleet the measured rate
+        # fell clearly short of linear scaling — cap the model there.
+        if n_max > min(means) and means[n_max] < 0.9 * n_max * per_worker:
+            ceiling = best
+    return ThroughputModel(per_worker, ceiling)
+
+
+def _profile_rate_prior(stage_profiles):
+    """Per-worker rows/s prior from journaled stage profiles: one over
+    the mean per-span critical-path time of the heaviest stage (spans in
+    this pipeline are batch-grained, so this is deliberately a coarse
+    order-of-magnitude prior, not a fit)."""
+    worst_mean_us = 0.0
+    for record in stage_profiles or ():
+        profile = (record or {}).get("profile") or {}
+        for stats in profile.values():
+            mean_us = (stats or {}).get("mean_us")
+            if mean_us and mean_us > worst_mean_us:
+                worst_mean_us = float(mean_us)
+    if worst_mean_us <= 0:
+        return None
+    return 1e6 / worst_mean_us
+
+
+class ThroughputModel(object):
+    """``predict(n) = min(n * per_worker, ceiling)`` with marginals."""
+
+    def __init__(self, per_worker_rows_s, ceiling_rows_s=None):
+        self.per_worker_rows_s = float(per_worker_rows_s)
+        self.ceiling_rows_s = (None if ceiling_rows_s is None
+                               else float(ceiling_rows_s))
+
+    def predict(self, n):
+        """Modeled fleet rows/s with ``n`` serving workers."""
+        if n <= 0:
+            return 0.0
+        linear = n * self.per_worker_rows_s
+        if self.ceiling_rows_s is not None:
+            return min(linear, self.ceiling_rows_s)
+        return linear
+
+    def marginal(self, n):
+        """Predicted rows/s gained by admitting worker ``n + 1``."""
+        return self.predict(n + 1) - self.predict(n)
+
+    def to_dict(self):
+        return {"per_worker_rows_s": self.per_worker_rows_s,
+                "ceiling_rows_s": self.ceiling_rows_s}
+
+
+def whatif_replay(model, samples):
+    """Replay the model over measured history: median relative error of
+    ``predict(n)`` vs each recorded ``(n, rows_s)`` sample.
+
+    Returns ``(error, ok)`` where ``error`` is the median relative error
+    (``None`` with ``ok=False`` when there is nothing to replay) and
+    ``ok`` means the model is trustworthy enough to act on
+    (``error <= WHATIF_TOLERANCE``).
+    """
+    errors = []
+    for n, rows_s in samples:
+        if n <= 0 or rows_s is None or rows_s <= 0:
+            continue
+        predicted = model.predict(n)
+        errors.append(abs(predicted - rows_s) / rows_s)
+    if not errors:
+        return None, False
+    errors.sort()
+    mid = len(errors) // 2
+    if len(errors) % 2:
+        error = errors[mid]
+    else:
+        error = (errors[mid - 1] + errors[mid]) / 2.0
+    return error, error <= WHATIF_TOLERANCE
+
+
+class ModelPlanner(object):
+    """Drop-in for :class:`~petastorm_tpu.service.fleet.AutoscalePlanner`:
+    same ``plan(signals) -> [decision]`` contract, but decisions come
+    from predicted marginal rows/s instead of backlog streaks.
+
+    Extra signal consumed (both optional, planner degrades to hold):
+
+    - ``signals["rates"]``: per-worker delivered rows/s (already in
+      ``fleet_signals``) — summed into a throughput sample each tick.
+    - ``signals["stage_profiles"]``: journaled profile records, the
+      sparse-sample prior.
+
+    Decisions carry ``model``/``predicted_rows_s``/``whatif_error`` keys
+    so the controller can journal them as ``fleet_plan`` WAL records.
+    Probe/revert: every admit/drain is a *probe*; if, ``probe_windows``
+    ticks later, measured throughput landed outside the what-if
+    tolerance of the prediction, the opposite action is issued and the
+    model's ceiling is re-anchored to what was actually measured
+    (autotuner-style revert, PR 10).
+    """
+
+    def __init__(self, config=None, probe_windows=3):
+        self._config = (AutoscaleConfig() if config is None
+                        else AutoscaleConfig.coerce(config))
+        self._probe_windows = max(1, int(probe_windows))
+        self._samples = []          # [(n_serving, fleet_rows_s)]
+        self._cooldown = 0
+        self._probe = None          # {"action","worker_id","predicted",
+        #                             "age","n_target"}
+        self.last_model = None
+        self.last_whatif_error = None
+
+    @property
+    def config(self):
+        """The coerced :class:`AutoscaleConfig` (controller parity with
+        :class:`~petastorm_tpu.service.fleet.AutoscalePlanner`)."""
+        return self._config
+
+    # -- sample plumbing ------------------------------------------------
+
+    def observe(self, n_serving, rows_s):
+        """Record one throughput sample (test seam; ``plan`` does this
+        from signals automatically)."""
+        if n_serving > 0 and rows_s and rows_s > 0:
+            self._samples.append((int(n_serving), float(rows_s)))
+            del self._samples[:-SAMPLES_KEPT]
+
+    @property
+    def samples(self):
+        return list(self._samples)
+
+    # -- planning -------------------------------------------------------
+
+    def plan(self, signals):
+        serving = list(signals.get("serving", ()))
+        standby = list(signals.get("standby", ()))
+        draining = list(signals.get("draining", ()))
+        rates = signals.get("rates") or {}
+        n = len(serving)
+
+        fleet_rows_s = sum(r for r in rates.values() if r and r > 0)
+        self.observe(n, fleet_rows_s)
+
+        # Retire finished drains exactly like the streak planner: a
+        # draining worker with no backlog left goes back to standby.
+        backlog = signals.get("backlog") or {}
+        decisions = []
+        for worker_id in draining:
+            if not backlog.get(worker_id):
+                decisions.append({"action": "retire", "worker_id": worker_id,
+                                  "reason": "drain complete"})
+
+        model = fit_throughput_model(
+            self._samples, signals.get("stage_profiles") or ())
+        self.last_model = model
+        if model is None or n == 0:
+            return decisions
+
+        error, ok = whatif_replay(model, self._samples)
+        self.last_whatif_error = error
+
+        if self._probe is not None:
+            decision = self._check_probe_locked(model, fleet_rows_s, n)
+            if decision is not None:
+                decisions.append(decision)
+            return decisions
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return decisions
+        if not ok:
+            # Model not validated by what-if replay: never act on it.
+            return decisions
+
+        threshold = MIN_MARGINAL_FRACTION * model.per_worker_rows_s
+        if standby and model.marginal(n) >= threshold:
+            worker_id = sorted(standby)[0]
+            decisions.append(self._probe_decision(
+                "admit", worker_id, model, error,
+                predicted=model.predict(n + 1), n_target=n + 1,
+                reason="marginal %.1f rows/s >= %.1f"
+                       % (model.marginal(n), threshold)))
+        elif (n > self._config.min_serving
+              and model.marginal(n - 1) < threshold):
+            # The n-th worker buys less than the hysteresis threshold:
+            # predicted fleet loss of draining it is negligible.
+            worker_id = self._drain_candidate(serving, rates)
+            decisions.append(self._probe_decision(
+                "drain", worker_id, model, error,
+                predicted=model.predict(n - 1), n_target=n - 1,
+                reason="marginal %.1f rows/s < %.1f"
+                       % (model.marginal(n - 1), threshold)))
+        return decisions
+
+    @staticmethod
+    def _drain_candidate(serving, rates):
+        """Drain the slowest serving worker (ties broken by id so the
+        choice is deterministic and journal-replayable)."""
+        return sorted(serving,
+                      key=lambda w: (rates.get(w) or 0.0, w))[0]
+
+    def _probe_decision(self, action, worker_id, model, error, predicted,
+                        n_target, reason):
+        self._probe = {"action": action, "worker_id": worker_id,
+                       "predicted": predicted, "age": 0,
+                       "n_target": n_target}
+        self._cooldown = self._config.cooldown_windows
+        return {"action": action, "worker_id": worker_id,
+                "reason": reason, "model": model.to_dict(),
+                "predicted_rows_s": predicted, "whatif_error": error,
+                "probe": True}
+
+    def _check_probe_locked(self, model, fleet_rows_s, n):
+        """Age the outstanding probe; revert it if measurement lands
+        outside tolerance of its prediction once it matures."""
+        probe = self._probe
+        probe["age"] += 1
+        if probe["age"] < self._probe_windows:
+            return None
+        self._probe = None
+        predicted = probe["predicted"]
+        if n != probe["n_target"]:
+            # The fleet moved under us (operator action, worker death):
+            # the probe is unjudgeable — drop it without reverting.
+            return None
+        if predicted > 0 and fleet_rows_s > 0:
+            miss = abs(fleet_rows_s - predicted) / predicted
+            if miss > WHATIF_TOLERANCE and probe["action"] == "admit":
+                # Admit under-delivered: the fleet is ceiling-bound at
+                # what we actually measured.  Re-anchor and revert.
+                self._samples.append((n, fleet_rows_s))
+                del self._samples[:-SAMPLES_KEPT]
+                self._cooldown = self._config.cooldown_windows
+                return {"action": "drain", "worker_id": probe["worker_id"],
+                        "reason": "probe revert: measured %.1f vs "
+                                  "predicted %.1f rows/s"
+                                  % (fleet_rows_s, predicted),
+                        "model": model.to_dict(),
+                        "predicted_rows_s": model.predict(n - 1),
+                        "whatif_error": miss, "probe": True}
+        return None

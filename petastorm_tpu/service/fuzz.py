@@ -70,6 +70,15 @@ def default_run_fn(seed, points):
         # a run whose counts never reach its indices fires nothing and
         # trips the scenario's fired-nothing guard.
         failpoint_window=24,
+        # Fleet cache tier armed with a deterministic mid-stream drain:
+        # this is what puts the ``cache-peer-gone`` (peer fetch/push/serve
+        # paths) and ``handoff-torn`` (drain handoff shipping) points on
+        # exercised code paths — without it their call counts stay at
+        # zero and their fire windows are unreachable. Digest stays the
+        # seeded contract: remote-warm, local-warm and cold fills serve
+        # byte-identical batches, and the drain happens at a fixed
+        # consumed-batch position, not a timer.
+        cache="mem", fleet_cache=True, fleet_cache_drain_after=12,
         shuffle_seed=seed, ordered=True)
 
 
@@ -167,7 +176,9 @@ def reproducer_command(seed, points):
             f"--chaos failpoints --chaos-seed {seed} "
             f"--failpoint-points {','.join(points)} "
             "--failpoint-window 24 --rows 1536 --days 8 --workers 2 "
-            f"--batch-size 64 --shuffle-seed {seed} --ordered")
+            "--batch-size 64 --cache mem --fleet-cache "
+            f"--fleet-cache-drain-after 12 --shuffle-seed {seed} "
+            "--ordered")
 
 
 def fuzz(seeds, run_fn=None, shrink=True, check_determinism=True,

@@ -194,6 +194,14 @@ class BatchWorker:
         (``docs/guides/service.md#failure-model-and-recovery``). Legacy
         untagged/fcfs streams cannot express ``piece_failed`` and keep
         the fail behavior regardless.
+    :param fleet_cache: wrap ``batch_cache`` in the fleet cache tier
+        (:class:`~petastorm_tpu.cache_impl.fleet_tier.FleetCacheTier`):
+        consistent-hash entry placement across the dispatcher's cache
+        peers, remote warm serves over the framed transport, and warm
+        handoff of the memory tier when this worker is drained
+        (``docs/guides/caching.md#fleet-cache-tier``). Requires a
+        ``batch_cache`` and a ``dispatcher_address`` (ring membership
+        rides the heartbeat channel); ignored without a cache.
     """
 
     def __init__(self, dataset_url, dispatcher_address=None,
@@ -204,7 +212,7 @@ class BatchWorker:
                  rpc_deadline_s=30.0, max_frame_bytes=None,
                  batch_cache=None, batch_transform=None, standby=False,
                  on_piece_error="fail", corpus="", transport=None,
-                 metrics_port=None):
+                 metrics_port=None, fleet_cache=False):
         from petastorm_tpu.service.transport import resolve_mode
 
         if on_piece_error not in ("fail", "quarantine"):
@@ -222,7 +230,24 @@ class BatchWorker:
         self._dispatcher_address = (tuple(dispatcher_address)
                                     if dispatcher_address else None)
         self._batch_size = batch_size
+        # Fleet cache tier (docs/guides/caching.md#fleet-cache-tier):
+        # wraps the local cache in consistent-hash placement + remote
+        # warm serves + drain handoff. The tier is a drop-in for the
+        # BatchCache everywhere below (engines, diagnostics, cleanup);
+        # ring membership follows the dispatcher's heartbeat-published
+        # peer list.
+        self._fleet_tier = None
+        if fleet_cache and batch_cache is not None:
+            from petastorm_tpu.cache_impl.fleet_tier import FleetCacheTier
+
+            self._fleet_tier = batch_cache = FleetCacheTier(
+                batch_cache, self.worker_id)
         self._batch_cache = batch_cache
+        # Lifecycle state as the dispatcher last published it over the
+        # heartbeat channel — the serving→draining edge triggers the warm
+        # handoff exactly once per drain.
+        self._fleet_state = None
+        self._handoff_thread = None
         # The placement-flippable collated-batch transform
         # (docs/guides/pipeline.md#transform-placement): applied to every
         # batch before serialization UNLESS the stream request carries
@@ -412,6 +437,11 @@ class BatchWorker:
             self._frame_pool = None
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=drain_timeout_s)
+        if self._handoff_thread is not None:
+            # The tier's cleanup (above) already closed what the handoff
+            # pushes through; a straggling handoff thread ends on its
+            # next failed RPC — the join is a bounded courtesy.
+            self._handoff_thread.join(timeout=drain_timeout_s)
         if self._trace_armed_remote:
             # Balance the beacon's acquire — an in-process worker must
             # not leave the shared collector armed past its lifetime.
@@ -481,6 +511,10 @@ class BatchWorker:
             "re_register": re_register,
             "standby": self._standby,
             "corpus": self.corpus,
+            # Fleet cache tier advertisement: journaled with the
+            # registration, so the dispatcher's published cache-peer list
+            # (and its replay) never guesses at who serves cache RPCs.
+            "cache_fleet": self._fleet_tier is not None,
         }
         if self.metrics_port is not None:
             payload["metrics_port"] = self.metrics_port
@@ -495,6 +529,15 @@ class BatchWorker:
                            fencing_epoch=reply.get("fencing_epoch"))
         FLIGHT.note("worker.registered", re_register=re_register,
                     state=reply.get("state"))
+        if self._fleet_tier is not None \
+                and reply.get("cache_peers") is not None:
+            # Register-time ring seed; heartbeats keep it converged.
+            try:
+                self._fleet_tier.update_peers(reply["cache_peers"])
+            except (ValueError, TypeError):
+                self._log.warning("malformed cache_peers in registration "
+                                  "reply — starting with an empty ring",
+                                  exc_info=True)
         return reply
 
     def _control_rpc(self, header, description, retries=None):
@@ -572,6 +615,16 @@ class BatchWorker:
                     tracing.COLLECTOR.ts_us((t0 + t1) / 2.0),
                     float(remote_us), (t1 - t0) * 1e6)
             self._sync_trace_arming(bool(reply.get("trace")))
+            if self._fleet_tier is not None:
+                peers = reply.get("cache_peers")
+                if peers is not None:
+                    try:
+                        self._fleet_tier.update_peers(peers)
+                    except (ValueError, TypeError):
+                        self._log.warning(
+                            "malformed cache_peers in heartbeat reply — "
+                            "keeping the previous ring", exc_info=True)
+                self._sync_fleet_state(reply.get("worker_state"))
             if "brownout_level" in reply:
                 from petastorm_tpu.service.resilience import \
                     note_brownout_level
@@ -590,6 +643,60 @@ class BatchWorker:
                     self._register(re_register=True, retries=0)
                 except (OSError, RuntimeError, ProtocolError):
                     continue  # registration retried on the next tick
+
+    # -- fleet cache tier --------------------------------------------------
+
+    def _sync_fleet_state(self, state):
+        """Follow this worker's dispatcher-published lifecycle state. The
+        serving→draining edge launches the warm handoff exactly once per
+        drain: the memory tier ships to the peers inheriting this
+        worker's keyspace BEFORE the drain completes, so the fleet
+        re-decodes nothing (``docs/guides/caching.md#fleet-cache-tier``).
+        Run on its own named thread — a handoff is entry-count × RPC
+        long, and the heartbeat loop must keep renewing the lease that
+        keeps this worker alive while it runs."""
+        if state is None:
+            return
+        previous, self._fleet_state = self._fleet_state, state
+        if (state == "draining" and previous not in (None, "draining")
+                and self._fleet_tier is not None
+                and (self._handoff_thread is None
+                     or not self._handoff_thread.is_alive())):
+            self._handoff_thread = threading.Thread(
+                target=self._run_handoff, daemon=True,
+                name=f"cache-peer-handoff-{self.worker_id}")
+            self._handoff_thread.start()
+
+    def _run_handoff(self):
+        try:
+            summary = self._fleet_tier.handoff()
+        except Exception:
+            self._log.warning("warm handoff failed — the inheriting "
+                              "peers will cold-fill", exc_info=True)
+            return
+        self._log.info(
+            "warm handoff shipped %d entries (%d bytes) to %d peer(s)"
+            "%s", summary["entries"], summary["bytes"],
+            len(summary["peers"]), " [torn]" if summary["torn"] else "")
+        FLIGHT.note("worker.cache_handoff", **{
+            k: summary[k] for k in ("entries", "bytes", "errors", "torn")})
+        if self._dispatcher_address is None:
+            return
+        try:
+            # Journaled like steals: the dispatcher appends a
+            # cache_handoff WAL record, so the drain's warmth movement
+            # replays with the rest of the fleet history.
+            self._control_rpc(
+                {"type": "cache_handoff", "worker_id": self.worker_id,
+                 "entries": summary["entries"], "bytes": summary["bytes"],
+                 "peers": summary["peers"], "errors": summary["errors"],
+                 "torn": summary["torn"]},
+                description=f"worker {self.worker_id} handoff report",
+                retries=0)
+        except (OSError, ProtocolError):
+            self._log.warning("handoff report did not reach the "
+                              "dispatcher (handoff itself completed)",
+                              exc_info=True)
 
     # -- fleet tracing -----------------------------------------------------
 
@@ -650,7 +757,7 @@ class BatchWorker:
         reader = FramedReader(sock,  # buffered, per-connection
                               max_frame_bytes=self._max_frame_bytes)
         while not self._server.stopped.is_set():
-            header, _ = reader.recv()
+            header, payload = reader.recv()
             kind = header.get("type")
             if kind == "stream":
                 self._stream(sock, header, conn_reader=reader)
@@ -663,6 +770,10 @@ class BatchWorker:
                 send_framed(sock, {"type": "diagnostics",
                                    "worker_id": self.worker_id},
                             self.diagnostics_snapshot())
+            elif kind == "cache_fetch":
+                self._handle_cache_fetch(sock, header)
+            elif kind == "cache_put":
+                self._handle_cache_put(sock, header, payload)
             elif kind == "trace":
                 send_framed(sock, self._trace_snapshot())
             elif kind == "ping":
@@ -671,6 +782,40 @@ class BatchWorker:
             else:
                 send_framed(sock, {"type": "error",
                                    "error": f"unknown request {kind!r}"})
+
+    def _handle_cache_fetch(self, sock, header):
+        """A peer asking for a warm entry: reply with its meta + the ONE
+        contiguous frame buffer (the cached bytes are the wire bytes), or
+        a miss. Serving rides :func:`send_framed`'s scatter-gather — no
+        decode, no re-serialization."""
+        tier = self._fleet_tier
+        if tier is None:
+            send_framed(sock, {"type": "error",
+                               "error": "fleet cache tier not armed"})
+            return
+        reply, payload = tier.serve_fetch(str(header.get("key")))
+        send_framed(sock, reply, payload)
+
+    def _handle_cache_put(self, sock, header, payload):
+        """A peer shipping an entry here (write-through placement or a
+        draining peer's warm handoff). Adoption validates meta against
+        payload length — a torn transfer is refused, never published."""
+        tier = self._fleet_tier
+        if tier is None:
+            send_framed(sock, {"type": "error",
+                               "error": "fleet cache tier not armed"})
+            return
+        try:
+            entry = tier.adopt(
+                str(header.get("key")), header.get("meta") or [],
+                (payload or {}).get("buf", b""),
+                origin=str(header.get("origin", "placement")))
+        except (ValueError, KeyError, TypeError) as exc:
+            send_framed(sock, {"type": "error",
+                               "error": f"cache_put refused: {exc}"})
+            return
+        send_framed(sock, {"type": "ok", "key": header.get("key"),
+                           "rows": entry.rows})
 
     def _stream(self, sock, header, conn_reader):
         """Serve one ``stream`` request: batches of the named pieces, then
@@ -1748,6 +1893,14 @@ class BatchWorker:
             metrics["cache_hits_total"] = stats["hits"]
             metrics["cache_misses_total"] = stats["misses"]
             metrics["cache_permuted_serves_total"] = stats["permuted_serves"]
+            # Fleet-tier visibility (the status --watch CACHE column):
+            # which tier this worker's cache is, how many entries it
+            # holds, and how much of its warmth arrived remotely.
+            metrics["cache_tier"] = stats.get("tier", "local")
+            metrics["cache_entries_mem"] = stats["entries_mem"]
+            metrics["cache_entries_disk"] = stats["entries_disk"]
+            if "remote_hits" in stats:
+                metrics["cache_remote_hits_total"] = stats["remote_hits"]
             out["cache"] = stats
         return out
 

@@ -215,6 +215,62 @@ FLEET_JOB_CACHE_LOOKUPS = REGISTRY.counter(
     "zero decode)",
     labels=("job", "outcome"))
 
+# -- fleet cache tier: consistent-hash peers + warm handoff
+# (cache_impl/fleet_tier.py, cache_impl/hash_ring.py) ------------------------
+
+CACHE_PEER_FETCHES = REGISTRY.counter(
+    "petastorm_cache_peer_fetches_total",
+    "Remote cache-peer fetches attempted by this worker's fleet tier, by "
+    "outcome: hit = the ring owner served the warm entry (promoted into "
+    "the local memory tier, zero re-decode), miss = the owner had no "
+    "entry (a genuine fleet-wide cold key), error = dial/protocol "
+    "failure (fed to the per-peer breaker), breaker_open = the fetch was "
+    "skipped without dialing because the owner's breaker is open — all "
+    "non-hit outcomes degrade to a local fill, never a stream error",
+    labels=("outcome",))
+CACHE_PEER_SERVES = REGISTRY.counter(
+    "petastorm_cache_peer_serves_total",
+    "cache_fetch requests this worker answered FOR its peers, by outcome "
+    "(hit/miss) — the serving-side mirror of the fetches counter; a "
+    "fleet-wide scrape balances the two",
+    labels=("outcome",))
+CACHE_PEER_PUSHES = REGISTRY.counter(
+    "petastorm_cache_peer_pushes_total",
+    "Write-through placement pushes of freshly-filled entries to their "
+    "ring owner, by outcome (sent/error/dropped — dropped = the bounded "
+    "push queue was full; placement is best-effort, the remote-fetch "
+    "path covers the gap)",
+    labels=("outcome",))
+CACHE_PEER_HANDOFF_ENTRIES = REGISTRY.counter(
+    "petastorm_cache_peer_handoff_entries_total",
+    "Warm entries moved by drain handoff, by direction (sent = shipped "
+    "off a draining worker, received = adopted from one) — a drain with "
+    "handoff enabled re-homes its memory tier so the fleet re-decodes "
+    "nothing",
+    labels=("direction",))
+
+# -- model-based fleet planner (service/fleet_model.py) ----------------------
+
+FLEET_MODEL_PREDICTED_ROWS = REGISTRY.gauge(
+    "petastorm_fleet_model_predicted_rows_per_s",
+    "The fitted throughput model's predicted fleet rows/s at the planner-"
+    "chosen serving-worker count (min(n * per_worker_rate, ceiling)) — "
+    "compare with the measured delivery rate to read the model's error "
+    "live")
+FLEET_MODEL_WHATIF_ERROR = REGISTRY.gauge(
+    "petastorm_fleet_model_whatif_error_pct",
+    "Median relative error (percent) of the model's what-if replay over "
+    "the recorded (serving count, rows/s) sample history — decisions are "
+    "gated on this staying under the tolerance, so a persistently high "
+    "value means the planner is holding, not scaling")
+FLEET_MODEL_DECISIONS = REGISTRY.counter(
+    "petastorm_fleet_model_decisions_total",
+    "Decisions the model-based planner issued (and journaled as "
+    "fleet_plan records), by action (admit/drain/retire, plus "
+    "probe-revert drains) — the journaled mirror of the generic "
+    "autoscale decisions counter",
+    labels=("action",))
+
 DISPATCHER_GENERATION = REGISTRY.gauge(
     "petastorm_service_dispatcher_generation",
     "Dynamic-mode ownership-generation high-water mark: every assignment, "

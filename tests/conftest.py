@@ -106,6 +106,33 @@ _FLEET_AUTOSCALE_THREAD_PREFIX = "fleet-autoscale"
 #: live service topology inside it.
 _FUZZ_THREAD_PREFIX = "failpoint-fuzz"
 
+#: The fleet cache tier's peer threads — ``cache-peer-push-<wid>``
+#: (placement pusher) and ``cache-peer-handoff-<wid>`` (drain handoff
+#: shipper, worker.py). Both are daemons; one surviving a test means a
+#: fleet-cache worker was never stopped (or its tier never cleanup()d) —
+#: the pusher keeps dialing ring peers that no longer exist.
+_CACHE_PEER_THREAD_PREFIX = "cache-peer"
+
+
+def _orphan_cache_tmp_files():
+    """``.tmp`` staging files inside every LIVE cache dir. The disk tier
+    writes entries as ``mkstemp(... suffix=".tmp")`` + ``os.replace``; a
+    write interrupted between the two — a failpoint (``handoff-torn``,
+    ``cache-peer-gone``) firing mid-handoff-adoption, a killed worker —
+    orphans the staging file, which ``os.replace`` will never claim and
+    eviction (keyed on the entry suffix) will never delete."""
+    from petastorm_tpu.cache_impl import live_cache_dirs
+
+    out = set()
+    for cache_dir in live_cache_dirs():
+        try:
+            names = os.listdir(cache_dir)
+        except OSError:
+            continue  # dir vanished — live_cache_dirs leak check owns it
+        out.update(os.path.join(cache_dir, n) for n in names
+                   if n.endswith(".tmp"))
+    return out
+
 
 @pytest.fixture(autouse=True)
 def _resource_leak_guard(request):
@@ -137,6 +164,7 @@ def _resource_leak_guard(request):
     before_memfds = _open_memfd_fds()
     before_shm = live_shm_counts()
     before_cache_dirs = live_cache_dirs()
+    before_cache_tmp = _orphan_cache_tmp_files()
     before_jobs = open_job_registrations()
     before_mixture_passes = open_mixture_passes()
     yield
@@ -162,7 +190,8 @@ def _resource_leak_guard(request):
             and t.name.startswith((_READER_POOL_THREAD_PREFIX,
                                    _AUTOTUNE_THREAD_PREFIX,
                                    _FLEET_AUTOSCALE_THREAD_PREFIX,
-                                   _FUZZ_THREAD_PREFIX))]
+                                   _FUZZ_THREAD_PREFIX,
+                                   _CACHE_PEER_THREAD_PREFIX))]
         leaked_sockets = _open_socket_fds() - before_sockets
         leaked_memfds = _open_memfd_fds() - before_memfds
         # Live-arena registry deltas: a leaked RingProducer/RingConsumer
@@ -173,6 +202,7 @@ def _resource_leak_guard(request):
                       for kind in after_shm
                       if after_shm[kind] > before_shm.get(kind, 0)}
         leaked_cache_dirs = live_cache_dirs() - before_cache_dirs
+        leaked_cache_tmp = _orphan_cache_tmp_files() - before_cache_tmp
         leaked_jobs = open_job_registrations() - before_jobs
         # An abandoned MixedBatchSource pass holds N per-corpus inner
         # iterators (stream threads, heartbeats, sockets) — the mixture
@@ -181,6 +211,7 @@ def _resource_leak_guard(request):
         if not leaked_threads and not leaked_pool_threads \
                 and not leaked_sockets and not leaked_memfds \
                 and not leaked_shm and not leaked_cache_dirs \
+                and not leaked_cache_tmp \
                 and not leaked_jobs and leaked_mixture <= 0 \
                 and leaked_schedule is None:
             return
@@ -190,18 +221,22 @@ def _resource_leak_guard(request):
     pytest.fail(
         f"test leaked resources past teardown: "
         f"non-daemon threads {[t.name for t in leaked_threads]}, "
-        f"reader-pool/autotune/fleet-autoscale/failpoint-fuzz threads "
-        f"{[t.name for t in leaked_pool_threads]} "
+        f"reader-pool/autotune/fleet-autoscale/failpoint-fuzz/cache-peer "
+        f"threads {[t.name for t in leaked_pool_threads]} "
         f"(an unstopped Reader — e.g. a streaming piece engine whose "
         f"owner never stopped/joined it — an autotuned loader whose "
         f"controller was never stopped, a Dispatcher(autoscale=) never "
-        f"stopped, or a hung fuzz run), "
+        f"stopped, a hung fuzz run, or a fleet-cache worker whose peer "
+        f"pusher/handoff thread was never stopped), "
         f"sockets {sorted(leaked_sockets)}, "
         f"shm arenas: memfds {sorted(leaked_memfds)}, live ring/pool/"
         f"eventfd registry deltas {leaked_shm} (a RingProducer/"
         f"RingConsumer or FramePool never close()d — an orphaned arena "
         f"pins its full size in /dev/shm), "
         f"cache dirs {sorted(leaked_cache_dirs)}, "
+        f"orphaned cache .tmp staging files {sorted(leaked_cache_tmp)} "
+        f"(a disk-tier write — e.g. a handoff adoption spilling to disk "
+        f"— interrupted between mkstemp and os.replace), "
         f"open job registrations {sorted(leaked_jobs)} (a register_job "
         f"without end_job — use fleet.JobHandle), "
         f"open mixture passes {max(leaked_mixture, 0)} (a "
